@@ -1,0 +1,38 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""legate_sparse_tpu: TPU-native distributed sparse linear algebra.
+
+A drop-in ``scipy.sparse`` replacement built on JAX/XLA/Pallas with
+``jax.sharding`` distribution — the TPU-native counterpart of the
+reference Legion/CUDA framework (reference: ``legate_sparse/__init__.py``
+which clones the scipy.sparse namespace over its native symbols,
+``__init__.py:20-26``).
+
+Usage::
+
+    import legate_sparse_tpu as sparse
+    A = sparse.diags([1, -2, 1], [-1, 0, 1], shape=(N, N), format="csr")
+    y = A @ x
+    x, iters = sparse.linalg.cg(A, y)
+"""
+
+import scipy.sparse as _scipy_sparse
+
+from .runtime import runtime  # noqa: F401  (configures x64 at import)
+from .module import *  # noqa: F401,F403
+from .module import (  # explicit re-exports for linters
+    csr_array, csr_matrix, dia_array, dia_matrix, diags, eye, identity,
+    mmread, mmwrite, spmv, spgemm_csr_csr_csr, issparse, isspmatrix,
+    isspmatrix_csr, isspmatrix_dia, is_sparse_matrix, coord_ty, nnz_ty,
+)
+from .coverage import clone_module
+from . import linalg  # noqa: F401
+from . import parallel  # noqa: F401
+
+__version__ = "25.07.0"
+
+# Fill every remaining scipy.sparse name as a fallback so this module is
+# namespace-complete (reference ``__init__.py:26``).
+clone_module(_scipy_sparse, globals())
+
+del _scipy_sparse, clone_module

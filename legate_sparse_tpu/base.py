@@ -1,0 +1,126 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Shared base classes for compressed sparse formats.
+
+Parity with the reference's ``CompressedBase``/``DenseSparseBase``
+(reference: ``legate_sparse/base.py:63-268``): structure-sharing
+``_with_data``, ``astype``, ``sum(axis)``, and the auto-generated family
+of zero-preserving unary ufuncs applied to ``.data``
+(``base.py:209-250``).  The rect-pair ``pos`` encoding and its
+pack/unpack helpers (``base.py:272-296``) have no TPU analog — plain
+``indptr`` arrays are kept throughout, which XLA handles natively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class CompressedBase:
+    """Base for csr/dia arrays: dtype casting, sums, zero-preserving ufuncs."""
+
+    def asformat(self, format, copy: bool = False):
+        """Dispatch to ``to<format>()`` (reference ``base.py:92-108``)."""
+        if format is None or format == self.format:
+            if copy:
+                return self.copy()
+            return self
+        convert = getattr(self, "to" + format, None)
+        if convert is None:
+            raise ValueError(f"Format {format} is unknown.")
+        return convert(copy=copy)
+
+    def astype(self, dtype, casting: str = "unsafe", copy: bool = True):
+        """Cast the value array, sharing structure (reference ``base.py:198-206``)."""
+        dtype = np.dtype(dtype)
+        if self.dtype != dtype:
+            return self._with_data(self.data.astype(dtype), copy=copy)
+        return self.copy() if copy else self
+
+    def sum(self, axis=None, dtype=None, out=None):
+        """Row/column/global sums.
+
+        The reference computes axis sums as SpMV against a ones vector
+        (``base.py:111-171``); here segment-reductions do it in one pass.
+        """
+        from .csr import csr_array
+
+        if not isinstance(self, csr_array):
+            return self.tocsr().sum(axis=axis, dtype=dtype, out=out)
+        rows, cols = self.shape
+        if axis is None:
+            result = jnp.sum(self.data)
+        elif axis in (0, -2):
+            result = jnp.zeros((cols,), dtype=self.data.dtype).at[
+                self.indices
+            ].add(self.data)
+        elif axis in (1, -1):
+            from .ops.convert import row_ids_from_indptr
+            import jax
+
+            row_ids = row_ids_from_indptr(self.indptr, int(self.nnz))
+            result = jax.ops.segment_sum(
+                self.data, row_ids, num_segments=rows, indices_are_sorted=True
+            )
+        else:
+            raise ValueError(f"invalid axis {axis}")
+        if dtype is not None:
+            result = result.astype(dtype)
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+
+    def mean(self, axis=None, dtype=None, out=None):
+        rows, cols = self.shape
+        denom = {None: rows * cols, 0: rows, -2: rows, 1: cols, -1: cols}[axis]
+        s = self.sum(axis=axis, dtype=dtype)
+        result = s / denom
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+
+# Univariate ufuncs with f(0) = 0, applied elementwise to .data
+# (reference ``base.py:209-250``; same function list).
+_UFUNCS_WITH_FIXED_POINT_AT_ZERO = (
+    "sin", "tan", "arcsin", "arctan", "sinh", "tanh", "arcsinh", "arctanh",
+    "rint", "sign", "expm1", "log1p", "deg2rad", "rad2deg", "floor", "ceil",
+    "trunc", "sqrt",
+)
+
+
+def _install_unary_ufuncs(cls) -> None:
+    for name in _UFUNCS_WITH_FIXED_POINT_AT_ZERO:
+        op = getattr(jnp, name)
+
+        def method(self, _op=op):
+            return self._with_data(_op(self.data))
+
+        method.__name__ = name
+        method.__doc__ = f"Element-wise {name} (zero-preserving)."
+        setattr(cls, name, method)
+
+
+_install_unary_ufuncs(CompressedBase)
+
+
+class DenseSparseBase:
+    """Base for {Dense, Sparse}-format matrices (CSR/CSC), reference
+    ``base.py:256-268``.  Partition caching is XLA's job here, so this
+    only carries the structure-sharing constructor."""
+
+    @classmethod
+    def make_with_same_nnz_structure(cls, mat, arg, shape=None, dtype=None):
+        if shape is None:
+            shape = mat.shape
+        if dtype is None:
+            dtype = mat.dtype
+        return cls(arg, shape=shape, dtype=dtype)

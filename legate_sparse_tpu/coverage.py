@@ -1,0 +1,76 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""scipy.sparse namespace cloning with provenance wrappers.
+
+Parity with the reference's coverage layer (reference:
+``legate_sparse/coverage.py:50-107``): every name in ``scipy.sparse``
+not implemented natively is re-exported as a scipy fallback, and every
+implemented callable is wrapped so profilers attribute device work to
+the user-level API call.  The reference tags Legion tasks via
+``@track_provenance``; the JAX-native analog is ``jax.named_scope`` +
+``jax.profiler.TraceAnnotation``-visible names.
+"""
+
+from __future__ import annotations
+
+import functools
+import types as pytypes
+from typing import Any, Container, Mapping
+
+import jax
+
+MOD_INTERNAL = {"__dir__", "__getattr__"}
+
+_WRAP_BLOCKLIST = ("__class__", "__init__", "__init_subclass__", "__new__",
+                   "__getattribute__", "__setattr__", "__subclasshook__")
+
+
+def wrap(func, name: str | None = None):
+    """Wrap a callable in a profiler scope (analog of reference
+    ``coverage.py:50-56`` ``@track_provenance``)."""
+    scope = f"legate_sparse_tpu.{name or getattr(func, '__qualname__', 'op')}"
+
+    @functools.wraps(func)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        with jax.named_scope(scope):
+            return func(*args, **kwargs)
+
+    return wrapper
+
+
+def clone_module(
+    origin_module: pytypes.ModuleType,
+    new_globals: Mapping[str, Any],
+    include_self: bool = True,
+) -> None:
+    """Fill unimplemented ``origin_module`` names into ``new_globals``.
+
+    Mirrors reference ``coverage.py:59-85``: for every public symbol of
+    the origin (scipy.sparse), if the caller's globals already define it,
+    keep the native version (wrapped for provenance); otherwise install
+    the scipy fallback so the namespace is drop-in complete.
+    """
+    mod_names = set(new_globals.keys())
+    for attr in dir(origin_module):
+        if attr.startswith("_") or attr in MOD_INTERNAL:
+            continue
+        value = getattr(origin_module, attr)
+        if attr in mod_names:
+            native = new_globals[attr]
+            if callable(native) and not isinstance(native, type):
+                new_globals[attr] = wrap(native, attr)  # type: ignore[index]
+            continue
+        # scipy fallback (host-side; documented escape hatch).
+        new_globals[attr] = value  # type: ignore[index]
+
+
+def clone_scipy_arr_kind(origin_class):
+    """Class decorator stamping scipy-facade metadata on native array
+    classes (reference ``coverage.py:87-107``); methods stay native."""
+
+    def decorator(cls):
+        cls.__doc__ = cls.__doc__ or origin_class.__doc__
+        cls._scipy_origin = origin_class
+        return cls
+
+    return decorator

@@ -1,0 +1,98 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Matrix construction gallery.
+
+Parity with the reference's scipy-compatible ``diags`` constructor
+(reference: ``legate_sparse/gallery.py:77-195``): build a DIA data array
+from per-diagonal sequences/scalars, then convert to the requested
+format.  Layout and validation rules follow scipy (column-aligned DIA).
+Offsets/shape handling is done with host numpy (it is O(num_diags)
+metadata work); the data array itself is a device array.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .dia import dia_array
+from .runtime import runtime
+
+
+def diags(diagonals, offsets=0, shape=None, format=None, dtype=None):
+    """Construct a sparse matrix from diagonals (scipy.sparse.diags)."""
+    # Normalize: a bare sequence of scalars + scalar offset = one diagonal.
+    if np.isscalar(offsets) or isinstance(offsets, numbers.Integral):
+        if len(diagonals) == 0 or np.isscalar(diagonals[0]):
+            diagonals = [diagonals]
+        offsets = [offsets]
+    offsets = np.atleast_1d(np.asarray(offsets, dtype=np.int64))
+    diagonals = [np.atleast_1d(np.asarray(d)) for d in diagonals]
+    if len(diagonals) != len(offsets):
+        raise ValueError("number of diagonals != number of offsets")
+    if len(np.unique(offsets)) != len(offsets):
+        raise ValueError("offset array contains duplicate values")
+
+    if dtype is None:
+        dtype = np.result_type(*[d.dtype for d in diagonals])
+        if not np.issubdtype(dtype, np.floating) and not np.issubdtype(
+            dtype, np.complexfloating
+        ):
+            dtype = dtype  # keep integer dtypes as scipy does
+    dtype = np.dtype(dtype)
+
+    if shape is None:
+        m = len(diagonals[0]) + abs(int(offsets[0]))
+        shape = (m, m)
+    rows, cols = (int(shape[0]), int(shape[1]))
+
+    width = cols  # scipy dia data width
+    data = np.zeros((len(offsets), width), dtype=dtype)
+    for j, (diag, off) in enumerate(zip(diagonals, offsets)):
+        off = int(off)
+        length = min(rows + min(off, 0), cols - max(off, 0))
+        if length < 0:
+            raise ValueError(
+                f"Offset {off} (index {j}) out of bounds for shape {shape}"
+            )
+        start = max(0, off)
+        if diag.shape[0] == 1 and length > 1:
+            data[j, start : start + length] = diag[0]
+        else:
+            if diag.shape[0] != length and not (
+                diag.shape[0] == 1 and length == 1
+            ):
+                raise ValueError(
+                    f"Diagonal length (index {j}: {diag.shape[0]} at offset "
+                    f"{off}) does not agree with array size ({rows}, {cols})."
+                )
+            data[j, start : start + length] = diag[:length]
+
+    result = dia_array((jnp.asarray(data), jnp.asarray(offsets)),
+                       shape=(rows, cols))
+    if format in (None, "dia"):
+        return result
+    return result.asformat(format)
+
+
+def eye(m, n=None, k=0, dtype=None, format=None):
+    """Sparse identity/eye (scipy.sparse.eye shape)."""
+    if n is None:
+        n = m
+    if dtype is None:
+        dtype = runtime.default_float
+    length = min(int(m) + min(k, 0), int(n) - max(k, 0))
+    if length <= 0:
+        return diags([np.zeros(0, dtype=dtype)], [0], shape=(int(m), int(n)),
+                     format=format, dtype=dtype)
+    return diags(
+        [np.ones(length, dtype=np.dtype(dtype))], [k],
+        shape=(int(m), int(n)), format=format, dtype=dtype,
+    )
+
+
+def identity(n, dtype=None, format=None):
+    return eye(n, dtype=dtype, format=format)

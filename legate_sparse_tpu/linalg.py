@@ -1,0 +1,478 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Iterative solvers: CG, GMRES, LinearOperator.
+
+Parity target: the reference's solver layer (reference:
+``legate_sparse/linalg.py:85-668`` — ``LinearOperator`` family,
+``cg_axpby`` fused update, ``cg`` with deferred convergence checks,
+restarted ``gmres``).
+
+TPU-first re-design: the reference hides latency by keeping scalars as
+Legion futures and testing convergence every ``conv_test_iters``
+iterations (``linalg.py:507-533``).  The XLA-native equivalent is
+stronger: the *entire* CG iteration runs inside ``lax.while_loop`` under
+one ``jit`` — zero host round-trips until the solve finishes; the
+convergence cadence is preserved for iteration-count parity.  The fused
+``cg_axpby`` kernel (reference ``axpby_template.inl:27-71``) exists for
+API parity but fuses automatically when used inside jit.
+"""
+
+from __future__ import annotations
+
+import warnings
+from functools import partial
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .csr import csr_array
+from .utils import fill_out as _fill_out, is_sparse_matrix
+
+
+# --------------------------------------------------------------------------
+# LinearOperator family (reference ``linalg.py:85-421``)
+# --------------------------------------------------------------------------
+class LinearOperator:
+    """Common interface for matrix-vector products.
+
+    Iterative solvers only need ``A @ v``; this class abstracts matrices,
+    callables, and compositions behind ``matvec``/``rmatvec`` the same
+    way the reference (and scipy) do.  Matvec implementations must be
+    jax-traceable to participate in jitted solver loops.
+    """
+
+    ndim = 2
+
+    def __new__(cls, *args, **kwargs):
+        if cls is LinearOperator:
+            return super().__new__(_CustomLinearOperator)
+        obj = super().__new__(cls)
+        if (
+            type(obj)._matvec == LinearOperator._matvec
+            and type(obj)._matmat == LinearOperator._matmat
+        ):
+            warnings.warn(
+                "LinearOperator subclass should implement"
+                " at least one of _matvec and _matmat.",
+                category=RuntimeWarning,
+                stacklevel=2,
+            )
+        return obj
+
+    def __init__(self, dtype, shape):
+        if dtype is not None:
+            dtype = np.dtype(dtype)
+        self.dtype = dtype
+        self.shape = tuple(int(s) for s in shape)
+
+    def _init_dtype(self):
+        if self.dtype is None:
+            v = jnp.zeros(self.shape[-1])
+            self.dtype = np.dtype(self.matvec(v).dtype)
+
+    # -- default implementations, each in terms of the other --
+    def _matvec(self, x, out=None):
+        return self._matmat(x.reshape(-1, 1), out=out).reshape(-1)
+
+    def _matmat(self, X, out=None):
+        cols = [self._matvec(X[:, j]) for j in range(X.shape[1])]
+        result = jnp.stack(cols, axis=1)
+        return result
+
+    def _rmatvec(self, x, out=None):
+        raise NotImplementedError("rmatvec is not defined")
+
+    def matvec(self, x, out=None):
+        M, N = self.shape
+        if x.shape != (N,) and x.shape != (N, 1):
+            raise ValueError("dimension mismatch")
+        return self._matvec(x, out=out)
+
+    def rmatvec(self, x, out=None):
+        M, N = self.shape
+        if x.shape != (M,) and x.shape != (M, 1):
+            raise ValueError("dimension mismatch")
+        return self._rmatvec(x, out=out)
+
+    def matmat(self, X, out=None):
+        if X.ndim != 2:
+            raise ValueError("expected 2-d array")
+        M, N = self.shape
+        if X.shape[0] != N:
+            raise ValueError("dimension mismatch")
+        return self._matmat(X, out=out)
+
+    def __matmul__(self, x):
+        if x.ndim == 1:
+            return self.matvec(x)
+        return self.matmat(x)
+
+
+class _CustomLinearOperator(LinearOperator):
+    """LinearOperator from user callables (reference ``linalg.py:312-372``)."""
+
+    def __init__(
+        self, shape, matvec, rmatvec=None, matmat=None, dtype=None,
+        rmatmat=None,
+    ):
+        super().__init__(dtype, shape)
+        self.__matvec_impl = matvec
+        self.__rmatvec_impl = rmatvec
+        self.__matmat_impl = matmat
+        self.__rmatmat_impl = rmatmat
+        self._init_dtype()
+
+    def _matvec(self, x, out=None):
+        result = self.__matvec_impl(x)
+        return _fill_out(result, out)
+
+    def _rmatvec(self, x, out=None):
+        if self.__rmatvec_impl is None:
+            raise NotImplementedError("rmatvec is not defined")
+        return _fill_out(self.__rmatvec_impl(x), out=out)
+
+    def _matmat(self, X, out=None):
+        if self.__matmat_impl is not None:
+            return _fill_out(self.__matmat_impl(X), out)
+        return super()._matmat(X, out=out)
+
+
+class _SparseMatrixLinearOperator(LinearOperator):
+    """Wraps a csr_array; caches the conjugate transpose for rmatvec
+    (reference ``linalg.py:375-390``)."""
+
+    def __init__(self, A: csr_array):
+        self.A = A
+        self.AT = None
+        super().__init__(A.dtype, A.shape)
+
+    def _matvec(self, x, out=None):
+        return self.A.dot(x, out=out)
+
+    def _rmatvec(self, x, out=None):
+        if self.AT is None:
+            self.AT = self.A.T.conj(copy=False)
+        return self.AT.dot(x, out=out)
+
+
+class _DenseMatrixLinearOperator(LinearOperator):
+    def __init__(self, A):
+        self.A = jnp.asarray(A)
+        super().__init__(self.A.dtype, self.A.shape)
+
+    def _matvec(self, x, out=None):
+        return _fill_out(self.A @ x, out)
+
+    def _rmatvec(self, x, out=None):
+        return _fill_out(self.A.conj().T @ x, out)
+
+
+class IdentityOperator(LinearOperator):
+    """No-op operator (reference ``linalg.py:392-414``)."""
+
+    def __init__(self, shape, dtype=None):
+        super().__init__(dtype, shape)
+
+    def _matvec(self, x, out=None):
+        return _fill_out(x, out)
+
+    def _rmatvec(self, x, out=None):
+        return _fill_out(x, out)
+
+
+def make_linear_operator(A) -> LinearOperator:
+    """Promote matrices/callables to LinearOperator (reference
+    ``linalg.py:417-431``)."""
+    if isinstance(A, LinearOperator):
+        return A
+    if is_sparse_matrix(A):
+        if not isinstance(A, csr_array):
+            A = A.tocsr()
+        return _SparseMatrixLinearOperator(A)
+    return _DenseMatrixLinearOperator(A)
+
+
+# --------------------------------------------------------------------------
+# Fused vector updates (reference ``linalg.py:424-451`` + AXPBY task)
+# --------------------------------------------------------------------------
+@partial(jax.jit, static_argnames=("isalpha", "negate"))
+def _cg_axpby_impl(y, x, a, b, isalpha: bool, negate: bool):
+    coef = a / b
+    if negate:
+        coef = -coef
+    if isalpha:
+        return coef * x + y  # y = (±a/b)·x + y
+    return x + coef * y      # y = x + (±a/b)·y
+
+
+def cg_axpby(y, x, a, b, isalpha: bool = True, negate: bool = False):
+    """y = alpha*x + beta*y with the alpha/beta division fused in-kernel.
+
+    API parity with the reference (``linalg.py:434-451``), which passes
+    ``a``/``b`` as futures so alpha = a/b is computed inside the task.
+    Under jit the division and AXPBY fuse into one VPU pass anyway; numpy
+    ``y`` is updated in place to preserve the reference's mutation
+    contract.
+    """
+    result = _cg_axpby_impl(
+        jnp.asarray(y), jnp.asarray(x), jnp.asarray(a), jnp.asarray(b),
+        bool(isalpha), bool(negate),
+    )
+    if isinstance(y, np.ndarray):
+        np.copyto(y, np.asarray(result, dtype=y.dtype))
+        return y
+    return result
+
+
+def _get_atol_rtol(b_norm, tol=None, atol=0.0, rtol=1e-5):
+    """scipy-compatible tolerance resolution (reference ``linalg.py:454-462``)."""
+    rtol = float(tol) if tol is not None else rtol
+    if atol is None:
+        atol = rtol
+    atol = max(float(atol), float(rtol) * float(b_norm))
+    return atol, rtol
+
+
+# --------------------------------------------------------------------------
+# CG (reference ``linalg.py:465-535``)
+# --------------------------------------------------------------------------
+def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
+             maxiter: int, conv_test_iters: int):
+    """Whole preconditioned-CG solve as one XLA while_loop.
+
+    State carries (x, r, p, rho, iters, done).  Convergence is only
+    *tested* every ``conv_test_iters`` iterations — iteration-count
+    parity with the reference's deferred check (``linalg.py:529-533``)
+    and fewer reductions on the critical path.
+    """
+    dtype = b.dtype
+    atol2 = jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2
+
+    def cond(state):
+        x, r, p, rho, iters, done = state
+        return jnp.logical_and(iters < maxiter, jnp.logical_not(done))
+
+    def body(state):
+        x, r, p, rho_old, iters, done = state
+        z = M_mv(r)
+        rho = jnp.vdot(r, z)
+        # Safe divides: an exactly-zero residual (x0 == solution) must
+        # flow through to the convergence check, not produce NaNs.
+        beta = jnp.where(
+            jnp.logical_or(iters == 0, rho_old == 0),
+            jnp.zeros_like(rho),
+            rho / jnp.where(rho_old == 0, jnp.ones_like(rho_old), rho_old),
+        )
+        p = z + beta * p
+        q = A_mv(p)
+        pq = jnp.vdot(p, q)
+        alpha = jnp.where(
+            pq == 0,
+            jnp.zeros_like(rho),
+            rho / jnp.where(pq == 0, jnp.ones_like(pq), pq),
+        )
+        x = x + alpha * p
+        r = r - alpha * q
+        iters = iters + 1
+        check = jnp.logical_or(
+            iters % conv_test_iters == 0, iters == maxiter - 1
+        )
+        rnorm2 = jnp.real(jnp.vdot(r, r))
+        done = jnp.logical_or(done, jnp.logical_and(check, rnorm2 < atol2))
+        return (x, r, p, rho, iters, done)
+
+    r0 = b - A_mv(x0)
+    state0 = (
+        x0,
+        r0,
+        jnp.zeros_like(b),
+        jnp.ones((), dtype=dtype),
+        jnp.asarray(0, dtype=jnp.int64),
+        jnp.asarray(False),
+    )
+    x, r, p, rho, iters, done = jax.lax.while_loop(cond, body, state0)
+    return x, iters
+
+
+def cg(
+    A,
+    b,
+    x0=None,
+    tol=None,
+    maxiter=None,
+    M=None,
+    callback=None,
+    atol=0.0,
+    rtol=1e-5,
+    conv_test_iters: int = 25,
+):
+    """Conjugate Gradient solve of ``A x = b`` (scipy-shaped signature,
+    reference ``linalg.py:465-535``).  Returns ``(x, iters)``.
+
+    Without a callback the solve is a single jitted while_loop (no host
+    sync per iteration).  With a callback, a Python-level loop mirrors
+    the reference's structure so user code observes every iterate.
+    """
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    assert b.ndim == 1
+    assert len(A.shape) == 2 and A.shape[0] == A.shape[1]
+
+    bnrm2 = float(jnp.linalg.norm(b))
+    atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
+    n = b.shape[0]
+    if maxiter is None:
+        maxiter = n * 10
+
+    A_op = make_linear_operator(A)
+    M_op = (
+        IdentityOperator(A_op.shape, dtype=A_op.dtype)
+        if M is None
+        else make_linear_operator(M)
+    )
+    x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+         else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+
+    if callback is None:
+        return _cg_loop(
+            A_op.matvec, M_op.matvec, b, x, atol, int(maxiter),
+            int(conv_test_iters),
+        )
+
+    # Callback path: Python loop, one deferred pipeline per iteration.
+    r = b - A_op.matvec(x)
+    p = jnp.zeros_like(b)
+    rho = jnp.ones((), dtype=b.dtype)
+    iters = 0
+    while iters < maxiter:
+        z = M_op.matvec(r)
+        rho_old = rho
+        rho = jnp.vdot(r, z)
+        beta = jnp.where(iters == 0, jnp.zeros_like(rho), rho / rho_old)
+        p = z + beta * p
+        q = A_op.matvec(p)
+        alpha = rho / jnp.vdot(p, q)
+        x = x + alpha * p
+        r = r - alpha * q
+        iters += 1
+        callback(x)
+        if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
+            jnp.linalg.norm(r)
+        ) < atol:
+            break
+    return x, iters
+
+
+# --------------------------------------------------------------------------
+# GMRES (reference ``linalg.py:540-668``)
+# --------------------------------------------------------------------------
+def _arnoldi_cycle(A_mv, M_mv, x, b, restart: int):
+    """One restart cycle: build the Krylov basis + Hessenberg matrix with
+    modified Gram-Schmidt, entirely under jit (reference builds the same
+    quantities with per-iteration host control, ``linalg.py:600-668``)."""
+    dtype = b.dtype
+    n = b.shape[0]
+    r = b - A_mv(x)
+    beta = jnp.linalg.norm(r)
+    V0 = jnp.zeros((restart + 1, n), dtype=dtype)
+    H0 = jnp.zeros((restart + 1, restart), dtype=dtype)
+    V0 = V0.at[0].set(jnp.where(beta > 0, r / beta, r))
+
+    def body(j, carry):
+        V, H = carry
+        w = A_mv(M_mv(V[j]))
+
+        def mgs_step(i, wh):
+            w, H = wh
+            hij = jnp.vdot(V[i], w) * (i <= j)
+            H = H.at[i, j].set(hij)
+            return (w - hij * V[i], H)
+
+        w, H = jax.lax.fori_loop(0, j + 1, mgs_step, (w, H))
+        hnorm = jnp.linalg.norm(w)
+        H = H.at[j + 1, j].set(hnorm)
+        V = V.at[j + 1].set(jnp.where(hnorm > 1e-30, w / hnorm, w))
+        return (V, H)
+
+    V, H = jax.lax.fori_loop(0, restart, body, (V0, H0))
+    return V, H, beta
+
+
+def gmres(
+    A,
+    b,
+    x0=None,
+    tol=None,
+    restart=None,
+    maxiter=None,
+    M=None,
+    callback=None,
+    restrt=None,
+    atol=0.0,
+    callback_type=None,
+    rtol=1e-5,
+):
+    """Restarted GMRES (scipy/cupy-shaped signature, reference
+    ``linalg.py:540-668``).  Returns ``(x, iters)``.
+
+    Inner Arnoldi cycles run jitted; the small (restart+1, restart)
+    least-squares solve happens on host per cycle — the identical split
+    the reference makes (``lstsq`` on host, everything else deferred).
+    """
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    assert b.ndim == 1
+    assert len(A.shape) == 2 and A.shape[0] == A.shape[1]
+    assert restrt is None or not restart
+    if restrt is not None:
+        restart = restrt
+
+    n = b.shape[0]
+    bnrm2 = float(jnp.linalg.norm(b))
+    atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
+    if maxiter is None:
+        maxiter = n * 10
+    if restart is None:
+        restart = 20
+    restart = min(int(restart), n)
+
+    A_op = make_linear_operator(A)
+    M_op = (
+        IdentityOperator(A_op.shape, dtype=A_op.dtype)
+        if M is None
+        else make_linear_operator(M)
+    )
+    x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+         else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+
+    arnoldi = jax.jit(
+        partial(_arnoldi_cycle, A_op.matvec, M_op.matvec, restart=restart)
+    )
+
+    iters = 0
+    while iters < maxiter:
+        V, H, beta = arnoldi(x, b)
+        beta_f = float(beta)
+        if beta_f < atol:
+            break
+        # Host-side small lstsq: min || beta e1 - H y ||.
+        Hh = np.asarray(H)
+        e1 = np.zeros(restart + 1, dtype=Hh.dtype)
+        e1[0] = beta_f
+        y, *_ = np.linalg.lstsq(Hh, e1, rcond=None)
+        update = jnp.asarray(y) @ V[:restart]
+        x = x + M_op.matvec(update)
+        iters += restart
+        if callback is not None:
+            if callback_type == "pr_norm":
+                callback(float(jnp.linalg.norm(b - A_op.matvec(x))) / bnrm2)
+            else:
+                callback(x)
+        if float(jnp.linalg.norm(b - A_op.matvec(x))) < atol:
+            break
+    return x, iters

@@ -1,0 +1,30 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Public module namespace (reference: ``legate_sparse/module.py``)."""
+
+from .csr import csr_array, csr_matrix, spmv, spgemm_csr_csr_csr  # noqa: F401
+from .dia import dia_array, dia_matrix  # noqa: F401
+from .gallery import diags, eye, identity  # noqa: F401
+from .io import mmread, mmwrite  # noqa: F401
+from .types import coord_ty, nnz_ty  # noqa: F401
+from .base import CompressedBase
+
+
+def is_sparse_matrix(o) -> bool:
+    return isinstance(o, CompressedBase)
+
+
+def issparse(o) -> bool:
+    return is_sparse_matrix(o)
+
+
+def isspmatrix(o) -> bool:
+    return is_sparse_matrix(o)
+
+
+def isspmatrix_csr(o) -> bool:
+    return isinstance(o, csr_array)
+
+
+def isspmatrix_dia(o) -> bool:
+    return isinstance(o, dia_array)

@@ -1,0 +1,23 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Compute kernels for legate_sparse_tpu.
+
+This package is the TPU-native replacement for the reference's C++/CUDA
+leaf-task library (reference: ``src/sparse/`` — SpMV, SpGEMM, conversions,
+see ``legate_sparse_cpp.cmake:125-192``).  Each reference task has a jitted
+XLA implementation here; banded matrices additionally get the gather-free
+DIA fast path in ``dia_ops.py``.
+"""
+
+from .spmv import csr_spmv, csr_spmm  # noqa: F401
+from .convert import (  # noqa: F401
+    row_ids_from_indptr,
+    indptr_from_row_ids,
+    dense_to_csr,
+    csr_to_dense,
+    coo_to_csr,
+    csr_transpose,
+    csr_diagonal,
+)
+from .spgemm import spgemm_csr_csr_csr_impl, coalesce_coo  # noqa: F401
+from .dia_ops import dia_spmv, dia_spmm  # noqa: F401

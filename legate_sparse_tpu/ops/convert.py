@@ -1,0 +1,156 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Format conversions as jitted XLA programs.
+
+TPU-native replacements for the reference conversion tasks:
+
+- dense->CSR (reference: ``src/sparse/array/conv/dense_to_csr.cc`` two-pass
+  NNZ count + fill, driven single-process from ``csr.py:109-148``) — here a
+  fully shardable ``jnp.nonzero(size=...)`` compaction.
+- CSR->dense (reference: ``src/sparse/array/conv/csr_to_dense.cc``) — a
+  scatter-add.
+- pos->coordinates expansion (reference:
+  ``src/sparse/array/conv/pos_to_coordinates_template.inl:55-110`` thrust
+  scan/scatter/gather chain) — a single ``jnp.repeat`` /
+  ``searchsorted``.
+- COO->CSR (reference: ``csr.py:183-219`` stable argsort by row +
+  bincount/cumsum) — lexsort + bincount.
+- transpose (reference: ``csr.py:512-542`` expand + stable argsort by crd).
+- get-diagonal (reference: ``src/sparse/array/csr/get_diagonal.cc``).
+
+Shape discipline: every function takes/returns arrays whose sizes (rows,
+nnz) are static at trace time — the XLA analog of the reference blocking
+on nnz futures (``csr.py:130,714``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..types import coord_dtype_for, nnz_ty
+
+
+@partial(jax.jit, static_argnames=("nnz",))
+def row_ids_from_indptr(indptr: jax.Array, nnz: int) -> jax.Array:
+    """Expand CSR indptr to a per-nonzero row-id vector.
+
+    Equivalent of the reference's EXPAND_POS_TO_COORDINATES task
+    (``pos_to_coordinates_template.inl:55-110``), which on TPU is one
+    ``searchsorted`` over the row pointers (O(nnz log rows), fully
+    vectorized; beats materializing repeat lengths for ragged rows).
+    """
+    if nnz == 0:
+        return jnp.zeros((0,), dtype=indptr.dtype)
+    return jnp.searchsorted(
+        indptr[1:-1], jnp.arange(nnz, dtype=indptr.dtype), side="right"
+    ).astype(indptr.dtype)
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def indptr_from_row_ids(row_ids: jax.Array, rows: int) -> jax.Array:
+    """Inverse expansion: per-nnz row ids (sorted) -> indptr of length rows+1."""
+    counts = jnp.bincount(row_ids, length=rows)
+    return jnp.concatenate(
+        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(counts).astype(nnz_ty)]
+    )
+
+
+def dense_nnz(dense) -> int:
+    """Host-blocking nonzero count (the analog of ``int(nnz)`` at
+    reference ``csr.py:130`` — shapes must be concrete before compaction)."""
+    return int(jnp.count_nonzero(dense))
+
+
+@partial(jax.jit, static_argnames=("nnz",))
+def dense_to_csr(dense: jax.Array, nnz: int):
+    """Compact a 2-D dense array into (data, indices, indptr).
+
+    One pass, no single-process bottleneck: ``jnp.nonzero(size=nnz)``
+    enumerates nonzeros in row-major = CSR order.  (The reference needs a
+    manual 1-process fill task here, an acknowledged scaling limitation,
+    ``csr.py:134-145``; on XLA the compaction shards.)
+    """
+    rows, cols = dense.shape
+    ridx, cidx = jnp.nonzero(dense, size=nnz, fill_value=0)
+    data = dense[ridx, cidx]
+    cdt = coord_dtype_for(max(rows, cols))
+    counts = jnp.bincount(ridx, length=rows)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(counts).astype(nnz_ty)]
+    )
+    return data, cidx.astype(cdt), indptr
+
+
+@partial(jax.jit, static_argnames=("shape",))
+def csr_to_dense(data, indices, indptr, shape):
+    """Scatter CSR triplets into a dense (rows, cols) array
+    (reference task ``csr_to_dense.cc``; duplicates accumulate)."""
+    rows, cols = shape
+    row_ids = row_ids_from_indptr(indptr, data.shape[0])
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[row_ids, indices].add(data, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def coo_to_csr(rows_idx, cols_idx, values, rows: int):
+    """Stable sort COO by row, then build indptr.
+
+    Matches reference semantics (``csr.py:183-219``): a *stable* argsort on
+    the row indices so intra-row input order is preserved (scipy property
+    relied on by ``test_csr_from_coo``), duplicates kept.
+    """
+    order = jnp.argsort(rows_idx, stable=True)
+    r = rows_idx[order]
+    c = cols_idx[order]
+    v = values[order]
+    indptr = indptr_from_row_ids(r, rows)
+    return v, c, indptr
+
+
+@partial(jax.jit, static_argnames=("rows", "cols"))
+def csr_transpose(data, indices, indptr, rows: int, cols: int):
+    """CSR -> CSR of the transpose.
+
+    Reference algorithm (``csr.py:512-542``): expand pos to row
+    coordinates, stably argsort by column index, rebuild pos.  Identical
+    structure here — expand, stable sort by ``indices``, bincount.
+    """
+    nnz = data.shape[0]
+    row_ids = row_ids_from_indptr(indptr, nnz)
+    order = jnp.argsort(indices, stable=True)
+    new_indices = row_ids[order].astype(indices.dtype)
+    new_data = data[order]
+    new_indptr = indptr_from_row_ids(indices[order], cols)
+    return new_data, new_indices, new_indptr
+
+
+@partial(jax.jit, static_argnames=("rows", "k"))
+def csr_diagonal(data, indices, indptr, rows: int, k: int = 0):
+    """Extract the k-th diagonal (reference task ``get_diagonal.cc``;
+    the reference only supports k=0, ``csr.py:345-368`` — we allow any k).
+
+    For row i the diagonal element is at column i+k; absent entries are 0,
+    duplicates sum (scipy semantics).
+    """
+    nnz = data.shape[0]
+    row_ids = row_ids_from_indptr(indptr, nnz)
+    on_diag = indices == (row_ids + k).astype(indices.dtype)
+    contrib = jnp.where(on_diag, data, jnp.zeros((), dtype=data.dtype))
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=rows)
+
+
+@partial(jax.jit, static_argnames=("nnz_out",))
+def compact_mask(mask, arrays, nnz_out: int):
+    """Gather elements of each array where mask is True, in order.
+
+    The XLA replacement for the reference's unbound output stores
+    (``csr.py:620-621``): callers first materialize ``int(mask.sum())``
+    on host, then compact with a static output size.
+    """
+    idx = jnp.nonzero(mask, size=nnz_out, fill_value=0)[0]
+    return tuple(a[idx] for a in arrays)

@@ -1,0 +1,19 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Distribution layer: meshes, row-block sharded CSR, collective SpMV.
+
+The TPU-native replacement for the reference's Legion partitioning
+machinery (reference: align/image constraints at ``csr.py:580-593``,
+NCCL communicator at ``csr.py:637``, projection functors
+``projections.cc:23-64``): a 1-D ``jax.sharding.Mesh`` over the row
+dimension, ``shard_map``-ped kernels, and explicit ICI collectives
+(``all_gather``/``psum``/``ppermute``).
+"""
+
+from .mesh import make_row_mesh, row_spec  # noqa: F401
+from .dist_csr import (  # noqa: F401
+    DistCSR,
+    shard_csr,
+    dist_spmv,
+    dist_cg,
+)

@@ -1,0 +1,41 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Mesh construction helpers.
+
+The reference lets Legion pick a launch domain from the machine shape
+(reference: ``runtime.py:75-81``, projection functors mapping 1-D grids
+onto 2-D stores ``projections.cc:23-64``).  Here the machine model is a
+``jax.sharding.Mesh``; sparse row-block distribution wants a 1-D mesh
+whose single axis (``"rows"``) spans every chip — ICI-contiguous so the
+halo ``ppermute`` in distributed SpMV rides neighbor links.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+ROW_AXIS = "rows"
+
+
+def make_row_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or given) devices with axis name ``rows``."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (ROW_AXIS,))
+
+
+def row_spec() -> PartitionSpec:
+    return PartitionSpec(ROW_AXIS)
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(ROW_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

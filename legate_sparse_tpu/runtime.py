@@ -1,0 +1,66 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Runtime singleton: device discovery and global configuration.
+
+The reference's runtime shim (reference: ``legate_sparse/runtime.py:54-107``)
+wraps the Legion machine model — store creation, task factories, processor
+counts.  On TPU none of that exists: XLA owns compilation and placement, and
+``jax.sharding`` owns distribution.  What remains useful is a single place
+that answers "how many devices do I have", "what mesh should ops default
+to", and dtype-policy questions — that is this module.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .settings import settings
+
+import jax
+
+
+class Runtime:
+    """Process-wide singleton (analog of reference ``runtime.py:54``)."""
+
+    def __init__(self) -> None:
+        if settings.x64:
+            # scipy-parity: default dtype is float64 (emulated on TPU;
+            # benchmarks opt into float32/bfloat16 explicitly).
+            jax.config.update("jax_enable_x64", True)
+        self._default_mesh = None
+
+    @property
+    def num_devices(self) -> int:
+        return len(jax.devices())
+
+    @property
+    def num_procs(self) -> int:
+        return self.num_devices
+
+    @property
+    def num_gpus(self) -> int:  # parity shim; TPUs are the accelerator here
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+    @property
+    def default_mesh(self):
+        """1-D mesh over all addressable devices, axis name ``rows``.
+
+        Lazily built; the TPU analog of Legion picking a launch domain
+        from the machine (reference ``runtime.py:75-81``).
+        """
+        if self._default_mesh is None:
+            from .parallel.mesh import make_row_mesh
+
+            self._default_mesh = make_row_mesh()
+        return self._default_mesh
+
+    def set_default_mesh(self, mesh) -> None:
+        self._default_mesh = mesh
+
+    # Value dtype used when constructors receive python lists / no dtype.
+    @property
+    def default_float(self) -> np.dtype:
+        return np.dtype(np.float64) if settings.x64 else np.dtype(np.float32)
+
+
+runtime = Runtime()

@@ -1,0 +1,52 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Environment-driven settings.
+
+Parity with the reference's settings layer (reference:
+``legate_sparse/settings.py:22-48``), re-expressed without Legate's
+``PrioritizedSetting`` machinery: each setting reads an environment
+variable once at import, and can be overridden programmatically.
+
+Settings
+--------
+``precise_images`` (``LEGATE_SPARSE_PRECISE_IMAGES``)
+    Reference semantics: use precise Legion image partitions instead of
+    min/max bounding-box approximations (reference ``settings.py:23-33``).
+    Accepted for parity.  CURRENT STATUS: informational only — the
+    distributed SpMV always uses the min/max column-window (halo) or
+    all_gather realization; a precise per-index gather path is planned.
+
+``fast_spgemm`` (``LEGATE_SPARSE_FAST_SPGEMM``)
+    Reference semantics: pick cuSPARSE SpGEMM ALG1 (fast, memory hungry)
+    over ALG3 (reference ``settings.py:35-45``).  Accepted for parity.
+    CURRENT STATUS: informational only — the ESC SpGEMM always performs
+    one full sort; a chunked low-memory mode is planned
+    (``spgemm_chunk_products`` reserves its chunk size).
+
+``x64`` (``LEGATE_SPARSE_TPU_X64``)
+    Enable float64 (scipy-parity default: on).  Set to ``0`` for
+    TPU-native float32/bfloat16-only operation.
+"""
+
+import os
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() not in ("0", "false", "no", "off", "")
+
+
+class Settings:
+    def __init__(self) -> None:
+        self.precise_images: bool = _env_bool("LEGATE_SPARSE_PRECISE_IMAGES", False)
+        self.fast_spgemm: bool = _env_bool("LEGATE_SPARSE_FAST_SPGEMM", False)
+        self.x64: bool = _env_bool("LEGATE_SPARSE_TPU_X64", True)
+        # Capacity multiplier for spgemm chunked mode (rows per chunk heuristic).
+        self.spgemm_chunk_products: int = int(
+            os.environ.get("LEGATE_SPARSE_SPGEMM_CHUNK", 1 << 24)
+        )
+
+
+settings = Settings()

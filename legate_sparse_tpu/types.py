@@ -1,0 +1,43 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Canonical dtypes for legate_sparse_tpu.
+
+Parity with the reference's canonical types (reference:
+``legate_sparse/types.py:20-25`` defines ``coord_ty=int64``,
+``nnz_ty=uint64``).  TPU-first deviation: XLA strongly prefers 32-bit
+integer indices (vector lanes, gather throughput), so the *default*
+coordinate type here is int32, transparently promoted to int64 whenever a
+matrix dimension or nnz count exceeds ``int32`` range.  ``nnz_ty`` is int64
+(JAX has weak uint64 support and nnz counts never need the extra bit).
+"""
+
+import numpy as np
+
+# Default (TPU-friendly) coordinate type; promoted to int64 for huge axes.
+coord_ty = np.dtype(np.int32)
+# Wide coordinate type used when shapes exceed int32 range.
+wide_coord_ty = np.dtype(np.int64)
+# Type used for nnz counts / indptr.
+nnz_ty = np.dtype(np.int64)
+
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+uint64 = np.dtype(np.uint64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+# Value dtypes accepted by the compute kernels (reference:
+# ``legate_sparse/utils.py:28-33`` SUPPORTED_DATATYPES).
+SUPPORTED_DATATYPES = (
+    np.dtype(np.float32),
+    np.dtype(np.float64),
+    np.dtype(np.complex64),
+    np.dtype(np.complex128),
+)
+
+
+def coord_dtype_for(extent: int) -> np.dtype:
+    """Pick int32 unless ``extent`` (a dimension or nnz) needs int64."""
+    return coord_ty if extent <= np.iinfo(np.int32).max else wide_coord_ty

@@ -1,0 +1,104 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Array utilities: dtype coercion, conversion helpers.
+
+Parity with the reference store/array utilities (reference:
+``legate_sparse/utils.py``).  The store<->cuPyNumeric plumbing
+(``utils.py:48-65``) has no TPU analog — jax.Arrays are used directly —
+but the dtype-coercion rules (``utils.py:90-114``) and grid factorization
+(``utils.py:118-124``) are kept semantically identical.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .types import SUPPORTED_DATATYPES
+
+
+def is_sparse_matrix(o: Any) -> bool:
+    from .base import CompressedBase
+
+    return isinstance(o, CompressedBase)
+
+
+def find_common_type(*args) -> np.dtype:
+    """numpy result_type over sparse matrices / arrays / scalars.
+
+    Mirrors reference ``utils.py:90-103``: size-1 arrays participate as
+    scalar types so that e.g. float32 matrix * python float stays float32.
+    """
+    array_types = []
+    scalar_types = []
+    for array in args:
+        if is_sparse_matrix(array):
+            array_types.append(np.dtype(array.dtype))
+        elif np.isscalar(array):
+            scalar_types.append(np.result_type(array))
+        elif getattr(array, "size", None) == 1:
+            scalar_types.append(np.dtype(array.dtype))
+        else:
+            array_types.append(np.dtype(array.dtype))
+    return np.result_type(*array_types, *scalar_types)
+
+
+def cast_to_common_type(*args) -> Tuple[Any, ...]:
+    """Cast all arguments to their common dtype (reference ``utils.py:106-114``)."""
+    common = find_common_type(*args)
+    out = []
+    for arg in args:
+        if is_sparse_matrix(arg):
+            out.append(arg.astype(common, copy=False))
+        else:
+            out.append(jnp.asarray(arg, dtype=common))
+    return tuple(out)
+
+
+def require_supported_dtype(dtype: np.dtype) -> None:
+    if np.dtype(dtype) not in SUPPORTED_DATATYPES:
+        raise NotImplementedError(
+            f"Operation not supported for dtype {np.dtype(dtype)}; "
+            f"supported: {[str(d) for d in SUPPORTED_DATATYPES]}"
+        )
+
+
+def factor_int(n: int) -> Tuple[int, int]:
+    """Decompose n into a near-square grid (reference ``utils.py:118-124``)."""
+    val = math.ceil(math.sqrt(n))
+    val2 = int(n / val)
+    while val2 * val != float(n):
+        val -= 1
+        val2 = int(n / val)
+    return val, val2
+
+
+def fill_out(result, out, check_shape: bool = True):
+    """Uniform functional ``out=`` contract.
+
+    JAX arrays are immutable, so true aliasing writes are impossible; for
+    parity with the reference's ``out=`` semantics (``csr.py:457-476``)
+    numpy outputs are filled in place and returned, jax outputs get the
+    result cast to their dtype.  Shared by csr/dia methods and linalg.
+    """
+    if out is None:
+        return result
+    if check_shape and tuple(out.shape) != tuple(result.shape):
+        raise ValueError(f"out shape {out.shape} != result {result.shape}")
+    if isinstance(out, np.ndarray):
+        np.copyto(out, np.asarray(result, dtype=out.dtype))
+        return out
+    return result.astype(out.dtype)
+
+
+def asarray_1d(x, dtype=None):
+    arr = jnp.asarray(x, dtype=dtype)
+    if arr.ndim == 2 and arr.shape[1] == 1:
+        arr = arr.reshape(-1)
+    if arr.ndim != 1:
+        raise ValueError(f"expected 1-D array, got shape {arr.shape}")
+    return arr

@@ -1,0 +1,95 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""ctypes bridge to the native C++ helper library.
+
+The analog of the reference's CFFI boundary (reference:
+``legate_sparse/config.py:49-113`` dlopens ``liblegate_sparse.so``),
+reduced to the pieces that genuinely belong in native code on a TPU
+stack: host-side IO parsing.  The library is optional — every entry
+point has a numpy fallback and callers degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "..", "src", "build", "liblegate_sparse_tpu.so"),
+        os.path.join(here, "liblegate_sparse_tpu.so"),
+    ]
+    for path in candidates:
+        if os.path.exists(path):
+            try:
+                lib = ctypes.CDLL(path)
+                _bind(lib)
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.lst_mtx_read.restype = ctypes.c_int
+    lib.lst_mtx_read.argtypes = [
+        ctypes.c_char_p,                     # path
+        ctypes.POINTER(ctypes.c_int64),      # out m
+        ctypes.POINTER(ctypes.c_int64),      # out n
+        ctypes.POINTER(ctypes.c_int64),      # out nnz (post symmetry)
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),   # rows
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),   # cols
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # vals
+    ]
+    lib.lst_free.restype = None
+    lib.lst_free.argtypes = [ctypes.c_void_p]
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_mtx_read(path: str) -> Optional[Tuple[int, int, np.ndarray, np.ndarray, np.ndarray]]:
+    """Fast C++ matrix-market parse; None if the library is unavailable.
+
+    Native counterpart of the reference's single-task parser
+    (``src/sparse/io/mtx_to_coo.cc:31-143``).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    m = ctypes.c_int64()
+    n = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    rows_p = ctypes.POINTER(ctypes.c_int64)()
+    cols_p = ctypes.POINTER(ctypes.c_int64)()
+    vals_p = ctypes.POINTER(ctypes.c_double)()
+    rc = lib.lst_mtx_read(
+        path.encode(), ctypes.byref(m), ctypes.byref(n), ctypes.byref(nnz),
+        ctypes.byref(rows_p), ctypes.byref(cols_p), ctypes.byref(vals_p),
+    )
+    if rc != 0:
+        return None
+    count = nnz.value
+    try:
+        rows = np.ctypeslib.as_array(rows_p, shape=(count,)).copy()
+        cols = np.ctypeslib.as_array(cols_p, shape=(count,)).copy()
+        vals = np.ctypeslib.as_array(vals_p, shape=(count,)).copy()
+    finally:
+        lib.lst_free(rows_p)
+        lib.lst_free(cols_p)
+        lib.lst_free(vals_p)
+    return m.value, n.value, rows, cols, vals

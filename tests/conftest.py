@@ -1,0 +1,33 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Test configuration: run the suite on a virtual 8-device CPU mesh.
+
+The TPU analog of the reference's ``legate.tester`` resource shapes
+(reference ``test.py:24-32``): the same pytest files exercise 1-device
+and 8-device behavior, with multi-device tests using the host-platform
+device-count trick instead of a pod (SURVEY §4).
+"""
+
+import os
+
+# Must be set before the jax backend initializes.  The environment's
+# sitecustomize may force-register an accelerator platform and override
+# JAX_PLATFORMS, so pin the config directly after import as well.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

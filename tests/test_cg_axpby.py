@@ -1,0 +1,26 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Fused axpby kernel tests (mirrors reference ``test_cg_axpby.py``:
+all four isalpha x negate combinations vs closed form)."""
+
+import numpy as np
+import pytest
+
+from legate_sparse_tpu.linalg import cg_axpby
+
+
+@pytest.mark.parametrize("isalpha", [True, False])
+@pytest.mark.parametrize("negate", [True, False])
+def test_cg_axpby(isalpha, negate):
+    rng = np.random.default_rng(3)
+    n = 57
+    y = rng.standard_normal(n)
+    x = rng.standard_normal(n)
+    a, b = 3.7, 1.3
+    coef = -(a / b) if negate else (a / b)
+    expected = coef * x + y if isalpha else x + coef * y
+    y_arg = y.copy()
+    result = cg_axpby(y_arg, x, a, b, isalpha=isalpha, negate=negate)
+    np.testing.assert_allclose(result, expected, atol=1e-14)
+    # numpy outputs are mutated in place (reference contract).
+    np.testing.assert_allclose(y_arg, expected, atol=1e-14)

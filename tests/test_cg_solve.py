@@ -1,0 +1,79 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""CG solver tests (mirrors reference ``test_cg_solve.py``)."""
+
+import numpy as np
+import pytest
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+from utils_test.gen import spd_system
+
+
+def test_cg_solve():
+    N = 1000
+    A_dense, x = spd_system(N, 0.1, 471014)
+    assert np.all(np.linalg.eigvals(A_dense) > 0)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    x_pred, iters = linalg.cg(A, y, tol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(A @ x_pred), np.asarray(y), rtol=1e-8, atol=0.0
+    )
+    assert iters > 0
+
+
+def test_cg_solve_with_callback():
+    N = 300
+    A_dense, x = spd_system(N, 0.1, 471014)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    residuals = []
+
+    def callback(xk):
+        residuals.append(y - A @ xk)
+
+    x_pred, iters = linalg.cg(A, y, tol=1e-8, callback=callback)
+    np.testing.assert_allclose(
+        np.asarray(A @ x_pred), np.asarray(y), rtol=1e-8, atol=0.0
+    )
+    assert len(residuals) == iters
+
+
+def test_cg_solve_linear_operator():
+    N = 300
+    A_dense, x = spd_system(N, 0.1, 7)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    op = linalg.LinearOperator(A.shape, matvec=lambda v: A @ v,
+                               dtype=A.dtype)
+    x_pred, _ = linalg.cg(op, y, tol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(A @ x_pred), np.asarray(y), rtol=1e-8, atol=0.0
+    )
+
+
+def test_cg_solve_preconditioned():
+    N = 300
+    A_dense, x = spd_system(N, 0.1, 99)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    dinv = 1.0 / np.asarray(A.diagonal())
+    M = linalg.LinearOperator(
+        A.shape, matvec=lambda v: dinv * v, dtype=A.dtype
+    )
+    x_pred, iters_pre = linalg.cg(A, y, tol=1e-10, M=M)
+    np.testing.assert_allclose(
+        np.asarray(A @ x_pred), np.asarray(y), rtol=1e-8, atol=1e-8
+    )
+
+
+def test_cg_x0():
+    N = 200
+    A_dense, x = spd_system(N, 0.2, 31)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    x_pred, iters = linalg.cg(A, y, x0=np.asarray(x), tol=1e-8,
+                              conv_test_iters=1)
+    # Starting at the exact solution must converge immediately.
+    assert iters <= 2

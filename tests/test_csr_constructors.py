@@ -1,0 +1,77 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""csr_array constructor differential tests vs scipy (mirrors reference
+``test_csr_from_dense.py``, ``test_csr_from_coo.py``, ``test_csr_from_csr.py``,
+``test_csr_to_dense.py``)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+from utils_test.gen import random_csr, simple_system_gen
+
+
+@pytest.mark.parametrize("N", [5, 29])
+@pytest.mark.parametrize("M", [7, 17])
+def test_from_dense(N, M):
+    a_dense, A, _ = simple_system_gen(N, M, sparse.csr_array)
+    s = scsp.csr_array(a_dense)
+    assert A.nnz == s.nnz
+    np.testing.assert_array_equal(np.asarray(A.indptr), s.indptr)
+    np.testing.assert_array_equal(np.asarray(A.indices), s.indices)
+    np.testing.assert_allclose(np.asarray(A.data), s.data)
+
+
+@pytest.mark.parametrize("N", [4, 25])
+def test_to_dense_roundtrip(N):
+    a_dense, A, _ = simple_system_gen(N, N + 3, sparse.csr_array)
+    np.testing.assert_allclose(np.asarray(A.todense()), a_dense)
+
+
+def test_from_coo_unsorted():
+    # Unsorted COO triplets must produce scipy-identical CSR (stable
+    # within-row order, duplicates preserved).
+    rng = np.random.default_rng(42)
+    N, M, nnz = 13, 11, 40
+    rows = rng.integers(0, N, nnz)
+    cols = rng.integers(0, M, nnz)
+    vals = rng.standard_normal(nnz)
+    A = sparse.csr_array((vals, (rows, cols)), shape=(N, M))
+    s = scsp.coo_matrix((vals, (rows, cols)), shape=(N, M)).tocsr()
+    s.sum_duplicates()
+    np.testing.assert_allclose(
+        np.asarray(A.todense()), s.todense(), atol=1e-14
+    )
+
+
+def test_from_scipy():
+    s = random_csr(20, 30, 0.3, 7)
+    A = sparse.csr_array(s)
+    assert A.shape == (20, 30)
+    assert A.nnz == s.nnz
+    np.testing.assert_allclose(np.asarray(A.todense()), s.todense())
+
+
+def test_from_data_indices_indptr():
+    s = random_csr(15, 9, 0.4, 3)
+    A = sparse.csr_array(
+        (s.data, s.indices, s.indptr), shape=s.shape
+    )
+    np.testing.assert_allclose(np.asarray(A.todense()), s.todense())
+
+
+def test_copy_and_dtype():
+    s = random_csr(10, 10, 0.5, 1)
+    A = sparse.csr_array(s)
+    B = sparse.csr_array(A, copy=True)
+    C = A.astype(np.float32)
+    assert B.nnz == A.nnz
+    assert C.dtype == np.float32
+    assert A.dtype == np.float64
+
+
+def test_repr_and_str():
+    A = sparse.csr_array(np.eye(3))
+    assert "3x3" in repr(A)
+    assert "(0, 0)" in str(A)

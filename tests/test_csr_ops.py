@@ -1,0 +1,100 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Transpose / diagonal / sum / arithmetic tests (mirrors reference
+``test_csr_transpose.py``, ``test_diagonal.py``)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+from utils_test.gen import random_csr, simple_system_gen
+
+
+@pytest.mark.parametrize("N,M", [(5, 5), (12, 7), (7, 12)])
+def test_transpose(N, M):
+    s = random_csr(N, M, 0.4, 11)
+    A = sparse.csr_array(s)
+    At = A.T
+    assert At.shape == (M, N)
+    np.testing.assert_allclose(np.asarray(At.todense()), s.T.todense())
+    # transpose must produce scipy-identical structure
+    st = s.T.tocsr()
+    st.sort_indices()
+    np.testing.assert_array_equal(np.asarray(At.indptr), st.indptr)
+
+
+@pytest.mark.parametrize("N", [5, 20])
+def test_diagonal(N):
+    s = random_csr(N, N, 0.5, 2)
+    A = sparse.csr_array(s)
+    np.testing.assert_allclose(np.asarray(A.diagonal()), s.diagonal())
+
+
+@pytest.mark.parametrize("k", [-2, -1, 1, 3])
+def test_diagonal_k(k):
+    s = random_csr(9, 9, 0.6, 4)
+    A = sparse.csr_array(s)
+    np.testing.assert_allclose(np.asarray(A.diagonal(k)), s.diagonal(k))
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_sum(axis):
+    s = random_csr(8, 13, 0.4, 6)
+    A = sparse.csr_array(s)
+    expected = np.asarray(s.todense()).sum(axis=axis)
+    np.testing.assert_allclose(np.asarray(A.sum(axis=axis)), expected,
+                               atol=1e-13)
+
+
+def test_scalar_mul_div_neg():
+    a_dense, A, _ = simple_system_gen(6, 8, sparse.csr_array)
+    np.testing.assert_allclose(
+        np.asarray((2.5 * A).todense()), 2.5 * a_dense
+    )
+    np.testing.assert_allclose(
+        np.asarray((A / 2.0).todense()), a_dense / 2.0
+    )
+    np.testing.assert_allclose(np.asarray((-A).todense()), -a_dense)
+
+
+def test_add_sub():
+    sa = random_csr(10, 9, 0.3, 1)
+    sb = random_csr(10, 9, 0.3, 2)
+    A = sparse.csr_array(sa)
+    B = sparse.csr_array(sb)
+    np.testing.assert_allclose(
+        np.asarray((A + B).todense()), (sa + sb).todense(), atol=1e-14
+    )
+    np.testing.assert_allclose(
+        np.asarray((A - B).todense()), (sa - sb).todense(), atol=1e-14
+    )
+
+
+def test_multiply_dense_and_vector():
+    a_dense, A, x = simple_system_gen(7, 9, sparse.csr_array)
+    other = np.random.default_rng(3).random((7, 9))
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(other).todense()), a_dense * other, atol=1e-14
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(x).todense()), a_dense * x[None, :], atol=1e-14
+    )
+
+
+def test_conj_complex():
+    s = random_csr(6, 6, 0.5, 9).astype(np.complex128)
+    s.data = s.data + 1j * np.arange(s.nnz)
+    A = sparse.csr_array(s)
+    np.testing.assert_allclose(
+        np.asarray(A.conj().todense()), np.conj(np.asarray(s.todense()))
+    )
+
+
+def test_mean():
+    s = random_csr(6, 4, 0.5, 9)
+    A = sparse.csr_array(s)
+    np.testing.assert_allclose(
+        np.asarray(A.mean(axis=1)), np.asarray(s.todense()).mean(axis=1),
+        atol=1e-14,
+    )

@@ -1,0 +1,73 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""diags / dia_array tests (mirrors reference ``test_diags.py`` and the
+dia_array transpose/tocsr paths)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.mark.parametrize(
+    "diagonals,offsets,shape",
+    [
+        ([[1, 2, 3, 4]], [0], None),
+        ([[1, 2, 3], [4, 5, 6, 7], [8, 9, 10]], [-1, 0, 1], None),
+        ([[1, 2, 3, 4, 5]], [2], (7, 7)),
+        ([[1] * 6, [2] * 6], [0, -1], (7, 6)),
+    ],
+)
+def test_diags_matches_scipy(diagonals, offsets, shape):
+    ours = sparse.diags(diagonals, offsets, shape=shape, format="csr",
+                        dtype=np.float64)
+    theirs = scsp.diags(diagonals, offsets, shape=shape, format="csr",
+                        dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(ours.todense()), theirs.todense())
+
+
+def test_diags_scalar_broadcast():
+    ours = sparse.diags([1, -2, 1], [-1, 0, 1], shape=(8, 8), format="csr")
+    theirs = scsp.diags([1, -2, 1], [-1, 0, 1], shape=(8, 8), format="csr")
+    np.testing.assert_allclose(np.asarray(ours.todense()), theirs.todense())
+
+
+def test_diags_dia_format():
+    ours = sparse.diags([[1, 2, 3, 4], [4, 5, 6]], [0, 1], shape=(4, 4))
+    assert ours.format == "dia"
+    theirs = scsp.diags([[1, 2, 3, 4], [4, 5, 6]], [0, 1], shape=(4, 4))
+    np.testing.assert_allclose(np.asarray(ours.todense()), theirs.todense())
+
+
+def test_dia_transpose():
+    d = sparse.diags([[1, 2, 3, 4], [5, 6, 7, 8]], [0, -1], shape=(5, 4))
+    s = scsp.diags([[1, 2, 3, 4], [5, 6, 7, 8]], [0, -1], shape=(5, 4))
+    np.testing.assert_allclose(
+        np.asarray(d.T.todense()), s.T.todense()
+    )
+
+
+def test_dia_nnz():
+    d = sparse.diags([1, 1], [0, 2], shape=(6, 6))
+    s = scsp.diags([np.ones(6), np.ones(4)], [0, 2], shape=(6, 6))
+    assert d.nnz == s.nnz
+
+
+def test_dia_tocsr_explicit_zero_drop():
+    # tocsr drops explicit zeros from the stored diagonals (in-band zeros).
+    d = sparse.dia_array(
+        (np.array([[1.0, 0.0, 3.0]]), np.array([0])), shape=(3, 3)
+    )
+    c = d.tocsr()
+    assert c.nnz == 2
+
+
+def test_eye_identity():
+    np.testing.assert_allclose(
+        np.asarray(sparse.identity(5, format="csr").todense()), np.eye(5)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse.eye(4, 6, k=1, format="csr").todense()),
+        np.eye(4, 6, k=1),
+    )

@@ -1,0 +1,97 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Distribution tests on the virtual 8-device CPU mesh (the analog of
+the reference's multi-rank legate.tester runs, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import (
+    DistCSR, dist_cg, dist_spmv, make_row_mesh, shard_csr,
+)
+from legate_sparse_tpu.parallel.dist_csr import shard_vector
+from utils_test.gen import banded_matrix, random_csr
+
+
+requires_multi = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multiple devices"
+)
+
+
+@requires_multi
+@pytest.mark.parametrize("N", [64, 129])
+@pytest.mark.parametrize("nnz_per_row", [3, 11])
+def test_dist_spmv_banded_halo(N, nnz_per_row):
+    s = banded_matrix(N, nnz_per_row)
+    A = sparse.csr_array(s)
+    D = shard_csr(A)
+    assert D.halo >= 0, "banded matrix should take the halo-exchange path"
+    x = np.random.default_rng(0).standard_normal(N)
+    x_sh = shard_vector(x, D.mesh, D.rows_padded)
+    y = dist_spmv(D, x_sh)
+    np.testing.assert_allclose(np.asarray(y)[:N], s @ x, atol=1e-12)
+
+
+@requires_multi
+def test_dist_spmv_random_allgather():
+    N = 100
+    s = random_csr(N, N, 0.2, 3)
+    A = sparse.csr_array(s)
+    D = shard_csr(A, force_all_gather=True)
+    assert D.halo == -1
+    x = np.random.default_rng(1).standard_normal(N)
+    x_sh = shard_vector(x, D.mesh, D.rows_padded)
+    y = dist_spmv(D, x_sh)
+    np.testing.assert_allclose(np.asarray(y)[:N], s @ x, atol=1e-12)
+
+
+@requires_multi
+def test_dist_spmv_rectangular():
+    N, M = 48, 80
+    s = random_csr(N, M, 0.3, 7)
+    A = sparse.csr_array(s)
+    D = shard_csr(A)
+    assert D.halo == -1  # rectangular -> all_gather path
+    x = np.random.default_rng(2).standard_normal(M)
+    # x for rectangular case: padded to shard count * ceil — here x is
+    # gathered fully, shard layout just needs divisibility.
+    x_sh = shard_vector(
+        x, D.mesh, int(np.ceil(M / D.num_shards)) * D.num_shards
+    )
+    y = dist_spmv(D, x_sh)
+    np.testing.assert_allclose(np.asarray(y)[:N], s @ x, atol=1e-12)
+
+
+@requires_multi
+def test_dist_cg_poisson():
+    # 1-D Poisson (tridiagonal SPD) solved across 8 shards.
+    import scipy.sparse as scsp
+
+    N = 256
+    s = scsp.diags([-1.0, 4.0, -1.0], [-1, 0, 1], shape=(N, N)).tocsr()
+    A = sparse.csr_array(s)
+    D = shard_csr(A)
+    rng = np.random.default_rng(4)
+    x_true = rng.standard_normal(N)
+    b = s @ x_true
+    x, iters = dist_cg(D, b, tol=1e-10, maxiter=2000)
+    np.testing.assert_allclose(np.asarray(x), x_true, atol=1e-6)
+    assert int(iters) > 0
+
+
+@requires_multi
+def test_dist_matches_single_device():
+    N = 90
+    s = banded_matrix(N, 5)
+    A = sparse.csr_array(s)
+    D = shard_csr(A)
+    x = np.random.default_rng(6).standard_normal(N)
+    y_single = A @ x
+    x_sh = shard_vector(x, D.mesh, D.rows_padded)
+    y_dist = dist_spmv(D, x_sh)
+    np.testing.assert_allclose(
+        np.asarray(y_dist)[:N], np.asarray(y_single), atol=1e-12
+    )

@@ -1,0 +1,40 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""GMRES tests (mirrors reference ``test_gmres_solve.py``)."""
+
+import numpy as np
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+from utils_test.gen import spd_system, random_dense
+
+
+def test_gmres_solve():
+    N = 200
+    A_dense, x = spd_system(N, 0.1, 471014)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    x_pred, iters = linalg.gmres(A, y, tol=1e-10, restart=40, maxiter=4000)
+    resid = np.linalg.norm(np.asarray(A @ x_pred) - np.asarray(y))
+    assert resid < 1e-8 * np.linalg.norm(np.asarray(y)) + 1e-6
+
+
+def test_gmres_nonsymmetric():
+    N = 120
+    rng = np.random.default_rng(5)
+    A_dense = random_dense(N, N, 0.2, 3) + N * np.eye(N)
+    A = sparse.csr_array(A_dense)
+    x = rng.standard_normal(N)
+    y = A @ x
+    x_pred, _ = linalg.gmres(A, y, tol=1e-10, restart=30, maxiter=3000)
+    np.testing.assert_allclose(np.asarray(x_pred), x, atol=1e-5)
+
+
+def test_gmres_restrt_alias():
+    N = 60
+    A_dense, x = spd_system(N, 0.3, 11)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    x_pred, _ = linalg.gmres(A, y, tol=1e-10, restrt=20)
+    resid = np.linalg.norm(np.asarray(A @ x_pred) - np.asarray(y))
+    assert resid < 1e-6

@@ -1,0 +1,63 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Matrix-market IO tests (mirrors reference ``test_io.py``: mmread
+equals scipy.io.mmread).  Fixtures are generated, not shipped."""
+
+import numpy as np
+import pytest
+import scipy.io
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+from utils_test.gen import random_csr
+
+
+@pytest.fixture
+def mtx_file(tmp_path):
+    def make(mat, name="m.mtx", **kw):
+        path = tmp_path / name
+        scipy.io.mmwrite(str(path), mat, **kw)
+        return str(path)
+
+    return make
+
+
+def test_mmread_general(mtx_file):
+    s = random_csr(17, 13, 0.3, 5)
+    path = mtx_file(s.tocoo())
+    A = sparse.mmread(path)
+    expected = scipy.io.mmread(path).todense()
+    np.testing.assert_allclose(np.asarray(A.todense()), expected)
+
+
+def test_mmread_symmetric(mtx_file):
+    s = random_csr(11, 11, 0.4, 8)
+    sym = s + s.T
+    path = mtx_file(sym.tocoo(), symmetry="symmetric")
+    A = sparse.mmread(path)
+    np.testing.assert_allclose(
+        np.asarray(A.todense()), scipy.io.mmread(path).todense()
+    )
+
+
+def test_mmread_pattern(mtx_file, tmp_path):
+    path = tmp_path / "p.mtx"
+    path.write_text(
+        "%%MatrixMarket matrix coordinate pattern general\n"
+        "3 4 3\n1 1\n2 3\n3 4\n"
+    )
+    A = sparse.mmread(str(path))
+    expected = np.zeros((3, 4))
+    expected[0, 0] = expected[1, 2] = expected[2, 3] = 1.0
+    np.testing.assert_allclose(np.asarray(A.todense()), expected)
+
+
+def test_mmwrite_roundtrip(tmp_path):
+    s = random_csr(9, 9, 0.5, 2)
+    A = sparse.csr_array(s)
+    path = tmp_path / "out.mtx"
+    sparse.mmwrite(str(path), A)
+    B = sparse.mmread(str(path))
+    np.testing.assert_allclose(
+        np.asarray(B.todense()), np.asarray(A.todense())
+    )

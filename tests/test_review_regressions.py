@@ -1,0 +1,94 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Regression tests for issues found in code review."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+from utils_test.gen import random_csr, spd_system
+
+
+def test_multiply_with_duplicate_entries():
+    # COO input with duplicates: elementwise product must match scipy
+    # (square of summed values, not sum of squared values).
+    L = sparse.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 2),
+    )
+    S = scsp.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 2),
+    )
+    result = L.multiply(L)
+    expected = S.multiply(S).todense()
+    np.testing.assert_allclose(np.asarray(result.todense()), expected)
+
+
+def test_multiply_differing_patterns():
+    sa = random_csr(9, 7, 0.4, 1)
+    sb = random_csr(9, 7, 0.4, 2)
+    A = sparse.csr_array(sa)
+    B = sparse.csr_array(sb)
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(B).todense()),
+        np.asarray(sa.multiply(sb).todense()),
+        atol=1e-14,
+    )
+
+
+def test_multiply_scipy_operand():
+    sa = random_csr(6, 6, 0.5, 3)
+    A = sparse.csr_array(sa)
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(sa).todense()),
+        np.asarray(sa.multiply(sa).todense()),
+        atol=1e-14,
+    )
+
+
+def test_cg_x0_dtype_mismatch():
+    N = 64
+    A_dense, x = spd_system(N, 0.2, 5)
+    A = sparse.csr_array(A_dense)
+    y = A @ x
+    # float32 x0 against float64 b must cast, not crash the while_loop.
+    x_pred, _ = linalg.cg(A, y, x0=np.zeros(N, dtype=np.float32), tol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(A @ x_pred), np.asarray(y), rtol=1e-8, atol=1e-10
+    )
+
+
+def test_has_canonical_format_tracking():
+    # COO with duplicates: not canonical until sum_duplicates.
+    L = sparse.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 2),
+    )
+    assert not L.has_canonical_format
+    L.sum_duplicates()
+    assert L.has_canonical_format
+    assert L.nnz == 1
+    np.testing.assert_allclose(np.asarray(L.data), [3.0])
+    # Dense constructor output is canonical.
+    A = sparse.csr_array(np.eye(3))
+    assert A.has_canonical_format
+
+
+def test_dia_spmv_fast_path():
+    d = sparse.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(16, 16))
+    s = scsp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(16, 16))
+    x = np.random.default_rng(0).standard_normal(16)
+    np.testing.assert_allclose(np.asarray(d @ x), s @ x, atol=1e-13)
+    X = np.random.default_rng(1).standard_normal((16, 4))
+    np.testing.assert_allclose(np.asarray(d @ X), s @ X, atol=1e-13)
+
+
+def test_dia_rectangular_spmv():
+    d = sparse.diags([[1, 2, 3, 4], [4, 5, 6]], [0, 1], shape=(5, 4))
+    s = scsp.diags([[1, 2, 3, 4], [4, 5, 6]], [0, 1], shape=(5, 4),
+                   dtype=np.float64)
+    x = np.arange(4.0)
+    np.testing.assert_allclose(np.asarray(d.astype(np.float64) @ x), s @ x)

@@ -1,0 +1,67 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""SpMV differential tests (mirrors reference ``test_spmv.py``)."""
+
+import numpy as np
+import pytest
+
+import legate_sparse_tpu as sparse
+from utils_test.gen import banded_matrix, simple_system_gen
+
+
+@pytest.mark.parametrize("N", [5, 29])
+@pytest.mark.parametrize("M", [7, 17])
+@pytest.mark.parametrize("inline", [True, False])
+def test_csr_spmv(N, M, inline):
+    a_dense, A, x = simple_system_gen(N, M, sparse.csr_array)
+    if inline:
+        y = np.zeros((N,))
+        A.dot(x, out=y)
+    else:
+        y = A @ x
+    np.testing.assert_allclose(np.asarray(y), a_dense @ x, atol=1e-13)
+
+
+@pytest.mark.parametrize("N", [5, 29])
+@pytest.mark.parametrize("nnz_per_row", [3, 9])
+@pytest.mark.parametrize("unsupported_dtype", ["int64", "bool"])
+def test_csr_spmv_unsupported_dtype(N, nnz_per_row, unsupported_dtype):
+    A = sparse.csr_array(banded_matrix(N, nnz_per_row)).astype(
+        unsupported_dtype
+    )
+    x = np.zeros((N,))
+    with pytest.raises(NotImplementedError):
+        A.dot(x)
+
+
+def test_csr_spmv_matrix_vector_column():
+    a_dense, A, x = simple_system_gen(12, 12, sparse.csr_array)
+    y = A @ x.reshape(-1, 1)
+    assert y.shape == (12, 1)
+    np.testing.assert_allclose(np.asarray(y).ravel(), a_dense @ x, atol=1e-13)
+
+
+def test_csr_spmm_dense():
+    a_dense, A, _ = simple_system_gen(10, 14, sparse.csr_array)
+    X = np.random.default_rng(5).random((14, 6))
+    Y = A @ X
+    np.testing.assert_allclose(np.asarray(Y), a_dense @ X, atol=1e-13)
+
+
+def test_spmv_free_function():
+    a_dense, A, x = simple_system_gen(9, 9, sparse.csr_array)
+    y = np.zeros(9)
+    sparse.spmv(A, x, y)
+    np.testing.assert_allclose(y, a_dense @ x, atol=1e-13)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex64,
+                                   np.complex128])
+def test_spmv_dtypes(dtype):
+    a_dense, A, x = simple_system_gen(8, 8, sparse.csr_array)
+    A = A.astype(dtype)
+    y = A @ x.astype(dtype)
+    np.testing.assert_allclose(
+        np.asarray(y), a_dense.astype(dtype) @ x.astype(dtype),
+        rtol=1e-5 if dtype in (np.float32, np.complex64) else 1e-12,
+    )

@@ -1,0 +1,34 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Zero-preserving unary ufunc family (mirrors reference
+``test_unary_operation.py`` over the ``base.py:209-250`` family)."""
+
+import numpy as np
+import pytest
+
+import legate_sparse_tpu as sparse
+from utils_test.gen import simple_system_gen
+
+UFUNCS = [
+    "sin", "tan", "arcsin", "arctan", "sinh", "tanh", "arcsinh",
+    "rint", "sign", "expm1", "log1p", "deg2rad", "rad2deg", "floor",
+    "ceil", "trunc", "sqrt",
+]
+
+
+@pytest.mark.parametrize("name", UFUNCS)
+def test_unary(name):
+    a_dense, A, _ = simple_system_gen(9, 7, sparse.csr_array)
+    # Inputs are in [0, 1): in-domain for all listed functions.
+    result = getattr(A, name)()
+    expected = getattr(np, name)(a_dense)
+    np.testing.assert_allclose(
+        np.asarray(result.todense()), expected, atol=1e-13
+    )
+
+
+def test_arctanh_domain():
+    a_dense, A, _ = simple_system_gen(5, 5, sparse.csr_array, tol=0.4)
+    np.testing.assert_allclose(
+        np.asarray(A.arctanh().todense()), np.arctanh(a_dense), atol=1e-13
+    )

@@ -1,0 +1,59 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Test matrix generators (role of reference ``tests/integration/utils/``:
+banded and seeded-random fixtures for differential testing vs scipy)."""
+
+import numpy as np
+import scipy.sparse as scsp
+
+
+def banded_matrix(n: int, nnz_per_row: int, dtype=np.float64):
+    """Banded scipy CSR with nnz_per_row diagonals (odd), values 1..k."""
+    assert nnz_per_row % 2 == 1
+    half = nnz_per_row // 2
+    offsets = list(range(-half, half + 1))
+    diagonals = [
+        np.full(n - abs(off), float(off + half + 1), dtype=dtype)
+        for off in offsets
+    ]
+    return scsp.diags(diagonals, offsets, shape=(n, n), format="csr",
+                      dtype=dtype)
+
+
+def random_csr(n: int, m: int, density: float, seed: int, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    mat = scsp.random(
+        n, m, density=density, format="csr", dtype=np.float64,
+        random_state=np.random.RandomState(seed),
+        data_rvs=rng.standard_normal,
+    )
+    return mat.astype(dtype)
+
+
+def random_dense(n: int, m: int, density: float, seed: int):
+    return np.asarray(random_csr(n, m, density, seed).todense())
+
+
+def random_vector(n: int, seed: int):
+    return np.random.default_rng(seed).standard_normal(n)
+
+
+def simple_system_gen(n, m, cls, tol=0.5, seed=0):
+    """Thresholded random dense + its sparse version + a vector
+    (same contract as reference ``sample.py:49-55``)."""
+    rng = np.random.default_rng(seed)
+    a_dense = rng.random((n, m))
+    x = rng.random(m)
+    a_dense = np.where(a_dense < tol, a_dense, 0.0)
+    a_sparse = None if cls is None else cls(a_dense)
+    return a_dense, a_sparse, x
+
+
+def spd_system(n: int, density: float, seed: int):
+    """SPD matrix A + rhs (same construction as reference
+    ``test_cg_solve.py:23-35``: symmetrized random + N·I)."""
+    A = random_dense(n, n, density, seed)
+    A = 0.5 * (A + A.T)
+    A = A + n * np.eye(n)
+    x = random_vector(n, seed + 1)
+    return A, x
