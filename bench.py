@@ -1,0 +1,92 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Headline benchmark: CSR SpMV achieved HBM bandwidth on one chip.
+
+Prints ONE JSON line::
+
+    {"metric": "csr_spmv_bandwidth", "value": <GB/s>, "unit": "GB/s",
+     "vs_baseline": <fraction of measured stream bandwidth>}
+
+Config matches the reference's SpMV microbenchmark default (banded
+matrix, nnz/row=11 — reference ``examples/spmv_microbenchmark.py:34-52``,
+``examples/common.py:206-249``) at 2^20 rows.  ``vs_baseline`` is the
+achieved fraction of this chip's *measured* stream bandwidth (triad-style
+copy), i.e. the roofline fraction BASELINE.md's north-star targets
+(>= 0.70).  The reference publishes no absolute numbers (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _time_fn(fn, *args, warmup: int = 5, iters: int = 20) -> float:
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _stream_bandwidth() -> float:
+    """Measured triad bandwidth (GB/s): z = a*x + y on 2^26 f32 lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 26
+    x = jnp.ones((n,), dtype=jnp.float32)
+    y = jnp.ones((n,), dtype=jnp.float32)
+    triad = jax.jit(lambda x, y: 1.000001 * x + y)
+    dt = _time_fn(triad, x, y)
+    bytes_moved = 3 * 4 * n  # read x, read y, write z
+    return bytes_moved / dt / 1e9
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import legate_sparse_tpu as sparse
+    from legate_sparse_tpu.ops.spmv import csr_spmv
+
+    n = 1 << 20
+    nnz_per_row = 11
+    half = nnz_per_row // 2
+    offsets = list(range(-half, half + 1))
+    diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
+    A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
+                     dtype=np.float32)
+    x = jnp.ones((n,), dtype=jnp.float32)
+
+    data, indices, indptr = A.data, A.indices, A.indptr
+    dt = _time_fn(lambda: csr_spmv(data, indices, indptr, x, n))
+
+    nnz = A.nnz
+    # Byte traffic (BASELINE.md): values + column indices + row pointers
+    # + gathered x + written y.
+    bytes_moved = (
+        nnz * (data.dtype.itemsize + indices.dtype.itemsize)
+        + (n + 1) * indptr.dtype.itemsize
+        + n * x.dtype.itemsize
+        + n * data.dtype.itemsize
+    )
+    bw = bytes_moved / dt / 1e9
+    stream = _stream_bandwidth()
+    print(json.dumps({
+        "metric": "csr_spmv_bandwidth",
+        "value": round(bw, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(bw / stream, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,12 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._indices = indices
         self._indptr = indptr
         self._canonical = canonical
+        # Cached static structure for the SpMV hot path (the analog of
+        # Legion caching image partitions across solver iterations,
+        # reference §3.2): built lazily on first matvec.
+        self._row_ids = None
+        self._ell = None
+        self._ell_width = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
             f"indptr length {self._indptr.shape[0]} != rows+1 "
@@ -161,10 +167,13 @@ class csr_array(CompressedBase, DenseSparseBase):
     def _with_data(self, data, copy: bool = False):
         if copy:
             data = jnp.array(data)
-        return csr_array._from_parts(
+        out = csr_array._from_parts(
             data, self._indices, self._indptr, self.shape,
             canonical=self._canonical,
         )
+        out._row_ids = self._row_ids  # sparsity structure is shared
+        out._ell_width = self._ell_width
+        return out
 
     # ---------------- properties ----------------
     @property
@@ -189,6 +198,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         if value.shape != self._data.shape:
             raise ValueError("cannot change nnz via data setter")
         self._data = value
+        self._ell = None  # packed values are stale; sparsity is not
 
     @property
     def indices(self):
@@ -200,6 +210,9 @@ class csr_array(CompressedBase, DenseSparseBase):
         if value.shape != self._indices.shape:
             raise ValueError("cannot change nnz via indices setter")
         self._indices = value
+        self._ell = None
+        self._ell_width = None
+        self._canonical = None
 
     @property
     def indptr(self):
@@ -239,6 +252,9 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._indices = indices.astype(self._indices.dtype)
         self._indptr = indptr
         self._canonical = True
+        self._row_ids = None
+        self._ell = None
+        self._ell_width = None
 
     def _canonicalized(self) -> "csr_array":
         if self.has_canonical_format:
@@ -250,6 +266,50 @@ class csr_array(CompressedBase, DenseSparseBase):
     @property
     def T(self):
         return self.transpose()
+
+    # ---------------- cached matvec structure ----------------
+    def _get_ell(self):
+        """Lazily build/cache the ELL packing (None if padding too big or
+        the matrix structure is a tracer).  The pack itself runs on
+        device (one fused gather); only the max-row-width W is a host
+        sync, cached with the structure."""
+        if any(
+            isinstance(a, jax.core.Tracer)
+            for a in (self._data, self._indices, self._indptr)
+        ):
+            # Don't cache tracer-derived packs on the Python object
+            # (trace leak); the segment-sum path is fully traceable.
+            return None
+        if self._ell is None:
+            from .settings import settings
+
+            if self._ell_width is None:
+                rows = self.shape[0]
+                self._ell_width = (
+                    max(int(jnp.max(jnp.diff(self._indptr))), 1)
+                    if rows and self.nnz
+                    else 1
+                )
+            W = self._ell_width
+            if not _spmv_ops.ell_within_budget(
+                self.shape[0], W, self.nnz, settings.ell_max_expand
+            ):
+                self._ell = False
+            else:
+                self._ell = _spmv_ops.ell_pack_device(
+                    self._data, self._indices, self._indptr,
+                    self.shape[0], W,
+                )
+        return self._ell if self._ell is not False else None
+
+    def _get_row_ids(self):
+        if isinstance(self._indptr, jax.core.Tracer):
+            return _convert.row_ids_from_indptr(self._indptr, self.nnz)
+        if self._row_ids is None:
+            self._row_ids = _convert.row_ids_from_indptr(
+                self._indptr, self.nnz
+            )
+        return self._row_ids
 
     # ---------------- conversions ----------------
     def todense(self, order=None, out=None):
@@ -416,9 +476,18 @@ class csr_array(CompressedBase, DenseSparseBase):
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
             A, x = cast_to_common_type(self, other_arr)
-            y = _spmv_ops.csr_spmv(
-                A.data, A.indices, A.indptr, x, self.shape[0]
-            )
+            src = self if A is self else None
+            ell = src._get_ell() if src is not None else None
+            if ell is not None:
+                y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
+            elif src is not None:
+                y = _spmv_ops.csr_spmv_rowids(
+                    A.data, A.indices, src._get_row_ids(), x, self.shape[0]
+                )
+            else:
+                y = _spmv_ops.csr_spmv(
+                    A.data, A.indices, A.indptr, x, self.shape[0]
+                )
             if squeeze:
                 y = y[:, None]
             return fill_out(y, out)
@@ -428,9 +497,18 @@ class csr_array(CompressedBase, DenseSparseBase):
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
             A, X = cast_to_common_type(self, other_arr)
-            Y = _spmv_ops.csr_spmm(
-                A.data, A.indices, A.indptr, X, self.shape[0]
-            )
+            src = self if A is self else None
+            ell = src._get_ell() if src is not None else None
+            if ell is not None:
+                Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
+            elif src is not None:
+                Y = _spmv_ops.csr_spmm_rowids(
+                    A.data, A.indices, src._get_row_ids(), X, self.shape[0]
+                )
+            else:
+                Y = _spmv_ops.csr_spmm(
+                    A.data, A.indices, A.indptr, X, self.shape[0]
+                )
             return fill_out(Y, out)
         raise ValueError(f"cannot multiply csr_array by ndim={other_arr.ndim}")
 
@@ -489,8 +567,7 @@ def _elementwise_intersect_multiply(a: csr_array, b: csr_array) -> csr_array:
 
 def spmv(A: csr_array, x, y):
     """Free-function SpMV: y <- A @ x (reference ``csr.py:562-593``)."""
-    result = _spmv_ops.csr_spmv(A.data, A.indices, A.indptr, x, A.shape[0])
-    return fill_out(result, y)
+    return A.dot(jnp.asarray(x), out=y)
 
 
 def spgemm_csr_csr_csr(A: csr_array, B: csr_array) -> csr_array:

@@ -6,8 +6,8 @@ TPU-native re-expression of the reference's entire distribution story
 (reference, §2.3 of SURVEY):
 
 - Row-block data parallelism — ``align(y, A_pos)`` equi-partitioning of
-  rows (reference ``csr.py:580-593``) becomes a 1-D mesh with the three
-  CSR arrays laid out as (num_shards, ...) blocks sharded on axis 0.
+  rows (reference ``csr.py:580-593``) becomes a 1-D mesh with the CSR
+  arrays laid out as (num_shards, ...) blocks sharded on axis 0.
 - Image partitioning — ``image(crd, x, MIN_MAX)`` bounding-box gathers
   (reference ``csr.py:587-591``, ``fast_image_partition.cu:29-55``)
   become build-time column-window computation; at solve time each shard
@@ -17,10 +17,20 @@ TPU-native re-expression of the reference's entire distribution story
   becomes host-side padding to the max local nnz: XLA's static-shape
   analog of unbound stores.
 
-Padding invariants: rows are padded to a multiple of the shard count and
-each shard's nonzeros are padded to the per-shard max with
-(index=last-valid, value=0) entries, which contribute zeros to the last
-local row — semantics are exact, no masking needed.
+Layout: each shard's rows are packed **ELL-style** — (rows_per_shard, W)
+value/column blocks, W = the matrix's max nonzeros-per-row — so the
+per-shard SpMV is one rectangular gather + a W-width masked row
+reduction.  On TPU this runs at HBM roofline where flat
+scatter/segment-sum kernels do not (the vector units consume the
+(rows, W) tile directly; no scatter, no searchsorted).  Matrices whose
+max row width would blow the padding budget fall back to padded-CSR
+blocks + segment_sum.
+
+Padding invariants: rows are padded to a multiple of the shard count
+(appended rows have count 0).  Padded ELL slots replicate the row's
+last valid column with value 0 and are masked out of the product by the
+per-row counts (see ``ops.spmv.ell_pack``); padded CSR slots map to the
+last local row with value 0.
 """
 
 from __future__ import annotations
@@ -37,29 +47,30 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..csr import csr_array
-from ..types import nnz_ty
 from .mesh import ROW_AXIS, make_row_mesh
 
 
 @dataclass
 class DistCSR:
-    """Row-block sharded CSR matrix.
+    """Row-block sharded sparse matrix (ELL or padded-CSR layout).
 
-    Arrays are (R, ...) blocks sharded over mesh axis ``rows``:
+    ELL layout (``ell=True``): ``data``/``cols`` are (R, rows_per_shard,
+    W) and ``counts`` is (R, rows_per_shard) per-row nnz; ``cols`` holds
+    *rebased* indices into the shard's halo-extended x window when
+    ``halo >= 0``, else global indices.
 
-    - ``data``/``indices``: (R, nnz_max) value / global column index
-    - ``indices_rebased``: (R, nnz_max) column index rebased to the
-      shard's halo-extended x window (valid when ``halo >= 0``)
-    - ``indptr``: (R, rows_per_shard + 1) local row pointers
+    Padded-CSR layout: ``data``/``cols`` are (R, nnz_max) with
+    ``row_ids`` (R, nnz_max) static local row ids.
     """
 
     data: jax.Array
-    indices: jax.Array
-    indices_rebased: Optional[jax.Array]
-    indptr: jax.Array
+    cols: jax.Array
+    counts: Optional[jax.Array]
+    row_ids: Optional[jax.Array]
     shape: Tuple[int, int]
     rows_per_shard: int
-    halo: int           # -1 = halo exchange not applicable -> all_gather
+    halo: int           # -1 = no halo window -> all_gather realization
+    ell: bool
     mesh: Mesh
 
     @property
@@ -80,7 +91,8 @@ class DistCSR:
 
 
 def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
-              force_all_gather: bool = False) -> DistCSR:
+              force_all_gather: bool = False,
+              ell_max_expand: Optional[float] = None) -> DistCSR:
     """Partition a csr_array into row blocks over a 1-D mesh.
 
     Host-side build step (the analog of Legion solving partition
@@ -90,6 +102,10 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     (``fast_image_partition.cu:29-55``) — and picks halo-exchange when
     every window fits within one neighbor shard on each side.
     """
+    from ..settings import settings
+
+    if ell_max_expand is None:
+        ell_max_expand = settings.ell_max_expand
     if mesh is None:
         mesh = make_row_mesh()
     R = int(np.prod(mesh.devices.shape))
@@ -98,60 +114,101 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     indptr = np.asarray(A.indptr)
     indices = np.asarray(A.indices)
     data = np.asarray(A.data)
+    counts = np.diff(indptr)
+    nnz = int(indptr[-1])
 
     starts = np.minimum(np.arange(R) * rps, rows)
     ends = np.minimum(starts + rps, rows)
-    lo = indptr[starts]
-    hi = indptr[ends]
-    local_nnz = hi - lo
-    nnz_max = max(int(local_nnz.max()), 1) if A.nnz else 1
 
-    data_b = np.zeros((R, nnz_max), dtype=data.dtype)
-    idx_b = np.zeros((R, nnz_max), dtype=indices.dtype)
-    ptr_b = np.zeros((R, rps + 1), dtype=indptr.dtype)
+    # Column windows per shard (FAST_IMAGE_RANGE analog).
     col_min = np.zeros(R, dtype=np.int64)
     col_max = np.zeros(R, dtype=np.int64)
+    lo = indptr[starts]
+    hi = indptr[ends]
     for s in range(R):
-        ln = int(local_nnz[s])
-        data_b[s, :ln] = data[lo[s] : hi[s]]
-        idx_b[s, :ln] = indices[lo[s] : hi[s]]
-        # Padding entries keep index 0 / value 0 (contribute 0 to last row).
-        nrows_s = ends[s] - starts[s]
-        ptr_b[s, : nrows_s + 1] = indptr[starts[s] : ends[s] + 1] - lo[s]
-        ptr_b[s, nrows_s + 1 :] = ln
-        if ln:
-            col_min[s] = idx_b[s, :ln].min()
-            col_max[s] = idx_b[s, :ln].max()
+        if hi[s] > lo[s]:
+            win = indices[lo[s] : hi[s]]
+            col_min[s] = win.min()
+            col_max[s] = win.max()
         else:
-            col_min[s] = starts[s] if starts[s] < cols else 0
+            col_min[s] = min(starts[s], max(cols - 1, 0))
             col_max[s] = col_min[s]
 
     # Halo width: how far each shard's window reaches outside its own
     # row block (square matrices only — halo mode needs x and rows to be
     # conformally sharded).
     halo = -1
-    indices_rebased = None
     if rows == cols and not force_all_gather:
         left_reach = np.maximum(starts - col_min, 0)
         right_reach = np.maximum(col_max + 1 - ends, 0)
         h = int(max(left_reach.max(), right_reach.max()))
         if h <= rps:
             halo = h
-            # Rebase: local index = global - (start - h).
-            reb = idx_b - (starts - h)[:, None]
-            reb = np.clip(reb, 0, rps + 2 * h - 1)
-            indices_rebased = reb.astype(idx_b.dtype)
+
+    from ..ops.spmv import ell_pack, ell_within_budget
+
+    rows_p = R * rps
+    W = max(int(counts.max()), 1) if rows and nnz else 1
+    # Budget uses the *padded* row count — what actually gets allocated.
+    use_ell = ell_within_budget(rows_p, W, nnz, ell_max_expand)
 
     spec = NamedSharding(mesh, P(ROW_AXIS))
     put = lambda arr: jax.device_put(jnp.asarray(arr), spec)
+
+    if use_ell:
+        # Shared (rows, W) ELL pack, padded to R*rps rows, then reshaped
+        # to (R, rps, W) row blocks.
+        ell_data, ell_cols, ell_counts = ell_pack(
+            data, indices, indptr, rows, W, xp=np
+        )
+        if rows_p > rows:
+            pad = rows_p - rows
+            ell_data = np.concatenate(
+                [ell_data, np.zeros((pad, W), dtype=ell_data.dtype)]
+            )
+            ell_cols = np.concatenate(
+                [ell_cols, np.zeros((pad, W), dtype=ell_cols.dtype)]
+            )
+            ell_counts = np.concatenate(
+                [ell_counts, np.zeros((pad,), dtype=ell_counts.dtype)]
+            )
+        ell_cols = ell_cols.reshape(R, rps, W)
+        ell_data = ell_data.reshape(R, rps, W)
+        ell_counts = ell_counts.reshape(R, rps)
+        if halo >= 0:
+            # Rebase to the halo-extended window: local = global-(start-h).
+            reb = ell_cols - (starts - halo)[:, None, None]
+            ell_cols = np.clip(reb, 0, rps + 2 * halo - 1).astype(
+                indices.dtype
+            )
+        return DistCSR(
+            data=put(ell_data), cols=put(ell_cols), counts=put(ell_counts),
+            row_ids=None, shape=(rows, cols), rows_per_shard=rps,
+            halo=halo, ell=True, mesh=mesh,
+        )
+
+    # Padded-CSR fallback: (R, nnz_max) + static row ids.
+    local_nnz = hi - lo
+    nnz_max = max(int(local_nnz.max()), 1) if nnz else 1
+    data_b = np.zeros((R, nnz_max), dtype=data.dtype)
+    idx_b = np.zeros((R, nnz_max), dtype=indices.dtype)
+    rid_b = np.zeros((R, nnz_max), dtype=np.int32)
+    for s in range(R):
+        ln = int(local_nnz[s])
+        data_b[s, :ln] = data[lo[s] : hi[s]]
+        idx_b[s, :ln] = indices[lo[s] : hi[s]]
+        local_counts = counts[starts[s] : ends[s]]
+        rid = np.repeat(
+            np.arange(ends[s] - starts[s], dtype=np.int32), local_counts
+        )
+        rid_b[s, :ln] = rid
+        rid_b[s, ln:] = max(rps - 1, 0)  # padding -> last row, value 0
+    if halo >= 0:
+        reb = idx_b - (starts - halo)[:, None]
+        idx_b = np.clip(reb, 0, rps + 2 * halo - 1).astype(indices.dtype)
     return DistCSR(
-        data=put(data_b),
-        indices=put(idx_b),
-        indices_rebased=put(indices_rebased) if indices_rebased is not None else None,
-        indptr=put(ptr_b),
-        shape=(rows, cols),
-        rows_per_shard=rps,
-        halo=halo,
+        data=put(data_b), cols=put(idx_b), counts=None, row_ids=put(rid_b),
+        shape=(rows, cols), rows_per_shard=rps, halo=halo, ell=False,
         mesh=mesh,
     )
 
@@ -165,53 +222,22 @@ def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P(ROW_AXIS)))
 
 
-def _local_row_ids(indptr_local, nnz_max: int):
-    return jnp.searchsorted(
-        indptr_local[1:-1], jnp.arange(nnz_max, dtype=indptr_local.dtype),
-        side="right",
-    )
-
-
-def _spmv_kernel_allgather(data, indices, indptr, x_local, rows_per_shard):
-    """Per-shard body: gather the full x over ICI, then local SpMV.
-
-    The ``all_gather`` is the general-case image realization (reference's
-    Realm copies for MIN_MAX images spanning many shards).
-    """
-    x_full = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-    d = data[0]
-    prod = d * x_full[indices[0]]
-    row_ids = _local_row_ids(indptr[0], d.shape[0])
-    y = jax.ops.segment_sum(
-        prod, row_ids, num_segments=rows_per_shard, indices_are_sorted=True
-    )
-    return y
-
-
-def _spmv_kernel_halo(data, indices_rebased, indptr, x_local,
-                      rows_per_shard, halo):
-    """Per-shard body: fixed-width neighbor halo exchange over ICI.
+def _extend_x(x_local, halo: int):
+    """Halo exchange: ppermute boundary slices to/from ring neighbors.
 
     Structurally the ring/context-parallel neighbor pattern: each shard
-    ppermutes its boundary slices left/right, never materializing the
-    global x — this is what makes 1e8-row weak scaling possible where
-    ``all_gather`` would not (SURVEY §7 hard part #4).
+    never materializes the global x — this is what makes 1e8-row weak
+    scaling possible where ``all_gather`` would not (SURVEY §7 hard
+    part #4).
     """
+    if halo <= 0:
+        return x_local
     axis_size = jax.lax.axis_size(ROW_AXIS)
-    d = data[0]
-    if halo > 0:
-        right_perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        left_perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
-        from_left = jax.lax.ppermute(x_local[-halo:], ROW_AXIS, right_perm)
-        from_right = jax.lax.ppermute(x_local[:halo], ROW_AXIS, left_perm)
-        x_ext = jnp.concatenate([from_left, x_local, from_right])
-    else:
-        x_ext = x_local
-    prod = d * x_ext[indices_rebased[0]]
-    row_ids = _local_row_ids(indptr[0], d.shape[0])
-    return jax.ops.segment_sum(
-        prod, row_ids, num_segments=rows_per_shard, indices_are_sorted=True
-    )
+    right_perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    left_perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+    from_left = jax.lax.ppermute(x_local[-halo:], ROW_AXIS, right_perm)
+    from_right = jax.lax.ppermute(x_local[:halo], ROW_AXIS, left_perm)
+    return jnp.concatenate([from_left, x_local, from_right])
 
 
 def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
@@ -220,26 +246,44 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     ``x`` and the result are row-block sharded vectors of length
     ``A.rows_padded``.  The distribution contract matches the reference
     SpMV task (``csr.py:562-593``): y aligned with the row partition,
-    x gathered per the column image.
+    x gathered per the column image (halo ppermute or all_gather).
     """
     from jax import shard_map
 
-    if A.halo >= 0 and A.indices_rebased is not None:
-        kernel = partial(
-            _spmv_kernel_halo,
-            rows_per_shard=A.rows_per_shard,
-            halo=A.halo,
-        )
-        args = (A.data, A.indices_rebased, A.indptr, x)
-        in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None), P(ROW_AXIS, None),
-                    P(ROW_AXIS))
+    halo = A.halo
+
+    if A.ell:
+        def kernel(data, cols, counts, x_local):
+            if halo >= 0:
+                x_src = _extend_x(x_local, halo)
+            else:
+                x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+            W = data.shape[-1]
+            slot = jnp.arange(W, dtype=counts.dtype)
+            valid = slot[None, :] < counts[0][:, None]
+            prod = jnp.where(valid, data[0] * x_src[cols[0]],
+                             jnp.zeros((1, 1), dtype=data.dtype))
+            return jnp.sum(prod, axis=1)
     else:
-        kernel = partial(
-            _spmv_kernel_allgather, rows_per_shard=A.rows_per_shard
-        )
-        args = (A.data, A.indices, A.indptr, x)
-        in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None), P(ROW_AXIS, None),
-                    P(ROW_AXIS))
+        rps = A.rows_per_shard
+
+        def kernel(data, cols, row_ids, x_local):
+            if halo >= 0:
+                x_src = _extend_x(x_local, halo)
+            else:
+                x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+            prod = data[0] * x_src[cols[0]]
+            return jax.ops.segment_sum(
+                prod, row_ids[0], num_segments=rps, indices_are_sorted=True
+            )
+
+    args = (
+        (A.data, A.cols, A.counts, x) if A.ell
+        else (A.data, A.cols, A.row_ids, x)
+    )
+    in_specs = tuple(
+        P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in args
+    )
     return shard_map(
         kernel, mesh=A.mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
         check_vma=False,

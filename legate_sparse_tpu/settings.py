@@ -43,6 +43,13 @@ class Settings:
         self.precise_images: bool = _env_bool("LEGATE_SPARSE_PRECISE_IMAGES", False)
         self.fast_spgemm: bool = _env_bool("LEGATE_SPARSE_FAST_SPGEMM", False)
         self.x64: bool = _env_bool("LEGATE_SPARSE_TPU_X64", True)
+        # SpMV fast path: pack CSR into ELL (rows, max-row-nnz) when the
+        # padded size stays within this multiple of the true nnz.  TPU
+        # gathers over a rectangular layout run at HBM roofline; scatter-
+        # based segment sums do not.  Set to 0 to disable ELL packing.
+        self.ell_max_expand: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_ELL_EXPAND", "4.0")
+        )
         # Capacity multiplier for spgemm chunked mode (rows per chunk heuristic).
         self.spgemm_chunk_products: int = int(
             os.environ.get("LEGATE_SPARSE_SPGEMM_CHUNK", 1 << 24)

@@ -92,3 +92,60 @@ def test_dia_rectangular_spmv():
                    dtype=np.float64)
     x = np.arange(4.0)
     np.testing.assert_allclose(np.asarray(d.astype(np.float64) @ x), s @ x)
+
+
+def test_ell_padding_does_not_poison_rows():
+    """Padded ELL slots contribute an exact 0 even against non-finite x:
+    rows not touching the inf column stay finite, a row touching it
+    yields inf (not nan), and an empty row yields exactly 0."""
+    # Row 0 has 2 nnz (both col>=1), row 1 has 1 nnz -> W=2, one pad slot.
+    A = sparse.csr_array(
+        (np.array([1.0, 2.0, 3.0]), np.array([1, 2, 2]),
+         np.array([0, 2, 3])),
+        shape=(2, 3),
+    )
+    x = np.array([np.inf, 1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(A @ x), [3.0, 3.0])
+    # Row 0 touches the inf column with 1 valid + 1 padded slot:
+    # 1*inf + pad must be inf, not nan (pad product masked, 0*inf trap).
+    B = sparse.csr_array(
+        (np.array([1.0, 2.0, 3.0]), np.array([0, 0, 1]),
+         np.array([0, 1, 3])),
+        shape=(2, 2),
+    )
+    yB = np.asarray(B @ np.array([np.inf, 1.0]))
+    assert np.isinf(yB[0]) and not np.isnan(yB[0])
+    # Empty middle row stays exactly 0 against inf anywhere in x.
+    C = sparse.csr_array(
+        (np.array([1.0, 2.0]), np.array([0, 1]), np.array([0, 1, 1, 2])),
+        shape=(3, 2),
+    )
+    yC = np.asarray(C @ np.array([np.inf, 1.0]))
+    assert yC[1] == 0.0
+
+
+def test_matvec_traceable_in_data():
+    """A @ x must stay jit-traceable w.r.t. the matrix data."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = np.array([0, 1, 1], dtype=np.int32)
+    ptr = np.array([0, 2, 3], dtype=np.int64)
+    x = jnp.asarray(np.array([1.0, 2.0]))
+
+    @jax.jit
+    def f(d):
+        A = sparse.csr_array((d, idx, ptr), shape=(2, 2))
+        return A @ x
+
+    y = np.asarray(f(jnp.asarray(np.array([1.0, 2.0, 3.0]))))
+    np.testing.assert_allclose(y, [5.0, 6.0])
+
+
+def test_data_update_reuses_structure():
+    """Updating .data keeps the cached ELL width and stays correct."""
+    A = sparse.csr_array(np.array([[1.0, 0.0], [0.0, 2.0]]))
+    x = np.array([1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(A @ x), [1.0, 2.0])
+    A.data = np.array([3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(A @ x), [3.0, 4.0])
