@@ -1,0 +1,133 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""2-D Poisson PDE solver with Dirichlet boundaries (reference
+``examples/pde.py``): penta-diagonal operator via ``diags().tocsr()``,
+CG solve, ``--throughput`` mode subtracting warmup iterations.
+
+On the tpu backend the whole CG solve runs as one jitted while_loop;
+``--throughput`` therefore measures steady-state device iteration time
+with zero host round-trips (reference measures Legion pipeline
+throughput the same way, ``pde.py:180-205``).
+"""
+
+import argparse
+import sys
+
+from common import get_phase_procs, parse_common_args
+
+
+def d2_mat_dirichlet_2d(nx, ny, dx, dy):
+    """Centered second-order 2-D Laplacian with Dirichlet BCs on the
+    (nx-2)(ny-2) interior unknowns (reference ``pde.py:24-88``)."""
+    a = 1.0 / dx**2
+    g = 1.0 / dy**2
+    c = -2.0 * a - 2.0 * g
+
+    diag_size = (nx - 2) * (ny - 2) - 1
+    first = np.full((nx - 3), a)
+    chunks = np.concatenate([np.zeros(1), first])
+    diag_a = np.concatenate(
+        [first, np.tile(chunks, (diag_size - (nx - 3)) // (nx - 2))]
+    )
+    diag_g = g * np.ones((nx - 2) * (ny - 3))
+    diag_c = c * np.ones((nx - 2) * (ny - 2))
+    return sparse.diags(
+        [diag_g, diag_a, diag_c, diag_a, diag_g],
+        [-(nx - 2), -1, 0, 1, nx - 2],
+        dtype=np.float64,
+    ).tocsr()
+
+
+def p_exact_2d(X, Y):
+    """Exact solution for the manufactured rhs (reference ``pde.py:92-116``)."""
+    return -1.0 / (2.0 * np.pi**2) * np.sin(np.pi * X) * np.cos(
+        np.pi * Y
+    ) - 1.0 / (50.0 * np.pi**2) * np.sin(5.0 * np.pi * X) * np.cos(
+        5.0 * np.pi * Y
+    )
+
+
+def execute(nx, ny, throughput, tol, max_iters, warmup_iters, timer):
+    xmin, xmax = 0.0, 1.0
+    ymin, ymax = -0.5, 0.5
+    dx = (xmax - xmin) / (nx - 1)
+    dy = (ymax - ymin) / (ny - 1)
+
+    build, solve = get_phase_procs(use_tpu)
+
+    with build:
+        x = np.linspace(xmin, xmax, nx)
+        y = np.linspace(ymin, ymax, ny)
+        X, Y = np.meshgrid(x, y, indexing="ij")
+        b = np.sin(np.pi * X) * np.cos(np.pi * Y) + np.sin(
+            5.0 * np.pi * X
+        ) * np.cos(5.0 * np.pi * Y)
+        if throughput:
+            n = b.shape[0] - 2
+            bflat = np.ones((n * n,))
+        else:
+            bflat = b[1:-1, 1:-1].flatten("F")
+        A = d2_mat_dirichlet_2d(nx, ny, dx, dy)
+
+    with solve:
+        # Warm up: one SpMV builds/caches the matrix structure and
+        # triggers kernel compilation before timing.
+        _ = A.dot(np.ones((A.shape[1],)))
+
+        if throughput:
+            assert max_iters > warmup_iters
+            p_sol, iters = linalg.cg(A, bflat, rtol=tol,
+                                     maxiter=warmup_iters)
+            max_iters = max_iters - warmup_iters
+            print(f"max_iters has been updated to: {max_iters}")
+
+        timer.start()
+        if throughput:
+            p_sol, iters = linalg.cg(A, bflat, rtol=tol, maxiter=max_iters)
+        else:
+            p_sol, iters = linalg.cg(A, bflat, rtol=tol)
+        total = timer.stop(p_sol)
+
+        if throughput:
+            print(
+                f"CG Mesh: {nx}x{ny}, A numrows: {A.shape[0]} , ms / iter:"
+                f" {total / max_iters}"
+            )
+            sys.exit(0)
+        norm_ini = float(np.linalg.norm(bflat))
+        norm_res = float(np.linalg.norm(bflat - np.asarray(A @ p_sol)))
+        if norm_res <= norm_ini * tol:
+            print(
+                f"CG converged after {iters} iterations, final residual"
+                f" relative norm: {norm_res / norm_ini}"
+            )
+        else:
+            print(
+                f"CG didn't converge after {iters} iterations, final"
+                f" residual relative norm: {norm_res / norm_ini}"
+            )
+        print(f"Total time: {total} ms")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", "--nx", type=int, default=128)
+    parser.add_argument("-m", "--ny", type=int, default=128)
+    parser.add_argument("-t", "--throughput", action="store_true")
+    parser.add_argument("--tol", type=float, default=1e-10)
+    parser.add_argument("-i", "--max-iters", type=int, default=None,
+                        dest="max_iters")
+    parser.add_argument("-w", "--warmup-iters", type=int, default=None,
+                        dest="warmup_iters")
+    args, _ = parser.parse_known_args()
+    _, timer, np, sparse, linalg, use_tpu = parse_common_args()
+
+    if args.throughput and args.max_iters is None:
+        print("Must provide --max-iters when using --throughput.")
+        sys.exit(1)
+
+    execute(
+        nx=args.nx, ny=args.ny, throughput=args.throughput, tol=args.tol,
+        max_iters=args.max_iters, warmup_iters=args.warmup_iters,
+        timer=timer,
+    )

@@ -1,0 +1,82 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""SpGEMM microbenchmark (reference
+``examples/spgemm_microbenchmark.py``).
+
+``--stable`` reuses the same matrices (the framework's cached-structure
+analog of Legion partition caching); without it, fresh matrices per
+iteration measure the full build+multiply cost.
+"""
+
+import argparse
+
+from common import (
+    banded_matrix,
+    get_arg_number,
+    get_phase_procs,
+    parse_common_args,
+)
+
+
+def get_matrices(N, nnz_per_row, fname1, fname2):
+    if fname1:
+        A = sparse.mmread(fname1)
+        if not hasattr(A, "dot"):
+            A = A.tocsr()
+        B = sparse.mmread(fname2).tocsr() if fname2 else A.copy()
+        return A, B
+    A = banded_matrix(N, nnz_per_row)
+    return A, A.copy()
+
+
+def run_spgemm(N, nnz_per_row, fname1, fname2, iters, stable, timer):
+    warmup = 5
+    if stable:
+        A, B = get_matrices(N, nnz_per_row, fname1, fname2)
+        C = None
+        for _ in range(warmup):
+            C = A @ B
+        timer.start()
+        for _ in range(iters):
+            C = A @ B
+        total = timer.stop(C.data if hasattr(C, "data") else None)
+    else:
+        total = 0.0
+        for i in range(iters + warmup):
+            A, B = get_matrices(N, nnz_per_row, fname1, fname2)
+            timer.start()
+            C = A @ B
+            t = timer.stop(C.data if hasattr(C, "data") else None)
+            if i >= warmup:
+                total += t
+    Cnnz = (A @ B).nnz
+    print(
+        f"SPGEMM {A.shape}x{B.shape} , nnz ({A.nnz})x({B.nnz})->({Cnnz}) :"
+        f" ms / iteration: {total / iters}"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-n", "--nrows", type=str, default="1k", dest="n")
+    parser.add_argument("--nnz-per-row", type=int, default=5,
+                        dest="nnz_per_row")
+    parser.add_argument("--stable", action="store_true")
+    parser.add_argument("--filename1", dest="fname_first", type=str,
+                        default="")
+    parser.add_argument("--filename2", dest="fname_second", type=str,
+                        default="")
+    parser.add_argument("-i", "--iters", type=int, default=100)
+    args, _ = parser.parse_known_args()
+    _, timer, np, sparse, linalg, use_tpu = parse_common_args()
+    get_phase_procs(use_tpu)
+
+    run_spgemm(
+        get_arg_number(args.n),
+        args.nnz_per_row,
+        args.fname_first,
+        args.fname_second,
+        args.iters,
+        args.stable,
+        timer,
+    )

@@ -1,0 +1,83 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""SpMV microbenchmark: banded matrix, N sweep (reference
+``examples/spmv_microbenchmark.py``).
+
+Prints ``SPMV rows: <N>, nnz: <nnz> , ms / iter: <t>`` per size, same
+shape as the reference (``spmv_microbenchmark.py:52``).
+"""
+
+import argparse
+
+from common import (
+    banded_matrix,
+    get_arg_number,
+    get_phase_procs,
+    parse_common_args,
+)
+
+
+def spmv_dispatch(A, x, y, i, repartition, use_out):
+    if use_out:
+        if repartition and i % 2:
+            A.dot(y, out=x)
+            return x
+        A.dot(x, out=y)
+        return y
+    if repartition and i % 2:
+        return A @ y
+    return A @ x
+
+
+def run_spmv(A, iters, repartition, timer, use_out):
+    x = np.ones((A.shape[1],))
+    y = np.zeros((A.shape[0],))
+    assert not repartition or A.shape[0] == A.shape[1]
+
+    last = None
+    for i in range(5):  # warmup (reference uses 5)
+        last = spmv_dispatch(A, x, y, i, repartition, use_out)
+
+    timer.start()
+    for i in range(iters):
+        last = spmv_dispatch(A, x, y, i, repartition, use_out)
+    total = timer.stop(last)
+
+    print(
+        f"SPMV rows: {A.shape[0]}, nnz: {A.nnz} , ms / iter: {total / iters}"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nmin", type=str, default="1k")
+    parser.add_argument("--nmax", type=str, default="1k")
+    parser.add_argument("--nnz-per-row", type=int, default=11,
+                        dest="nnz_per_row")
+    parser.add_argument("--repartition", action="store_true")
+    parser.add_argument("-f", "--filename", dest="fname", type=str,
+                        default="")
+    parser.add_argument("-i", "--iters", type=int, default=100)
+    parser.add_argument("-d", "--from-diags", action="store_true",
+                        dest="from_diags")
+    parser.add_argument("--use-out", action="store_true", dest="use_out",
+                        help="write into a preallocated output array")
+    args, _ = parser.parse_known_args()
+    _, timer, np, sparse, linalg, use_tpu = parse_common_args()
+    init_procs, bench_procs = get_phase_procs(use_tpu)
+
+    if args.fname:
+        A = sparse.mmread(args.fname)
+        if not hasattr(A, "dot"):
+            A = A.tocsr()
+        with bench_procs:
+            run_spmv(A, args.iters, args.repartition, timer, args.use_out)
+    else:
+        N = get_arg_number(args.nmin)
+        while N <= get_arg_number(args.nmax):
+            with init_procs:
+                A = banded_matrix(N, args.nnz_per_row, args.from_diags)
+            with bench_procs:
+                run_spmv(A, args.iters, args.repartition, timer,
+                         args.use_out)
+            N *= 2

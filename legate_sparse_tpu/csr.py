@@ -268,47 +268,60 @@ class csr_array(CompressedBase, DenseSparseBase):
         return self.transpose()
 
     # ---------------- cached matvec structure ----------------
-    def _get_ell(self):
-        """Lazily build/cache the ELL packing (None if padding too big or
-        the matrix structure is a tracer).  The pack itself runs on
-        device (one fused gather); only the max-row-width W is a host
-        sync, cached with the structure."""
-        if any(
-            isinstance(a, jax.core.Tracer)
-            for a in (self._data, self._indices, self._indptr)
-        ):
-            # Don't cache tracer-derived packs on the Python object
-            # (trace leak); the segment-sum path is fully traceable.
-            return None
-        if self._ell is None:
-            from .settings import settings
+    @staticmethod
+    def _can_build_cache(*arrays) -> bool:
+        """True when structure caches may be built *now*: no tracer
+        operands and no ambient trace (under omnistaging even ops on
+        concrete arrays stage into an active trace, so caching their
+        results on the Python object would leak tracers)."""
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return False
+        try:
+            from jax._src.core import trace_state_clean
+        except ImportError:  # pragma: no cover - jax internals moved
+            # Unknown trace state: never cache (the uncached path is
+            # always correct; caching inside a trace leaks tracers).
+            return False
+        return trace_state_clean()
 
-            if self._ell_width is None:
-                rows = self.shape[0]
-                self._ell_width = (
-                    max(int(jnp.max(jnp.diff(self._indptr))), 1)
-                    if rows and self.nnz
-                    else 1
-                )
-            W = self._ell_width
-            if not _spmv_ops.ell_within_budget(
-                self.shape[0], W, self.nnz, settings.ell_max_expand
-            ):
-                self._ell = False
-            else:
-                self._ell = _spmv_ops.ell_pack_device(
-                    self._data, self._indices, self._indptr,
-                    self.shape[0], W,
-                )
-        return self._ell if self._ell is not False else None
+    def _get_ell(self):
+        """Cached ELL packing, or None (padding too big / can't build
+        under an active trace).  The pack runs on device (one fused
+        gather); only the max-row-width W is a host sync, cached with
+        the structure."""
+        if self._ell is not None:
+            return self._ell if self._ell is not False else None
+        if not self._can_build_cache(self._data, self._indices,
+                                     self._indptr):
+            return None
+        from .settings import settings
+
+        if self._ell_width is None:
+            rows = self.shape[0]
+            self._ell_width = (
+                max(int(jnp.max(jnp.diff(self._indptr))), 1)
+                if rows and self.nnz
+                else 1
+            )
+        W = self._ell_width
+        if not _spmv_ops.ell_within_budget(
+            self.shape[0], W, self.nnz, settings.ell_max_expand
+        ):
+            self._ell = False
+            return None
+        self._ell = _spmv_ops.ell_pack_device(
+            self._data, self._indices, self._indptr, self.shape[0], W
+        )
+        return self._ell
 
     def _get_row_ids(self):
-        if isinstance(self._indptr, jax.core.Tracer):
+        """Cached per-nnz row ids, or a non-cached computation when a
+        cache can't be built (inside a trace / tracer structure)."""
+        if self._row_ids is not None:
+            return self._row_ids
+        if not self._can_build_cache(self._indptr):
             return _convert.row_ids_from_indptr(self._indptr, self.nnz)
-        if self._row_ids is None:
-            self._row_ids = _convert.row_ids_from_indptr(
-                self._indptr, self.nnz
-            )
+        self._row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
         return self._row_ids
 
     # ---------------- conversions ----------------

@@ -56,6 +56,22 @@ def csr_spmv_rowids(data, indices, row_ids, x, rows: int):
     )
 
 
+@partial(jax.jit, static_argnames=("rows",))
+def csr_spmv_rowids_masked(data, indices, row_ids, valid_nnz, x, rows: int):
+    """SpMV over a zero-padded nonzero suffix: slots >= ``valid_nnz``
+    contribute an exact 0 (masked product, not 0*x — preserves IEEE
+    semantics against non-finite x, same invariant as ``ell_spmv``)."""
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = jnp.where(
+        slot < valid_nnz, data * x[indices],
+        jnp.zeros((1,), dtype=data.dtype),
+    )
+    return jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+
+
 @jax.jit
 def ell_spmv(ell_data, ell_cols, ell_counts, x):
     """SpMV over ELL-packed structure: the TPU fast path.

@@ -60,7 +60,8 @@ class DistCSR:
     ``halo >= 0``, else global indices.
 
     Padded-CSR layout: ``data``/``cols`` are (R, nnz_max) with
-    ``row_ids`` (R, nnz_max) static local row ids.
+    ``row_ids`` (R, nnz_max) static local row ids and ``counts`` the
+    (R,) per-shard valid nnz (padding suffix masked in-kernel).
     """
 
     data: jax.Array
@@ -207,7 +208,8 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         reb = idx_b - (starts - halo)[:, None]
         idx_b = np.clip(reb, 0, rps + 2 * halo - 1).astype(indices.dtype)
     return DistCSR(
-        data=put(data_b), cols=put(idx_b), counts=None, row_ids=put(rid_b),
+        data=put(data_b), cols=put(idx_b),
+        counts=put(local_nnz.astype(np.int32)), row_ids=put(rid_b),
         shape=(rows, cols), rows_per_shard=rps, halo=halo, ell=False,
         mesh=mesh,
     )
@@ -250,6 +252,8 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     """
     from jax import shard_map
 
+    from ..ops import spmv as _spmv_ops
+
     halo = A.halo
 
     if A.ell:
@@ -258,29 +262,22 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
                 x_src = _extend_x(x_local, halo)
             else:
                 x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-            W = data.shape[-1]
-            slot = jnp.arange(W, dtype=counts.dtype)
-            valid = slot[None, :] < counts[0][:, None]
-            prod = jnp.where(valid, data[0] * x_src[cols[0]],
-                             jnp.zeros((1, 1), dtype=data.dtype))
-            return jnp.sum(prod, axis=1)
+            return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
+
+        args = (A.data, A.cols, A.counts, x)
     else:
         rps = A.rows_per_shard
 
-        def kernel(data, cols, row_ids, x_local):
+        def kernel(data, cols, row_ids, counts, x_local):
             if halo >= 0:
                 x_src = _extend_x(x_local, halo)
             else:
                 x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-            prod = data[0] * x_src[cols[0]]
-            return jax.ops.segment_sum(
-                prod, row_ids[0], num_segments=rps, indices_are_sorted=True
+            return _spmv_ops.csr_spmv_rowids_masked(
+                data[0], cols[0], row_ids[0], counts[0], x_src, rps
             )
 
-    args = (
-        (A.data, A.cols, A.counts, x) if A.ell
-        else (A.data, A.cols, A.row_ids, x)
-    )
+        args = (A.data, A.cols, A.row_ids, A.counts, x)
     in_specs = tuple(
         P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in args
     )

@@ -149,3 +149,28 @@ def test_data_update_reuses_structure():
     np.testing.assert_allclose(np.asarray(A @ x), [1.0, 2.0])
     A.data = np.array([3.0, 4.0])
     np.testing.assert_allclose(np.asarray(A @ x), [3.0, 4.0])
+
+
+def test_dist_padded_csr_fallback_masks_padding():
+    """Padded-CSR distributed fallback: padding slots must contribute an
+    exact 0 even when x holds non-finite values (reviewer repro)."""
+    import jax
+    from legate_sparse_tpu.parallel import shard_csr, dist_spmv
+    from legate_sparse_tpu.parallel.dist_csr import shard_vector
+    from legate_sparse_tpu.parallel.mesh import make_row_mesh
+
+    dense = np.array(
+        [[1.0, 1.0, 0.0, 0.0],
+         [0.0, 2.0, 0.0, 0.0],
+         [0.0, 1.0, 3.0, 0.0],
+         [0.0, 0.0, 0.0, 0.0]]
+    )
+    A = sparse.csr_array(dense)
+    mesh = make_row_mesh(jax.devices()[:2])
+    dA = shard_csr(A, mesh=mesh, ell_max_expand=0)  # force CSR fallback
+    assert not dA.ell
+    x = shard_vector(np.array([1.0, np.inf, 1.0, 1.0]), mesh,
+                     dA.rows_padded)
+    y = np.asarray(dist_spmv(dA, x))[:4]
+    assert np.isinf(y[0]) and np.isinf(y[1]) and np.isinf(y[2])
+    assert y[3] == 0.0
