@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from jax.numpy import asarray as jnp_asarray
+
 from .csr import csr_array
 from .utils import asarray_1d  # noqa: F401
 
@@ -68,7 +70,12 @@ def _parse_mtx_host(path: str):
 
 
 def mmread(source) -> csr_array:
-    """Read a MatrixMarket file into a csr_array."""
+    """Read a MatrixMarket file into a csr_array.
+
+    Pipeline: native (or numpy) host parse -> native stable COO->CSR
+    counting sort when available (skips the device argsort for host
+    data) -> one device transfer of the final CSR triple.
+    """
     path = str(source)
     try:
         from .utils_native import native_mtx_read
@@ -80,6 +87,20 @@ def mmread(source) -> csr_array:
         m, n, rows, cols, vals = _parse_mtx_host(path)
     else:
         m, n, rows, cols, vals = parsed
+    try:
+        from .utils_native import native_coo_to_csr
+
+        converted = native_coo_to_csr(
+            np.asarray(rows), np.asarray(cols), np.asarray(vals), m
+        )
+    except Exception:
+        converted = None
+    if converted is not None:
+        data, indices, indptr = converted
+        return csr_array._from_parts(
+            jnp_asarray(data), jnp_asarray(indices), jnp_asarray(indptr),
+            (m, n), canonical=None,
+        )
     return csr_array((vals, (rows, cols)), shape=(m, n))
 
 

@@ -21,16 +21,38 @@ _LIB = None
 _LIB_TRIED = False
 
 
+def _try_build(src_dir: str) -> None:
+    """One-shot best-effort `make -C src` (quiet; failures ignored —
+    the numpy fallbacks remain in force)."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", src_dir],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=120,
+            check=False,
+        )
+    except Exception:
+        pass
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
     here = os.path.dirname(os.path.abspath(__file__))
+    src_dir = os.path.join(here, "..", "src")
     candidates = [
-        os.path.join(here, "..", "src", "build", "liblegate_sparse_tpu.so"),
+        os.path.join(src_dir, "build", "liblegate_sparse_tpu.so"),
         os.path.join(here, "liblegate_sparse_tpu.so"),
     ]
+    if not any(os.path.exists(p) for p in candidates) and os.path.isdir(
+        src_dir
+    ):
+        _try_build(src_dir)
     for path in candidates:
         if os.path.exists(path):
             try:
@@ -56,6 +78,17 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.lst_free.restype = None
     lib.lst_free.argtypes = [ctypes.c_void_p]
+    lib.lst_coo_to_csr.restype = ctypes.c_int
+    lib.lst_coo_to_csr.argtypes = [
+        ctypes.c_int64,                      # nnz
+        ctypes.c_int64,                      # rows
+        ctypes.POINTER(ctypes.c_int64),      # row
+        ctypes.POINTER(ctypes.c_int64),      # col
+        ctypes.POINTER(ctypes.c_double),     # val
+        ctypes.POINTER(ctypes.c_int64),      # out indptr
+        ctypes.POINTER(ctypes.c_int64),      # out cols
+        ctypes.POINTER(ctypes.c_double),     # out vals
+    ]
 
 
 def native_available() -> bool:
@@ -93,3 +126,32 @@ def native_mtx_read(path: str) -> Optional[Tuple[int, int, np.ndarray, np.ndarra
         lib.lst_free(cols_p)
         lib.lst_free(vals_p)
     return m.value, n.value, rows, cols, vals
+
+
+def native_coo_to_csr(
+    row: np.ndarray, col: np.ndarray, val: np.ndarray, rows_n: int
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Stable host-side COO->CSR (counting sort by row; intra-row order
+    and duplicates preserved — same contract as the device argsort path,
+    reference ``csr.py:183-219``).  None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    nnz = int(row.shape[0])
+    row = np.ascontiguousarray(row, dtype=np.int64)
+    col = np.ascontiguousarray(col, dtype=np.int64)
+    val = np.ascontiguousarray(val, dtype=np.float64)
+    indptr = np.empty(rows_n + 1, dtype=np.int64)
+    out_cols = np.empty(nnz, dtype=np.int64)
+    out_vals = np.empty(nnz, dtype=np.float64)
+    as_p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+    rc = lib.lst_coo_to_csr(
+        nnz, int(rows_n),
+        as_p(row, ctypes.c_int64), as_p(col, ctypes.c_int64),
+        as_p(val, ctypes.c_double),
+        as_p(indptr, ctypes.c_int64), as_p(out_cols, ctypes.c_int64),
+        as_p(out_vals, ctypes.c_double),
+    )
+    if rc != 0:
+        return None
+    return out_vals, out_cols, indptr

@@ -1,0 +1,214 @@
+// Copyright 2026.
+// SPDX-License-Identifier: Apache-2.0
+//
+// Native host-side helpers for legate_sparse_tpu, exposed over a plain
+// C ABI consumed via ctypes (legate_sparse_tpu/utils_native.py).
+//
+// This is the TPU framework's counterpart of the reference's C++ leaf
+// tasks that are genuinely host work rather than accelerator compute:
+// the matrix-market parser (reference: src/sparse/io/mtx_to_coo.cc) and
+// a stable COO->CSR conversion (reference reaches this through a device
+// argsort, csr.py:183-219).  Errors return nonzero codes — callers fall
+// back to the numpy implementations.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// Read one logical line (handles very long lines) into buf; returns
+// false at EOF.
+bool read_line(FILE* f, std::string& buf) {
+  buf.clear();
+  char chunk[1 << 16];
+  while (std::fgets(chunk, sizeof(chunk), f)) {
+    buf += chunk;
+    if (!buf.empty() && buf.back() == '\n') {
+      buf.pop_back();
+      if (!buf.empty() && buf.back() == '\r') buf.pop_back();
+      return true;
+    }
+  }
+  return !buf.empty();
+}
+
+std::string lower(std::string s) {
+  for (char& c : s) c = static_cast<char>(std::tolower(c));
+  return s;
+}
+
+enum Field { FIELD_REAL, FIELD_INTEGER, FIELD_PATTERN };
+enum Symmetry { SYM_GENERAL, SYM_SYMMETRIC, SYM_SKEW };
+
+}  // namespace
+
+extern "C" {
+
+void lst_free(void* p) { std::free(p); }
+
+// Parse a MatrixMarket coordinate file.  On success (return 0) the
+// caller owns *rows/*cols/*vals (malloc'd; release with lst_free) and
+// *nnz is the entry count after symmetry expansion.
+int lst_mtx_read(const char* path, int64_t* out_m, int64_t* out_n,
+                 int64_t* out_nnz, int64_t** out_rows, int64_t** out_cols,
+                 double** out_vals) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+
+  std::string line;
+  if (!read_line(f, line)) {
+    std::fclose(f);
+    return 2;
+  }
+  // Header: %%MatrixMarket matrix coordinate <field> <symmetry>
+  char obj[64] = {0}, fmt[64] = {0}, field_s[64] = {0}, sym_s[64] = {0};
+  if (std::sscanf(line.c_str(), "%%%%MatrixMarket %63s %63s %63s %63s",
+                  obj, fmt, field_s, sym_s) != 4) {
+    std::fclose(f);
+    return 2;
+  }
+  if (lower(obj) != "matrix" || lower(fmt) != "coordinate") {
+    std::fclose(f);
+    return 3;
+  }
+  Field field;
+  std::string fs = lower(field_s);
+  if (fs == "real" || fs == "double") {
+    field = FIELD_REAL;
+  } else if (fs == "integer") {
+    field = FIELD_INTEGER;
+  } else if (fs == "pattern") {
+    field = FIELD_PATTERN;
+  } else {
+    std::fclose(f);
+    return 3;  // complex unsupported here; numpy fallback handles errors
+  }
+  Symmetry sym;
+  std::string ss = lower(sym_s);
+  if (ss == "general") {
+    sym = SYM_GENERAL;
+  } else if (ss == "symmetric") {
+    sym = SYM_SYMMETRIC;
+  } else if (ss == "skew-symmetric") {
+    sym = SYM_SKEW;
+  } else {
+    std::fclose(f);
+    return 3;
+  }
+
+  // Skip comment lines, find the dimensions line.
+  do {
+    if (!read_line(f, line)) {
+      std::fclose(f);
+      return 2;
+    }
+  } while (!line.empty() && line[0] == '%');
+
+  int64_t m = 0, n = 0, declared = 0;
+  if (std::sscanf(line.c_str(), "%ld %ld %ld", &m, &n, &declared) != 3 ||
+      m < 0 || n < 0 || declared < 0) {
+    std::fclose(f);
+    return 2;
+  }
+
+  size_t cap = static_cast<size_t>(declared) *
+               (sym == SYM_GENERAL ? 1 : 2);
+  if (cap == 0) cap = 1;
+  auto* rows = static_cast<int64_t*>(std::malloc(cap * sizeof(int64_t)));
+  auto* cols = static_cast<int64_t*>(std::malloc(cap * sizeof(int64_t)));
+  auto* vals = static_cast<double*>(std::malloc(cap * sizeof(double)));
+  if (!rows || !cols || !vals) {
+    std::free(rows);
+    std::free(cols);
+    std::free(vals);
+    std::fclose(f);
+    return 4;
+  }
+
+  size_t idx = 0;
+  int64_t seen = 0;
+  while (seen < declared && read_line(f, line)) {
+    if (line.empty()) continue;
+    char* p = const_cast<char*>(line.c_str());
+    char* end = nullptr;
+    int64_t r = std::strtoll(p, &end, 10);
+    if (end == p) continue;  // blank/garbage line
+    p = end;
+    int64_t c = std::strtoll(p, &end, 10);
+    if (end == p) { idx = 0; break; }
+    p = end;
+    double v;
+    if (field == FIELD_PATTERN) {
+      v = 1.0;
+    } else if (field == FIELD_INTEGER) {
+      v = static_cast<double>(std::strtoll(p, &end, 10));
+    } else {
+      v = std::strtod(p, &end);
+    }
+    --r;  // 1-based -> 0-based
+    --c;
+    if (r < 0 || r >= m || c < 0 || c >= n) { idx = 0; break; }
+    rows[idx] = r;
+    cols[idx] = c;
+    vals[idx] = v;
+    ++idx;
+    ++seen;
+    if (sym != SYM_GENERAL && r != c) {
+      rows[idx] = c;
+      cols[idx] = r;
+      vals[idx] = (sym == SYM_SKEW) ? -v : v;
+      ++idx;
+    }
+  }
+  std::fclose(f);
+  if (seen != declared || idx == 0) {
+    // Truncated file or malformed entry: refuse (fallback re-parses).
+    if (!(declared == 0 && idx == 0)) {
+      std::free(rows);
+      std::free(cols);
+      std::free(vals);
+      return 5;
+    }
+  }
+
+  *out_m = m;
+  *out_n = n;
+  *out_nnz = static_cast<int64_t>(idx);
+  *out_rows = rows;
+  *out_cols = cols;
+  *out_vals = vals;
+  return 0;
+}
+
+// Stable COO->CSR: counting sort by row (intra-row input order kept,
+// duplicates preserved — the same contract as the device path).
+// Caller provides out_indptr (rows_n + 1), out_cols / out_vals (nnz).
+int lst_coo_to_csr(int64_t nnz, int64_t rows_n, const int64_t* row,
+                   const int64_t* col, const double* val,
+                   int64_t* out_indptr, int64_t* out_cols,
+                   double* out_vals) {
+  if (nnz < 0 || rows_n < 0) return 1;
+  std::vector<int64_t> count(static_cast<size_t>(rows_n) + 1, 0);
+  for (int64_t i = 0; i < nnz; ++i) {
+    if (row[i] < 0 || row[i] >= rows_n) return 2;
+    ++count[static_cast<size_t>(row[i]) + 1];
+  }
+  for (int64_t r = 0; r < rows_n; ++r) count[r + 1] += count[r];
+  std::memcpy(out_indptr, count.data(),
+              (static_cast<size_t>(rows_n) + 1) * sizeof(int64_t));
+  std::vector<int64_t> cursor(count.begin(), count.end() - 1);
+  for (int64_t i = 0; i < nnz; ++i) {
+    int64_t& pos = cursor[static_cast<size_t>(row[i])];
+    out_cols[pos] = col[i];
+    out_vals[pos] = val[i];
+    ++pos;
+  }
+  return 0;
+}
+
+}  // extern "C"
