@@ -5,7 +5,7 @@
 Prints ONE JSON line::
 
     {"metric": "csr_spmv_bandwidth", "value": <GB/s>, "unit": "GB/s",
-     "vs_baseline": <fraction of measured stream bandwidth>}
+     "vs_baseline": <fraction of measured stream bandwidth>, ...}
 
 Config matches the reference's SpMV microbenchmark default (banded
 matrix, nnz/row=11 — reference ``examples/spmv_microbenchmark.py:34-52``,
@@ -13,14 +13,69 @@ matrix, nnz/row=11 — reference ``examples/spmv_microbenchmark.py:34-52``,
 achieved fraction of this chip's *measured* stream bandwidth (triad-style
 copy), i.e. the roofline fraction BASELINE.md's north-star targets
 (>= 0.70).  The reference publishes no absolute numbers (BASELINE.md).
+
+Extra keys in the same JSON object (driver contract stays one line):
+``platform`` (tpu/cpu), ``stream_gbs`` (measured roofline),
+``irregular_gbs``/``irregular_frac`` (random-sparsity matrix through the
+segment-sum fallback — the path banded ELL never exercises), and
+``spmv_ms`` (raw per-iteration time).
+
+Robustness: the TPU backend is probed in a SUBPROCESS with a timeout and
+retries before this process commits to it — a hung or erroring tunnel
+(round-1 failure: ``BENCH_r01.json`` rc=1 backend-init crash) degrades
+to a CPU run with ``"platform": "cpu"`` recorded instead of losing the
+round's data.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Probe budget must stay well inside any plausible driver timeout: a
+# hung tunnel costs (retries+1)*timeout before the CPU fallback starts,
+# and the fallback run itself still needs a few minutes.
+PROBE_TIMEOUT_S = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_TIMEOUT", "90"))
+PROBE_RETRIES = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_RETRIES", "1"))
+
+
+def _probe_accelerator() -> bool:
+    """Can a fresh process initialize the default (accelerator) backend?
+
+    Runs ``jax.devices()`` in a subprocess so a hang (unavailable TPU
+    tunnel) costs a bounded timeout, not the whole bench.
+    """
+    code = (
+        "import jax; ds = jax.devices(); "
+        "assert ds and ds[0].platform != 'cpu', ds; print('ok')"
+    )
+    for attempt in range(PROBE_RETRIES + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=PROBE_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return True
+            sys.stderr.write(
+                f"bench: accelerator probe attempt {attempt + 1} failed "
+                f"(rc={r.returncode}): {r.stderr.strip()[-400:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench: accelerator probe attempt {attempt + 1} timed out "
+                f"after {PROBE_TIMEOUT_S}s\n"
+            )
+        if attempt < PROBE_RETRIES:
+            time.sleep(min(5 * (attempt + 1), 15))
+    return False
 
 
 def _time_fn(fn, *args, warmup: int = 5, iters: int = 20) -> float:
@@ -50,43 +105,124 @@ def _stream_bandwidth() -> float:
     return bytes_moved / dt / 1e9
 
 
+def _banded_config(sparse, n: int, nnz_per_row: int):
+    half = nnz_per_row // 2
+    offsets = list(range(-half, half + 1))
+    diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
+    return sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
+                        dtype=np.float32)
+
+
+def _irregular_config(sparse, n: int, nnz_per_row: int):
+    """Random-sparsity CSR with skewed row lengths: defeats the ELL
+    budget (one heavy row) so the segment-sum fallback is what runs."""
+    rng = np.random.default_rng(0)
+    counts = rng.integers(1, 2 * nnz_per_row, size=n).astype(np.int64)
+    counts[0] = min(64 * nnz_per_row, n)  # heavy row blows the ELL budget
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    nnz = int(indptr[-1])
+    indices = rng.integers(0, n, size=nnz).astype(np.int32)
+    # Sort column indices within each row (canonical CSR).
+    row_ids = np.repeat(np.arange(n), counts)
+    order = np.lexsort((indices, row_ids))
+    indices = indices[order]
+    data = np.ones(nnz, dtype=np.float32)
+    return sparse.csr_array((data, indices, indptr), shape=(n, n))
+
+
+def _spmv_bytes(A, x) -> int:
+    """Byte-traffic model matching the kernel that actually runs.
+
+    With an active ELL cache (``A._get_ell()``) the kernel streams the
+    (rows, W) padded data/cols blocks + per-row counts (never indptr);
+    otherwise the cached-structure path (``csr_spmv_rowids``) reads
+    values + column indices + an nnz-length row-id array + x, and
+    writes y.
+    """
+    n = A.shape[0]
+    ell = A._get_ell()
+    if ell is not None:
+        ell_data, ell_cols, ell_counts = ell
+        return int(
+            ell_data.size * ell_data.dtype.itemsize
+            + ell_cols.size * ell_cols.dtype.itemsize
+            + ell_counts.size * ell_counts.dtype.itemsize
+            + n * x.dtype.itemsize          # gathered x (≥; gathers re-read)
+            + n * ell_data.dtype.itemsize   # written y
+        )
+    nnz = A.nnz
+    row_ids = A._get_row_ids()
+    return int(
+        nnz * (A.data.dtype.itemsize + A.indices.dtype.itemsize)
+        + row_ids.size * row_ids.dtype.itemsize
+        + n * x.dtype.itemsize
+        + n * A.data.dtype.itemsize
+    )
+
+
 def main() -> None:
+    use_accel = _probe_accelerator()
+    if not use_accel:
+        from legate_sparse_tpu._platform import pin_cpu
+
+        pin_cpu()
+
     import jax
     import jax.numpy as jnp
 
     import legate_sparse_tpu as sparse
 
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError as e:  # probe passed but in-process init failed
+        sys.stderr.write(f"bench: backend init failed in-process: {e}\n")
+        from legate_sparse_tpu._platform import pin_cpu
+
+        pin_cpu()
+        platform = jax.devices()[0].platform
+
     n = 1 << 20
     nnz_per_row = 11
-    half = nnz_per_row // 2
-    offsets = list(range(-half, half + 1))
-    diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
-    A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
-                     dtype=np.float32)
+    A = _banded_config(sparse, n, nnz_per_row)
     x = jnp.ones((n,), dtype=jnp.float32)
 
     # Time the shipped hot path (A @ x -> cached ELL kernel), exactly
     # what every solver iteration executes.
     dt = _time_fn(lambda: A @ x)
+    bw = _spmv_bytes(A, x) / dt / 1e9
 
-    data, indices, indptr = A.data, A.indices, A.indptr
-    nnz = A.nnz
-    # Byte traffic (BASELINE.md): values + column indices + row pointers
-    # + gathered x + written y.
-    bytes_moved = (
-        nnz * (data.dtype.itemsize + indices.dtype.itemsize)
-        + (n + 1) * indptr.dtype.itemsize
-        + n * x.dtype.itemsize
-        + n * data.dtype.itemsize
-    )
-    bw = bytes_moved / dt / 1e9
     stream = _stream_bandwidth()
-    print(json.dumps({
+
+    # Secondary config: irregular matrix -> segment-sum fallback path.
+    irregular_gbs = None
+    try:
+        A_ir = _irregular_config(sparse, n // 4, nnz_per_row)
+        x_ir = jnp.ones((A_ir.shape[0],), dtype=jnp.float32)
+        dt_ir = _time_fn(lambda: A_ir @ x_ir)
+        irregular_gbs = _spmv_bytes(A_ir, x_ir) / dt_ir / 1e9
+    except Exception as e:  # secondary metric must not kill the headline
+        sys.stderr.write(f"bench: irregular config failed: {e!r}\n")
+
+    # The contract metric (vs_baseline >= 0.70 of TPU HBM roofline) must
+    # not be satisfiable by the CPU fallback: report null off-TPU and put
+    # the fallback's roofline fraction in its own key.
+    frac = round(bw / stream, 4)
+    result = {
         "metric": "csr_spmv_bandwidth",
         "value": round(bw, 2),
         "unit": "GB/s",
-        "vs_baseline": round(bw / stream, 4),
-    }))
+        "vs_baseline": frac if platform != "cpu" else None,
+        "platform": platform,
+        "stream_gbs": round(stream, 2),
+        "spmv_ms": round(dt * 1e3, 4),
+    }
+    if platform == "cpu":
+        result["cpu_vs_baseline"] = frac
+    if irregular_gbs is not None:
+        result["irregular_gbs"] = round(irregular_gbs, 2)
+        result["irregular_frac"] = round(irregular_gbs / stream, 4)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

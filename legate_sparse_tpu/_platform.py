@@ -1,0 +1,102 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Host-platform (CPU) pinning for tests / dryruns / fallbacks.
+
+The execution environment's sitecustomize may force-register an
+accelerator platform and override ``JAX_PLATFORMS`` programmatically,
+so setting the env var alone is not sufficient — ``jax.config.update``
+must be called after the jax import as well.  And the whole pin must
+happen before any backend initializes: initializing an unavailable TPU
+tunnel can hang indefinitely (the round-1 ``MULTICHIP`` failure mode).
+
+Single source of truth for the three call sites: ``tests/conftest.py``,
+``__graft_entry__.dryrun_multichip`` and ``bench.py``'s CPU fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+# State captured by the first pin_cpu() call, for restore_platform().
+_saved: dict | None = None
+
+
+def pin_cpu(n_devices: int = 0, *, override_env: bool = True) -> None:
+    """Pin jax to the host (cpu) platform with >= n_devices devices.
+
+    Safe to call whether or not jax is already imported, but must run
+    before any jax backend is initialized (XLA_FLAGS and platform
+    selection are frozen at first backend init).  ``n_devices=0`` pins
+    the platform without touching the virtual device count.
+    """
+    global _saved
+    if _saved is None:
+        _saved = {
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS"),
+        }
+
+    if override_env:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if n_devices > 0:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+        if m is None:
+            flags = f"{flags} {_COUNT_FLAG}={n_devices}".strip()
+            os.environ["XLA_FLAGS"] = flags
+        elif int(m.group(1)) < n_devices:
+            os.environ["XLA_FLAGS"] = (
+                flags[: m.start()] + f"{_COUNT_FLAG}={n_devices}"
+                + flags[m.end():]
+            )
+
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        # Too late for XLA_FLAGS to take effect; still repoint the
+        # platform selection and drop stale backend caches.
+        sys.stderr.write(
+            "legate_sparse_tpu: pin_cpu called after backend init; "
+            "clearing backends (virtual device count may be stale)\n"
+        )
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    if "jax_platforms_prior" not in _saved:
+        _saved["jax_platforms_prior"] = jax.config.jax_platforms
+    jax.config.update("jax_platforms", "cpu")
+
+
+def restore_platform() -> None:
+    """Undo pin_cpu: put back the env vars and platform selection so a
+    later accelerator use in the same process is not silently degraded
+    (clears the now-stale cpu backend caches)."""
+    global _saved
+    if _saved is None:
+        return
+    for key in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        val = _saved.get(key)
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+    import jax
+
+    if "jax_platforms_prior" in _saved:
+        jax.config.update("jax_platforms", _saved["jax_platforms_prior"])
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    _saved = None

@@ -10,19 +10,19 @@ device-count trick instead of a pod (SURVEY §4).
 
 import os
 
-# Must be set before the jax backend initializes.  The environment's
-# sitecustomize may force-register an accelerator platform and override
-# JAX_PLATFORMS, so pin the config directly after import as well.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Must run before the jax backend initializes (see _platform.pin_cpu).
+# LEGATE_SPARSE_TPU_TEST_DEVICES re-runs the suite at a different
+# resource shape (the legate.tester analog): 1 = single device, 8 =
+# default mesh.  LEGATE_SPARSE_TPU_TEST_PLATFORM=tpu skips the pin so
+# @pytest.mark.tpu smoke tests can run on a real chip.
+TEST_DEVICES = int(os.environ.get("LEGATE_SPARSE_TPU_TEST_DEVICES", "8"))
+
+if os.environ.get("LEGATE_SPARSE_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    from legate_sparse_tpu._platform import pin_cpu
+
+    pin_cpu(TEST_DEVICES, override_env=False)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

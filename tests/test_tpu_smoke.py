@@ -1,0 +1,71 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""TPU smoke lane: the core kernels compile and match scipy on a real chip.
+
+The rest of the suite pins the cpu platform for determinism
+(``conftest.py``); this file is the continuously-runnable evidence that
+SpMV / SpGEMM / CG compile and run on the accelerator — the role of the
+reference's on-hardware ``legate --gpus 1`` test invocation.
+
+Invocation (documented driver contract)::
+
+    LEGATE_SPARSE_TPU_TEST_PLATFORM=tpu python -m pytest -m tpu tests/ -q
+
+Under the default (cpu-pinned) suite these tests skip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import linalg
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def accel():
+    """Skip unless the default platform is an accelerator."""
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        pytest.skip(
+            "no accelerator platform (set LEGATE_SPARSE_TPU_TEST_PLATFORM"
+            "=tpu to run the smoke lane on a real chip)"
+        )
+    return platform
+
+
+def _poisson(n_grid, dtype=np.float32):
+    n = n_grid * n_grid
+    return sparse.diags(
+        [-1.0, -1.0, 4.0, -1.0, -1.0],
+        [-n_grid, -1, 0, 1, n_grid],
+        shape=(n, n), format="csr", dtype=dtype,
+    )
+
+
+def test_spmv_matches_scipy(accel):
+    A = _poisson(16)
+    x = np.linspace(-1.0, 1.0, A.shape[0]).astype(np.float32)
+    y = np.asarray(A @ x)
+    y_ref = A.toscipy() @ x
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_spgemm_matches_scipy(accel):
+    A = _poisson(8)
+    C = A @ A
+    C_ref = (A.toscipy() @ A.toscipy()).tocsr()
+    C_sp = C.toscipy()
+    np.testing.assert_allclose(C_sp.toarray(), C_ref.toarray(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cg_converges(accel):
+    A = _poisson(16)
+    b = np.ones(A.shape[0], dtype=np.float32)
+    x, info = linalg.cg(A, b, rtol=1e-5, maxiter=2000)
+    res = np.linalg.norm(np.asarray(A @ np.asarray(x)) - b)
+    assert res < 1e-2 * np.linalg.norm(b)
