@@ -35,11 +35,15 @@ def max_eigenvalue(A, iters=15):
 
 class WeightedJacobi:
     """Weighted-Jacobi smoother, omega scaled by the spectral radius of
-    D^-1 A per level (reference ``gmg.py:146-198``)."""
+    D^-1 A per level (reference ``gmg.py:146-198``).  ``power_iters``
+    controls the spectral-radius power iteration (the reference leaves
+    the count to the caller; 1 matches its examples' usage but is a
+    crude Rayleigh quotient — raise it for run-for-run parity checks)."""
 
-    def __init__(self, omega=4.0 / 3.0):
+    def __init__(self, omega=4.0 / 3.0, power_iters=1):
         self.level_params = []
         self._init_omega = omega
+        self._power_iters = power_iters
 
     def init_level_params(self, A, level):
         D_inv = 1.0 / np.asarray(A.diagonal())
@@ -52,7 +56,7 @@ class WeightedJacobi:
             ),
             shape=A.shape,
         )
-        spectral_radius = max_eigenvalue(A @ D_inv_mat, 1)
+        spectral_radius = max_eigenvalue(A @ D_inv_mat, self._power_iters)
         omega = self._init_omega / spectral_radius
         self.level_params.append((omega, D_inv))
         assert len(self.level_params) - 1 == level
@@ -126,7 +130,7 @@ class GMG:
     """Geometric multigrid V-cycle used as a CG preconditioner
     (reference ``gmg.py:61-143``)."""
 
-    def __init__(self, A, shape, levels, smoother, gridop):
+    def __init__(self, A, shape, levels, smoother, gridop, power_iters=1):
         self.A = A
         self.shape = shape
         self.N = int(numpy.prod(shape))
@@ -135,7 +139,9 @@ class GMG:
             "injection": injection_operator,
             "linear": linear_operator,
         }[gridop]
-        self.smoother = {"jacobi": WeightedJacobi}[smoother]()
+        self.smoother = {"jacobi": WeightedJacobi}[smoother](
+            power_iters=power_iters
+        )
         self.operators = self.compute_operators(A)
 
     def compute_operators(self, A):
@@ -183,8 +189,65 @@ def print_diagnostics(operators):
     print(output)
 
 
+def execute_distributed(N, data, gridop, levels, maxiter, tol, verbose,
+                        power_iters, timer):
+    """Distributed GMG+CG over the device mesh (DistCSR hierarchy +
+    collective V-cycle) — the multi-chip rendition of this app."""
+    import numpy as host_np
+
+    from legate_sparse_tpu.parallel import DistGMG, shard_csr
+    from legate_sparse_tpu.parallel.dist_csr import dist_cg
+    from legate_sparse_tpu.parallel.mesh import make_row_mesh
+
+    timer.start()
+    rng = numpy.random.default_rng(0)
+    if data == "poisson":
+        from common import poisson2D as gen
+        A = gen(N)
+    elif data == "diffusion":
+        from common import diffusion2D as gen
+        A = gen(N)
+    else:
+        raise NotImplementedError(data)
+    b = rng.random(N**2)
+    print(f"GMG (distributed): {A.shape}")
+    print(f"Data creation time: {timer.stop()} ms")
+
+    timer.start()
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    gmg = DistGMG(dA, levels=levels, gridop=gridop,
+                  power_iters=power_iters)
+    print(f"GMG init time: {timer.stop()} ms")
+    print(gmg.diagnostics())
+
+    callback = None
+    if verbose:
+        def callback(x):
+            print(f"Residual: {host_np.linalg.norm(b - np.asarray(A @ np.asarray(x)))}")
+
+    timer.start()
+    x, iters = dist_cg(dA, b, M=gmg.cycle, rtol=tol, maxiter=maxiter,
+                       callback=callback)
+    total = timer.stop(x)
+
+    norm_ini = float(host_np.linalg.norm(b))
+    norm_res = float(
+        host_np.linalg.norm(b - host_np.asarray(A @ np.asarray(x)))
+    )
+    status = "Converged" if norm_res <= norm_ini * tol else (
+        "Failed to converge"
+    )
+    print(
+        f"{status} in {iters} iterations, final residual relative "
+        f"norm: {norm_res / norm_ini}"
+    )
+    print(f"Solve Time: {total} ms")
+    print(f"Iteration time: {total / max(int(iters), 1)} ms")
+
+
 def execute(N, data, smoother, gridop, levels, maxiter, tol, verbose,
-            warmup, timer):
+            warmup, timer, power_iters=1):
     build, solve = get_phase_procs(use_tpu)
 
     if warmup:
@@ -213,7 +276,7 @@ def execute(N, data, smoother, gridop, levels, maxiter, tol, verbose,
 
     timer.start()
     mg_solver = GMG(A=A, shape=(N, N), levels=levels, smoother=smoother,
-                    gridop=gridop)
+                    gridop=gridop, power_iters=power_iters)
     M = mg_solver.linear_operator()
     print(f"GMG init time: {timer.stop()} ms")
     print_diagnostics(mg_solver.operators)
@@ -257,11 +320,25 @@ if __name__ == "__main__":
     parser.add_argument("-v", "--verbose", action="store_true")
     parser.add_argument("--tol", type=float, default=1e-10)
     parser.add_argument("-w", "--warmup", action="store_true")
+    parser.add_argument("--power-iters", type=int, default=1,
+                        dest="power_iters",
+                        help="spectral-radius power-iteration count")
+    parser.add_argument("--distributed", action="store_true",
+                        help="run the DistCSR/collective V-cycle path "
+                        "over the device mesh")
     args, _ = parser.parse_known_args()
     _, timer, np, sparse, linalg, use_tpu = parse_common_args()
-    execute(
-        N=args.N, data=args.data, smoother=args.smoother,
-        gridop=args.gridop, levels=args.levels, maxiter=args.maxiter,
-        tol=args.tol, verbose=args.verbose, warmup=args.warmup,
-        timer=timer,
-    )
+    if args.distributed:
+        execute_distributed(
+            N=args.N, data=args.data, gridop=args.gridop,
+            levels=args.levels, maxiter=args.maxiter, tol=args.tol,
+            verbose=args.verbose, power_iters=args.power_iters,
+            timer=timer,
+        )
+    else:
+        execute(
+            N=args.N, data=args.data, smoother=args.smoother,
+            gridop=args.gridop, levels=args.levels, maxiter=args.maxiter,
+            tol=args.tol, verbose=args.verbose, warmup=args.warmup,
+            timer=timer, power_iters=args.power_iters,
+        )

@@ -153,6 +153,34 @@ class csr_array(CompressedBase, DenseSparseBase):
             f"indptr length {self._indptr.shape[0]} != rows+1 "
             f"({self.shape[0] + 1})"
         )
+        from .settings import settings as _settings
+
+        if _settings.check_bounds:
+            self._check_bounds()
+
+    def _check_bounds(self) -> None:
+        """Debug-mode index validation (LEGATE_SPARSE_TPU_CHECK_BOUNDS;
+        the accessor-bounds-check analog of the reference's
+        ``Legion_BOUNDS_CHECKS``, ``install.py:375-381``).  Host syncs —
+        only for debugging."""
+        import numpy as _np
+
+        indptr = _np.asarray(self._indptr)
+        indices = _np.asarray(self._indices)
+        if indptr[0] != 0 or indptr[-1] != indices.shape[0]:
+            raise IndexError(
+                f"indptr endpoints [{indptr[0]}, {indptr[-1]}] "
+                f"inconsistent with nnz={indices.shape[0]}"
+            )
+        if _np.any(_np.diff(indptr) < 0):
+            raise IndexError("indptr is not monotonically non-decreasing")
+        if indices.size and (
+            indices.min() < 0 or indices.max() >= self.shape[1]
+        ):
+            raise IndexError(
+                f"column indices out of range [0, {self.shape[1]}): "
+                f"min={indices.min()}, max={indices.max()}"
+            )
 
     @classmethod
     def _from_parts(cls, data, indices, indptr, shape,
@@ -492,7 +520,11 @@ class csr_array(CompressedBase, DenseSparseBase):
             src = self if A is self else None
             ell = src._get_ell() if src is not None else None
             if ell is not None:
-                y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
+                from .ops.pallas_spmv import ell_spmv_maybe_pallas
+
+                y = ell_spmv_maybe_pallas(ell[0], ell[1], ell[2], x)
+                if y is None:
+                    y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
             elif src is not None:
                 y = _spmv_ops.csr_spmv_rowids(
                     A.data, A.indices, src._get_row_ids(), x, self.shape[0]

@@ -96,9 +96,16 @@ def mmread(source) -> csr_array:
     except Exception:
         converted = None
     if converted is not None:
+        # Normalize to the canonical dtypes every constructor applies
+        # (coord_dtype_for / nnz_ty) so the parsed matrix has the same
+        # index dtypes whether or not the native library is present.
+        from .types import coord_dtype_for, nnz_ty
+
         data, indices, indptr = converted
         return csr_array._from_parts(
-            jnp_asarray(data), jnp_asarray(indices), jnp_asarray(indptr),
+            jnp_asarray(data),
+            jnp_asarray(indices.astype(coord_dtype_for(max(m, n)))),
+            jnp_asarray(indptr.astype(nnz_ty)),
             (m, n), canonical=None,
         )
     return csr_array((vals, (rows, cols)), shape=(m, n))

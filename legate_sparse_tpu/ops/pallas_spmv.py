@@ -1,0 +1,111 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Pallas TPU kernel for ELL SpMV (the L1 hot-loop analog).
+
+Role parity with the reference's hand-tuned SpMV leaf
+(``src/sparse/array/csr/spmv.cu:62-152``): the XLA ELL path
+(``ops/spmv.py``) is the default; this kernel is the hand-scheduled
+alternative for the case where XLA's fusion leaves bandwidth on the
+table.  Design:
+
+- x resides **whole in VMEM** (a 2^20-row f32 x is 4 MB; the kernel is
+  for single-chip/shard-local SpMV where x — or the halo window — fits).
+- The (rows, W) ELL value/column blocks stream through VMEM in
+  ``(TILE_R, W)`` tiles over a 1-D grid; each tile does one VPU gather
+  ``x[cols]``, a masked multiply, and a W-width row reduction — the
+  whole tile's HBM traffic is touched exactly once.
+- Padded slots are masked via per-row counts (products, not operands,
+  so non-finite x never injects NaN — the same IEEE invariant as
+  ``ell_spmv``).
+
+Opt-in: ``LEGATE_SPARSE_TPU_PALLAS=1`` routes ``csr_array @ x`` through
+this kernel on TPU (with transparent fallback if lowering fails);
+``interpret=True`` is used on CPU for differential testing.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+TILE_R = 256
+
+
+def _kernel(x_ref, data_ref, cols_ref, counts_ref, y_ref):
+    data = data_ref[:]                    # (TILE_R, W)
+    cols = cols_ref[:]                    # (TILE_R, W) int32
+    counts = counts_ref[:]                # (TILE_R, 1)
+    x = x_ref[:]                          # (n_pad, 1) whole vector
+    W = data.shape[1]
+    slot = jax.lax.broadcasted_iota(jnp.int32, data.shape, 1)
+    valid = slot < counts                 # (TILE_R, W)
+    gathered = jnp.take(x[:, 0], cols, axis=0)   # VPU dynamic gather
+    prod = jnp.where(valid, data * gathered,
+                     jnp.zeros((), data.dtype))
+    y_ref[:] = jnp.sum(prod, axis=1, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def pallas_ell_spmv(ell_data, ell_cols, ell_counts, x,
+                    interpret: bool = False):
+    """y = A @ x over ELL blocks via one Pallas pass (rows padded to a
+    TILE_R multiple by the caller wrapper below)."""
+    from jax.experimental import pallas as pl
+
+    rows, W = ell_data.shape
+    assert rows % TILE_R == 0, rows
+    n = x.shape[0]
+    grid = (rows // TILE_R,)
+
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 1), ell_data.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n, 1), lambda i: (0, 0)),          # x, whole
+            pl.BlockSpec((TILE_R, W), lambda i: (i, 0)),     # data tile
+            pl.BlockSpec((TILE_R, W), lambda i: (i, 0)),     # cols tile
+            pl.BlockSpec((TILE_R, 1), lambda i: (i, 0)),     # counts
+        ],
+        out_specs=pl.BlockSpec((TILE_R, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x.reshape(-1, 1), ell_data, ell_cols,
+      ell_counts.reshape(-1, 1).astype(jnp.int32))[:, 0]
+
+
+_PALLAS_OK: dict = {}
+
+
+def ell_spmv_maybe_pallas(ell_data, ell_cols, ell_counts, x):
+    """Route through the Pallas kernel when enabled and lowerable;
+    pad rows to TILE_R and truncate the result.  Returns None when the
+    route is unavailable (caller uses the XLA path)."""
+    if os.environ.get("LEGATE_SPARSE_TPU_PALLAS", "0") != "1":
+        return None
+    platform = jax.devices()[0].platform
+    interpret = platform == "cpu"
+    rows, W = ell_data.shape
+    rows_p = -(-rows // TILE_R) * TILE_R
+    key = (rows_p, W, str(ell_data.dtype), interpret)
+    if _PALLAS_OK.get(key) is False:
+        return None
+    pad = rows_p - rows
+    if pad:
+        zd = jnp.zeros((pad, W), ell_data.dtype)
+        zc = jnp.zeros((pad, W), ell_cols.dtype)
+        ell_data = jnp.concatenate([ell_data, zd])
+        ell_cols = jnp.concatenate([ell_cols, zc])
+        ell_counts = jnp.concatenate(
+            [ell_counts, jnp.zeros((pad,), ell_counts.dtype)]
+        )
+    try:
+        y = pallas_ell_spmv(ell_data, ell_cols, ell_counts, x,
+                            interpret=interpret)
+        _PALLAS_OK[key] = True
+        return y[:rows]
+    except Exception:
+        _PALLAS_OK[key] = False
+        return None

@@ -82,6 +82,19 @@ def run_heads(rows, cols):
     return jnp.concatenate([jnp.ones((1,), dtype=bool), change])
 
 
+@partial(jax.jit, static_argnames=("cap",))
+def _compress_chunk(rows, cols, vals, heads, cap: int):
+    """Merge duplicate runs into padded (cap,) triplet arrays (chunked
+    mode's compress: ``cap`` is the shared static capacity so every
+    chunk reuses one compilation; the caller slices the valid prefix)."""
+    seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int64)) - 1, 0, cap - 1)
+    # Sentinel (padding) entries carry value 0, so scatter-adding every
+    # slot is harmless wherever their clipped seg lands.
+    out_vals = jnp.zeros((cap,), dtype=vals.dtype).at[seg].add(vals)
+    head_idx = jnp.nonzero(heads, size=cap, fill_value=0)[0]
+    return rows[head_idx], cols[head_idx], out_vals
+
+
 @partial(jax.jit, static_argnames=("nnz_c", "m"))
 def compress_coo(rows, cols, vals, heads, nnz_c: int, m: int):
     """Segment-sum duplicate (row, col) runs and compact to nnz_c triplets."""
@@ -106,22 +119,156 @@ def coalesce_coo(rows, cols, vals, m: int):
     return compress_coo(rows, cols, vals, heads, nnz_c, m)
 
 
+# Diagnostic: number of expand chunks used by the most recent SpGEMM
+# (1 = single-shot ALG1-analog path).  Read by tests.
+_last_num_chunks = 1
+
+
+def _chunk_bounds(a_indices, b_indptr, num_products: int,
+                  chunk_products: int):
+    """Split the A-nonzero axis so each chunk emits <= chunk_products
+    products (single A-nonzeros emitting more get their own chunk)."""
+    counts = np.asarray(jnp.diff(b_indptr))[np.asarray(a_indices)]
+    starts = np.concatenate([[0], np.cumsum(counts, dtype=np.int64)])
+    bounds = [0]
+    while starts[bounds[-1]] < num_products:
+        nxt = int(
+            np.searchsorted(
+                starts, starts[bounds[-1]] + chunk_products, side="right"
+            ) - 1
+        )
+        nxt = max(nxt, bounds[-1] + 1)           # always make progress
+        bounds.append(min(nxt, len(starts) - 1))
+    return bounds, starts
+
+
+@partial(jax.jit, static_argnames=("cap", "span", "m"))
+def _expand_range(a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
+                  cap: int, span: int, m: int, e_lo, e_len):
+    """Expand products for A-nonzeros [e_lo, e_lo + e_len) (chunked mode).
+
+    ``cap``/``span`` are the padded product/nonzero capacities shared by
+    every chunk (``e_lo``/``e_len`` stay dynamic, so all chunks reuse
+    ONE compilation).  Surplus slots carry row sentinel ``m`` (sorts
+    last) and value 0.
+    """
+    nnz_a = a_data.shape[0]
+    a_rows = row_ids_from_indptr(a_indptr, nnz_a)
+    s = jnp.arange(span, dtype=nnz_ty)
+    valid_e = s < e_len
+    idx = jnp.clip(e_lo + s, 0, nnz_a - 1)
+    a_idx_c = a_indices[idx]
+    b_row_nnz = jnp.where(valid_e, jnp.diff(b_indptr)[a_idx_c], 0)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(b_row_nnz).astype(nnz_ty)]
+    )
+    t_local = starts[-1]
+    t = jnp.arange(cap, dtype=nnz_ty)
+    e = jnp.clip(jnp.searchsorted(starts, t, side="right") - 1, 0, span - 1)
+    valid = t < t_local
+    within = t - starts[e]
+    b_pos = jnp.clip(
+        b_indptr[a_idx_c[e]].astype(nnz_ty) + within, 0,
+        max(b_data.shape[0] - 1, 0),
+    )
+    rows = jnp.where(valid, a_rows[idx[e]], m).astype(b_indices.dtype)
+    cols = jnp.where(valid, b_indices[b_pos], 0)
+    vals = jnp.where(valid, a_data[idx[e]] * b_data[b_pos],
+                     jnp.zeros((), a_data.dtype))
+    return rows, cols, vals
+
+
 def spgemm_csr_csr_csr_impl(
     a_data, a_indices, a_indptr,
     b_data, b_indices, b_indptr,
     m: int, k: int, n: int,
+    chunk_products: int | None = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Full ESC SpGEMM.  Two host syncs (T, nnz_C) bracket the jitted
     phases — the XLA analog of the reference's two-phase launch structure
-    (``csr.py:686-748``)."""
+    (``csr.py:686-748``).
+
+    Memory modes (reference ``settings.py:35-45``, cuSPARSE ALG1 vs ALG3
+    in ``spgemm_csr_csr_csr.cu:196-216``): by default the expansion is
+    one (T,)-sized pass; when ``chunk_products`` is set (from
+    ``settings.spgemm_chunk_products`` unless ``settings.fast_spgemm``)
+    and T exceeds it, the expansion runs in bounded chunks along the
+    A-nonzero axis whose partial products are coalesced incrementally —
+    peak memory O(chunk + nnz_C) instead of O(T).
+    """
+    global _last_num_chunks
+    from ..settings import settings
+
+    if chunk_products is None and not settings.fast_spgemm:
+        chunk_products = settings.spgemm_chunk_products
+
     num_products = spgemm_num_products(a_indices, a_indptr, b_indptr)
+    val_dtype = jnp.result_type(a_data.dtype, b_data.dtype)
     if num_products == 0:
+        _last_num_chunks = 1
         cdt = coord_dtype_for(max(m, n))
         return (
-            jnp.zeros((0,), dtype=jnp.result_type(a_data.dtype, b_data.dtype)),
+            jnp.zeros((0,), dtype=val_dtype),
             jnp.zeros((0,), dtype=cdt),
             jnp.zeros((m + 1,), dtype=nnz_ty),
         )
+
+    if chunk_products is not None and num_products > chunk_products:
+        bounds, starts = _chunk_bounds(
+            a_indices, b_indptr, num_products, chunk_products
+        )
+        _last_num_chunks = len(bounds) - 1
+        # Pad every chunk to one (cap, span) -> one compiled expand.
+        cap = int(
+            max(starts[b1] - starts[b0]
+                for b0, b1 in zip(bounds[:-1], bounds[1:]))
+        )
+        span = int(max(b1 - b0 for b0, b1 in zip(bounds[:-1], bounds[1:])))
+        acc_r = acc_c = acc_v = None
+        for b0, b1 in zip(bounds[:-1], bounds[1:]):
+            r, c, v = _expand_range(
+                a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
+                cap, span, m, int(b0), int(b1 - b0),
+            )
+            r, c, v = sort_coo(r, c, v)
+            # Merge within the chunk (sentinel rows sort last; one
+            # shared-capacity compile), slice the valid prefix, fold.
+            heads = jnp.logical_and(run_heads(r, c), r < m)
+            nnz_chunk = int(jnp.sum(heads))
+            if nnz_chunk == 0:
+                continue
+            r2, c2, v2 = _compress_chunk(r, c, v, heads, cap)
+            r2, c2, v2 = (
+                r2[:nnz_chunk].astype(jnp.int64), c2[:nnz_chunk],
+                v2[:nnz_chunk],
+            )
+            if acc_r is None:
+                acc_r, acc_c, acc_v = r2, c2, v2
+            else:
+                acc_r = jnp.concatenate([acc_r, r2])
+                acc_c = jnp.concatenate([acc_c, c2])
+                acc_v = jnp.concatenate([acc_v, v2])
+            # Fold the accumulator whenever it outgrows the chunk budget
+            # so peak memory stays O(chunk + nnz_C), as documented.
+            if acc_r.shape[0] > max(chunk_products, cap):
+                f_vals, f_cols, f_indptr = coalesce_coo(
+                    acc_r, acc_c, acc_v, m
+                )
+                acc_r = row_ids_from_indptr(
+                    f_indptr, f_cols.shape[0]
+                ).astype(jnp.int64)
+                acc_c = f_cols
+                acc_v = f_vals
+        if acc_r is None:
+            cdt = coord_dtype_for(max(m, n))
+            return (
+                jnp.zeros((0,), dtype=val_dtype),
+                jnp.zeros((0,), dtype=cdt),
+                jnp.zeros((m + 1,), dtype=nnz_ty),
+            )
+        return coalesce_coo(acc_r, acc_c, acc_v, m)
+
+    _last_num_chunks = 1
     rows, cols, vals = _expand(
         a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
         num_products, m,

@@ -91,16 +91,40 @@ def ell_spmv(ell_data, ell_cols, ell_counts, x):
     return jnp.sum(prod, axis=1)
 
 
+# Above this many intermediate elements (rows*W*k), ell_spmm switches to
+# a W-slice accumulation loop instead of materializing the full
+# (rows, W, k) product tensor (~512 MB of f32 at the default cap).
+_ELL_SPMM_MATERIALIZE_CAP = 1 << 27
+
+
 @jax.jit
 def ell_spmm(ell_data, ell_cols, ell_counts, X):
-    """Y = A @ X (dense X, shape (cols, k)) over ELL-packed structure."""
-    W = ell_data.shape[1]
+    """Y = A @ X (dense X, shape (cols, k)) over ELL-packed structure.
+
+    Shapes are static under jit, so the memory strategy is picked at
+    trace time: one fused (rows, W, k) pass when it fits, else a
+    fori_loop accumulating one W-slice at a time (transient memory
+    O(rows*k) instead of O(rows*W*k))."""
+    rows, W = ell_data.shape
+    k = X.shape[1]
     slot = jnp.arange(W, dtype=ell_counts.dtype)
     valid = slot[None, :] < ell_counts[:, None]
-    prod = jnp.where(valid[:, :, None],
-                     ell_data[:, :, None] * X[ell_cols, :],
-                     jnp.zeros((1, 1, 1), dtype=ell_data.dtype))
-    return jnp.sum(prod, axis=1)
+    if rows * W * k <= _ELL_SPMM_MATERIALIZE_CAP:
+        prod = jnp.where(valid[:, :, None],
+                         ell_data[:, :, None] * X[ell_cols, :],
+                         jnp.zeros((1, 1, 1), dtype=ell_data.dtype))
+        return jnp.sum(prod, axis=1)
+
+    def body(w, Y):
+        v = jax.lax.dynamic_slice_in_dim(valid, w, 1, axis=1)       # (rows,1)
+        d = jax.lax.dynamic_slice_in_dim(ell_data, w, 1, axis=1)
+        c = jax.lax.dynamic_slice_in_dim(ell_cols, w, 1, axis=1)[:, 0]
+        contrib = jnp.where(v, d * X[c, :],
+                            jnp.zeros((1, 1), dtype=ell_data.dtype))
+        return Y + contrib
+
+    Y0 = jnp.zeros((rows, k), dtype=ell_data.dtype)
+    return jax.lax.fori_loop(0, W, body, Y0)
 
 
 def ell_within_budget(rows: int, W: int, nnz: int,

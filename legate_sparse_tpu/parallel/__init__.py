@@ -17,3 +17,6 @@ from .dist_csr import (  # noqa: F401
     dist_spmv,
     dist_cg,
 )
+from .dist_spgemm import dist_spgemm  # noqa: F401
+from .dist_csr import dist_diagonal  # noqa: F401
+from .dist_gmg import DistGMG  # noqa: F401

@@ -73,6 +73,17 @@ class DistCSR:
     halo: int           # -1 = no halo window -> all_gather realization
     ell: bool
     mesh: Mesh
+    # Precise-image gather plan (LEGATE_SPARSE_PRECISE_IMAGES): (R, R, C)
+    # local x indices shard ``src`` sends to ``dst`` via all_to_all; cols
+    # are then rebased into the compact (R*C,) receive buffer.  None =
+    # halo/all_gather realization.  (Reference ``settings.py:23-33``.)
+    gather_idx: Optional[jax.Array] = None
+    # Inverse map, sharded by *destination*: gather_globals[s, t, p] =
+    # global column of compact position t*C+p on shard s.  Lets every
+    # consumer (diagonal, SpGEMM, to_csr) recover global columns with
+    # one flat lookup.
+    gather_globals: Optional[jax.Array] = None
+    cols_per_shard: int = 0
 
     @property
     def num_shards(self) -> int:
@@ -90,10 +101,130 @@ class DistCSR:
         """A jittable ``x_padded -> y_padded`` closure for solver loops."""
         return partial(dist_spmv, self)
 
+    def to_csr(self):
+        """Gather the distributed matrix back to a host csr_array.
+
+        Test/inspection utility (the analog of the reference pulling a
+        store through ``store_to_cupynumeric_array``); O(global nnz) on
+        the host — not a scale path.
+        """
+        from ..csr import csr_array
+
+        rows, cols = self.shape
+        R = self.num_shards
+        rps = self.rows_per_shard
+        starts = np.arange(R) * rps
+        data_b = np.asarray(self.data)
+        cols_b = np.asarray(self.cols)
+        ggl = (np.asarray(self.gather_globals)
+               if self.gather_globals is not None else None)
+
+        def to_global(s, col_local):
+            if ggl is not None:      # precise: compact buffer position
+                base = ggl[s].reshape(-1)
+                rc = base.shape[0]
+                col_local = col_local.astype(np.int64)
+                own = col_local - rc + s * self.cols_per_shard
+                return np.where(
+                    col_local < rc, base[np.clip(col_local, 0, rc - 1)],
+                    own,
+                )
+            if self.halo >= 0:
+                return col_local.astype(np.int64) + (starts[s] - self.halo)
+            return col_local.astype(np.int64)
+
+        coo_r, coo_c, coo_v = [], [], []
+        if self.ell:
+            counts = np.asarray(self.counts)          # (R, rps)
+            for s in range(R):
+                for_r = np.arange(rps)[:, None]
+                W = cols_b.shape[-1]
+                slot = np.arange(W)[None, :]
+                valid = slot < counts[s][:, None]
+                gcol = to_global(s, cols_b[s])
+                r_ids = np.broadcast_to(for_r + starts[s], (rps, W))
+                coo_r.append(r_ids[valid])
+                coo_c.append(gcol[valid])
+                coo_v.append(data_b[s][valid])
+        else:
+            counts = np.asarray(self.counts)          # (R,)
+            rids_b = np.asarray(self.row_ids)
+            for s in range(R):
+                ln = int(counts[s])
+                gcol = to_global(s, cols_b[s, :ln])
+                coo_r.append(rids_b[s, :ln].astype(np.int64) + starts[s])
+                coo_c.append(gcol)
+                coo_v.append(data_b[s, :ln])
+        coo_r = np.concatenate(coo_r) if coo_r else np.zeros(0, np.int64)
+        coo_c = np.concatenate(coo_c) if coo_c else np.zeros(0, np.int64)
+        coo_v = (np.concatenate(coo_v) if coo_v
+                 else np.zeros(0, self.dtype))
+        keep = coo_r < rows  # drop padding rows
+        return csr_array(
+            (coo_v[keep], (coo_r[keep], coo_c[keep])), shape=self.shape
+        )
+
+    def toscipy(self):
+        return self.to_csr().toscipy()
+
+
+def _precise_gather_plan(indices, indptr, starts, ends, R, cps, cols):
+    """Per-shard precise image: exactly the x entries each shard reads
+    (reference precise images, ``settings.py:23-33``), as an all_to_all
+    send plan + a rebase map global col -> compact buffer position.
+
+    A shard's *own* x block never rides the collective — the compact
+    buffer is ``concat(recv.flat (R*C), x_local (cps))``, so C is the
+    max count over *off-shard* pairs only (for a banded matrix with one
+    long-range row, C stays O(1) instead of O(rps)).
+
+    Returns (gather_idx (R_src, R_dst, C), gather_globals (R_dst, R_src,
+    C), rebase: (shard, global cols) -> compact positions).
+    """
+    needed = []     # needed[s][t] = sorted unique cols shard s reads from t
+    C = 1
+    for s in range(R):
+        win = np.unique(indices[indptr[starts[s]] : indptr[ends[s]]])
+        per_t = []
+        for t in range(R):
+            sub = win[(win >= t * cps) & (win < (t + 1) * cps)]
+            per_t.append(sub)
+            if t != s:
+                C = max(C, sub.shape[0])
+        needed.append(per_t)
+    gather_idx = np.zeros((R, R, C), dtype=np.int32)
+    for s in range(R):
+        for t in range(R):
+            if t == s:
+                continue
+            sub = needed[s][t]
+            gather_idx[t, s, : sub.shape[0]] = sub - t * cps
+    gather_globals = (
+        np.transpose(gather_idx, (1, 0, 2)).astype(np.int64)
+        + (np.arange(R, dtype=np.int64) * cps)[None, :, None]
+    )
+
+    def rebase(s, cols_global):
+        flat = cols_global.reshape(-1)
+        t_of = np.clip(flat // cps, 0, R - 1)
+        res = np.empty(flat.shape[0], dtype=np.int64)
+        for t in range(R):
+            m = t_of == t
+            if not m.any():
+                continue
+            if t == s:     # own block: appended local region
+                res[m] = R * C + (flat[m] - s * cps)
+            else:
+                res[m] = t * C + np.searchsorted(needed[s][t], flat[m])
+        return np.clip(res.reshape(cols_global.shape), 0, R * C + cps - 1)
+
+    return gather_idx, gather_globals, rebase
+
 
 def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
               force_all_gather: bool = False,
-              ell_max_expand: Optional[float] = None) -> DistCSR:
+              ell_max_expand: Optional[float] = None,
+              precise: Optional[bool] = None) -> DistCSR:
     """Partition a csr_array into row blocks over a 1-D mesh.
 
     Host-side build step (the analog of Legion solving partition
@@ -107,6 +238,10 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
 
     if ell_max_expand is None:
         ell_max_expand = settings.ell_max_expand
+    if precise is None:
+        # Env default; an explicit force_all_gather argument wins over it
+        # (explicit precise=True still takes precedence over both).
+        precise = settings.precise_images and not force_all_gather
     if mesh is None:
         mesh = make_row_mesh()
     R = int(np.prod(mesh.devices.shape))
@@ -135,16 +270,35 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             col_min[s] = min(starts[s], max(cols - 1, 0))
             col_max[s] = col_min[s]
 
+    # Precise images replace the min/max window realization outright.
+    gather_idx = gather_globals = rebase_precise = None
+    cps = math.ceil(cols / R) if cols else 1   # x column-block size
+    if precise:
+        gather_idx, gather_globals, rebase_precise = _precise_gather_plan(
+            indices, indptr, starts, ends, R, cps, cols
+        )
+
     # Halo width: how far each shard's window reaches outside its own
     # row block (square matrices only — halo mode needs x and rows to be
     # conformally sharded).
     halo = -1
-    if rows == cols and not force_all_gather:
+    if rows == cols and not force_all_gather and not precise:
         left_reach = np.maximum(starts - col_min, 0)
         right_reach = np.maximum(col_max + 1 - ends, 0)
         h = int(max(left_reach.max(), right_reach.max()))
         if h <= rps:
             halo = h
+        else:
+            # The global max-window is blown (e.g. one long-range row —
+            # the reference's per-shard images keep every *other* shard
+            # narrow, ``csr.py:587-591``).  Try the precise plan and keep
+            # it if its buffer beats a full all_gather realization.
+            gi, gg, rb = _precise_gather_plan(
+                indices, indptr, starts, ends, R, cps, cols
+            )
+            if R * gi.shape[-1] + cps < R * rps:
+                precise = True
+                gather_idx, gather_globals, rebase_precise = gi, gg, rb
 
     from ..ops.spmv import ell_pack, ell_within_budget
 
@@ -176,7 +330,11 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         ell_cols = ell_cols.reshape(R, rps, W)
         ell_data = ell_data.reshape(R, rps, W)
         ell_counts = ell_counts.reshape(R, rps)
-        if halo >= 0:
+        if precise:
+            ell_cols = np.stack(
+                [rebase_precise(s, ell_cols[s]) for s in range(R)]
+            ).astype(np.int32)
+        elif halo >= 0:
             # Rebase to the halo-extended window: local = global-(start-h).
             reb = ell_cols - (starts - halo)[:, None, None]
             ell_cols = np.clip(reb, 0, rps + 2 * halo - 1).astype(
@@ -186,6 +344,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             data=put(ell_data), cols=put(ell_cols), counts=put(ell_counts),
             row_ids=None, shape=(rows, cols), rows_per_shard=rps,
             halo=halo, ell=True, mesh=mesh,
+            gather_idx=(put(gather_idx) if precise else None),
+            gather_globals=(put(gather_globals) if precise else None),
+            cols_per_shard=cps,
         )
 
     # Padded-CSR fallback: (R, nnz_max) + static row ids.
@@ -204,7 +365,11 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         )
         rid_b[s, :ln] = rid
         rid_b[s, ln:] = max(rps - 1, 0)  # padding -> last row, value 0
-    if halo >= 0:
+    if precise:
+        idx_b = np.stack(
+            [rebase_precise(s, idx_b[s]) for s in range(R)]
+        ).astype(np.int32)
+    elif halo >= 0:
         reb = idx_b - (starts - halo)[:, None]
         idx_b = np.clip(reb, 0, rps + 2 * halo - 1).astype(indices.dtype)
     return DistCSR(
@@ -212,6 +377,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         counts=put(local_nnz.astype(np.int32)), row_ids=put(rid_b),
         shape=(rows, cols), rows_per_shard=rps, halo=halo, ell=False,
         mesh=mesh,
+        gather_idx=(put(gather_idx) if precise else None),
+        gather_globals=(put(gather_globals) if precise else None),
+        cols_per_shard=cps,
     )
 
 
@@ -255,32 +423,135 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     from ..ops import spmv as _spmv_ops
 
     halo = A.halo
+    precise = A.gather_idx is not None
+
+    def realize(x_local, gidx_local=None):
+        """Per-shard x realization: precise all_to_all gather, halo
+        ppermute, or tiled all_gather — the three image strategies."""
+        if precise:
+            parts = x_local[gidx_local]            # (R_dst, C) to send
+            recv = jax.lax.all_to_all(
+                parts, ROW_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            # pos = t*C + rank for off-shard cols; own block appended.
+            return jnp.concatenate([recv.reshape(-1), x_local])
+        if halo >= 0:
+            return _extend_x(x_local, halo)
+        return jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
 
     if A.ell:
-        def kernel(data, cols, counts, x_local):
-            if halo >= 0:
-                x_src = _extend_x(x_local, halo)
-            else:
-                x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-            return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
+        if precise:
+            def kernel(data, cols, counts, gidx, x_local):
+                x_src = realize(x_local, gidx[0])
+                return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
 
-        args = (A.data, A.cols, A.counts, x)
+            args = (A.data, A.cols, A.counts, A.gather_idx, x)
+        else:
+            def kernel(data, cols, counts, x_local):
+                x_src = realize(x_local)
+                return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
+
+            args = (A.data, A.cols, A.counts, x)
     else:
         rps = A.rows_per_shard
 
-        def kernel(data, cols, row_ids, counts, x_local):
-            if halo >= 0:
-                x_src = _extend_x(x_local, halo)
-            else:
-                x_src = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-            return _spmv_ops.csr_spmv_rowids_masked(
-                data[0], cols[0], row_ids[0], counts[0], x_src, rps
-            )
+        if precise:
+            def kernel(data, cols, row_ids, counts, gidx, x_local):
+                x_src = realize(x_local, gidx[0])
+                return _spmv_ops.csr_spmv_rowids_masked(
+                    data[0], cols[0], row_ids[0], counts[0], x_src, rps
+                )
 
-        args = (A.data, A.cols, A.row_ids, A.counts, x)
+            args = (A.data, A.cols, A.row_ids, A.counts, A.gather_idx, x)
+        else:
+            def kernel(data, cols, row_ids, counts, x_local):
+                x_src = realize(x_local)
+                return _spmv_ops.csr_spmv_rowids_masked(
+                    data[0], cols[0], row_ids[0], counts[0], x_src, rps
+                )
+
+            args = (A.data, A.cols, A.row_ids, A.counts, x)
     in_specs = tuple(
         P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in args
     )
+    return shard_map(
+        kernel, mesh=A.mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+        check_vma=False,
+    )(*args)
+
+
+def dist_diagonal(A: DistCSR) -> jax.Array:
+    """diag(A) as a row-block sharded padded vector (square A).
+
+    Distributed analog of the get-diagonal task (reference
+    ``src/sparse/array/csr/get_diagonal.cc``); feeds the Jacobi
+    smoother in distributed GMG.
+    """
+    from jax import shard_map
+
+    rps = A.rows_per_shard
+    halo = A.halo
+    precise = A.gather_globals is not None
+
+    cps = A.cols_per_shard
+
+    def global_cols(cols, shard, ggl=None):
+        """Layout columns -> global columns for any realization."""
+        if precise:
+            base = ggl.reshape(-1)
+            rc = base.shape[0]
+            own = cols - rc + shard.astype(jnp.int64) * cps
+            return jnp.where(
+                cols < rc, base[jnp.clip(cols, 0, rc - 1)], own
+            )
+        if halo >= 0:
+            return cols.astype(jnp.int64) + (
+                shard.astype(jnp.int64) * rps - halo
+            )
+        return cols.astype(jnp.int64)
+
+    if A.ell:
+        def kernel(data, cols, counts, *rest):
+            data, cols, counts = data[0], cols[0], counts[0]
+            ggl = rest[0][0] if precise else None
+            shard = jax.lax.axis_index(ROW_AXIS)
+            row_g = shard.astype(jnp.int64) * rps + jnp.arange(
+                rps, dtype=jnp.int64
+            )
+            W = cols.shape[1]
+            slot = jnp.arange(W, dtype=counts.dtype)
+            valid = slot[None, :] < counts[:, None]
+            g = global_cols(cols, shard, ggl)
+            hit = jnp.logical_and(valid, g == row_g[:, None])
+            return jnp.sum(
+                jnp.where(hit, data, jnp.zeros((), data.dtype)), axis=1
+            )
+
+        args = (A.data, A.cols, A.counts) + (
+            (A.gather_globals,) if precise else ()
+        )
+    else:
+        def kernel(data, cols, row_ids, counts, *rest):
+            data, cols, row_ids, counts = (
+                data[0], cols[0], row_ids[0], counts[0]
+            )
+            ggl = rest[0][0] if precise else None
+            shard = jax.lax.axis_index(ROW_AXIS)
+            slot = jnp.arange(data.shape[0], dtype=jnp.int32)
+            valid = slot < counts
+            target = (row_ids.astype(jnp.int64)
+                      + shard.astype(jnp.int64) * rps)
+            g = global_cols(cols, shard, ggl)
+            hit = jnp.logical_and(valid, g == target)
+            return jax.ops.segment_sum(
+                jnp.where(hit, data, jnp.zeros((), data.dtype)),
+                row_ids, num_segments=rps, indices_are_sorted=True,
+            )
+
+        args = (A.data, A.cols, A.row_ids, A.counts) + (
+            (A.gather_globals,) if precise else ()
+        )
+    in_specs = tuple(P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in args)
     return shard_map(
         kernel, mesh=A.mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
         check_vma=False,
@@ -293,17 +564,23 @@ def dist_cg(
     x0=None,
     tol=None,
     maxiter: Optional[int] = None,
+    M=None,
+    callback=None,
     atol: float = 0.0,
     rtol: float = 1e-5,
     conv_test_iters: int = 25,
 ):
-    """Distributed CG: one jitted while_loop over sharded state.
+    """Distributed (optionally preconditioned) CG: one jitted while_loop
+    over sharded state.
 
     Global reductions (rho, pq, convergence norm) are jnp.vdot on sharded
     vectors — GSPMD lowers them to local dots + ``psum`` over ICI,
     replacing the reference's future-based scalar plumbing
-    (``linalg.py:507-533``).  Returns the solution truncated to the
-    unpadded length, plus the iteration count.
+    (``linalg.py:507-533``).  ``M`` is a jittable preconditioner on
+    padded sharded vectors (e.g. ``DistGMG.cycle`` — the reference's
+    headline GMG-preconditioned configuration, ``examples/gmg.py:104-143``).
+    Returns the solution truncated to the unpadded length, plus the
+    iteration count.
     """
     from ..linalg import _cg_loop, _get_atol_rtol
 
@@ -318,8 +595,47 @@ def dist_cg(
     atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
     if maxiter is None:
         maxiter = rows * 10
-    x, iters = _cg_loop(
-        A.matvec_fn(), lambda r: r, b_sh, x0_sh, atol, int(maxiter),
-        int(conv_test_iters),
-    )
+    M_mv = M if M is not None else (lambda r: r)
+    if callback is None:
+        x, iters = _cg_loop(
+            A.matvec_fn(), M_mv, b_sh, x0_sh, atol, int(maxiter),
+            int(conv_test_iters),
+        )
+        return x[:rows], iters
+
+    # Callback path: Python-driven loop so user code observes every
+    # iterate (mirrors ``linalg.cg``'s callback contract; the truncated
+    # host view of x is passed, matching the reference's semantics).
+    A_mv = A.matvec_fn()
+    x = x0_sh
+    r = b_sh - A_mv(x)
+    p = jnp.zeros_like(b_sh)
+    rho = jnp.ones((), dtype=b_sh.dtype)
+    iters = 0
+    while iters < maxiter:
+        z = M_mv(r)
+        rho_old = rho
+        rho = jnp.vdot(r, z)
+        # Same zero-division guards as _cg_loop: an exactly-converged
+        # residual must reach the convergence check, not produce NaNs.
+        beta = jnp.where(
+            jnp.logical_or(iters == 0, rho_old == 0),
+            jnp.zeros_like(rho),
+            rho / jnp.where(rho_old == 0, jnp.ones_like(rho_old), rho_old),
+        )
+        p = z + beta * p
+        q = A_mv(p)
+        pq = jnp.vdot(p, q)
+        alpha = jnp.where(
+            pq == 0, jnp.zeros_like(rho),
+            rho / jnp.where(pq == 0, jnp.ones_like(pq), pq),
+        )
+        x = x + alpha * p
+        r = r - alpha * q
+        iters += 1
+        callback(x[:rows])
+        if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
+            jnp.linalg.norm(r)
+        ) < atol:
+            break
     return x[:rows], iters

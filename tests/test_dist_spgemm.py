@@ -1,0 +1,146 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Distributed SpGEMM differential tests (8-device CPU mesh).
+
+The distributed analog of the reference's GPU single-phase SpGEMM test
+coverage (reference ``tests/integration/test_spgemm.py:25-34``), plus
+the GMG Galerkin triple product R @ A @ P the op exists to serve
+(reference ``examples/gmg.py:90-102``).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import (
+    dist_spgemm, dist_spmv, make_row_mesh, shard_csr,
+)
+
+needs_multi = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multiple devices"
+)
+
+
+def _mesh(n=None):
+    devs = jax.devices()
+    return make_row_mesh(devs if n is None else devs[:n])
+
+
+def _random_csr(rng, m, n, density=0.08, dtype=np.float64):
+    M = sp.random(m, n, density=density, random_state=rng,
+                  format="csr", dtype=dtype)
+    M.sum_duplicates()
+    return M
+
+
+def _check(dC, C_ref, rtol=1e-10):
+    C = dC.to_csr().toscipy()
+    assert C.shape == C_ref.shape
+    np.testing.assert_allclose(C.toarray(), C_ref.toarray(), rtol=rtol,
+                               atol=1e-12)
+
+
+@needs_multi
+@pytest.mark.slow
+@pytest.mark.parametrize("shape", [(64, 64, 64), (96, 40, 56), (17, 33, 9)])
+def test_dist_spgemm_random(shape):
+    rng = np.random.RandomState(7)
+    m, k, n = shape
+    A_sp = _random_csr(rng, m, k)
+    B_sp = _random_csr(rng, k, n)
+    mesh = _mesh()
+    dA = shard_csr(sparse.csr_array(A_sp), mesh=mesh)
+    dB = shard_csr(sparse.csr_array(B_sp), mesh=mesh)
+    _check(dist_spgemm(dA, dB), (A_sp @ B_sp).tocsr())
+
+
+@needs_multi
+def test_dist_spgemm_banded_ell_layout():
+    # Banded operands stay under the ELL budget -> exercises the ELL
+    # (and halo-rebased) layout path on both sides.
+    n = 128
+    A = sparse.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n),
+                     format="csr", dtype=np.float64)
+    mesh = _mesh()
+    dA = shard_csr(A, mesh=mesh)
+    assert dA.ell, "banded operand should take the ELL layout"
+    C_ref = (A.toscipy() @ A.toscipy()).tocsr()
+    _check(dist_spgemm(dA, dA), C_ref)
+
+
+@needs_multi
+def test_dist_spgemm_empty_product():
+    mesh = _mesh()
+    m, k, n = 24, 16, 24
+    A_sp = sp.csr_matrix((m, k), dtype=np.float64)
+    B_sp = sp.csr_matrix((k, n), dtype=np.float64)
+    dA = shard_csr(sparse.csr_array(A_sp), mesh=mesh)
+    dB = shard_csr(sparse.csr_array(B_sp), mesh=mesh)
+    dC = dist_spgemm(dA, dB)
+    assert dC.to_csr().nnz == 0
+    assert dC.shape == (m, n)
+
+
+@needs_multi
+@pytest.mark.slow
+def test_dist_spgemm_mixed_layouts():
+    # ELL A times padded-CSR B (skewed row lengths defeat the budget).
+    rng = np.random.RandomState(3)
+    n = 96
+    A = sparse.diags([1.0, 3.0, 1.0], [-1, 0, 1], shape=(n, n),
+                     format="csr", dtype=np.float64)
+    B_sp = _random_csr(rng, n, n, density=0.02)
+    # One heavy row blows the ELL padding budget.
+    heavy = sp.lil_matrix((n, n), dtype=np.float64)
+    heavy[0, :] = 1.0
+    B_sp = (B_sp + heavy.tocsr()).tocsr()
+    mesh = _mesh()
+    dA = shard_csr(A, mesh=mesh)
+    dB = shard_csr(sparse.csr_array(B_sp), mesh=mesh)
+    assert dA.ell and not dB.ell
+    _check(dist_spgemm(dA, dB), (A.toscipy() @ B_sp).tocsr())
+
+
+@needs_multi
+def test_dist_galerkin_triple_product():
+    """A_c = R @ A @ P — the GMG coarse-operator construction."""
+    nf, nc = 64, 32
+    A = sparse.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(nf, nf),
+                     format="csr", dtype=np.float64)
+    # Linear interpolation P (nf x nc) and restriction R = P^T / 2.
+    rows, cols, vals = [], [], []
+    for i in range(nf):
+        c = i // 2
+        if c < nc:
+            rows.append(i); cols.append(c); vals.append(0.5 + 0.5 * (i % 2))
+    P_sp = sp.csr_matrix((vals, (rows, cols)), shape=(nf, nc))
+    R_sp = (P_sp.T / 2.0).tocsr()
+    mesh = _mesh()
+    dA = shard_csr(A, mesh=mesh)
+    dP = shard_csr(sparse.csr_array(P_sp), mesh=mesh)
+    dR = shard_csr(sparse.csr_array(R_sp), mesh=mesh)
+    dAP = dist_spgemm(dA, dP)
+    dAc = dist_spgemm(dR, dAP)
+    Ac_ref = (R_sp @ (A.toscipy() @ P_sp)).tocsr()
+    _check(dAc, Ac_ref)
+
+
+@needs_multi
+@pytest.mark.slow
+def test_dist_spgemm_result_feeds_spmv():
+    """The padded-CSR product must be directly usable by dist_spmv."""
+    n = 80
+    A = sparse.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n),
+                     format="csr", dtype=np.float64)
+    mesh = _mesh()
+    dA = shard_csr(A, mesh=mesh)
+    dC = dist_spgemm(dA, dA)
+    x = np.linspace(0.0, 1.0, n)
+    from legate_sparse_tpu.parallel.dist_csr import shard_vector
+    xs = shard_vector(x, mesh, dC.rows_padded)
+    y = np.asarray(dist_spmv(dC, xs))[:n]
+    y_ref = (A.toscipy() @ A.toscipy()) @ x
+    np.testing.assert_allclose(y, y_ref, rtol=1e-10, atol=1e-12)
