@@ -134,13 +134,24 @@ def _irregular_config(sparse, n: int, nnz_per_row: int):
 def _spmv_bytes(A, x) -> int:
     """Byte-traffic model matching the kernel that actually runs.
 
-    With an active ELL cache (``A._get_ell()``) the kernel streams the
+    With an active DIA cache (exactly-banded matrix) the shifted-add
+    kernel streams the (num_diags, cols) diagonal array + x + y.  With
+    an active ELL cache (``A._get_ell()``) the kernel streams the
     (rows, W) padded data/cols blocks + per-row counts (never indptr);
     otherwise the cached-structure path (``csr_spmv_rowids``) reads
     values + column indices + an nnz-length row-id array + x, and
     writes y.
     """
     n = A.shape[0]
+    dia = A._get_dia()
+    if dia is not None:
+        dia_data, _offsets, mask = dia
+        return int(
+            dia_data.size * dia_data.dtype.itemsize
+            + (mask.size * mask.dtype.itemsize if mask is not None else 0)
+            + x.size * x.dtype.itemsize
+            + n * dia_data.dtype.itemsize
+        )
     ell = A._get_ell()
     if ell is not None:
         ell_data, ell_cols, ell_counts = ell
@@ -182,7 +193,12 @@ def main() -> None:
         pin_cpu()
         platform = jax.devices()[0].platform
 
-    n = 1 << 20
+    # Size the banded config so its byte traffic (~870 MB at 2^24 rows,
+    # W=11, f32) matches the stream measurement's (~800 MB): this chip
+    # has a multi-ms fixed dispatch overhead per op, so a small working
+    # set would measure overhead, not bandwidth.  Overridable for
+    # smaller test chips.
+    n = 1 << int(os.environ.get("LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS", "24"))
     nnz_per_row = 11
     A = _banded_config(sparse, n, nnz_per_row)
     x = jnp.ones((n,), dtype=jnp.float32)
@@ -216,6 +232,8 @@ def main() -> None:
         "platform": platform,
         "stream_gbs": round(stream, 2),
         "spmv_ms": round(dt * 1e3, 4),
+        "path": ("dia" if A._get_dia() is not None
+                 else "ell" if A._get_ell() is not None else "csr"),
     }
     if platform == "cpu":
         result["cpu_vs_baseline"] = frac

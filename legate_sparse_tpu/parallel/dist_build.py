@@ -154,10 +154,34 @@ def dist_diags(
             from ..types import coord_dtype_for
 
             ell_cols = col.astype(coord_dtype_for(n))
+        if halo >= 0:
+            # DIA fast-path blocks (gather-free dist_spmv): value of
+            # diagonal d at local row r, zeroed outside the matrix.
+            tgt = r[:, None] + offs_dev[None, :]
+            in_range = jnp.logical_and(
+                jnp.logical_and(tgt >= 0, tgt < n), r[:, None] < n
+            )                                            # (rps, W)
+            dia_block = jnp.where(
+                in_range.T, vals_by_diag, jnp.zeros((), dtype)
+            )
+            return ell_data[None], ell_cols[None], cnt[None], dia_block[None]
         return ell_data[None], ell_cols[None], cnt[None]
 
     blocks = tuple(array_blocks[d] for d in sorted(array_blocks))
     in_specs = tuple(P(ROW_AXIS, None) for _ in blocks)
+    if halo >= 0:
+        out_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                     P(ROW_AXIS, None), P(ROW_AXIS, None, None))
+        data, cols_b, counts, dia_data = shard_map(
+            kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )(*blocks)
+        return DistCSR(
+            data=data, cols=cols_b, counts=counts, row_ids=None,
+            shape=(n, n), rows_per_shard=rps, halo=halo, ell=True,
+            mesh=mesh, dia_data=dia_data,
+            dia_offsets=tuple(int(o) for o in offs.tolist()),
+        )
     out_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
                  P(ROW_AXIS, None))
     data, cols_b, counts = shard_map(

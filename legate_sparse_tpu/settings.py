@@ -69,6 +69,15 @@ class Settings:
         self.spgemm_chunk_products: int = int(
             os.environ.get("LEGATE_SPARSE_SPGEMM_CHUNK", 1 << 24)
         )
+        # SpMV fastest path: exactly-banded CSR matrices run gather-free
+        # shifted-add (DIA) kernels when num_diags*cols stays within this
+        # multiple of nnz.  Set to 0 to disable band detection.
+        self.dia_max_expand: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_DIA_EXPAND", "2.0")
+        )
+        self.dia_max_diags: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_DIA_MAX_DIAGS", "128")
+        )
 
 
 settings = Settings()
