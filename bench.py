@@ -9,7 +9,9 @@ Prints ONE JSON line::
 
 Config matches the reference's SpMV microbenchmark default (banded
 matrix, nnz/row=11 — reference ``examples/spmv_microbenchmark.py:34-52``,
-``examples/common.py:206-249``) at 2^20 rows.  ``vs_baseline`` is the
+``examples/common.py:206-249``) at 2^24 rows (~870 MB of DIA traffic,
+sized to match the stream measurement's so per-dispatch overhead does
+not mask bandwidth; override via LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS).  ``vs_baseline`` is the
 achieved fraction of this chip's *measured* stream bandwidth (triad-style
 copy), i.e. the roofline fraction BASELINE.md's north-star targets
 (>= 0.70).  The reference publishes no absolute numbers (BASELINE.md).

@@ -243,20 +243,20 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
              maxiter: int, conv_test_iters: int):
     """Whole preconditioned-CG solve as one XLA while_loop.
 
-    State carries (x, r, p, rho, iters, done).  Convergence is only
-    *tested* every ``conv_test_iters`` iterations — iteration-count
-    parity with the reference's deferred check (``linalg.py:529-533``)
-    and fewer reductions on the critical path.
+    State carries (x, r, p, rho, iters, done) plus the loop-invariant
+    (atol2, maxiter) *as state* — dynamic values rather than trace-time
+    constants, so solves with different tolerances/iteration budgets
+    (e.g. a warmup run followed by a timed run) reuse one compiled
+    loop instead of recompiling.
     """
     dtype = b.dtype
-    atol2 = jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2
 
     def cond(state):
-        x, r, p, rho, iters, done = state
-        return jnp.logical_and(iters < maxiter, jnp.logical_not(done))
+        x, r, p, rho, iters, done, atol2, miter = state
+        return jnp.logical_and(iters < miter, jnp.logical_not(done))
 
     def body(state):
-        x, r, p, rho_old, iters, done = state
+        x, r, p, rho_old, iters, done, atol2, miter = state
         z = M_mv(r)
         rho = jnp.vdot(r, z)
         # Safe divides: an exactly-zero residual (x0 == solution) must
@@ -278,11 +278,11 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
         r = r - alpha * q
         iters = iters + 1
         check = jnp.logical_or(
-            iters % conv_test_iters == 0, iters == maxiter - 1
+            iters % conv_test_iters == 0, iters == miter - 1
         )
         rnorm2 = jnp.real(jnp.vdot(r, r))
         done = jnp.logical_or(done, jnp.logical_and(check, rnorm2 < atol2))
-        return (x, r, p, rho, iters, done)
+        return (x, r, p, rho, iters, done, atol2, miter)
 
     r0 = b - A_mv(x0)
     state0 = (
@@ -292,9 +292,11 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
         jnp.ones((), dtype=dtype),
         jnp.asarray(0, dtype=jnp.int64),
         jnp.asarray(False),
+        jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2,
+        jnp.asarray(maxiter, dtype=jnp.int64),
     )
-    x, r, p, rho, iters, done = jax.lax.while_loop(cond, body, state0)
-    return x, iters
+    out = jax.lax.while_loop(cond, body, state0)
+    return out[0], out[4]
 
 
 def cg(

@@ -40,12 +40,19 @@ def _kernel(x_ref, data_ref, cols_ref, counts_ref, y_ref):
     counts = counts_ref[:]                # (TILE_R, 1)
     x = x_ref[:]                          # (n_pad, 1) whole vector
     W = data.shape[1]
-    slot = jax.lax.broadcasted_iota(jnp.int32, data.shape, 1)
-    valid = slot < counts                 # (TILE_R, W)
-    gathered = jnp.take(x[:, 0], cols, axis=0)   # VPU dynamic gather
-    prod = jnp.where(valid, data * gathered,
-                     jnp.zeros((), data.dtype))
-    y_ref[:] = jnp.sum(prod, axis=1, keepdims=True)
+    # Per-slot 2-D gathers (operand and indices both 2-D): the form
+    # Mosaic can lower, unlike a flat 1-D-operand gather with 2-D
+    # indices ("Only 2D gather is supported").  W is small (ELL width),
+    # so the static unroll stays cheap; every gather reads VMEM.
+    acc = jnp.zeros((data.shape[0], 1), dtype=data.dtype)
+    for w in range(W):
+        g = jnp.take_along_axis(
+            x, cols[:, w : w + 1].astype(jnp.int32), axis=0
+        )                                  # (TILE_R, 1)
+        valid = counts > w                 # (TILE_R, 1)
+        acc = acc + jnp.where(valid, data[:, w : w + 1] * g,
+                              jnp.zeros((), data.dtype))
+    y_ref[:] = acc
 
 
 @partial(jax.jit, static_argnames=("interpret",))

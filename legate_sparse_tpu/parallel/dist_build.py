@@ -39,6 +39,7 @@ def dist_diags(
     shape,
     mesh: Optional[Mesh] = None,
     dtype=np.float64,
+    materialize_ell: bool = True,
 ) -> DistCSR:
     """Banded ``DistCSR`` built shard-locally (scipy ``diags`` semantics).
 
@@ -54,7 +55,11 @@ def dist_diags(
       materialized).
 
     The result is the ELL layout ``shard_csr`` would pick for a banded
-    matrix, with the same halo/rebase invariants.
+    matrix, with the same halo/rebase invariants, plus DIA fast-path
+    blocks in halo mode.  ``materialize_ell=False`` (halo mode only)
+    skips the ELL blocks entirely — the memory-lean scale path: the
+    matrix then supports ``dist_spmv``/``dist_diagonal``/``to_csr``
+    (solvers) but not block consumers like ``dist_spgemm``.
     """
     if mesh is None:
         mesh = make_row_mesh()
@@ -77,6 +82,11 @@ def dist_diags(
     # neighbor block on each side.
     reach = int(max(offs.max(initial=0), -offs.min(initial=0)))
     halo = reach if reach <= rps else -1
+    if not materialize_ell and halo < 0:
+        raise ValueError(
+            "materialize_ell=False requires halo mode "
+            f"(band reach {reach} > rows-per-shard {rps})"
+        )
 
     dtype = np.dtype(dtype)
 
@@ -114,18 +124,6 @@ def dist_diags(
         start = shard.astype(jnp.int64) * rps
         r_l = jnp.arange(rps, dtype=jnp.int64)
         r = start + r_l
-        # Valid diagonal range per row: k in [-r, n-1-r].
-        lo = jnp.searchsorted(offs_dev, -r, side="left")
-        hi = jnp.searchsorted(offs_dev, n - r, side="left")
-        cnt = jnp.where(r < n, hi - lo, 0).astype(jnp.int32)
-        slot = jnp.arange(W, dtype=jnp.int32)
-        valid = slot[None, :] < cnt[:, None]
-        d_idx = jnp.clip(
-            lo[:, None] + jnp.minimum(slot[None, :],
-                                      jnp.maximum(cnt[:, None] - 1, 0)),
-            0, W - 1,
-        )
-        col = jnp.clip(r[:, None] + offs_dev[d_idx], 0, n - 1)
 
         # vals_by_diag[d, r_l] = value of diagonal d at global row r.
         vals = []
@@ -142,18 +140,34 @@ def dist_diags(
                     jnp.full((rps,), float(spec), dtype=dtype)
                 )
         vals_by_diag = jnp.stack(vals)                      # (W, rps)
-        ell_data = jnp.where(
-            valid, vals_by_diag[d_idx, r_l[:, None]],
-            jnp.zeros((), dtype),
-        )
-        if halo >= 0:
-            ell_cols = jnp.clip(
-                col - (start - halo), 0, rps + 2 * halo - 1
-            ).astype(jnp.int32)
-        else:
-            from ..types import coord_dtype_for
 
-            ell_cols = col.astype(coord_dtype_for(n))
+        outs = ()
+        if materialize_ell:
+            # Valid diagonal range per row: k in [-r, n-1-r].
+            lo = jnp.searchsorted(offs_dev, -r, side="left")
+            hi = jnp.searchsorted(offs_dev, n - r, side="left")
+            cnt = jnp.where(r < n, hi - lo, 0).astype(jnp.int32)
+            slot = jnp.arange(W, dtype=jnp.int32)
+            valid = slot[None, :] < cnt[:, None]
+            d_idx = jnp.clip(
+                lo[:, None] + jnp.minimum(slot[None, :],
+                                          jnp.maximum(cnt[:, None] - 1, 0)),
+                0, W - 1,
+            )
+            col = jnp.clip(r[:, None] + offs_dev[d_idx], 0, n - 1)
+            ell_data = jnp.where(
+                valid, vals_by_diag[d_idx, r_l[:, None]],
+                jnp.zeros((), dtype),
+            )
+            if halo >= 0:
+                ell_cols = jnp.clip(
+                    col - (start - halo), 0, rps + 2 * halo - 1
+                ).astype(jnp.int32)
+            else:
+                from ..types import coord_dtype_for
+
+                ell_cols = col.astype(coord_dtype_for(n))
+            outs += (ell_data[None], ell_cols[None], cnt[None])
         if halo >= 0:
             # DIA fast-path blocks (gather-free dist_spmv): value of
             # diagonal d at local row r, zeroed outside the matrix.
@@ -164,39 +178,40 @@ def dist_diags(
             dia_block = jnp.where(
                 in_range.T, vals_by_diag, jnp.zeros((), dtype)
             )
-            return ell_data[None], ell_cols[None], cnt[None], dia_block[None]
-        return ell_data[None], ell_cols[None], cnt[None]
+            outs += (dia_block[None],)
+        return outs
 
     blocks = tuple(array_blocks[d] for d in sorted(array_blocks))
     in_specs = tuple(P(ROW_AXIS, None) for _ in blocks)
-    if halo >= 0:
-        out_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
-                     P(ROW_AXIS, None), P(ROW_AXIS, None, None))
-        data, cols_b, counts, dia_data = shard_map(
-            kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )(*blocks)
-        return DistCSR(
-            data=data, cols=cols_b, counts=counts, row_ids=None,
-            shape=(n, n), rows_per_shard=rps, halo=halo, ell=True,
-            mesh=mesh, dia_data=dia_data,
-            dia_offsets=tuple(int(o) for o in offs.tolist()),
-        )
-    out_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+    ell_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
                  P(ROW_AXIS, None))
-    data, cols_b, counts = shard_map(
+    out_specs = (ell_specs if materialize_ell else ()) + (
+        (P(ROW_AXIS, None, None),) if halo >= 0 else ()
+    )
+    results = shard_map(
         kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     )(*blocks)
 
+    data = cols_b = counts = dia_data = None
+    if materialize_ell:
+        data, cols_b, counts = results[:3]
+        results = results[3:]
+    if halo >= 0:
+        (dia_data,) = results
+
     return DistCSR(
         data=data, cols=cols_b, counts=counts, row_ids=None,
         shape=(n, n), rows_per_shard=rps, halo=halo, ell=True, mesh=mesh,
+        dia_data=dia_data,
+        dia_offsets=(tuple(int(o) for o in offs.tolist())
+                     if halo >= 0 else None),
     )
 
 
 def dist_poisson2d(N: int, mesh: Optional[Mesh] = None,
-                   dtype=np.float64) -> DistCSR:
+                   dtype=np.float64,
+                   materialize_ell: bool = True) -> DistCSR:
     """5-point 2-D Poisson operator, built entirely on device (no host
     data of any size — the boundary pattern is a traced callable)."""
     n = N * N
@@ -209,4 +224,5 @@ def dist_poisson2d(N: int, mesh: Optional[Mesh] = None,
         [4.0, off1, off1, -1.0, -1.0],
         [0, 1, -1, N, -N],
         shape=(n, n), mesh=mesh, dtype=dtype,
+        materialize_ell=materialize_ell,
     )

@@ -64,8 +64,12 @@ class DistCSR:
     (R,) per-shard valid nnz (padding suffix masked in-kernel).
     """
 
-    data: jax.Array
-    cols: jax.Array
+    # ELL/padded-CSR blocks.  May be None for a DIA-only matrix
+    # (``dist_diags(materialize_ell=False)`` — the memory-lean scale
+    # path): then only ``dia_*`` consumers (dist_spmv, dist_diagonal,
+    # to_csr) work and block-consuming ops raise with guidance.
+    data: Optional[jax.Array]
+    cols: Optional[jax.Array]
     counts: Optional[jax.Array]
     row_ids: Optional[jax.Array]
     shape: Tuple[int, int]
@@ -97,7 +101,8 @@ class DistCSR:
 
     @property
     def num_shards(self) -> int:
-        return self.data.shape[0]
+        blocks = self.data if self.data is not None else self.dia_data
+        return blocks.shape[0]
 
     @property
     def rows_padded(self) -> int:
@@ -105,7 +110,16 @@ class DistCSR:
 
     @property
     def dtype(self):
-        return np.dtype(self.data.dtype)
+        blocks = self.data if self.data is not None else self.dia_data
+        return np.dtype(blocks.dtype)
+
+    def _require_blocks(self, op: str) -> None:
+        if self.data is None:
+            raise ValueError(
+                f"{op} needs ELL/CSR blocks, but this DistCSR is "
+                "DIA-only (built with materialize_ell=False); rebuild "
+                "with materialize_ell=True"
+            )
 
     def matvec_fn(self):
         """A jittable ``x_padded -> y_padded`` closure for solver loops."""
@@ -123,6 +137,8 @@ class DistCSR:
         rows, cols = self.shape
         R = self.num_shards
         rps = self.rows_per_shard
+        if self.data is None:
+            return self._dia_to_csr_host()
         starts = np.arange(R) * rps
         data_b = np.asarray(self.data)
         cols_b = np.asarray(self.cols)
@@ -172,6 +188,35 @@ class DistCSR:
         keep = coo_r < rows  # drop padding rows
         return csr_array(
             (coo_v[keep], (coo_r[keep], coo_c[keep])), shape=self.shape
+        )
+
+    def _dia_to_csr_host(self):
+        """DIA-only matrix back to a host csr_array (test/inspection).
+
+        Faithful: exact bands carry every in-range slot explicitly,
+        masked bands use the stored explicit-entry mask — so explicit
+        zeros and holes round-trip correctly."""
+        from ..csr import csr_array
+
+        rows, cols = self.shape
+        R, nd, rps = self.dia_data.shape
+        ddata = np.asarray(self.dia_data)
+        dmask = (np.asarray(self.dia_mask)
+                 if self.dia_mask is not None else None)
+        r_pad = np.arange(R * rps, dtype=np.int64)
+        coo_r, coo_c, coo_v = [], [], []
+        for d, o in enumerate(self.dia_offsets):
+            col = r_pad + o
+            valid = (col >= 0) & (col < cols) & (r_pad < rows)
+            if dmask is not None:
+                valid &= dmask[:, d, :].reshape(-1)
+            coo_r.append(r_pad[valid])
+            coo_c.append(col[valid])
+            coo_v.append(ddata[:, d, :].reshape(-1)[valid])
+        return csr_array(
+            (np.concatenate(coo_v), (np.concatenate(coo_r),
+                                     np.concatenate(coo_c))),
+            shape=self.shape,
         )
 
     def toscipy(self):
@@ -229,39 +274,6 @@ def _precise_gather_plan(indices, indptr, starts, ends, R, cps, cols):
         return np.clip(res.reshape(cols_global.shape), 0, R * C + cps - 1)
 
     return gather_idx, gather_globals, rebase
-
-
-def _host_band_structure(data, indices, indptr, rows, cols, nnz,
-                         canonical):
-    """Host-side band detection mirroring ``csr_array._get_dia``:
-    returns (sorted offsets ndarray, global scipy-layout DIA array,
-    explicit-entry mask or None-when-exact), else None when the
-    structure is not band-representable within the expansion budget."""
-    from ..ops.dia_ops import band_cover
-    from ..settings import settings
-
-    if not nnz or not canonical or settings.dia_max_expand <= 0:
-        return None
-    row_ids = np.repeat(np.arange(rows, dtype=np.int64), np.diff(indptr))
-    d = indices.astype(np.int64) - row_ids
-    offs = np.unique(d)
-    nd = offs.shape[0]
-    if nd > settings.dia_max_diags or nd * cols > (
-        settings.dia_max_expand * nnz
-    ):
-        return None
-    d_idx = np.searchsorted(offs, d)
-    dia = np.zeros((nd, cols), dtype=data.dtype)
-    dia[d_idx, indices] = data
-    exact = band_cover(
-        tuple(int(o) for o in offs), (rows, cols), cols
-    ) == nnz
-    if exact:
-        mask = None
-    else:
-        mask = np.zeros((nd, cols), dtype=bool)
-        mask[d_idx, indices] = True
-    return offs, dia, mask
 
 
 def _dia_shard_blocks(offs, dia_global, R, rps, rows, cols, dtype):
@@ -359,27 +371,29 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
                 precise = True
                 gather_idx, gather_globals, rebase_precise = gi, gg, rb
 
-    # Banded fast path: exactly-banded matrices in halo mode also carry
-    # per-shard DIA blocks so dist_spmv runs gather-free shifted-adds
-    # (same structure/exactness guard as csr_array._get_dia).
+    # Banded fast path: banded matrices in halo mode also carry
+    # per-shard DIA blocks so dist_spmv runs gather-free shifted-adds.
+    # Detection, budgets and the exact/masked split all live in
+    # ``csr_array._get_dia`` (single source of truth; this also warms
+    # A's own single-chip cache).
     dia_offs = dia_blocks = dia_mask_blocks = None
     if halo >= 0:
-        band = _host_band_structure(
-            data, indices, indptr, rows, cols, nnz,
-            A.has_canonical_format,
-        )
-        if band is not None:
-            offs_b, dia_global, mask_global = band
+        dia_cache = A._get_dia()
+        if dia_cache is not None:
+            dia_dev, offs_t, mask_dev = dia_cache
+            offs_b = np.asarray(offs_t, dtype=np.int64)
             mo = int(max(offs_b.max(initial=0), -offs_b.min(initial=0)))
             if mo <= rps:
                 halo = max(halo, mo)
-                dia_offs = tuple(int(o) for o in offs_b.tolist())
+                dia_offs = offs_t
                 dia_blocks = _dia_shard_blocks(
-                    offs_b, dia_global, R, rps, rows, cols, data.dtype
+                    offs_b, np.asarray(dia_dev), R, rps, rows, cols,
+                    data.dtype,
                 )
-                if mask_global is not None:
+                if mask_dev is not None:
                     dia_mask_blocks = _dia_shard_blocks(
-                        offs_b, mask_global, R, rps, rows, cols, bool
+                        offs_b, np.asarray(mask_dev), R, rps, rows,
+                        cols, bool,
                     )
 
     from ..ops.spmv import ell_pack, ell_within_budget
@@ -561,6 +575,8 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
             out_specs=P(ROW_AXIS), check_vma=False,
         )(*args)
 
+    A._require_blocks("dist_spmv")
+
     def realize(x_local, gidx_local=None):
         """Per-shard x realization: precise all_to_all gather, halo
         ppermute, or tiled all_gather — the three image strategies."""
@@ -626,6 +642,17 @@ def dist_diagonal(A: DistCSR) -> jax.Array:
     from jax import shard_map
 
     rps = A.rows_per_shard
+
+    if A.dia_data is not None:
+        # Banded: the main diagonal is one (R, rps) slice of the DIA
+        # blocks (0 at holes/padding already).
+        offs = A.dia_offsets
+        if 0 not in offs:
+            return jnp.zeros((A.rows_padded,), dtype=A.dtype)
+        d0 = offs.index(0)
+        return jnp.reshape(A.dia_data[:, d0, :], (-1,))
+
+    A._require_blocks("dist_diagonal")
     halo = A.halo
     precise = A.gather_globals is not None
 

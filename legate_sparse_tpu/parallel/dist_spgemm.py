@@ -220,6 +220,8 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     """
     if A.shape[1] != B.shape[0]:
         raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
+    A._require_blocks("dist_spgemm")
+    B._require_blocks("dist_spgemm")
     if A.mesh is not B.mesh and A.mesh != B.mesh:
         raise ValueError("operands must share a mesh")
     if A.rows_padded < A.shape[0] or B.rows_padded < B.shape[0]:
