@@ -143,6 +143,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._indices = indices
         self._indptr = indptr
         self._canonical = canonical
+        self._sorted = True if canonical else None
         # Cached static structure for the SpMV hot path (the analog of
         # Legion caching image partitions across solver iterations,
         # reference §3.2): built lazily on first matvec.
@@ -205,6 +206,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         out._row_ids = self._row_ids  # sparsity structure is shared
         out._ell_width = self._ell_width
         out._dia_offsets = self._dia_offsets
+        out._sorted = self._sorted
         return out
 
     # ---------------- properties ----------------
@@ -248,6 +250,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._canonical = None
+        self._sorted = None
 
     @property
     def indptr(self):
@@ -273,7 +276,23 @@ class csr_array(CompressedBase, DenseSparseBase):
 
     @property
     def has_sorted_indices(self) -> bool:
-        return self.has_canonical_format
+        """Non-decreasing indices within every row (duplicates allowed —
+        weaker than canonical; scipy's ``has_sorted_indices``)."""
+        if self._canonical:
+            return True
+        if getattr(self, "_sorted", None) is None:
+            if self.nnz < 2:
+                self._sorted = True
+            else:
+                row_ids = _convert.row_ids_from_indptr(
+                    self._indptr, self.nnz
+                )
+                same_row = row_ids[1:] == row_ids[:-1]
+                nondecreasing = self._indices[1:] >= self._indices[:-1]
+                self._sorted = bool(
+                    jnp.all(jnp.logical_or(~same_row, nondecreasing))
+                )
+        return self._sorted
 
     def sum_duplicates(self) -> None:
         """Merge duplicate (row, col) entries in place (scipy contract)."""
@@ -287,6 +306,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._indices = indices.astype(self._indices.dtype)
         self._indptr = indptr
         self._canonical = True
+        self._sorted = True
         self._row_ids = None
         self._ell = None
         self._ell_width = None
@@ -455,6 +475,108 @@ class csr_array(CompressedBase, DenseSparseBase):
             ),
             shape=self.shape,
         )
+
+    def todia(self, copy: bool = False):
+        """Convert to ``dia_array`` (scipy ``.todia()`` semantics: every
+        distinct ``col - row`` becomes a stored diagonal).  Reuses the
+        banded-structure machinery behind the SpMV fast path."""
+        from .dia import dia_array
+
+        a = self._canonicalized()
+        rows, cols = self.shape
+        if a.nnz == 0:
+            # scipy parity: empty DIA (no stored diagonals).
+            return dia_array(
+                (jnp.zeros((0, 0), dtype=self.dtype),
+                 jnp.zeros((0,), dtype=jnp.int64)),
+                shape=self.shape,
+            )
+        offsets = _dia_ops.csr_band_offsets(
+            a._indices, a._get_row_ids(), max(rows + cols, 1)
+        )
+        dia_data = _dia_ops.dia_from_csr(
+            a._data, a._indices, a._get_row_ids(), offsets, cols
+        )
+        return dia_array(
+            (dia_data, jnp.asarray(offsets, dtype=jnp.int64)),
+            shape=self.shape,
+        )
+
+    def asformat(self, format, copy: bool = False):
+        """Return this matrix in the given format, scipy ``asformat``
+        semantics ('csr' and 'dia'; there is no coo array class — use
+        ``tocoo()`` for the (row, col, data) view)."""
+        if format is None or format == "csr":
+            return self.tocsr(copy=copy)
+        if format == "dia":
+            return self.todia(copy=copy)
+        raise ValueError(f"unsupported format: {format!r}")
+
+    # ---------------- structure maintenance ----------------
+    def getnnz(self, axis=None):
+        """nnz total, or per-row / per-column counts (scipy semantics)."""
+        if axis is None:
+            return self.nnz
+        if axis in (1, -1):
+            return jnp.diff(self._indptr)
+        if axis == 0:
+            return (
+                jnp.zeros((self.shape[1],), dtype=nnz_ty)
+                .at[self._indices]
+                .add(1)
+            )
+        raise ValueError(f"invalid axis: {axis}")
+
+    def eliminate_zeros(self):
+        """Drop explicit zero entries in place (scipy semantics; one
+        host sync for the new nnz — the XLA static-shape analog of the
+        reference's blocking ``int(nnz)``)."""
+        mask = self._data != 0
+        new_nnz = int(jnp.sum(mask))
+        if new_nnz == self.nnz:
+            return
+        keep = jnp.nonzero(mask, size=new_nnz)[0]
+        row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
+        new_rows = row_ids[keep]
+        self._data = self._data[keep]
+        self._indices = self._indices[keep]
+        self._indptr = _convert.indptr_from_row_ids(
+            new_rows, self.shape[0]
+        )
+        self._row_ids = None
+        self._ell = None
+        self._ell_width = None
+        self._dia = None
+        self._dia_offsets = None
+
+    def sort_indices(self):
+        """Sort column indices within each row in place (stable; no
+        duplicate merging — scipy ``sort_indices`` semantics)."""
+        if self.has_sorted_indices:
+            return
+        row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
+        _, indices, data = jax.lax.sort(
+            [row_ids, self._indices, self._data], num_keys=2,
+            is_stable=True,
+        )
+        self._data = data
+        self._indices = indices
+        self._canonical = None
+        self._sorted = True
+        self._row_ids = None
+        self._ell = None
+        self._dia = None
+        self._dia_offsets = None
+
+    def power(self, n, dtype=None):
+        """Element-wise power (scipy semantics: duplicates are summed
+        first — scipy applies ``_deduped_data()`` — then each stored
+        entry is raised)."""
+        a = self._canonicalized()
+        data = a._data
+        if dtype is not None:
+            data = data.astype(dtype)
+        return a._with_data(data**n)
 
     # ---------------- element/structure ops ----------------
     def diagonal(self, k: int = 0):
@@ -712,10 +834,54 @@ def spmv(A: csr_array, x, y):
 
 
 def spgemm_csr_csr_csr(A: csr_array, B: csr_array) -> csr_array:
-    """C = A @ B via expand-sort-compress (reference ``csr.py:598-748``)."""
+    """C = A @ B (reference ``csr.py:598-748``).
+
+    Banded fast path: when both operands are *exact* bands (DIA caches
+    with no hole mask), C is the Minkowski-sum band computed as
+    nd_a*nd_b shifted elementwise multiplies — no expansion, no device
+    sort.  This covers the SpGEMM microbenchmark's banded config and
+    products of stencil operators.  Everything else runs the general
+    expand-sort-compress kernel.
+    """
     assert A.shape[1] == B.shape[0], "dimension mismatch in spgemm"
     m, k = A.shape
     n = B.shape[1]
+
+    from .settings import settings
+
+    dia_a = A._get_dia()
+    dia_b = B._get_dia() if dia_a is not None else None
+    if (
+        dia_a is not None
+        and dia_b is not None
+        and dia_a[2] is None
+        and dia_b[2] is None
+    ):
+        offs_c = _dia_ops.band_product_offsets(dia_a[1], dia_b[1])
+        nnz_c = _dia_ops.band_cover(offs_c, (m, n), n)
+        if (
+            len(offs_c) <= settings.dia_max_diags
+            and len(offs_c) * n <= settings.dia_max_expand * max(nnz_c, 1)
+            # scipy pattern parity: every in-bounds product slot must be
+            # structurally reachable, else the ESC kernel decides nnz.
+            and _dia_ops.band_product_is_full(
+                dia_a[1], dia_b[1], offs_c, A.shape, B.shape
+            )
+        ):
+            Cd = _dia_ops.dia_spgemm(
+                dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
+                A.shape, B.shape,
+            )
+            data, indices, indptr = _dia_ops.band_to_csr(
+                Cd, offs_c, (m, n), nnz_c
+            )
+            C = csr_array._from_parts(data, indices, indptr, (m, n))
+            # The product band is exact by construction: warm C's own
+            # fast-path cache for downstream matvecs (GMG coarse ops).
+            C._dia_offsets = offs_c
+            C._dia = (Cd, offs_c, None)
+            return C
+
     data, indices, indptr = _spgemm_ops.spgemm_csr_csr_csr_impl(
         A.data, A.indices, A.indptr, B.data, B.indices, B.indptr, m, k, n
     )

@@ -167,3 +167,108 @@ def dia_spmm(data: jax.Array, X: jax.Array, offsets: Tuple[int, ...],
             data[d, j_lo:j_hi, None] * X[j_lo:j_hi, :]
         )
     return Y
+
+
+def band_product_offsets(offs_a: Tuple[int, ...],
+                         offs_b: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Diagonals of C = A @ B for banded operands: the Minkowski sum."""
+    return tuple(sorted({oa + ob for oa in offs_a for ob in offs_b}))
+
+
+def band_product_is_full(offs_a, offs_b, offs_c, shape_a, shape_b) -> bool:
+    """True when every in-bounds slot of the product band is
+    structurally reachable (some (oa, ob) pair contributes to it), i.e.
+    the banded SpGEMM's full-band output has exactly the pattern the
+    structural (Gustavson/ESC) product would produce.  Host arithmetic
+    on static offsets only.
+
+    At matrix boundaries a slot can be in-bounds yet unreachable (e.g.
+    A = {-1} only, B = {+1} only: slot (0, 0) needs t = -1).  Such
+    products must take the general kernel to keep scipy pattern parity.
+    """
+    m, k = shape_a
+    _, n = shape_b
+    by_oc: dict = {o: [] for o in offs_c}
+    for oa in offs_a:
+        for ob in offs_b:
+            j_lo = max(0, ob, oa + ob)
+            j_hi = min(n, k + ob, m + oa + ob)
+            if j_hi > j_lo:
+                by_oc[oa + ob].append((j_lo, j_hi))
+    for oc in offs_c:
+        want_lo, want_hi = max(0, oc), min(n, m + oc)
+        if want_hi <= want_lo:
+            continue
+        covered = want_lo
+        for lo, hi in sorted(by_oc[oc]):
+            if lo > covered:
+                return False
+            covered = max(covered, hi)
+        if covered < want_hi:
+            return False
+    return True
+
+
+@partial(jax.jit, static_argnames=("offs_a", "offs_b", "offs_c",
+                                   "shape_a", "shape_b"))
+def dia_spgemm(a_data, b_data, offs_a: Tuple[int, ...],
+               offs_b: Tuple[int, ...], offs_c: Tuple[int, ...],
+               shape_a: Tuple[int, int], shape_b: Tuple[int, int]):
+    """C_dia = A_dia @ B_dia as nd_a*nd_b shifted elementwise multiplies.
+
+    For banded operands this replaces the ESC SpGEMM's expand/sort/
+    compress (O(T log T) with device-wide sorts) by pure streaming
+    multiply-adds with static slice bounds — the same gather-free
+    principle as ``dia_spmv``.  C[i, j] = sum_t A[i, t] B[t, j] with
+    t = j - ob, i = j - oa - ob; all bounds are static per (oa, ob).
+    """
+    m, k = shape_a
+    _, n = shape_b
+    idx_c = {o: i for i, o in enumerate(offs_c)}
+    Cd = jnp.zeros(
+        (len(offs_c), n),
+        dtype=jnp.result_type(a_data.dtype, b_data.dtype),
+    )
+    for a_i, oa in enumerate(offs_a):
+        for b_i, ob in enumerate(offs_b):
+            oc = oa + ob
+            j_lo = max(0, ob, oc)
+            j_hi = min(n, k + ob, m + oc)
+            if j_hi <= j_lo:
+                continue
+            contrib = (
+                a_data[a_i, j_lo - ob : j_hi - ob]
+                * b_data[b_i, j_lo:j_hi]
+            )
+            Cd = Cd.at[idx_c[oc], j_lo:j_hi].add(contrib)
+    return Cd
+
+
+@partial(jax.jit, static_argnames=("offsets", "shape", "nnz"))
+def band_to_csr(dia_data, offsets: Tuple[int, ...],
+                shape: Tuple[int, int], nnz: int):
+    """Full-band DIA -> CSR triple keeping every in-bounds band slot
+    (incl. explicit zeros), ``nnz = band_cover(offsets, shape, cols)``.
+    Offsets must be sorted; rows come out canonical."""
+    from ..types import coord_dtype_for, nnz_ty
+
+    rows, cols = shape
+    offs = jnp.asarray(offsets, dtype=jnp.int64)
+    i = jnp.arange(rows, dtype=jnp.int64)
+    # Valid offsets per row: o in [-i, cols-1-i] (contiguous in sorted offs).
+    lo = jnp.searchsorted(offs, -i, side="left")
+    hi = jnp.searchsorted(offs, cols - i, side="left")
+    counts = hi - lo
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), dtype=nnz_ty),
+         jnp.cumsum(counts).astype(nnz_ty)]
+    )
+    row_ids = jnp.repeat(i, counts, total_repeat_length=nnz)
+    pos_in_row = (
+        jnp.arange(nnz, dtype=jnp.int64)
+        - indptr[row_ids].astype(jnp.int64)
+    )
+    d_idx = lo[row_ids] + pos_in_row
+    col = row_ids + offs[d_idx]
+    vals = dia_data[d_idx, col]
+    return vals, col.astype(coord_dtype_for(max(rows, cols))), indptr

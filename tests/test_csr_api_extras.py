@@ -1,0 +1,139 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""scipy-API surface extras on csr_array: todia/asformat/getnnz/
+eliminate_zeros/sort_indices/power — differential vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.fixture
+def S():
+    S = scsp.random(60, 50, density=0.08, format="csr", random_state=5)
+    S.data[::7] = 0.0  # explicit zeros for eliminate_zeros
+    return S
+
+
+def test_todia_roundtrip(S):
+    A = sparse.csr_array(S)
+    D = A.todia()
+    assert D.data.shape[0] == S.todia().data.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(D.tocsr().todense()), S.toarray(), atol=1e-12
+    )
+
+
+def test_todia_banded_small():
+    A = sparse.diags([[1.0, 2.0], [3.0, 4.0, 5.0]], [-1, 0],
+                     shape=(3, 3), format="csr")
+    D = A.todia()
+    np.testing.assert_array_equal(np.asarray(D.offsets), [-1, 0])
+    np.testing.assert_allclose(
+        np.asarray(D.tocsr().todense()),
+        scsp.diags([[1.0, 2.0], [3.0, 4.0, 5.0]], [-1, 0]).toarray(),
+    )
+
+
+def test_asformat(S):
+    A = sparse.csr_array(S)
+    assert A.asformat("csr") is A
+    assert A.asformat(None) is A
+    from legate_sparse_tpu.dia import dia_array
+
+    assert isinstance(A.asformat("dia"), dia_array)
+    with pytest.raises(ValueError):
+        A.asformat("lil")
+
+
+def test_getnnz(S):
+    A = sparse.csr_array(S)
+    assert A.getnnz() == S.nnz
+    np.testing.assert_array_equal(np.asarray(A.getnnz(axis=1)),
+                                  S.getnnz(axis=1))
+    np.testing.assert_array_equal(np.asarray(A.getnnz(axis=0)),
+                                  S.getnnz(axis=0))
+
+
+def test_eliminate_zeros(S):
+    A = sparse.csr_array(S)
+    S2 = S.copy()
+    S2.eliminate_zeros()
+    A.eliminate_zeros()
+    assert A.nnz == S2.nnz
+    np.testing.assert_allclose(np.asarray(A.todense()), S2.toarray(),
+                               atol=1e-12)
+    # idempotent
+    A.eliminate_zeros()
+    assert A.nnz == S2.nnz
+
+
+def test_eliminate_zeros_invalidates_caches():
+    A = sparse.diags([[1.0, 0.0, 2.0]], [0], shape=(3, 3), format="csr")
+    x = np.array([1.0, 1.0, 1.0])
+    y0 = np.asarray(A @ x)
+    A.eliminate_zeros()
+    np.testing.assert_allclose(np.asarray(A @ x), y0, atol=1e-12)
+    assert A.nnz == 2
+
+
+def test_sort_indices():
+    data = np.array([1.0, 2.0, 3.0])
+    indices = np.array([3, 1, 2])
+    indptr = np.array([0, 2, 3])
+    A = sparse.csr_array((data, indices, indptr), shape=(2, 4))
+    Su = scsp.csr_array((data, indices, indptr), shape=(2, 4))
+    A.sort_indices()
+    Su.sort_indices()
+    np.testing.assert_array_equal(np.asarray(A.indices), Su.indices)
+    np.testing.assert_allclose(np.asarray(A.data), Su.data)
+
+
+def test_power(S):
+    A = sparse.csr_array(S)
+    np.testing.assert_allclose(
+        np.asarray(A.power(3).todense()), S.power(3).toarray(), atol=1e-12
+    )
+
+
+def test_power_coalesces_duplicates():
+    """scipy's power sums duplicates before raising; ours must too."""
+    r = np.array([0, 0])
+    c = np.array([0, 0])
+    v = np.array([1.0, 2.0])
+    A = sparse.csr_array((v, (r, c)), shape=(1, 1))
+    Sd = scsp.coo_array((v, (r, c)), shape=(1, 1)).tocsr()
+    np.testing.assert_allclose(
+        np.asarray(A.power(2).todense()), Sd.power(2).toarray()
+    )  # (1+2)^2 = 9, not 1^2 + 2^2
+
+
+def test_todia_empty():
+    A = sparse.csr_array(
+        (np.zeros(0), np.zeros(0, np.int64), np.zeros(2, np.int64)),
+        shape=(1, 3),
+    )
+    D = A.todia()
+    assert D.data.shape[0] == 0  # scipy: no stored diagonals
+    SD = scsp.csr_array((np.zeros(0), np.zeros(0, np.int64),
+                         np.zeros(2, np.int64)), shape=(1, 3)).todia()
+    assert SD.data.shape[0] == 0
+
+
+def test_sort_indices_stable_with_duplicates():
+    data = np.array([1.0, 2.0, 3.0])
+    indices = np.array([2, 2, 0])
+    indptr = np.array([0, 3, 3])
+    A = sparse.csr_array((data, indices, indptr), shape=(2, 3))
+    Su = scsp.csr_array((data.copy(), indices.copy(), indptr.copy()),
+                        shape=(2, 3))
+    A.sort_indices()
+    Su.sort_indices()
+    np.testing.assert_array_equal(np.asarray(A.indices), Su.indices)
+    np.testing.assert_allclose(np.asarray(A.data), Su.data)
+    assert A.has_sorted_indices
+    # second call is a no-op (flag cached despite duplicates)
+    A.sort_indices()
+    np.testing.assert_allclose(np.asarray(A.data), Su.data)

@@ -215,3 +215,58 @@ def test_dia_rectangular_not_crashing():
     S = scsp.diags(diags, offsets, shape=(5, 6), format="csr")
     x = np.random.default_rng(8).normal(size=6)
     np.testing.assert_allclose(np.asarray(A @ x), S @ x, rtol=1e-10)
+
+
+def test_banded_spgemm_fast_path():
+    """Exact-band @ exact-band runs the Minkowski-band kernel with
+    scipy nnz parity and warms the product's own DIA cache."""
+    n = 96
+    offsA = [-2, 0, 1]
+    offsB = [-1, 0, 3]
+    dA = [np.random.default_rng(i).normal(size=n - abs(o))
+          for i, o in enumerate(offsA)]
+    dB = [np.random.default_rng(9 + i).normal(size=n - abs(o))
+          for i, o in enumerate(offsB)]
+    A = sparse.diags(dA, offsA, shape=(n, n), format="csr")
+    B = sparse.diags(dB, offsB, shape=(n, n), format="csr")
+    SA = scsp.diags(dA, offsA, shape=(n, n), format="csr")
+    SB = scsp.diags(dB, offsB, shape=(n, n), format="csr")
+    C = A @ B
+    SC = SA @ SB
+    np.testing.assert_allclose(
+        np.asarray(C.todense()), SC.toarray(), rtol=1e-9, atol=1e-12
+    )
+    assert C.nnz == SC.nnz
+    assert C._dia not in (None, False)  # product cache pre-warmed
+    x = np.random.default_rng(3).normal(size=n)
+    np.testing.assert_allclose(np.asarray(C @ x), SC @ x, rtol=1e-8)
+
+
+def test_banded_spgemm_unreachable_slot_falls_back():
+    """A={-1} @ B={+1}: slot (0,0) is in-bounds but structurally
+    unreachable; the product must keep scipy's pattern (ESC path)."""
+    n = 32
+    A = sparse.diags([np.ones(n - 1)], [-1], shape=(n, n), format="csr")
+    B = sparse.diags([np.ones(n - 1)], [1], shape=(n, n), format="csr")
+    SC = (scsp.diags([np.ones(n - 1)], [-1], format="csr", shape=(n, n))
+          @ scsp.diags([np.ones(n - 1)], [1], format="csr", shape=(n, n)))
+    C = A @ B
+    assert C.nnz == SC.nnz
+    np.testing.assert_allclose(np.asarray(C.todense()), SC.toarray(),
+                               atol=1e-12)
+
+
+def test_banded_spgemm_rectangular():
+    A = sparse.diags([np.ones(50), np.ones(50)], [0, 1],
+                     shape=(50, 60), format="csr")
+    B = sparse.diags([np.ones(55), np.ones(55)], [0, -5],
+                     shape=(60, 55), format="csr")
+    SA = scsp.diags([np.ones(50), np.ones(50)], [0, 1],
+                    shape=(50, 60), format="csr")
+    SB = scsp.diags([np.ones(55), np.ones(55)], [0, -5],
+                    shape=(60, 55), format="csr")
+    C = A @ B
+    SC = SA @ SB
+    assert C.nnz == SC.nnz
+    np.testing.assert_allclose(np.asarray(C.todense()), SC.toarray(),
+                               atol=1e-12)
