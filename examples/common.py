@@ -113,7 +113,29 @@ def parse_common_args():
         choices=["tpu", "legate", "scipy"],
         help="'tpu' (alias 'legate') = this framework; 'scipy' = host baseline",
     )
+    parser.add_argument(
+        "--profile",
+        type=str,
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the run into DIR "
+             "(the Legion Prof analog: named_scope provenance from the "
+             "coverage layer shows up as trace annotations)",
+    )
     args, _ = parser.parse_known_args()
+
+    if args.profile and args.package in ("tpu", "legate"):
+        # tpu path only: the scipy baseline must stay JAX-free (and its
+        # trace would carry none of the named_scope annotations anyway).
+        import atexit
+
+        import jax
+
+        jax.profiler.start_trace(args.profile)
+        atexit.register(jax.profiler.stop_trace)
+        print(f"profiling -> {args.profile} (view with TensorBoard)")
+    elif args.profile:
+        print("--profile ignored for --package scipy (JAX-free baseline)")
 
     if args.package in ("tpu", "legate"):
         timer = JaxTimer()

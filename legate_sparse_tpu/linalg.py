@@ -478,3 +478,133 @@ def gmres(
         if float(jnp.linalg.norm(b - A_op.matvec(x))) < atol:
             break
     return x, iters
+
+
+def _safe_div(num, den):
+    """num/den with an exact-0 result (not NaN) when den == 0 — lets
+    exactly-converged states flow to the convergence check."""
+    return jnp.where(
+        den == 0, jnp.zeros_like(num),
+        num / jnp.where(den == 0, jnp.ones_like(den), den),
+    )
+
+
+def _bicgstab_body(A_mv: Callable, M_mv: Callable, conv_test_iters: int):
+    """One BiCGSTAB iteration as a state->state function (shared by the
+    while_loop path and the callback path, so both run the identical
+    algorithm with carried shadow-residual/direction state)."""
+
+    def body(state):
+        (x, r, rtilde, p, v, rho_prev, alpha, omega, iters, done, atol2,
+         miter) = state
+        rho = jnp.vdot(rtilde, r)
+        beta = _safe_div(rho, rho_prev) * _safe_div(alpha, omega)
+        first = iters == 0
+        p = jnp.where(first, r, r + beta * (p - omega * v))
+        phat = M_mv(p)
+        v = A_mv(phat)
+        alpha = _safe_div(rho, jnp.vdot(rtilde, v))
+        s = r - alpha * v
+        shat = M_mv(s)
+        t = A_mv(shat)
+        omega = _safe_div(jnp.vdot(t, s), jnp.vdot(t, t))
+        x = x + alpha * phat + omega * shat
+        r = s - omega * t
+        iters = iters + 1
+        check = jnp.logical_or(
+            iters % conv_test_iters == 0, iters == miter - 1
+        )
+        rnorm2 = jnp.real(jnp.vdot(r, r))
+        done = jnp.logical_or(done, jnp.logical_and(check, rnorm2 < atol2))
+        return (x, r, rtilde, p, v, rho, alpha, omega, iters, done,
+                atol2, miter)
+
+    return body
+
+
+def _bicgstab_state0(A_mv, b, x0, atol, maxiter):
+    r0 = b - A_mv(x0)
+    one = jnp.ones((), dtype=b.dtype)
+    return (
+        x0, r0, r0, jnp.zeros_like(b), jnp.zeros_like(b),
+        one, one, one,
+        jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False),
+        jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2,
+        jnp.asarray(maxiter, dtype=jnp.int64),
+    )
+
+
+def _bicgstab_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
+                   maxiter: int, conv_test_iters: int):
+    """Whole preconditioned-BiCGSTAB solve as one XLA while_loop (same
+    state-carried (atol2, maxiter) and deferred-convergence design as
+    ``_cg_loop``)."""
+
+    def cond(state):
+        return jnp.logical_and(
+            state[8] < state[11], jnp.logical_not(state[9])
+        )
+
+    body = _bicgstab_body(A_mv, M_mv, conv_test_iters)
+    out = jax.lax.while_loop(
+        cond, body, _bicgstab_state0(A_mv, b, x0, atol, maxiter)
+    )
+    return out[0], out[8]
+
+
+def bicgstab(
+    A,
+    b,
+    x0=None,
+    tol=None,
+    maxiter=None,
+    M=None,
+    callback=None,
+    atol=0.0,
+    rtol=1e-5,
+    conv_test_iters: int = 25,
+):
+    """BiCGSTAB solve of ``A x = b`` (scipy-shaped signature).
+
+    Beyond-reference solver (the reference ships cg/gmres only,
+    ``linalg.py:465-668``): handles non-symmetric systems without
+    GMRES's restart memory, entirely jitted like ``cg``.
+    """
+    A_op = make_linear_operator(A)
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    assert b.ndim == 1
+    assert len(A_op.shape) == 2 and A_op.shape[0] == A_op.shape[1]
+    n = b.shape[0]
+    bnrm2 = float(jnp.linalg.norm(b))
+    atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
+    if maxiter is None:
+        maxiter = n * 10
+    M_op = (
+        IdentityOperator(A_op.shape, dtype=A_op.dtype)
+        if M is None
+        else make_linear_operator(M)
+    )
+    x0_arr = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+              else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+    if callback is None:
+        return _bicgstab_loop(
+            A_op.matvec, M_op.matvec, b, x0_arr, atol, int(maxiter),
+            int(conv_test_iters),
+        )
+    # Callback path: step the SAME state->state iteration (shadow
+    # residual and direction state carried across steps) Python-side so
+    # user code observes every iterate; r lives in the state, so the
+    # convergence check costs no extra matvec.
+    body = jax.jit(_bicgstab_body(A_op.matvec, M_op.matvec,
+                                  conv_test_iters=1))
+    state = _bicgstab_state0(A_op.matvec, b, x0_arr, atol, int(maxiter))
+    iters = 0
+    while iters < maxiter:
+        state = body(state)
+        iters = int(state[8])
+        callback(state[0])
+        if bool(state[9]):  # done flag: ||r|| < atol at the cadence
+            break
+    return state[0], iters

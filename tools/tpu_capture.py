@@ -1,0 +1,155 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""One-shot TPU evidence capture: run when the chip is reachable.
+
+Probes the accelerator (bounded subprocess), then records in sequence:
+1. bench.py JSON line (the driver-contract metric),
+2. the @pytest.mark.tpu smoke lane,
+3. Pallas ELL kernel lowering check + timing vs the XLA paths,
+4. CG ms/iter on the pde operator (2048^2 grid, f32).
+
+Appends everything to TPU_EVIDENCE.md with a timestamp so perf claims
+in the repo are backed by recorded runs.
+
+Usage: python tools/tpu_capture.py  (from the repo root)
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "TPU_EVIDENCE.md")
+
+
+def probe(timeout_s: int = 90) -> bool:
+    code = ("import jax; ds = jax.devices(); "
+            "assert ds and ds[0].platform != 'cpu', ds; print('ok')")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True, cwd=ROOT)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run(cmd, timeout_s):
+    try:
+        r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                           text=True, cwd=ROOT)
+        return r.returncode, r.stdout[-4000:], r.stderr[-2000:]
+    except subprocess.TimeoutExpired:
+        return 124, "", "timeout"
+
+
+KERNEL_TIMING = r"""
+import time, json
+import numpy as np, jax, jax.numpy as jnp
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.ops import spmv as spmv_ops
+
+def t(fn, *a, iters=20, warm=3):
+    for _ in range(warm):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+n, W = 1 << 22, 11
+half = W // 2
+offs = list(range(-half, half + 1))
+diags = [np.ones(n - abs(o), dtype=np.float32) for o in offs]
+A = sparse.diags(diags, offs, shape=(n, n), format="csr", dtype=np.float32)
+x = jnp.ones((n,), jnp.float32)
+res = {"n": n, "W": W, "platform": jax.devices()[0].platform}
+res["dia_ms"] = round(t(lambda: A @ x) * 1e3, 3)
+ell = A._get_ell()
+if ell is None:
+    from legate_sparse_tpu.ops.spmv import ell_pack_device
+    ell = ell_pack_device(A.data, A.indices, A.indptr, n, W)
+res["ell_xla_ms"] = round(t(spmv_ops.ell_spmv, ell[0], ell[1], ell[2], x) * 1e3, 3)
+try:
+    from legate_sparse_tpu.ops.pallas_spmv import pallas_ell_spmv
+    res["ell_pallas_ms"] = round(t(pallas_ell_spmv, ell[0], ell[1], ell[2], x) * 1e3, 3)
+except Exception as e:
+    res["ell_pallas_error"] = repr(e)[:200]
+print(json.dumps(res))
+"""
+
+CG_TIMING = r"""
+import time, json
+import numpy as np, jax
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+
+N = 2048
+n = N * N
+main = np.full(n, 4.0, np.float32)
+off1 = np.full(n - 1, -1.0, np.float32)
+off1[np.arange(1, N) * N - 1] = 0.0
+offn = np.full(n - N, -1.0, np.float32)
+A = sparse.diags([main, off1, off1, offn, offn], [0, 1, -1, N, -N],
+                 shape=(n, n), format="csr", dtype=np.float32)
+b = np.ones(n, np.float32)
+x, it = linalg.cg(A, b, rtol=1e-6, maxiter=50)   # warmup + compile
+jax.block_until_ready(x)
+t0 = time.perf_counter()
+x, it = linalg.cg(A, b, rtol=0.0, maxiter=200)
+jax.block_until_ready(x)
+dt = time.perf_counter() - t0
+print(json.dumps({"grid": f"{N}x{N}", "rows": n,
+                  "cg_ms_per_iter": round(dt / int(it) * 1e3, 4),
+                  "iters": int(it),
+                  "platform": jax.devices()[0].platform}))
+"""
+
+
+def main() -> None:
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    if not probe():
+        print(f"{stamp}: TPU unreachable; nothing recorded")
+        sys.exit(1)
+    lines = [f"\n## Capture {stamp}\n"]
+
+    rc, out, err = run([sys.executable, "bench.py"], 900)
+    lines.append(f"### bench.py (rc={rc})\n```json\n{out.strip()}\n```\n")
+    if rc != 0:
+        lines.append(f"stderr: `{err[-500:]}`\n")
+
+    rc, out, err = run(
+        [sys.executable, "-m", "pytest", "-m", "tpu", "tests/", "-q"], 900
+    )
+    tail = "\n".join(out.strip().splitlines()[-3:])
+    lines.append(f"### tpu smoke lane (rc={rc})\n```\n{tail}\n```\n")
+    if rc != 0:
+        lines.append(f"stderr: `{err[-500:]}`\n")
+
+    rc, out, err = run([sys.executable, "-c", KERNEL_TIMING], 900)
+    lines.append(f"### kernel timings (rc={rc})\n```json\n{out.strip()}\n```\n")
+    if rc != 0:
+        lines.append(f"stderr: `{err[-500:]}`\n")
+
+    rc, out, err = run([sys.executable, "-c", CG_TIMING], 900)
+    lines.append(f"### CG pde 2048^2 f32 (rc={rc})\n```json\n{out.strip()}\n```\n")
+    if rc != 0:
+        lines.append(f"stderr: `{err[-500:]}`\n")
+
+    header = "" if os.path.exists(OUT) else (
+        "# TPU evidence log\n\nRecorded runs on the real chip backing "
+        "the perf claims in README.md / code comments.\n"
+    )
+    with open(OUT, "a") as f:
+        f.write(header + "".join(lines))
+    print(f"recorded -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
