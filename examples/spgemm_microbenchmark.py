@@ -56,6 +56,32 @@ def run_spgemm(N, nnz_per_row, fname1, fname2, iters, stable, timer):
     )
 
 
+def run_spgemm_distributed(N, nnz_per_row, iters, timer):
+    """Distributed banded product over the device mesh: exact-band
+    operands ride the ppermute-halo Minkowski kernel (no all_gather)."""
+    from legate_sparse_tpu.parallel import dist_spgemm, shard_csr
+    from legate_sparse_tpu.parallel.mesh import make_row_mesh
+
+    warmup = 5
+    mesh = make_row_mesh()
+    A = banded_matrix(N, nnz_per_row)
+    dA = shard_csr(A, mesh=mesh)
+    dB = shard_csr(A.copy(), mesh=mesh)
+    C = None
+    for _ in range(warmup):
+        C = dist_spgemm(dA, dB)
+    timer.start()
+    for _ in range(iters):
+        C = dist_spgemm(dA, dB)
+    total = timer.stop(C.dia_data if C.dia_data is not None else C.data)
+    path = "band" if C.dia_data is not None else "esc"
+    print(
+        f"SPGEMM (distributed, {path}) {A.shape}x{A.shape} over "
+        f"{int(np.prod(mesh.devices.shape))} devices : "
+        f"ms / iteration: {total / iters}"
+    )
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("-n", "--nrows", type=str, default="1k", dest="n")
@@ -67,9 +93,20 @@ if __name__ == "__main__":
     parser.add_argument("--filename2", dest="fname_second", type=str,
                         default="")
     parser.add_argument("-i", "--iters", type=int, default=100)
+    parser.add_argument("--distributed", action="store_true",
+                        help="banded product over the device mesh "
+                             "(tpu backend only)")
     args, _ = parser.parse_known_args()
     _, timer, np, sparse, linalg, use_tpu = parse_common_args()
     get_phase_procs(use_tpu)
+
+    if args.distributed:
+        if not use_tpu:
+            raise SystemExit("--distributed requires the tpu backend")
+        run_spgemm_distributed(
+            get_arg_number(args.n), args.nnz_per_row, args.iters, timer
+        )
+        raise SystemExit(0)
 
     run_spgemm(
         get_arg_number(args.n),

@@ -33,6 +33,42 @@ from .mesh import ROW_AXIS, make_row_mesh
 DiagSpec = Union[float, int, np.ndarray, Callable]
 
 
+def band_ell_local(vals_by_diag, offs_dev, n: int, rps: int, halo: int,
+                   start, r, r_l):
+    """Per-shard full-band -> ELL assembly (shared by ``dist_diags`` and
+    the banded distributed SpGEMM): given row-indexed diagonal values
+    ``vals_by_diag`` (W, rps) and sorted ``offs_dev``, produce
+    (ell_data, ell_cols, cnt) with the standard padded-slot conventions
+    (padding replicates the clamped column with value 0; cols rebased to
+    the halo window when ``halo >= 0``)."""
+    dtype = vals_by_diag.dtype
+    W = vals_by_diag.shape[0]
+    # Valid diagonal range per row: o in [-r, n-1-r].
+    lo = jnp.searchsorted(offs_dev, -r, side="left")
+    hi = jnp.searchsorted(offs_dev, n - r, side="left")
+    cnt = jnp.where(r < n, hi - lo, 0).astype(jnp.int32)
+    slot = jnp.arange(W, dtype=jnp.int32)
+    valid = slot[None, :] < cnt[:, None]
+    d_idx = jnp.clip(
+        lo[:, None] + jnp.minimum(slot[None, :],
+                                  jnp.maximum(cnt[:, None] - 1, 0)),
+        0, W - 1,
+    )
+    col = jnp.clip(r[:, None] + offs_dev[d_idx], 0, n - 1)
+    ell_data = jnp.where(
+        valid, vals_by_diag[d_idx, r_l[:, None]], jnp.zeros((), dtype)
+    )
+    if halo >= 0:
+        ell_cols = jnp.clip(
+            col - (start - halo), 0, rps + 2 * halo - 1
+        ).astype(jnp.int32)
+    else:
+        from ..types import coord_dtype_for
+
+        ell_cols = col.astype(coord_dtype_for(n))
+    return ell_data, ell_cols, cnt
+
+
 def dist_diags(
     diagonals: Sequence[DiagSpec],
     offsets: Sequence[int],
@@ -143,30 +179,9 @@ def dist_diags(
 
         outs = ()
         if materialize_ell:
-            # Valid diagonal range per row: k in [-r, n-1-r].
-            lo = jnp.searchsorted(offs_dev, -r, side="left")
-            hi = jnp.searchsorted(offs_dev, n - r, side="left")
-            cnt = jnp.where(r < n, hi - lo, 0).astype(jnp.int32)
-            slot = jnp.arange(W, dtype=jnp.int32)
-            valid = slot[None, :] < cnt[:, None]
-            d_idx = jnp.clip(
-                lo[:, None] + jnp.minimum(slot[None, :],
-                                          jnp.maximum(cnt[:, None] - 1, 0)),
-                0, W - 1,
+            ell_data, ell_cols, cnt = band_ell_local(
+                vals_by_diag, offs_dev, n, rps, halo, start, r, r_l
             )
-            col = jnp.clip(r[:, None] + offs_dev[d_idx], 0, n - 1)
-            ell_data = jnp.where(
-                valid, vals_by_diag[d_idx, r_l[:, None]],
-                jnp.zeros((), dtype),
-            )
-            if halo >= 0:
-                ell_cols = jnp.clip(
-                    col - (start - halo), 0, rps + 2 * halo - 1
-                ).astype(jnp.int32)
-            else:
-                from ..types import coord_dtype_for
-
-                ell_cols = col.astype(coord_dtype_for(n))
             outs += (ell_data[None], ell_cols[None], cnt[None])
         if halo >= 0:
             # DIA fast-path blocks (gather-free dist_spmv): value of

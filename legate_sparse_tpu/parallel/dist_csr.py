@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -514,68 +514,65 @@ def _extend_x(x_local, halo: int):
     return jnp.concatenate([from_left, x_local, from_right])
 
 
-def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
-    """y = A @ x with row-block parallelism (jittable).
+@lru_cache(maxsize=256)
+def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
+                 rps: int, n_rows: int, has_mask: bool):
+    """Cached shard_map callable for the banded dist SpMV.
 
-    ``x`` and the result are row-block sharded vectors of length
-    ``A.rows_padded``.  The distribution contract matches the reference
-    SpMV task (``csr.py:562-593``): y aligned with the row partition,
-    x gathered per the column image (halo ppermute or all_gather).
+    Structure-keyed caching is the Legion partition-cache analog: a
+    fresh closure per call would be a new jit identity, so repeated
+    direct ``dist_spmv`` calls (microbenchmarks, user loops outside
+    ``dist_cg``) would re-trace and recompile every time.
     """
     from jax import shard_map
 
-    from ..ops import spmv as _spmv_ops
-
-    halo = A.halo
-    precise = A.gather_idx is not None
-
-    if A.dia_data is not None and halo >= 0 and not precise:
-        # Banded fast path: halo exchange + static shifted-adds, zero
-        # gathers (the per-shard analog of ``ops.dia_ops.dia_spmv``).
-        rps = A.rows_per_shard
-        offsets = A.dia_offsets
-        n_rows = A.shape[0]
-
-        has_mask = A.dia_mask is not None
-
-        def dia_kernel(ddata, x_local, *rest):
-            x_ext = _extend_x(x_local, halo)
-            dd = ddata[0]                               # (nd, rps)
-            dm = rest[0][0] if has_mask else None
-            shard = jax.lax.axis_index(ROW_AXIS)
-            r_g = shard.astype(jnp.int64) * rps + jnp.arange(
-                rps, dtype=jnp.int64
-            )
-            y = jnp.zeros((rps,), dtype=dd.dtype)
-            for d, o in enumerate(offsets):
-                seg = jax.lax.slice_in_dim(
-                    x_ext, halo + o, halo + o + rps
-                )
-                # Mask *products* outside the matrix (and band holes in
-                # masked mode): ring-wrapped halo values, padding rows
-                # and holes carry weight 0, but 0*inf must not inject
-                # NaN (same IEEE invariant as ell_spmv).
-                if has_mask:
-                    valid = dm[d]
-                else:
-                    valid = jnp.logical_and(
-                        jnp.logical_and(r_g + o >= 0, r_g + o < n_rows),
-                        r_g < n_rows,
-                    )
-                y = y + jnp.where(valid, dd[d] * seg,
-                                  jnp.zeros((), dd.dtype))
-            return y
-
-        args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
-        in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS)) + (
-            (P(ROW_AXIS, None, None),) if has_mask else ()
+    def dia_kernel(ddata, x_local, *rest):
+        x_ext = _extend_x(x_local, halo)
+        dd = ddata[0]                               # (nd, rps)
+        dm = rest[0][0] if has_mask else None
+        shard = jax.lax.axis_index(ROW_AXIS)
+        r_g = shard.astype(jnp.int64) * rps + jnp.arange(
+            rps, dtype=jnp.int64
         )
-        return shard_map(
-            dia_kernel, mesh=A.mesh, in_specs=in_specs,
-            out_specs=P(ROW_AXIS), check_vma=False,
-        )(*args)
+        y = jnp.zeros((rps,), dtype=dd.dtype)
+        for d, o in enumerate(offsets):
+            seg = jax.lax.slice_in_dim(
+                x_ext, halo + o, halo + o + rps
+            )
+            # Mask *products* outside the matrix (and band holes in
+            # masked mode): ring-wrapped halo values, padding rows
+            # and holes carry weight 0, but 0*inf must not inject
+            # NaN (same IEEE invariant as ell_spmv).
+            if has_mask:
+                valid = dm[d]
+            else:
+                valid = jnp.logical_and(
+                    jnp.logical_and(r_g + o >= 0, r_g + o < n_rows),
+                    r_g < n_rows,
+                )
+            y = y + jnp.where(valid, dd[d] * seg,
+                              jnp.zeros((), dd.dtype))
+        return y
 
-    A._require_blocks("dist_spmv")
+    in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS)) + (
+        (P(ROW_AXIS, None, None),) if has_mask else ()
+    )
+    # jit wrapper: shard_map alone re-lowers per call; under jit the
+    # compiled executable is cached on (this fn, shapes).
+    return jax.jit(shard_map(
+        dia_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS), check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=256)
+def _block_spmv_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
+                   rps: int):
+    """Cached shard_map callable for the ELL / padded-CSR dist SpMV
+    (see ``_dia_spmv_fn`` for why caching matters)."""
+    from jax import shard_map
+
+    from ..ops import spmv as _spmv_ops
 
     def realize(x_local, gidx_local=None):
         """Per-shard x realization: precise all_to_all gather, halo
@@ -591,22 +588,23 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
             return _extend_x(x_local, halo)
         return jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
 
-    if A.ell:
+    if ell:
         if precise:
             def kernel(data, cols, counts, gidx, x_local):
                 x_src = realize(x_local, gidx[0])
                 return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
 
-            args = (A.data, A.cols, A.counts, A.gather_idx, x)
+            in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS))
         else:
             def kernel(data, cols, counts, x_local):
                 x_src = realize(x_local)
                 return _spmv_ops.ell_spmv(data[0], cols[0], counts[0], x_src)
 
-            args = (A.data, A.cols, A.counts, x)
+            in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS))
     else:
-        rps = A.rows_per_shard
-
         if precise:
             def kernel(data, cols, row_ids, counts, gidx, x_local):
                 x_src = realize(x_local, gidx[0])
@@ -614,7 +612,9 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
                     data[0], cols[0], row_ids[0], counts[0], x_src, rps
                 )
 
-            args = (A.data, A.cols, A.row_ids, A.counts, A.gather_idx, x)
+            in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS),
+                        P(ROW_AXIS, None, None), P(ROW_AXIS))
         else:
             def kernel(data, cols, row_ids, counts, x_local):
                 x_src = realize(x_local)
@@ -622,14 +622,49 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
                     data[0], cols[0], row_ids[0], counts[0], x_src, rps
                 )
 
-            args = (A.data, A.cols, A.row_ids, A.counts, x)
-    in_specs = tuple(
-        P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in args
-    )
-    return shard_map(
-        kernel, mesh=A.mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+            in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
         check_vma=False,
-    )(*args)
+    ))
+
+
+def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
+    """y = A @ x with row-block parallelism (jittable).
+
+    ``x`` and the result are row-block sharded vectors of length
+    ``A.rows_padded``.  The distribution contract matches the reference
+    SpMV task (``csr.py:562-593``): y aligned with the row partition,
+    x gathered per the column image (halo ppermute or all_gather).
+    The underlying shard_map computations are structure-cached, so
+    repeated calls on the same matrix structure reuse one compilation.
+    """
+    halo = A.halo
+    precise = A.gather_idx is not None
+
+    if A.dia_data is not None and halo >= 0 and not precise:
+        # Banded fast path: halo exchange + static shifted-adds, zero
+        # gathers (the per-shard analog of ``ops.dia_ops.dia_spmv``).
+        has_mask = A.dia_mask is not None
+        fn = _dia_spmv_fn(
+            A.mesh, A.dia_offsets, halo, A.rows_per_shard, A.shape[0],
+            has_mask,
+        )
+        args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
+        return fn(*args)
+
+    A._require_blocks("dist_spmv")
+    fn = _block_spmv_fn(A.mesh, halo, precise, A.ell, A.rows_per_shard)
+    if A.ell:
+        args = (A.data, A.cols, A.counts) + (
+            (A.gather_idx,) if precise else ()
+        ) + (x,)
+    else:
+        args = (A.data, A.cols, A.row_ids, A.counts) + (
+            (A.gather_idx,) if precise else ()
+        ) + (x,)
+    return fn(*args)
 
 
 def dist_diagonal(A: DistCSR) -> jax.Array:

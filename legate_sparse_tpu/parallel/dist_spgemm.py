@@ -33,7 +33,7 @@ Returns a padded-CSR ``DistCSR`` whose cols are global indices
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import numpy as np
@@ -211,19 +211,131 @@ def _expand_sorted(A: DistCSR, a_args, b_args, T_cap: int, n_cols: int):
     return c_row, c_col, c_val, heads, local_nnz
 
 
+def _dist_band_spgemm(A: DistCSR, B: DistCSR):
+    """C = A @ B for exactly-banded square operands: nd_a*nd_b shifted
+    multiplies on the row-indexed per-shard DIA blocks, with B's rows
+    realized by a ``ppermute`` halo exchange — no all_gather, no
+    expansion, no sort.  The distributed rendition of
+    ``ops.dia_ops.dia_spgemm``.
+
+    Returns a DIA-layout DistCSR (ELL blocks included, same assembly as
+    ``dist_diags``), or None when the preconditions don't hold (not
+    exact bands, band too wide for halo mode, pattern not provably
+    equal to the structural product).
+    """
+    from ..ops.dia_ops import (
+        band_cover, band_product_is_full, band_product_offsets,
+    )
+    from ..settings import settings
+
+    if (
+        A.dia_data is None or B.dia_data is None
+        or A.dia_mask is not None or B.dia_mask is not None
+        or A.shape[0] != A.shape[1] or B.shape[0] != B.shape[1]
+        or A.rows_per_shard != B.rows_per_shard
+    ):
+        return None
+    n = A.shape[0]
+    rps = A.rows_per_shard
+    offs_a, offs_b = A.dia_offsets, B.dia_offsets
+    offs_c = band_product_offsets(offs_a, offs_b)
+    nnz_c = band_cover(offs_c, (n, n), n)
+    h = max(abs(o) for o in offs_a)          # B-row reach of the product
+    halo_c = max(abs(o) for o in offs_c)     # halo of the result matrix
+    if (
+        h > rps or halo_c > rps
+        or len(offs_c) > settings.dia_max_diags
+        or len(offs_c) * n > settings.dia_max_expand * max(nnz_c, 1)
+        or not band_product_is_full(offs_a, offs_b, offs_c,
+                                    A.shape, B.shape)
+    ):
+        return None
+
+    fn = _band_spgemm_fn(A.mesh, offs_a, offs_b, offs_c, n, rps, h,
+                         halo_c)
+    data, cols_b, counts, dia_data = fn(A.dia_data, B.dia_data)
+    return DistCSR(
+        data=data, cols=cols_b, counts=counts, row_ids=None,
+        shape=(n, n), rows_per_shard=rps, halo=halo_c, ell=True,
+        mesh=A.mesh, dia_data=dia_data, dia_offsets=offs_c,
+    )
+
+
+@lru_cache(maxsize=128)
+def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
+    """Cached shard_map callable for the banded product (fresh closures
+    would re-trace/recompile on every call — same reasoning as
+    ``dist_csr._dia_spmv_fn``)."""
+    nd_c = len(offs_c)
+    idx_c = {o: i for i, o in enumerate(offs_c)}
+    offs_c_dev = jnp.asarray(offs_c, dtype=jnp.int64)
+    W = nd_c
+
+    def kernel(a_blk, b_blk):
+        a = a_blk[0]                               # (nd_a, rps)
+        b = b_blk[0]                               # (nd_b, rps)
+        # Halo-extend B's rows (axis 1) from ring neighbors.  Ring wrap
+        # at the global edges multiplies against A's out-of-range zeros
+        # (exact-band blocks are 0 there by construction), so wrapped
+        # values never reach the result.
+        if h > 0:
+            axis_size = jax.lax.axis_size(ROW_AXIS)
+            right = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            left = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+            from_left = jax.lax.ppermute(b[:, -h:], ROW_AXIS, right)
+            from_right = jax.lax.ppermute(b[:, :h], ROW_AXIS, left)
+            b_ext = jnp.concatenate([from_left, b, from_right], axis=1)
+        else:
+            b_ext = b
+        C = jnp.zeros((nd_c, rps), dtype=jnp.result_type(a.dtype, b.dtype))
+        for a_i, oa in enumerate(offs_a):
+            for b_i, ob in enumerate(offs_b):
+                seg = jax.lax.slice_in_dim(
+                    b_ext[b_i], h + oa, h + oa + rps
+                )
+                C = C.at[idx_c[oa + ob]].add(a[a_i] * seg)
+        # ELL assembly: the product band is full, so per-row counts and
+        # cols follow from the offsets alone (shared helper with
+        # dist_diags — one source of truth for the slot conventions).
+        from .dist_build import band_ell_local
+
+        shard = jax.lax.axis_index(ROW_AXIS)
+        start = shard.astype(jnp.int64) * rps
+        r_l = jnp.arange(rps, dtype=jnp.int64)
+        r = start + r_l
+        ell_data, ell_cols, cnt = band_ell_local(
+            C, offs_c_dev, n, rps, halo_c, start, r, r_l
+        )
+        return ell_data[None], ell_cols[None], cnt[None], C[None]
+
+    out_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                 P(ROW_AXIS, None), P(ROW_AXIS, None, None))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(ROW_AXIS, None, None), P(ROW_AXIS, None, None)),
+        out_specs=out_specs, check_vma=False,
+    ))
+
+
 def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     """C = A @ B, both row-block distributed; returns a row-block C.
 
-    Differentially tested against scipy on the 8-device CPU mesh
+    Exactly-banded square operands take the gather-free banded fast
+    path (``_dist_band_spgemm``: shifted multiplies + ppermute halo —
+    no all_gather of B); everything else runs the general collective
+    ESC.  Differentially tested against scipy on the 8-device CPU mesh
     (``tests/test_dist_spgemm.py``), including the GMG Galerkin
     triple product R @ A @ P.
     """
     if A.shape[1] != B.shape[0]:
         raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
-    A._require_blocks("dist_spgemm")
-    B._require_blocks("dist_spgemm")
     if A.mesh is not B.mesh and A.mesh != B.mesh:
         raise ValueError("operands must share a mesh")
+    C_band = _dist_band_spgemm(A, B)
+    if C_band is not None:
+        return C_band
+    A._require_blocks("dist_spgemm")
+    B._require_blocks("dist_spgemm")
     if A.rows_padded < A.shape[0] or B.rows_padded < B.shape[0]:
         raise AssertionError("padded row invariant violated")
     # Padded B rows have count 0 everywhere (shard_csr invariant), so

@@ -202,8 +202,21 @@ def test_dist_dia_only_matrix():
     b = np.ones(n)
     sol, _ = dist_cg(dA, b, rtol=1e-10)
     assert np.linalg.norm(b - S @ np.asarray(sol)) <= 1e-8
+    # Banded products work even DIA-only (no blocks needed).
+    C = dist_spgemm(dA, dA)
+    np.testing.assert_allclose(
+        C.to_csr().todense(), (S @ S).toarray(), atol=1e-10
+    )
+    # A product whose band blows the halo budget needs the general
+    # (block-consuming) path -> must raise with guidance on DIA-only.
+    from legate_sparse_tpu.parallel.dist_build import dist_diags
+
+    wide = dist_diags(
+        [1.0, 1.0], [0, 12], shape=(n, n), mesh=mesh,
+        materialize_ell=False,
+    )
     with pytest.raises(ValueError, match="materialize_ell"):
-        dist_spgemm(dA, dA)
+        dist_spgemm(wide, wide)  # product offset 24 > rps=16
 
 
 def test_dia_rectangular_not_crashing():

@@ -144,3 +144,63 @@ def test_dist_spgemm_result_feeds_spmv():
     y = np.asarray(dist_spmv(dC, xs))[:n]
     y_ref = (A.toscipy() @ A.toscipy()) @ x
     np.testing.assert_allclose(y, y_ref, rtol=1e-10, atol=1e-12)
+
+
+@needs_multi
+def test_dist_band_spgemm_fast_path():
+    """Exactly-banded square operands take the ppermute-halo banded
+    product (no all_gather of B): scipy parity + chainability."""
+    from legate_sparse_tpu.parallel.dist_csr import shard_vector
+
+    mesh = _mesh()
+    n = 256
+    offsA = [-1, 0, 1]
+    offsB = [-2, 0, 2]
+    dA = [np.random.default_rng(i).normal(size=n - abs(o))
+          for i, o in enumerate(offsA)]
+    dB = [np.random.default_rng(7 + i).normal(size=n - abs(o))
+          for i, o in enumerate(offsB)]
+    A = sparse.diags(dA, offsA, shape=(n, n), format="csr")
+    B = sparse.diags(dB, offsB, shape=(n, n), format="csr")
+    SA = sp.diags(dA, offsA, shape=(n, n), format="csr")
+    SB = sp.diags(dB, offsB, shape=(n, n), format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    dBm = shard_csr(B, mesh=mesh)
+    C = dist_spgemm(dAm, dBm)
+    assert C.dia_data is not None  # banded path produced a DIA result
+    SC = SA @ SB
+    np.testing.assert_allclose(
+        C.to_csr().todense(), SC.toarray(), rtol=1e-9, atol=1e-12
+    )
+    assert C.to_csr().nnz == SC.nnz
+    x = np.random.default_rng(3).normal(size=n)
+    xs = shard_vector(x, mesh, C.rows_padded)
+    np.testing.assert_allclose(
+        np.asarray(dist_spmv(C, xs))[:n], SC @ x, rtol=1e-8
+    )
+    # Chained product stays on the banded path.
+    C2 = dist_spgemm(C, C)
+    assert C2.dia_data is not None
+    np.testing.assert_allclose(
+        C2.to_csr().todense(), (SC @ SC).toarray(), rtol=1e-8, atol=1e-10
+    )
+
+
+@needs_multi
+def test_dist_band_spgemm_holey_falls_back():
+    """Holey-band operands (masked DIA) must take the general ESC path
+    and still match scipy."""
+    mesh = _mesh()
+    n = 64
+    d0 = np.where(np.arange(n) % 4 == 0, 0.0, 2.0)
+    A = sparse.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                     format="csr")
+    SA = sp.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                  format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    assert dAm.dia_mask is not None
+    C = dist_spgemm(dAm, dAm)
+    SC = SA @ SA
+    np.testing.assert_allclose(
+        C.to_csr().todense(), SC.toarray(), rtol=1e-9, atol=1e-12
+    )
