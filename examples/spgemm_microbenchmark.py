@@ -103,6 +103,11 @@ if __name__ == "__main__":
     if args.distributed:
         if not use_tpu:
             raise SystemExit("--distributed requires the tpu backend")
+        if args.stable or args.fname_first or args.fname_second:
+            raise SystemExit(
+                "--distributed benchmarks the banded config only; "
+                "--stable/--filename1/--filename2 are not supported"
+            )
         run_spgemm_distributed(
             get_arg_number(args.n), args.nnz_per_row, args.iters, timer
         )

@@ -496,8 +496,9 @@ def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P(ROW_AXIS)))
 
 
-def _extend_x(x_local, halo: int):
-    """Halo exchange: ppermute boundary slices to/from ring neighbors.
+def _extend_x(x_local, halo: int, axis: int = 0):
+    """Halo exchange: ppermute boundary slices to/from ring neighbors
+    along ``axis`` of the local block.
 
     Structurally the ring/context-parallel neighbor pattern: each shard
     never materializes the global x — this is what makes 1e8-row weak
@@ -509,9 +510,12 @@ def _extend_x(x_local, halo: int):
     axis_size = jax.lax.axis_size(ROW_AXIS)
     right_perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     left_perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
-    from_left = jax.lax.ppermute(x_local[-halo:], ROW_AXIS, right_perm)
-    from_right = jax.lax.ppermute(x_local[:halo], ROW_AXIS, left_perm)
-    return jnp.concatenate([from_left, x_local, from_right])
+    n = x_local.shape[axis]
+    tail = jax.lax.slice_in_dim(x_local, n - halo, n, axis=axis)
+    head = jax.lax.slice_in_dim(x_local, 0, halo, axis=axis)
+    from_left = jax.lax.ppermute(tail, ROW_AXIS, right_perm)
+    from_right = jax.lax.ppermute(head, ROW_AXIS, left_perm)
+    return jnp.concatenate([from_left, x_local, from_right], axis=axis)
 
 
 @lru_cache(maxsize=256)

@@ -34,7 +34,7 @@ Returns a padded-CSR ``DistCSR`` whose cols are global indices
 from __future__ import annotations
 
 from functools import lru_cache, partial
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import numpy as np
 
@@ -47,7 +47,35 @@ from .dist_csr import DistCSR
 from .mesh import ROW_AXIS
 
 
-def _a_local_flat(A: DistCSR, data, cols, counts, row_ids, ggl=None):
+class _Layout(NamedTuple):
+    """Static layout signature of a DistCSR — everything the ESC
+    kernels read about an operand besides its arrays.  Used as the
+    lru_cache key for the compiled shard_map phases, so it MUST capture
+    every operand attribute the kernel closures consult (adding a new
+    attribute read to a kernel without extending this key would leak
+    stale compilations)."""
+
+    ell: bool
+    rps: int
+    halo: int
+    cps: int
+    has_ggl: bool
+    shape: Tuple[int, int]
+    rows_padded: int
+    num_shards: int
+    inner: int          # W for ELL blocks, nnz_max for padded-CSR
+
+
+def _layout_of(M: DistCSR) -> _Layout:
+    return _Layout(
+        ell=M.ell, rps=M.rows_per_shard, halo=M.halo,
+        cps=M.cols_per_shard, has_ggl=M.gather_globals is not None,
+        shape=M.shape, rows_padded=M.rows_padded,
+        num_shards=M.num_shards, inner=int(M.cols.shape[-1]),
+    )
+
+
+def _a_local_flat(A: _Layout, data, cols, counts, row_ids, ggl=None):
     """Normalize a shard's A block to flat (a_row, a_col_global, a_val,
     a_valid) arrays of static length L.
 
@@ -56,7 +84,7 @@ def _a_local_flat(A: DistCSR, data, cols, counts, row_ids, ggl=None):
     global whatever the layout stores (halo-window-local or precise
     compact positions via ``ggl`` = the shard's gather_globals row).
     """
-    rps = A.rows_per_shard
+    rps = A.rps
     shard = jax.lax.axis_index(ROW_AXIS)
     start = shard.astype(jnp.int64) * rps
 
@@ -77,10 +105,10 @@ def _a_local_flat(A: DistCSR, data, cols, counts, row_ids, ggl=None):
         a_col = cols.astype(jnp.int64)
         a_val = data
 
-    if A.gather_globals is not None:
+    if A.has_ggl:
         base = ggl.reshape(-1)
         rc = base.shape[0]
-        own = a_col - rc + shard.astype(jnp.int64) * A.cols_per_shard
+        own = a_col - rc + shard.astype(jnp.int64) * A.cps
         a_col = jnp.where(
             a_col < rc, base[jnp.clip(a_col, 0, rc - 1)], own
         )
@@ -90,7 +118,7 @@ def _a_local_flat(A: DistCSR, data, cols, counts, row_ids, ggl=None):
     return a_row, a_col, a_val, a_valid
 
 
-def _b_global_flat(B: DistCSR, data, cols, counts, row_ids, ggl=None):
+def _b_global_flat(B: _Layout, data, cols, counts, row_ids, ggl=None):
     """All-gather B's blocks and expose flat per-row random access:
     (b_data_g, b_cols_g, b_start, b_counts) with global column indices.
 
@@ -100,18 +128,18 @@ def _b_global_flat(B: DistCSR, data, cols, counts, row_ids, ggl=None):
     block via the gathered ``gather_globals``.
     """
     R = B.num_shards
-    rps = B.rows_per_shard
+    rps = B.rps
     rows_p = B.rows_padded
 
     data_g = jax.lax.all_gather(data, ROW_AXIS)    # (R, ...) blocks
     cols_g = jax.lax.all_gather(cols, ROW_AXIS)
     counts_g = jax.lax.all_gather(counts, ROW_AXIS)
-    if B.gather_globals is not None:
+    if B.has_ggl:
         ggl_g = jax.lax.all_gather(ggl, ROW_AXIS)  # (R, R, C)
         # Un-rebase each source block with its own inverse map; the
         # appended-local region maps back to the block's own columns.
         per_block = cols_g.reshape(R, -1).astype(jnp.int64)
-        cps_b = B.cols_per_shard
+        cps_b = B.cps
         s_ids = jnp.arange(R, dtype=jnp.int64)
 
         def unreb(inv, c, s):
@@ -158,26 +186,25 @@ def _b_global_flat(B: DistCSR, data, cols, counts, row_ids, ggl=None):
     return b_data_g, b_cols_g, b_start, b_counts
 
 
-def _unrebase_b(B: DistCSR, b_cols_g, rps):
+def _unrebase_b(B: _Layout, b_cols_g, rps):
     """Undo halo-window rebasing on the gathered flat cols: entry j of
     block s stores local = global - (s*rps - halo)."""
     if B.ell:
-        W = B.cols.shape[-1]
-        per_block = rps * W
+        per_block = rps * B.inner
     else:
-        per_block = B.cols.shape[-1]
+        per_block = B.inner
     block_of = jnp.arange(b_cols_g.shape[0], dtype=jnp.int64) // per_block
     return b_cols_g + block_of * rps - B.halo
 
 
-def _expand_sorted(A: DistCSR, a_args, b_args, T_cap: int, n_cols: int):
+def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int):
     """Shared expand + two-key sort producing (c_row, c_col, c_val,
     heads, local_nnz) for one shard.  Invalid product slots carry the
     sentinel row ``rps`` (sorts after every valid row) and value 0."""
     a_row, a_col, a_val, a_valid = _a_local_flat(A, *a_args)
     b_data_g, b_cols_g, b_start, b_counts = b_args
 
-    rps = A.rows_per_shard
+    rps = A.rps
     counts_per_a = jnp.where(a_valid, b_counts[a_col], 0).astype(jnp.int64)
     starts = jnp.concatenate(
         [jnp.zeros((1,), jnp.int64), jnp.cumsum(counts_per_a)]
@@ -269,7 +296,6 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
     nd_c = len(offs_c)
     idx_c = {o: i for i, o in enumerate(offs_c)}
     offs_c_dev = jnp.asarray(offs_c, dtype=jnp.int64)
-    W = nd_c
 
     def kernel(a_blk, b_blk):
         a = a_blk[0]                               # (nd_a, rps)
@@ -278,15 +304,9 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
         # at the global edges multiplies against A's out-of-range zeros
         # (exact-band blocks are 0 there by construction), so wrapped
         # values never reach the result.
-        if h > 0:
-            axis_size = jax.lax.axis_size(ROW_AXIS)
-            right = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-            left = [(i, (i - 1) % axis_size) for i in range(axis_size)]
-            from_left = jax.lax.ppermute(b[:, -h:], ROW_AXIS, right)
-            from_right = jax.lax.ppermute(b[:, :h], ROW_AXIS, left)
-            b_ext = jnp.concatenate([from_left, b, from_right], axis=1)
-        else:
-            b_ext = b
+        from .dist_csr import _extend_x
+
+        b_ext = _extend_x(b, h, axis=1)
         C = jnp.zeros((nd_c, rps), dtype=jnp.result_type(a.dtype, b.dtype))
         for a_i, oa in enumerate(offs_a):
             for b_i, ob in enumerate(offs_b):
@@ -347,6 +367,7 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     rps = A.rows_per_shard
     m, n_cols = A.shape[0], B.shape[1]
     col_dtype = coord_dtype_for(n_cols)
+    la, lb = _layout_of(A), _layout_of(B)
 
     # Absent layout fields (ELL has no row_ids; only precise layouts
     # carry gather_globals) ride along as (R, 1) zero blocks so every
@@ -365,50 +386,9 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
 
     a_arrays = arrays_of(A)
     b_arrays = arrays_of(B)
-    NA = len(a_arrays)
-
-    def specs_for(arrs):
-        return tuple(P(ROW_AXIS, *([None] * (a.ndim - 1))) for a in arrs)
-
-    in_specs = specs_for(a_arrays) + specs_for(b_arrays)
-
-    # Inside shard_map each (R, ...) axis-0-sharded block arrives as a
-    # (1, ...) slice — index [0] for the local block (same convention as
-    # dist_spmv).
-    def local(args):
-        return tuple(x[0] for x in args)
 
     # ---- phase 1: T_local ------------------------------------------------
-    def t_kernel(*args):
-        a_args, b_args_raw = args[:NA], args[NA:]
-        a_row, a_col, a_val, a_valid = _a_local_flat(A, *local(a_args))
-        counts = local(b_args_raw)[2]
-        rid = local(b_args_raw)[3]
-        counts_g = jax.lax.all_gather(counts, ROW_AXIS)
-        if B.ell:
-            b_counts = counts_g.reshape(B.rows_padded).astype(jnp.int64)
-        else:
-            rid_g = jax.lax.all_gather(rid, ROW_AXIS)
-            nnz_max = B.data.shape[-1]
-            slot = jnp.arange(nnz_max, dtype=jnp.int32)
-            valid = slot[None, :] < counts_g[:, None]
-            ids_2d = jnp.where(valid, rid_g, B.rows_per_shard)
-            one = jnp.ones_like(ids_2d, dtype=jnp.int64)
-            percount = jax.vmap(
-                lambda ids, on: jax.ops.segment_sum(
-                    on, ids, num_segments=B.rows_per_shard + 1
-                )
-            )(ids_2d, one)[:, : B.rows_per_shard]
-            b_counts = percount.reshape(B.rows_padded)
-        t_local = jnp.sum(
-            jnp.where(a_valid, b_counts[a_col], 0), dtype=jnp.int64
-        )
-        return t_local[None]
-
-    t_locals = shard_map(
-        t_kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
-        check_vma=False,
-    )(*a_arrays, *b_arrays)
+    t_locals = _esc_t_fn(mesh, la, lb)(*a_arrays, *b_arrays)
     T_cap = int(jnp.max(t_locals))
 
     val_dtype = jnp.result_type(A.data.dtype, B.data.dtype)
@@ -425,26 +405,114 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         )
 
     # ---- phase 2: nnz_local ---------------------------------------------
-    def nnz_kernel(*args):
-        a_args, b_args_raw = args[:NA], args[NA:]
-        b_args = _b_global_flat(B, *local(b_args_raw))
-        *_, local_nnz = _expand_sorted(
-            A, local(a_args), b_args, T_cap, n_cols
-        )
-        return local_nnz[None]
-
-    nnz_locals = shard_map(
-        nnz_kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
-        check_vma=False,
-    )(*a_arrays, *b_arrays)
+    nnz_locals = _esc_nnz_fn(mesh, la, lb, T_cap)(*a_arrays, *b_arrays)
     nnz_cap = max(int(jnp.max(nnz_locals)), 1)
 
     # ---- phase 3: numeric ------------------------------------------------
+    vals_b, cols_b, rids_b, counts_b = _esc_numeric_fn(
+        mesh, la, lb, T_cap, nnz_cap
+    )(*a_arrays, *b_arrays)
+
+    return DistCSR(
+        data=vals_b, cols=cols_b, counts=counts_b.astype(jnp.int32),
+        row_ids=rids_b, shape=(m, n_cols), rows_per_shard=rps,
+        halo=-1, ell=False, mesh=mesh,
+    )
+
+
+def _esc_specs(L: _Layout):
+    """in_specs ndims for (data, cols, counts, row_ids, ggl) blocks of a
+    layout (placeholders are (R, 1), i.e. 2-D)."""
+    data_nd = 3 if L.ell else 2
+    counts_nd = 2 if L.ell else 1
+    ggl_nd = 3 if L.has_ggl else 2
+    return tuple(
+        P(ROW_AXIS, *([None] * (k - 1)))
+        for k in (data_nd, data_nd, counts_nd, 2, ggl_nd)
+    )
+
+
+def _local(args):
+    # Inside shard_map each (R, ...) axis-0-sharded block arrives as a
+    # (1, ...) slice — index [0] for the local block (same convention as
+    # dist_spmv).
+    return tuple(x[0] for x in args)
+
+
+@lru_cache(maxsize=128)
+def _esc_t_fn(mesh, la: _Layout, lb: _Layout):
+    """Cached phase-1 (product count) shard_map (structure-keyed, see
+    ``_Layout``; fresh closures per call would recompile every time)."""
+    in_specs = _esc_specs(la) + _esc_specs(lb)
+
+    def t_kernel(*args):
+        a_args, b_args_raw = args[:5], args[5:]
+        a_row, a_col, a_val, a_valid = _a_local_flat(la, *_local(a_args))
+        counts = _local(b_args_raw)[2]
+        rid = _local(b_args_raw)[3]
+        counts_g = jax.lax.all_gather(counts, ROW_AXIS)
+        if lb.ell:
+            b_counts = counts_g.reshape(lb.rows_padded).astype(jnp.int64)
+        else:
+            rid_g = jax.lax.all_gather(rid, ROW_AXIS)
+            nnz_max = lb.inner
+            slot = jnp.arange(nnz_max, dtype=jnp.int32)
+            valid = slot[None, :] < counts_g[:, None]
+            ids_2d = jnp.where(valid, rid_g, lb.rps)
+            one = jnp.ones_like(ids_2d, dtype=jnp.int64)
+            percount = jax.vmap(
+                lambda ids, on: jax.ops.segment_sum(
+                    on, ids, num_segments=lb.rps + 1
+                )
+            )(ids_2d, one)[:, : lb.rps]
+            b_counts = percount.reshape(lb.rows_padded)
+        t_local = jnp.sum(
+            jnp.where(a_valid, b_counts[a_col], 0), dtype=jnp.int64
+        )
+        return t_local[None]
+
+    return jax.jit(shard_map(
+        t_kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+        check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
+def _esc_nnz_fn(mesh, la: _Layout, lb: _Layout, T_cap: int):
+    """Cached phase-2 (output nnz) shard_map."""
+    in_specs = _esc_specs(la) + _esc_specs(lb)
+    n_cols = lb.shape[1]
+
+    def nnz_kernel(*args):
+        a_args, b_args_raw = args[:5], args[5:]
+        b_args = _b_global_flat(lb, *_local(b_args_raw))
+        *_, local_nnz = _expand_sorted(
+            la, _local(a_args), b_args, T_cap, n_cols
+        )
+        return local_nnz[None]
+
+    return jax.jit(shard_map(
+        nnz_kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+        check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
+def _esc_numeric_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
+                    nnz_cap: int):
+    """Cached phase-3 (numeric) shard_map."""
+    from ..types import coord_dtype_for
+
+    in_specs = _esc_specs(la) + _esc_specs(lb)
+    n_cols = lb.shape[1]
+    col_dtype = coord_dtype_for(n_cols)
+    rps = la.rps
+
     def numeric_kernel(*args):
-        a_args, b_args_raw = args[:NA], args[NA:]
-        b_args = _b_global_flat(B, *local(b_args_raw))
+        a_args, b_args_raw = args[:5], args[5:]
+        b_args = _b_global_flat(lb, *_local(b_args_raw))
         c_row, c_col, c_val, heads, local_nnz = _expand_sorted(
-            A, local(a_args), b_args, T_cap, n_cols
+            la, _local(a_args), b_args, T_cap, n_cols
         )
         seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int32)) - 1, 0,
                        nnz_cap - 1)
@@ -464,16 +532,10 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
 
     out_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None), P(ROW_AXIS, None),
                  P(ROW_AXIS))
-    vals_b, cols_b, rids_b, counts_b = shard_map(
-        numeric_kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False,
-    )(*a_arrays, *b_arrays)
-
-    return DistCSR(
-        data=vals_b, cols=cols_b, counts=counts_b.astype(jnp.int32),
-        row_ids=rids_b, shape=(m, n_cols), rows_per_shard=rps,
-        halo=-1, ell=False, mesh=mesh,
-    )
+    return jax.jit(shard_map(
+        numeric_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs, check_vma=False,
+    ))
 
 
 def _put_blocks(arr, mesh):
