@@ -22,6 +22,7 @@ from .runtime import runtime  # noqa: F401  (configures x64 at import)
 from .module import *  # noqa: F401,F403
 from .module import (  # explicit re-exports for linters
     csr_array, csr_matrix, dia_array, dia_matrix, diags, eye, identity,
+    kron, tril, triu, load_npz, save_npz,
     mmread, mmwrite, spmv, spgemm_csr_csr_csr, issparse, isspmatrix,
     isspmatrix_csr, isspmatrix_dia, is_sparse_matrix, coord_ty, nnz_ty,
 )

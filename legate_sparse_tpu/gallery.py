@@ -96,3 +96,77 @@ def eye(m, n=None, k=0, dtype=None, format=None):
 
 def identity(n, dtype=None, format=None):
     return eye(n, dtype=dtype, format=format)
+
+
+def kron(A, B, format=None):
+    """Kronecker product of sparse matrices (scipy ``kron`` semantics).
+
+    Beyond-reference API (the reference falls back to scipy's host
+    implementation through the facade clone): computed natively as one
+    vectorized COO outer expansion — entry (ra*mB + rb, ca*nB + cb)
+    with value va*vb — so the result stays a device ``csr_array``.
+    """
+    import jax.numpy as jnp
+
+    A = _as_csr(A)._canonicalized()
+    B = _as_csr(B)._canonicalized()
+    mA, nA = A.shape
+    mB, nB = B.shape
+    ra, ca, va = A.tocoo()
+    rb, cb, vb = B.tocoo()
+    ra = ra.astype(jnp.int64)[:, None]
+    ca = ca.astype(jnp.int64)[:, None]
+    rb = rb.astype(jnp.int64)[None, :]
+    cb = cb.astype(jnp.int64)[None, :]
+    rows = (ra * mB + rb).reshape(-1)
+    cols = (ca * nB + cb).reshape(-1)
+    vals = (va[:, None] * vb[None, :]).reshape(-1)
+    from .csr import csr_array
+
+    out = csr_array((vals, (rows, cols)), shape=(mA * mB, nA * nB))
+    return out.asformat(format)
+
+
+def _as_csr(A):
+    """Accept any sparse input (csr_array, dia_array, scipy sparse,
+    dense) and return a csr_array — the scipy-parity input contract of
+    the free functions below."""
+    from .csr import csr_array
+
+    if isinstance(A, csr_array):
+        return A
+    if hasattr(A, "tocsr"):
+        A = A.tocsr()
+    if isinstance(A, csr_array):
+        return A
+    return csr_array(A)
+
+
+def _tri_mask(A, k: int, keep_lower: bool):
+    import jax.numpy as jnp
+
+    from .csr import csr_array
+    from .ops.convert import row_ids_from_indptr, indptr_from_row_ids
+
+    A = _as_csr(A)
+    row_ids = row_ids_from_indptr(A.indptr, A.nnz)
+    d = A.indices.astype(jnp.int64) - row_ids.astype(jnp.int64)
+    keep = (d <= k) if keep_lower else (d >= k)
+    nnz_new = int(jnp.sum(keep))
+    idx = jnp.nonzero(keep, size=nnz_new)[0]
+    return csr_array._from_parts(
+        A.data[idx], A.indices[idx],
+        indptr_from_row_ids(row_ids[idx], A.shape[0]),
+        A.shape, canonical=A._canonical,
+    )
+
+
+def tril(A, k=0, format=None):
+    """Lower-triangular part (scipy ``tril`` semantics), computed on
+    device by masking ``col - row <= k``."""
+    return _tri_mask(A, int(k), keep_lower=True).asformat(format)
+
+
+def triu(A, k=0, format=None):
+    """Upper-triangular part (scipy ``triu`` semantics)."""
+    return _tri_mask(A, int(k), keep_lower=False).asformat(format)

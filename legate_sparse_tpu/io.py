@@ -127,3 +127,47 @@ def mmwrite(target, a) -> None:
         f.write(f"{a.shape[0]} {a.shape[1]} {a.nnz}\n")
         for r, c, v in zip(rows, cols, vals):
             f.write(f"{r + 1} {c + 1} {float(v):.17g}\n")
+
+
+def save_npz(file, matrix, compressed: bool = True) -> None:
+    """Persist a csr_array in scipy's ``save_npz`` container format
+    (round-trips with ``scipy.sparse.load_npz`` and vice versa).
+
+    Checkpoint/persistence beyond the reference (reader-only IO,
+    reference ``io.py:27-55``).
+    """
+    import numpy as _np
+
+    arrays = dict(
+        format=_np.array(b"csr"),
+        shape=_np.asarray(matrix.shape, dtype=_np.int64),
+        data=_np.asarray(matrix.data),
+        indices=_np.asarray(matrix.indices),
+        indptr=_np.asarray(matrix.indptr),
+    )
+    if compressed:
+        _np.savez_compressed(file, **arrays)
+    else:
+        _np.savez(file, **arrays)
+
+
+def load_npz(file) -> csr_array:
+    """Load a scipy ``save_npz`` container as a csr_array."""
+    import numpy as _np
+
+    with _np.load(file) as f:
+        fmt = f["format"].item()
+        if isinstance(fmt, bytes):
+            fmt = fmt.decode()
+        if fmt == "csr":
+            return csr_array(
+                (f["data"], f["indices"], f["indptr"]),
+                shape=tuple(int(s) for s in f["shape"]),
+            )
+    # Non-csr containers (csc/coo/dia/bsr/...): scipy decodes the
+    # layout (file-like sources are rewound; np.load consumed them).
+    if hasattr(file, "seek"):
+        file.seek(0)
+    import scipy.sparse as _ss
+
+    return csr_array(_ss.load_npz(file).tocsr())
