@@ -108,16 +108,25 @@ def kron(A, B, format=None):
     """
     import jax.numpy as jnp
 
+    from .types import coord_dtype_for
+
     A = _as_csr(A)._canonicalized()
     B = _as_csr(B)._canonicalized()
     mA, nA = A.shape
     mB, nB = B.shape
+    cdt = coord_dtype_for(max(mA * mB, nA * nB, 1))
+    if cdt.itemsize == 8 and jnp.zeros((), jnp.int64).dtype != jnp.int64:
+        raise OverflowError(
+            "kron output indices need int64 but x64 is disabled "
+            "(LEGATE_SPARSE_TPU_X64=0); enable x64 for products this "
+            "large"
+        )
     ra, ca, va = A.tocoo()
     rb, cb, vb = B.tocoo()
-    ra = ra.astype(jnp.int64)[:, None]
-    ca = ca.astype(jnp.int64)[:, None]
-    rb = rb.astype(jnp.int64)[None, :]
-    cb = cb.astype(jnp.int64)[None, :]
+    ra = ra.astype(cdt)[:, None]
+    ca = ca.astype(cdt)[:, None]
+    rb = rb.astype(cdt)[None, :]
+    cb = cb.astype(cdt)[None, :]
     rows = (ra * mB + rb).reshape(-1)
     cols = (ca * nB + cb).reshape(-1)
     vals = (va[:, None] * vb[None, :]).reshape(-1)
@@ -153,10 +162,13 @@ def _tri_mask(A, k: int, keep_lower: bool):
     d = A.indices.astype(jnp.int64) - row_ids.astype(jnp.int64)
     keep = (d <= k) if keep_lower else (d >= k)
     nnz_new = int(jnp.sum(keep))
-    idx = jnp.nonzero(keep, size=nnz_new)[0]
+    from .ops.convert import compact_mask
+
+    data, indices, rows_kept = compact_mask(
+        keep, (A.data, A.indices, row_ids), nnz_new
+    )
     return csr_array._from_parts(
-        A.data[idx], A.indices[idx],
-        indptr_from_row_ids(row_ids[idx], A.shape[0]),
+        data, indices, indptr_from_row_ids(rows_kept, A.shape[0]),
         A.shape, canonical=A._canonical,
     )
 

@@ -138,10 +138,18 @@ def save_npz(file, matrix, compressed: bool = True) -> None:
     """
     import numpy as _np
 
+    from .gallery import _as_csr
+
+    matrix = _as_csr(matrix)
+    data = _np.asarray(matrix.data)
+    if data.dtype.kind == "V" or str(data.dtype) == "bfloat16":
+        # npz has no portable bfloat16 encoding (numpy stores it as raw
+        # void, unreadable by scipy and np.load alike): widen to f32.
+        data = data.astype(_np.float32)
     arrays = dict(
         format=_np.array(b"csr"),
         shape=_np.asarray(matrix.shape, dtype=_np.int64),
-        data=_np.asarray(matrix.data),
+        data=data,
         indices=_np.asarray(matrix.indices),
         indptr=_np.asarray(matrix.indptr),
     )

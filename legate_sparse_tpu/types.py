@@ -29,8 +29,14 @@ complex64 = np.dtype(np.complex64)
 complex128 = np.dtype(np.complex128)
 
 # Value dtypes accepted by the compute kernels (reference:
-# ``legate_sparse/utils.py:28-33`` SUPPORTED_DATATYPES).
+# ``legate_sparse/utils.py:28-33`` SUPPORTED_DATATYPES) — plus
+# bfloat16, a TPU-native extension: the VPU operates on bf16 natively
+# and SpMV is bandwidth-bound, so halving value bytes nearly halves
+# solve time for tolerance-insensitive workloads.
+import jax.numpy as _jnp
+
 SUPPORTED_DATATYPES = (
+    np.dtype(_jnp.bfloat16),
     np.dtype(np.float32),
     np.dtype(np.float64),
     np.dtype(np.complex64),

@@ -111,3 +111,23 @@ def test_npz_dia_container(S):
     buf.seek(0)
     L = sparse.load_npz(buf)
     np.testing.assert_allclose(np.asarray(L.todense()), np.eye(5))
+
+
+def test_save_npz_accepts_dia_and_bf16():
+    import jax.numpy as jnp
+
+    buf = io.BytesIO()
+    sparse.save_npz(buf, sparse.eye(4))  # dia_array input
+    buf.seek(0)
+    np.testing.assert_allclose(scsp.load_npz(buf).toarray(), np.eye(4))
+    # bf16 values widen to f32 in the container (npz has no bf16).
+    A = sparse.diags([1.0, 2.0], [0, 1], shape=(3, 3), format="csr",
+                     dtype=jnp.bfloat16)
+    buf2 = io.BytesIO()
+    sparse.save_npz(buf2, A)
+    buf2.seek(0)
+    L = scsp.load_npz(buf2)
+    assert L.dtype == np.float32
+    np.testing.assert_allclose(
+        L.toarray(), np.asarray(A.todense(), dtype=np.float32)
+    )
