@@ -9,33 +9,40 @@ Prints ONE JSON line::
 
 Config matches the reference's SpMV microbenchmark default (banded
 matrix, nnz/row=11 — reference ``examples/spmv_microbenchmark.py:34-52``,
-``examples/common.py:206-249``) at 2^24 rows (~870 MB of DIA traffic,
-sized to match the stream measurement's so per-dispatch overhead does
-not mask bandwidth; override via LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS).  ``vs_baseline`` is the
-achieved fraction of this chip's *measured* stream bandwidth (triad-style
-copy), i.e. the roofline fraction BASELINE.md's north-star targets
-(>= 0.70).  The reference publishes no absolute numbers (BASELINE.md).
+``examples/common.py:206-249``) at 2^24 rows.  ``vs_baseline`` is the
+achieved fraction of this chip's *measured* stream (triad) bandwidth,
+i.e. the roofline fraction BASELINE.md's north-star targets (>= 0.70).
+The reference publishes no absolute numbers (BASELINE.md).
+
+Timing methodology (``legate_sparse_tpu/bench_timing.py``): ops run
+chained inside one jitted fori_loop at two trip counts and the delta is
+divided by the trip-count difference, with a host scalar fetch as the
+only trusted sync — on this TPU tunnel ``block_until_ready`` returns at
+dispatch-ack, not completion, so naive timing reports impossible
+numbers (measured 10x above the HBM roofline).  The stream measurement
+uses 2^26 lanes (512 MB working set) so it cannot hide in VMEM.
 
 Extra keys in the same JSON object (driver contract stays one line):
 ``platform`` (tpu/cpu), ``stream_gbs`` (measured roofline),
 ``irregular_gbs``/``irregular_frac`` (random-sparsity matrix through the
-segment-sum fallback — the path banded ELL never exercises), and
-``spmv_ms`` (raw per-iteration time).
+gather/segment-sum path banded never exercises), ``spmv_ms`` (per-
+iteration time), ``path`` (dia/ell/csr — which kernel the dispatch
+picked; "dia" means the Pallas band kernel on TPU).
 
 Robustness: the TPU backend is probed in a SUBPROCESS with a timeout and
 retries before this process commits to it — a hung or erroring tunnel
 (round-1 failure: ``BENCH_r01.json`` rc=1 backend-init crash) degrades
-to a CPU run with ``"platform": "cpu"`` recorded instead of losing the
-round's data.
+to a CPU run with ``"platform": "cpu"`` recorded.  Each phase is
+individually guarded so a mid-bench device fault still emits a JSON
+line with whatever was measured (round-2 failure mode: a TPU worker
+crash midway lost the whole round's data).
 """
 
 from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
-import time
 
 import numpy as np
 
@@ -47,77 +54,45 @@ PROBE_RETRIES = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_RETRIES", "1"))
 
 
 def _probe_accelerator() -> bool:
-    """Can a fresh process initialize the default (accelerator) backend?
+    """Can a fresh process initialize the default (accelerator) backend
+    AND run one op to completion?  Delegates to the shared subprocess
+    probe (``_platform.ensure_live_backend``), which also pins the cpu
+    platform on failure — the fallback this bench then runs on."""
+    from legate_sparse_tpu._platform import ensure_live_backend
 
-    Runs ``jax.devices()`` in a subprocess so a hang (unavailable TPU
-    tunnel) costs a bounded timeout, not the whole bench.
-    """
-    code = (
-        "import jax; ds = jax.devices(); "
-        "assert ds and ds[0].platform != 'cpu', ds; print('ok')"
+    return ensure_live_backend(
+        timeout_s=PROBE_TIMEOUT_S, retries=PROBE_RETRIES
     )
-    for attempt in range(PROBE_RETRIES + 1):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                timeout=PROBE_TIMEOUT_S,
-                capture_output=True,
-                text=True,
-            )
-            if r.returncode == 0 and "ok" in r.stdout:
-                return True
-            sys.stderr.write(
-                f"bench: accelerator probe attempt {attempt + 1} failed "
-                f"(rc={r.returncode}): {r.stderr.strip()[-400:]}\n"
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(
-                f"bench: accelerator probe attempt {attempt + 1} timed out "
-                f"after {PROBE_TIMEOUT_S}s\n"
-            )
-        if attempt < PROBE_RETRIES:
-            time.sleep(min(5 * (attempt + 1), 15))
-    return False
-
-
-def _time_fn(fn, *args, warmup: int = 5, iters: int = 20) -> float:
-    import jax
-
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
 
 
 def _stream_bandwidth() -> float:
-    """Measured triad bandwidth (GB/s): z = a*x + y on 2^26 f32 lanes."""
-    import jax
+    """Measured triad bandwidth (GB/s): x' = a*x + y, 2^26 f32 lanes —
+    512 MB working set so VMEM (~128 MB) cannot cache it."""
     import jax.numpy as jnp
+
+    from legate_sparse_tpu.bench_timing import loop_ms_per_iter
 
     n = 1 << 26
     x = jnp.ones((n,), dtype=jnp.float32)
-    y = jnp.ones((n,), dtype=jnp.float32)
-    triad = jax.jit(lambda x, y: 1.000001 * x + y)
-    dt = _time_fn(triad, x, y)
-    bytes_moved = 3 * 4 * n  # read x, read y, write z
-    return bytes_moved / dt / 1e9
+    y = jnp.full((n,), 1e-9, dtype=jnp.float32)
+    ms = loop_ms_per_iter(lambda v: 1.0000001 * v + y, x, k_lo=3, k_hi=18)
+    return 3 * 4 * n / (ms * 1e-3) / 1e9
 
 
 def _banded_config(sparse, n: int, nnz_per_row: int):
     half = nnz_per_row // 2
     offsets = list(range(-half, half + 1))
-    diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
+    # Row sums of 1.0 keep the chained x_{t+1} = A @ x_t magnitude-stable.
+    val = np.float32(1.0 / nnz_per_row)
+    diagonals = [np.full(n - abs(o), val, dtype=np.float32)
+                 for o in offsets]
     return sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
                         dtype=np.float32)
 
 
 def _irregular_config(sparse, n: int, nnz_per_row: int):
-    """Random-sparsity CSR with skewed row lengths: defeats the ELL
-    budget (one heavy row) so the segment-sum fallback is what runs."""
+    """Random-sparsity CSR with skewed row lengths: defeats band/ELL
+    detection (one heavy row) so the gather/segment-sum path runs."""
     rng = np.random.default_rng(0)
     counts = rng.integers(1, 2 * nnz_per_row, size=n).astype(np.int64)
     counts[0] = min(64 * nnz_per_row, n)  # heavy row blows the ELL budget
@@ -134,23 +109,21 @@ def _irregular_config(sparse, n: int, nnz_per_row: int):
 
 
 def _spmv_bytes(A, x) -> int:
-    """Byte-traffic model matching the kernel that actually runs.
-
-    With an active DIA cache (exactly-banded matrix) the shifted-add
-    kernel streams the (num_diags, cols) diagonal array + x + y.  With
-    an active ELL cache (``A._get_ell()``) the kernel streams the
-    (rows, W) padded data/cols blocks + per-row counts (never indptr);
-    otherwise the cached-structure path (``csr_spmv_rowids``) reads
-    values + column indices + an nnz-length row-id array + x, and
-    writes y.
-    """
+    """Byte-traffic model matching the kernel that actually runs (the
+    useful-traffic lower bound: x counted once even where a kernel
+    re-reads neighbor windows)."""
     n = A.shape[0]
     dia = A._get_dia()
     if dia is not None:
         dia_data, _offsets, mask = dia
+        mask_bytes = 0
+        if mask is not None:
+            # The Pallas kernel streams an int8 mask; the XLA fallback
+            # streams the bool (also 1 byte/slot).
+            mask_bytes = mask.size
         return int(
             dia_data.size * dia_data.dtype.itemsize
-            + (mask.size * mask.dtype.itemsize if mask is not None else 0)
+            + mask_bytes
             + x.size * x.dtype.itemsize
             + n * dia_data.dtype.itemsize
         )
@@ -161,8 +134,8 @@ def _spmv_bytes(A, x) -> int:
             ell_data.size * ell_data.dtype.itemsize
             + ell_cols.size * ell_cols.dtype.itemsize
             + ell_counts.size * ell_counts.dtype.itemsize
-            + n * x.dtype.itemsize          # gathered x (≥; gathers re-read)
-            + n * ell_data.dtype.itemsize   # written y
+            + n * x.dtype.itemsize
+            + n * ell_data.dtype.itemsize
         )
     nnz = A.nnz
     row_ids = A._get_row_ids()
@@ -172,6 +145,29 @@ def _spmv_bytes(A, x) -> int:
         + n * x.dtype.itemsize
         + n * A.data.dtype.itemsize
     )
+
+
+def _time_spmv_ms(A, x, normalize: bool, k_lo: int, k_hi: int) -> float:
+    """Chained A @ x per-iteration time; ``normalize`` rescales between
+    iterations for matrices whose row sums aren't ~1 (adds 2n words of
+    traffic, accounted by the caller)."""
+    import jax
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+
+    # Build structure caches eagerly (outside the trace).
+    _ = A @ x
+
+    if normalize:
+        def step(v):
+            y = A @ v
+            return y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-20)
+    else:
+        def step(v):
+            return A @ v
+
+    return loop_ms_per_iter(step, x, k_lo=k_lo, k_hi=k_hi)
 
 
 def main() -> None:
@@ -195,53 +191,65 @@ def main() -> None:
         pin_cpu()
         platform = jax.devices()[0].platform
 
-    # Size the banded config so its byte traffic (~870 MB at 2^24 rows,
-    # W=11, f32) matches the stream measurement's (~800 MB): this chip
-    # has a multi-ms fixed dispatch overhead per op, so a small working
-    # set would measure overhead, not bandwidth.  Overridable for
-    # smaller test chips.
-    n = 1 << int(os.environ.get("LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS", "24"))
-    nnz_per_row = 11
-    A = _banded_config(sparse, n, nnz_per_row)
-    x = jnp.ones((n,), dtype=jnp.float32)
-
-    # Time the shipped hot path (A @ x -> cached ELL kernel), exactly
-    # what every solver iteration executes.
-    dt = _time_fn(lambda: A @ x)
-    bw = _spmv_bytes(A, x) / dt / 1e9
-
-    stream = _stream_bandwidth()
-
-    # Secondary config: irregular matrix -> segment-sum fallback path.
-    irregular_gbs = None
-    try:
-        A_ir = _irregular_config(sparse, n // 4, nnz_per_row)
-        x_ir = jnp.ones((A_ir.shape[0],), dtype=jnp.float32)
-        dt_ir = _time_fn(lambda: A_ir @ x_ir)
-        irregular_gbs = _spmv_bytes(A_ir, x_ir) / dt_ir / 1e9
-    except Exception as e:  # secondary metric must not kill the headline
-        sys.stderr.write(f"bench: irregular config failed: {e!r}\n")
-
-    # The contract metric (vs_baseline >= 0.70 of TPU HBM roofline) must
-    # not be satisfiable by the CPU fallback: report null off-TPU and put
-    # the fallback's roofline fraction in its own key.
-    frac = round(bw / stream, 4)
     result = {
         "metric": "csr_spmv_bandwidth",
-        "value": round(bw, 2),
+        "value": None,
         "unit": "GB/s",
-        "vs_baseline": frac if platform != "cpu" else None,
+        "vs_baseline": None,
         "platform": platform,
-        "stream_gbs": round(stream, 2),
-        "spmv_ms": round(dt * 1e3, 4),
-        "path": ("dia" if A._get_dia() is not None
-                 else "ell" if A._get_ell() is not None else "csr"),
     }
-    if platform == "cpu":
-        result["cpu_vs_baseline"] = frac
-    if irregular_gbs is not None:
-        result["irregular_gbs"] = round(irregular_gbs, 2)
-        result["irregular_frac"] = round(irregular_gbs / stream, 4)
+
+    # On CPU shrink everything: the fallback exists to record *a* number.
+    default_log2 = "24" if platform != "cpu" else "20"
+    n = 1 << int(os.environ.get("LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS",
+                                default_log2))
+    nnz_per_row = 11
+
+    stream = None
+    try:
+        stream = _stream_bandwidth()
+        result["stream_gbs"] = round(stream, 2)
+    except Exception as e:
+        sys.stderr.write(f"bench: stream measurement failed: {e!r}\n")
+
+    try:
+        A = _banded_config(sparse, n, nnz_per_row)
+        x = jnp.full((n,), 1.0, dtype=jnp.float32)
+        dt_ms = _time_spmv_ms(A, x, normalize=False, k_lo=5, k_hi=35)
+        bw = _spmv_bytes(A, x) / (dt_ms * 1e-3) / 1e9
+        result["value"] = round(bw, 2)
+        result["spmv_ms"] = round(dt_ms, 4)
+        result["path"] = (
+            "dia" if A._get_dia() is not None
+            else "ell" if A._get_ell() is not None else "csr"
+        )
+        if stream:
+            frac = round(bw / stream, 4)
+            # The contract metric must not be satisfiable by the CPU
+            # fallback: report null off-TPU, fallback number separately.
+            if platform != "cpu":
+                result["vs_baseline"] = frac
+            else:
+                result["cpu_vs_baseline"] = frac
+    except Exception as e:
+        sys.stderr.write(f"bench: banded config failed: {e!r}\n")
+        result["error"] = repr(e)[:300]
+
+    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_IRREGULAR", "0") != "1":
+        try:
+            A_ir = _irregular_config(sparse, max(n // 16, 1 << 16),
+                                     nnz_per_row)
+            x_ir = jnp.ones((A_ir.shape[0],), dtype=jnp.float32)
+            dt_ms = _time_spmv_ms(A_ir, x_ir, normalize=True,
+                                  k_lo=2, k_hi=12)
+            extra = 2 * 4 * A_ir.shape[0]  # normalize read+write
+            bw_ir = (_spmv_bytes(A_ir, x_ir) + extra) / (dt_ms * 1e-3) / 1e9
+            result["irregular_gbs"] = round(bw_ir, 2)
+            if stream:
+                result["irregular_frac"] = round(bw_ir / stream, 4)
+        except Exception as e:
+            sys.stderr.write(f"bench: irregular config failed: {e!r}\n")
+
     print(json.dumps(result))
 
 

@@ -21,6 +21,16 @@ import sys
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# Subprocess snippet shared by bench.py and tools/tpu_capture.py: an
+# accelerator is "reachable" only if backend init succeeds AND one op
+# round-trips to completion (a crashed TPU worker can pass init but
+# hang on execution).
+ACCEL_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; ds = jax.devices(); "
+    "assert ds and ds[0].platform != 'cpu', ds; "
+    "assert float(jnp.ones((8, 128)).sum()) == 1024.0; print('ok')"
+)
+
 # State captured by the first pin_cpu() call, for restore_platform().
 _saved: dict | None = None
 
@@ -73,6 +83,65 @@ def pin_cpu(n_devices: int = 0, *, override_env: bool = True) -> None:
     if "jax_platforms_prior" not in _saved:
         _saved["jax_platforms_prior"] = jax.config.jax_platforms
     jax.config.update("jax_platforms", "cpu")
+    _re_resolve_dtype_policy()
+
+
+def _re_resolve_dtype_policy() -> None:
+    """The x64 default is platform-dependent (settings ``auto`` mode),
+    and the package is usually imported *before* pin_cpu runs (importing
+    this module imports the package) — so re-resolve after repinning."""
+    from .settings import settings, _resolve_x64
+
+    import jax
+
+    settings.x64 = _resolve_x64()
+    jax.config.update("jax_enable_x64", settings.x64)
+
+
+def ensure_live_backend(timeout_s: int = 30, retries: int = 0) -> bool:
+    """Probe the default accelerator in a subprocess (a dead tunnel
+    hangs rather than errors); pin the cpu platform when unreachable.
+    Returns True when the accelerator is live.
+
+    Plain CPU hosts (cpu-pinned, or no TPU signal at all) skip the
+    subprocess entirely — they'd pay a cold jax import for nothing.
+    """
+    import subprocess
+    import time
+
+    first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+    if first == "cpu":
+        return False
+    if first not in ("tpu", "axon"):
+        from .settings import _looks_tpu_hosted
+
+        if not _looks_tpu_hosted():
+            return False
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", ACCEL_PROBE_CODE],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return True
+            sys.stderr.write(
+                f"legate_sparse_tpu: accelerator probe attempt "
+                f"{attempt + 1} failed (rc={r.returncode}): "
+                f"{r.stderr.strip()[-400:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"legate_sparse_tpu: accelerator probe attempt "
+                f"{attempt + 1} timed out after {timeout_s}s\n"
+            )
+        if attempt < retries:
+            time.sleep(min(5 * (attempt + 1), 15))
+    sys.stderr.write(
+        "legate_sparse_tpu: accelerator unreachable; pinning cpu\n"
+    )
+    pin_cpu()
+    return False
 
 
 def restore_platform() -> None:
@@ -100,3 +169,4 @@ def restore_platform() -> None:
 
         clear_backends()
     _saved = None
+    _re_resolve_dtype_policy()

@@ -152,6 +152,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell_width = None
         self._dia = None
         self._dia_offsets = None
+        self._dia_pack = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
             f"indptr length {self._indptr.shape[0]} != rows+1 "
@@ -234,6 +235,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._data = value
         self._ell = None  # packed values are stale; sparsity is not
         self._dia = None
+        self._dia_pack = None
 
     @property
     def indices(self):
@@ -249,6 +251,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell_width = None
         self._dia = None
         self._dia_offsets = None
+        self._dia_pack = None
         self._canonical = None
         self._sorted = None
 
@@ -312,6 +315,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell_width = None
         self._dia = None
         self._dia_offsets = None
+        self._dia_pack = None
 
     def _canonicalized(self) -> "csr_array":
         if self.has_canonical_format:
@@ -436,6 +440,28 @@ class csr_array(CompressedBase, DenseSparseBase):
             self._dia = (dia_data, offsets, mask)
         return self._dia
 
+    def _get_dia_pack(self):
+        """Cached row-aligned band pack for the Pallas DIA kernel
+        (``ops/pallas_dia.py``), or None when the matrix isn't banded
+        or the kernel doesn't support it (f64, band reach > tile cap).
+        Built once per structure, on top of ``_get_dia()``."""
+        if self._dia_pack is not None:
+            return self._dia_pack if self._dia_pack is not False else None
+        dia = self._get_dia()
+        if dia is None or not self._can_build_cache(
+            self._data, self._indices, self._indptr
+        ):
+            if dia is None:
+                self._dia_pack = False
+            return None
+        from .ops import pallas_dia as _pallas_dia
+
+        dia_data, offsets, mask = dia
+        packed = _pallas_dia.pack_band(dia_data, offsets, self.shape,
+                                       mask=mask)
+        self._dia_pack = packed if packed is not None else False
+        return packed
+
     def _get_row_ids(self):
         """Cached per-nnz row ids, or a non-cached computation when a
         cache can't be built (inside a trace / tracer structure)."""
@@ -548,6 +574,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell_width = None
         self._dia = None
         self._dia_offsets = None
+        self._dia_pack = None
 
     def sort_indices(self):
         """Sort column indices within each row in place (stable; no
@@ -567,6 +594,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell = None
         self._dia = None
         self._dia_offsets = None
+        self._dia_pack = None
 
     def power(self, n, dtype=None):
         """Element-wise power (scipy semantics: duplicates are summed
@@ -718,14 +746,21 @@ class csr_array(CompressedBase, DenseSparseBase):
             ell = (src._get_ell() if src is not None and dia is None
                    else None)
             if dia is not None:
-                dia_data, offs, mask = dia
-                y = (
-                    _dia_ops.dia_spmv(dia_data, x, offs, self.shape)
-                    if mask is None
-                    else _dia_ops.dia_spmv_masked(
-                        dia_data, mask, x, offs, self.shape
-                    )
+                from .ops.pallas_dia import (
+                    dia_spmv_maybe_pallas, pallas_dia_active,
                 )
+
+                y = (dia_spmv_maybe_pallas(src._get_dia_pack(), x)
+                     if pallas_dia_active() else None)
+                if y is None:
+                    dia_data, offs, mask = dia
+                    y = (
+                        _dia_ops.dia_spmv(dia_data, x, offs, self.shape)
+                        if mask is None
+                        else _dia_ops.dia_spmv_masked(
+                            dia_data, mask, x, offs, self.shape
+                        )
+                    )
             elif ell is not None:
                 from .ops.pallas_spmv import ell_spmv_maybe_pallas
 

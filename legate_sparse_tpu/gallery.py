@@ -115,7 +115,9 @@ def kron(A, B, format=None):
     mA, nA = A.shape
     mB, nB = B.shape
     cdt = coord_dtype_for(max(mA * mB, nA * nB, 1))
-    if cdt.itemsize == 8 and jnp.zeros((), jnp.int64).dtype != jnp.int64:
+    import jax
+
+    if cdt.itemsize == 8 and not jax.config.jax_enable_x64:
         raise OverflowError(
             "kron output indices need int64 but x64 is disabled "
             "(LEGATE_SPARSE_TPU_X64=0); enable x64 for products this "

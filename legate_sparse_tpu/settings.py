@@ -27,9 +27,16 @@ Settings
     O(chunk + nnz_C) for product-heavy multiplies.
 
 ``x64`` (``LEGATE_SPARSE_TPU_X64``)
-    Enable float64 (scipy-parity default: on).  Set to ``0`` for
-    TPU-native float32/bfloat16-only operation.  On TPU float64 is
-    emulated (~10x slower) — production TPU runs should set ``0``.
+    ``1``/``0`` force float64 support on/off; unset (or ``auto``)
+    resolves by platform *without initializing any jax backend*:
+    CPU-hosted processes (``JAX_PLATFORMS`` names cpu first, e.g. the
+    test suite / multichip dryrun) get scipy-parity float64;
+    TPU-hosted processes (``JAX_PLATFORMS`` names tpu/axon first, or a
+    TPU runtime is importable) get float32/int32 — on TPU float64 is
+    emulated (~10x slower) and 64-bit types are rejected by Mosaic
+    (Pallas) kernels outright.  Other accelerator names resolve to
+    float64 (the split is TPU-specific; CUDA f64 is native, which is
+    also why the reference needs no such policy).
 
 ``check_bounds`` (``LEGATE_SPARSE_TPU_CHECK_BOUNDS``)
     Debug mode, the analog of the reference's ``--check-bounds``
@@ -50,11 +57,52 @@ def _env_bool(name: str, default: bool) -> bool:
     return val.lower() not in ("0", "false", "no", "off", "")
 
 
+def _looks_tpu_hosted() -> bool:
+    """Heuristic TPU detection with NO jax backend init (initializing an
+    unavailable tunnel can hang — the round-1 failure mode)."""
+    if os.environ.get("TPU_ACCELERATOR_TYPE") or os.environ.get(
+        "TPU_WORKER_HOSTNAMES"
+    ):
+        return True
+    try:
+        import importlib.util
+
+        return importlib.util.find_spec("libtpu") is not None
+    except Exception:
+        return False
+
+
+def _resolve_x64() -> bool:
+    val = os.environ.get("LEGATE_SPARSE_TPU_X64")
+    if val is not None and val.lower() != "auto":
+        return val.lower() not in ("0", "false", "no", "off", "")
+    # Platform signal: a programmatic pin (jax.config, e.g. pin_cpu with
+    # override_env=False under a TPU-set JAX_PLATFORMS env) outranks the
+    # env var.  Reading jax.config does NOT initialize a backend.
+    import sys
+
+    plats = ""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            plats = jax_mod.config.jax_platforms or ""
+        except Exception:
+            plats = ""
+    if not plats:
+        plats = os.environ.get("JAX_PLATFORMS", "")
+    first = plats.split(",")[0].strip().lower()
+    if first == "cpu":
+        return True
+    if first in ("tpu", "axon"):
+        return False
+    return not _looks_tpu_hosted()
+
+
 class Settings:
     def __init__(self) -> None:
         self.precise_images: bool = _env_bool("LEGATE_SPARSE_PRECISE_IMAGES", False)
         self.fast_spgemm: bool = _env_bool("LEGATE_SPARSE_FAST_SPGEMM", False)
-        self.x64: bool = _env_bool("LEGATE_SPARSE_TPU_X64", True)
+        self.x64: bool = _resolve_x64()
         self.check_bounds: bool = _env_bool(
             "LEGATE_SPARSE_TPU_CHECK_BOUNDS", False
         )
