@@ -11,9 +11,10 @@ TPU-first re-design:
 - Backends are ``tpu`` (this framework: jax-backed sparse + jitted
   solvers) and ``scipy`` (host differential baseline).  The reference's
   third backend (cupy) has no TPU analog.
-- ``JaxTimer`` brackets timed regions with ``jax.block_until_ready`` on
-  a flushed token — the XLA analog of ``legate.timing.time``'s implicit
-  execution fence (reference ``common.py:52-66``).
+- ``JaxTimer`` brackets timed regions with a host round-trip fetch —
+  the XLA analog of ``legate.timing.time``'s implicit execution fence
+  (reference ``common.py:52-66``), and the only sync that holds on
+  detached-dispatch backends (see the class docstring).
 - Phase scoping (reference ``Machine.only`` CPU-build/GPU-solve,
   ``common.py:128-159``) is a no-op scope: on TPU the build phase runs
   on host numpy and the solve phase under jit — the split is structural
@@ -27,6 +28,26 @@ import argparse
 import importlib
 
 import numpy
+
+
+def harness_float():
+    """Value dtype for the matrix generators.
+
+    tpu package runs follow the platform policy (f32 on TPU where f64
+    is emulated, scipy-parity f64 on CPU — ``settings.x64`` auto mode),
+    avoiding f64 host arrays that would be silently downcast at device
+    put.  ``--package scipy`` runs always get float64: the host
+    differential baseline keeps its independent f64 precision and stays
+    JAX-free."""
+    sparse_mod = globals().get("sparse")
+    if sparse_mod is not None and sparse_mod.__name__.startswith("scipy"):
+        return numpy.float64
+    try:
+        from legate_sparse_tpu.runtime import runtime
+
+        return runtime.default_float
+    except Exception:
+        return numpy.float64
 
 
 def get_arg_number(arg: str) -> int:
@@ -45,30 +66,57 @@ def get_arg_number(arg: str) -> int:
 
 
 class JaxTimer:
-    """Wall-clock timer with device synchronization at both ends."""
+    """Wall-clock timer with device synchronization at both ends.
+
+    Synchronization is a host ROUND TRIP (fetch a scalar computed from
+    a device buffer), not ``block_until_ready``: on detached-dispatch
+    backends (the axon TPU tunnel) ``block_until_ready`` returns at
+    dispatch-ack, before the device finishes, and a barrier-timed
+    region measures nothing (see ``legate_sparse_tpu/bench_timing.py``).
+    Execution is in-order per device, so fetching a freshly dispatched
+    scalar waits for all previously dispatched work.
+    """
 
     def __init__(self):
         self._start = None
+        self._token = None
+
+    def _sync(self):
+        import jax
+        import jax.numpy as jnp
+
+        jax.effects_barrier()
+        if self._token is None:
+            self._token = jnp.zeros((1,), jnp.float32)
+        # Device-dependent fetch: queued behind all prior dispatches.
+        float((self._token + 1.0)[0])
 
     def start(self):
         import time
-        import jax
 
         # Drain everything already dispatched so it is not charged to
         # the timed region (the reference's implicit fence).
-        jax.effects_barrier()
+        self._sync()
         self._start = time.perf_counter_ns()
 
     def stop(self, result=None):
-        """Milliseconds since start(); blocks on ``result`` if given,
-        else on a dispatch barrier."""
+        """Milliseconds since start(); round-trip syncs (on ``result``'s
+        first element if given — the cheapest true completion proof)."""
         import time
-        import jax
+        import numpy as _np
 
         if result is not None:
-            jax.block_until_ready(result)
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(result)
+            for leaf in leaves:
+                if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+                    float(_np.asarray(leaf.ravel()[0]))
+                    break
+            else:
+                self._sync()
         else:
-            jax.effects_barrier()
+            self._sync()
         return (time.perf_counter_ns() - self._start) / 1e6
 
 
@@ -169,7 +217,7 @@ def banded_matrix(N: int, nnz_per_row: int, from_diags: bool = False):
             [d - nnz_per_row // 2 for d in range(nnz_per_row)],
             shape=(N, N),
             format="csr",
-            dtype=numpy.float64,
+            dtype=harness_float(),
         )
     assert N > nnz_per_row and nnz_per_row % 2 == 1
     half = nnz_per_row // 2
@@ -178,7 +226,7 @@ def banded_matrix(N: int, nnz_per_row: int, from_diags: bool = False):
     ) + numpy.repeat(numpy.arange(N), nnz_per_row)
     mask = (cols >= 0) & (cols < N)
     cols = cols[mask]
-    data = numpy.ones(cols.shape[0], dtype=numpy.float64)
+    data = numpy.ones(cols.shape[0], dtype=harness_float())
     counts = mask.reshape(N, nnz_per_row).sum(axis=1)
     indptr = numpy.zeros(N + 1, dtype=numpy.int64)
     numpy.cumsum(counts, out=indptr[1:])
@@ -187,13 +235,14 @@ def banded_matrix(N: int, nnz_per_row: int, from_diags: bool = False):
     )
 
 
-def stencil_grid(S, grid, dtype=numpy.float64):
+def stencil_grid(S, grid, dtype=None):
     """CSR operator applying stencil ``S`` over an N-D ``grid`` with
     zero (Dirichlet) boundaries (reference ``common.py:252-310``).
 
     Vectorized: one DIA band per nonzero stencil entry, boundary
     connections zeroed by index arithmetic instead of slice loops.
     """
+    dtype = harness_float() if dtype is None else dtype
     S = numpy.asarray(S, dtype=dtype)
     grid = tuple(int(g) for g in grid)
     N_v = int(numpy.prod(grid))
@@ -250,7 +299,7 @@ def poisson2D(N: int):
     return sparse.diags(
         [diag_g, diag_a, diag_c, diag_a, diag_g],
         [-N, -1, 0, 1, N],
-        dtype=numpy.float64,
+        dtype=harness_float(),
     ).tocsr()
 
 

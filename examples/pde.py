@@ -13,7 +13,7 @@ throughput the same way, ``pde.py:180-205``).
 import argparse
 import sys
 
-from common import get_phase_procs, parse_common_args
+from common import get_phase_procs, harness_float, parse_common_args
 
 
 def d2_mat_dirichlet_2d(nx, ny, dx, dy):
@@ -33,7 +33,7 @@ def d2_mat_dirichlet_2d(nx, ny, dx, dy):
     return sparse.diags(
         [diag_g, diag_a, diag_c, diag_a, diag_g],
         [-(nx - 2), -1, 0, 1, nx - 2],
-        dtype=np.float64,
+        dtype=harness_float(),
     ).tocsr()
 
 
@@ -139,7 +139,7 @@ def execute_distributed(nx, ny, throughput, tol, max_iters, warmup_iters,
     mesh = make_row_mesh()
     dA = dist_diags(
         [c, off1, off1, g, g], [0, 1, -1, m, -m], shape=(n, n),
-        mesh=mesh, dtype=np.float64,
+        mesh=mesh, dtype=harness_float(),
         # Solver-only use: skip the ELL blocks, keep per-device matrix
         # memory at one DIA copy (the 1e8-row scale configuration).
         materialize_ell=False,

@@ -112,10 +112,16 @@ def ensure_live_backend(timeout_s: int = 30, retries: int = 0) -> bool:
     first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
     if first == "cpu":
         return False
-    if first not in ("tpu", "axon"):
+    if not first:
+        # Env unset: probe only when some accelerator signal exists —
+        # a plain CPU host should not pay a cold subprocess jax import.
+        # (A named accelerator platform, e.g. cuda, always probes.)
         from .settings import _looks_tpu_hosted
 
-        if not _looks_tpu_hosted():
+        gpu_hint = bool(os.environ.get("CUDA_VISIBLE_DEVICES")) or (
+            os.path.exists("/dev/nvidia0")
+        )
+        if not _looks_tpu_hosted() and not gpu_hint:
             return False
     for attempt in range(retries + 1):
         try:

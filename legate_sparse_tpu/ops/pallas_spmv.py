@@ -99,6 +99,19 @@ def ell_spmv_maybe_pallas(ell_data, ell_cols, ell_counts, x):
     key = (rows_p, W, str(ell_data.dtype), interpret)
     if _PALLAS_OK.get(key) is False:
         return None
+    if _PALLAS_OK.get(key) is None:
+        # Never make the FIRST attempt from inside an outer trace (the
+        # solvers jit whole iteration loops): a Mosaic compile failure
+        # would surface at the outer jit's compile, outside this except,
+        # with no fallback.  Defer to the XLA path until an eager call
+        # proves the kernel; same policy as pallas_dia.pack_band.
+        try:
+            from jax._src.core import trace_state_clean
+
+            if not trace_state_clean():
+                return None
+        except ImportError:  # jax internals moved; be conservative
+            return None
     pad = rows_p - rows
     if pad:
         zd = jnp.zeros((pad, W), ell_data.dtype)

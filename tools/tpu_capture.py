@@ -109,6 +109,50 @@ res["xla_ell_ms"] = round(ms, 4)
 print(json.dumps(res))
 """
 
+SPGEMM_TIMING = r"""
+import time, json
+import numpy as np, jax, jax.numpy as jnp
+import legate_sparse_tpu as sparse
+
+res = {"platform": jax.devices()[0].platform}
+
+def end_to_end_ms(f, reps=3):
+    # SpGEMM is host-coupled (nnz size oracle blocks), so time the
+    # whole user-visible call with a true result fetch; best-of-reps
+    # after a warmup.  Includes ~one RPC round trip of fixed cost.
+    f()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        C = f()
+        _ = float(np.asarray(C.data[0]))
+        best = min(best, time.perf_counter() - t0)
+    return round(best * 1e3, 2)
+
+n, W = 1 << 20, 11
+half = W // 2
+offs = list(range(-half, half + 1))
+val = np.float32(1.0 / W)
+diags = [np.full(n - abs(o), val, dtype=np.float32) for o in offs]
+A = sparse.diags(diags, offs, shape=(n, n), format="csr", dtype=np.float32)
+res["banded_n"] = n
+res["banded_spgemm_ms"] = end_to_end_ms(lambda: A @ A)
+
+m = 1 << 17
+rng = np.random.default_rng(0)
+counts = rng.integers(1, 2 * W, size=m).astype(np.int64)
+indptr = np.zeros(m + 1, np.int64); np.cumsum(counts, out=indptr[1:])
+nnz = int(indptr[-1])
+cols = rng.integers(0, m, size=nnz).astype(np.int32)
+row_ids = np.repeat(np.arange(m), counts)
+order = np.lexsort((cols, row_ids))
+B = sparse.csr_array((np.ones(nnz, np.float32), cols[order], indptr),
+                     shape=(m, m))
+res["esc_n"] = m
+res["esc_spgemm_ms"] = end_to_end_ms(lambda: B @ B)
+print(json.dumps(res))
+"""
+
 CG_TIMING = r"""
 import time, json
 import numpy as np, jax
@@ -137,10 +181,15 @@ def timed(maxiter):
     return best
 
 dt, dt2 = timed(200), timed(400)
-per_iter = (dt2 - dt) / 200        # fixed dispatch+fetch cost cancels
-print(json.dumps({"grid": f"{N}x{N}", "rows": n,
-                  "cg_ms_per_iter": round(per_iter * 1e3, 4),
-                  "platform": jax.devices()[0].platform}))
+if dt2 <= dt:
+    print(json.dumps({"grid": f"{N}x{N}", "rows": n,
+                      "error": "unresolvable timing",
+                      "t200_s": round(dt, 4), "t400_s": round(dt2, 4)}))
+else:
+    per_iter = (dt2 - dt) / 200    # fixed dispatch+fetch cost cancels
+    print(json.dumps({"grid": f"{N}x{N}", "rows": n,
+                      "cg_ms_per_iter": round(per_iter * 1e3, 4),
+                      "platform": jax.devices()[0].platform}))
 """
 
 
@@ -172,6 +221,11 @@ def main() -> None:
 
     rc, out, err = run([sys.executable, "-c", CG_TIMING], 1800)
     lines.append(f"### CG pde 2048^2 f32 (rc={rc})\n```json\n{out.strip()}\n```\n")
+    if rc != 0:
+        lines.append(f"stderr: `{err[-500:]}`\n")
+
+    rc, out, err = run([sys.executable, "-c", SPGEMM_TIMING], 1800)
+    lines.append(f"### SpGEMM end-to-end (rc={rc})\n```json\n{out.strip()}\n```\n")
     if rc != 0:
         lines.append(f"stderr: `{err[-500:]}`\n")
 
