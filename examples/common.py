@@ -26,8 +26,21 @@ TPU-first re-design:
 
 import argparse
 import importlib
+import importlib.util
+import os
+import sys
 
 import numpy
+
+# Make the repo checkout importable when examples run uninstalled
+# (`python examples/pde.py` puts examples/ on sys.path, not the root).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.path.isdir(os.path.join(_ROOT, "legate_sparse_tpu")):
+    # find_spec tests importability without executing the package (the
+    # scipy baseline path must stay JAX-free, and importing the package
+    # pulls in jax).
+    if importlib.util.find_spec("legate_sparse_tpu") is None:
+        sys.path.insert(0, _ROOT)
 
 
 def harness_float():

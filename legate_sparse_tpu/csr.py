@@ -789,14 +789,27 @@ class csr_array(CompressedBase, DenseSparseBase):
             ell = (src._get_ell() if src is not None and dia is None
                    else None)
             if dia is not None:
-                dia_data, offs, mask = dia
-                Y = (
-                    _dia_ops.dia_spmm(dia_data, X, offs, self.shape)
-                    if mask is None
-                    else _dia_ops.dia_spmm_masked(
-                        dia_data, mask, X, offs, self.shape
-                    )
+                from .ops.pallas_dia import (
+                    SPMM_MAX_K, dia_spmm_maybe_pallas, pallas_dia_active,
                 )
+
+                # Cheap k gate first: the pack build doubles band
+                # storage and must not run for calls that can only
+                # take the XLA path anyway.
+                Y = (
+                    dia_spmm_maybe_pallas(src._get_dia_pack(), X)
+                    if 0 < X.shape[1] <= SPMM_MAX_K and pallas_dia_active()
+                    else None
+                )
+                if Y is None:
+                    dia_data, offs, mask = dia
+                    Y = (
+                        _dia_ops.dia_spmm(dia_data, X, offs, self.shape)
+                        if mask is None
+                        else _dia_ops.dia_spmm_masked(
+                            dia_data, mask, X, offs, self.shape
+                        )
+                    )
             elif ell is not None:
                 Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
             elif src is not None:

@@ -41,7 +41,11 @@ def diags(diagonals, offsets=0, shape=None, format=None, dtype=None):
         if not np.issubdtype(dtype, np.floating) and not np.issubdtype(
             dtype, np.complexfloating
         ):
-            dtype = dtype  # keep integer dtypes as scipy does
+            # scipy.sparse.diags casts integer input to float64 (its
+            # FutureWarning notwithstanding), and integer matrices can't
+            # reach the SpMV kernels anyway (reference gates dtypes the
+            # same way).  Follow the platform float policy.
+            dtype = runtime.default_float
     dtype = np.dtype(dtype)
 
     if shape is None:

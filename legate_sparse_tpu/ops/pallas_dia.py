@@ -238,6 +238,169 @@ def pallas_dia_spmv(rdata, rmask, x, offsets: Tuple[int, ...],
     return y2.reshape(-1)[:rows]
 
 
+def _make_spmm_kernel(offsets: Tuple[int, ...], rows: int, cols: int,
+                      tile: int, masked: bool, interpret: bool):
+    """SpMM (dense multi-RHS) variant: X tiles are (tile, k), shifts
+    move whole rows — a pure sublane roll, no lane decomposition."""
+
+    def kernel(*refs):
+        if masked:
+            xm_ref, xc_ref, xp_ref, d_ref, m_ref, y_ref = refs
+        else:
+            xm_ref, xc_ref, xp_ref, d_ref, y_ref = refs
+            m_ref = None
+        import jax.experimental.pallas as pl
+
+        if interpret:
+            roll = lambda a, amt: jnp.roll(a, amt, 0)
+        else:
+            from jax.experimental.pallas import tpu as pltpu
+
+            roll = lambda a, amt: pltpu.roll(a, amt, 0)
+
+        base = pl.program_id(0) * tile
+        w = jnp.concatenate([xm_ref[:], xc_ref[:], xp_ref[:]], axis=0)
+        R3 = 3 * tile
+        gi = base + jax.lax.broadcasted_iota(jnp.int32, (tile, 1), 0)
+        dtype = d_ref.dtype
+        acc_dtype = jnp.float32 if dtype != jnp.float64 else dtype
+        acc = jnp.zeros((tile, w.shape[1]), acc_dtype)
+        for di, off in enumerate(offsets):
+            xs = roll(w, (R3 - (tile + off)) % R3)[:tile]
+            valid = (gi + off >= 0) & (gi + off < cols) & (gi < rows)
+            if masked:
+                valid = valid & (m_ref[di] > 0)
+            xsafe = jnp.where(valid, xs, jnp.zeros((), xs.dtype))
+            acc = acc + (d_ref[di] * xsafe).astype(acc_dtype)
+        y_ref[:] = acc.astype(dtype)
+
+    return kernel
+
+
+# Widest dense X the SpMM kernel takes before falling back (VMEM: the
+# three neighbor tiles + output at k lanes each).
+SPMM_MAX_K = 1024
+
+
+@partial(jax.jit,
+         static_argnames=("offsets", "shape", "tile", "interpret"))
+def pallas_dia_spmm(rdata, rmask, X, offsets: Tuple[int, ...],
+                    shape: Tuple[int, int], tile: int,
+                    interpret: bool = False):
+    """Y = A @ X for dense X (cols, k) over the row-aligned band pack.
+
+    Row shifts of a 2-D X are sublane-dimension rolls — cheaper than
+    the SpMV case, which must also decompose across lanes.
+    """
+    import jax.experimental.pallas as pl
+
+    rows, cols = shape
+    nd = len(offsets)
+    k = X.shape[1]
+    rows_pad = rdata.shape[1] * rdata.shape[2]
+    nt = rows_pad // tile
+    x_pad = -(-max(cols, rows_pad) // tile) * tile
+    ntx = x_pad // tile
+    Xv = jnp.pad(X, ((0, x_pad - cols), (0, 0)))
+    # Row-vector view of the band data: (nd, rows_pad, 1) broadcasts
+    # over X's k columns (bitcast-compatible reshape of the SpMV pack).
+    rd = rdata.reshape(nd, rows_pad, 1)
+    rm = rmask.reshape(nd, rows_pad, 1) if rmask is not None else None
+
+    masked = rm is not None
+    kernel = _make_spmm_kernel(offsets, rows, cols, tile, masked,
+                               interpret)
+    in_specs = [
+        pl.BlockSpec((tile, k), lambda i: (jnp.maximum(i - 1, 0), 0)),
+        pl.BlockSpec((tile, k), lambda i: (jnp.minimum(i, ntx - 1), 0)),
+        pl.BlockSpec((tile, k), lambda i: (jnp.minimum(i + 1, ntx - 1), 0)),
+        pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)),
+    ]
+    args = [Xv, Xv, Xv, rd]
+    if masked:
+        in_specs.append(pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)))
+        args.append(rm)
+
+    Y = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_pad, k), rdata.dtype),
+        grid=(nt,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((tile, k), lambda i: (i, 0)),
+        interpret=interpret,
+    )(*args)
+    return Y[:rows]
+
+
+_SPMM_FAILED: set = set()
+_SPMM_OK: set = set()
+
+
+def _spmm_tile(packed, k: int) -> Optional[int]:
+    """Row-tile for the SpMM kernel: VMEM scales with k, so it is
+    chosen per (band, k) — a power-of-two divisor of the SpMV tile that
+    still covers the band reach and fits the budget."""
+    max_off = max(abs(o) for o in packed.offsets)
+    tile = 1024
+    while tile < max_off:
+        tile *= 2
+    if tile > packed.tile:
+        return None
+    itemsize = np.dtype(packed.rdata.dtype).itemsize
+    nd = len(packed.offsets)
+    vmem = 4 * tile * k * itemsize + nd * tile * (itemsize + 1)
+    return tile if vmem <= _VMEM_BUDGET else None
+
+
+def dia_spmm_maybe_pallas(packed, X):
+    """SpMM through the Pallas kernel, or None for the XLA fallback."""
+    mode = _mode()
+    if mode == "0" or packed is None:
+        return None
+    k = X.shape[1]
+    if k == 0 or k > SPMM_MAX_K:
+        return None
+    interpret = mode == "interpret"
+    if not interpret:
+        try:
+            if jax.devices()[0].platform != "tpu":
+                return None
+        except Exception:
+            return None
+    tile = _spmm_tile(packed, k)
+    if tile is None:
+        return None
+    key = (packed.offsets, tile, k, str(packed.rdata.dtype), interpret)
+    if key in _SPMM_FAILED:
+        return None
+    # Never FIRST-attempt inside an outer trace (compile errors there
+    # escape this except with no fallback); eager calls prove the key.
+    if key not in _SPMM_OK:
+        try:
+            from jax._src.core import trace_state_clean
+
+            if not trace_state_clean():
+                return None
+        except ImportError:
+            return None
+    try:
+        y = pallas_dia_spmm(
+            packed.rdata, packed.rmask, X, packed.offsets, packed.shape,
+            tile, interpret=interpret,
+        )
+        _SPMM_OK.add(key)
+        return y
+    except Exception as e:
+        import sys
+
+        sys.stderr.write(
+            f"legate_sparse_tpu: pallas DIA SpMM unavailable "
+            f"({e!r:.200}); using XLA path\n"
+        )
+        _SPMM_FAILED.add(key)
+        return None
+
+
 # Runtime dispatch gate: default ON for TPU backends (the measured 7.5x
 # over the XLA path), opt out with LEGATE_SPARSE_TPU_PALLAS_DIA=0.
 # "interpret" forces the interpret-mode kernel on CPU (differential

@@ -71,3 +71,13 @@ def test_eye_identity():
         np.asarray(sparse.eye(4, 6, k=1, format="csr").todense()),
         np.eye(4, 6, k=1),
     )
+
+
+def test_diags_integer_input_casts_to_float():
+    # scipy.sparse.diags casts integer diagonals to float64; keeping
+    # int64 made A @ x raise (integer dtypes are gated out of the
+    # kernels, same as the reference).  Platform float policy applies.
+    ours = sparse.diags([1, -2, 1], [-1, 0, 1], shape=(5, 5), format="csr")
+    assert np.issubdtype(ours.dtype, np.floating)
+    y = np.asarray(ours @ np.ones(5, dtype=ours.dtype))
+    np.testing.assert_allclose(y, [-1.0, 0.0, 0.0, 0.0, -1.0])

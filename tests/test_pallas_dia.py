@@ -159,3 +159,61 @@ def test_dispatch_interpret_mode(rng, monkeypatch):
     A2 = sparse.csr_array(A_sp)
     y_xla = np.asarray(A2 @ jnp.asarray(x))
     np.testing.assert_allclose(y, y_xla, rtol=1e-6, atol=1e-6)
+
+
+# ---------------- SpMM (dense multi-RHS) variant ----------------
+
+def _spmm_via_pallas(A, X):
+    dia = A._get_dia()
+    assert dia is not None
+    dia_data, offsets, mask = dia
+    packed = pallas_dia.pack_band(dia_data, offsets, A.shape, mask=mask)
+    assert packed is not None
+    tile = pallas_dia._spmm_tile(packed, X.shape[1])
+    assert tile is not None
+    return np.asarray(
+        pallas_dia.pallas_dia_spmm(
+            packed.rdata, packed.rmask, jnp.asarray(X), packed.offsets,
+            packed.shape, tile, interpret=True,
+        )
+    )
+
+
+@pytest.mark.parametrize("k", [1, 3, 32])
+def test_spmm_exact_band(k, rng):
+    n = 700
+    A, A_sp = _banded(n, [-2, 0, 1], rng)
+    X = rng.standard_normal((n, k)).astype(np.float32)
+    Y = _spmm_via_pallas(A, X)
+    np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)
+
+
+def test_spmm_holey_band_mask(rng):
+    n = 400
+    main = rng.standard_normal(n).astype(np.float32)
+    off1 = rng.standard_normal(n - 1).astype(np.float32)
+    off1[::5] = 0.0
+    A_sp = scsp.diags([main, off1], [0, 1], format="csr")
+    A_sp.eliminate_zeros()
+    A = sparse.csr_array(A_sp)
+    assert A._get_dia()[2] is not None
+    X = rng.standard_normal((n, 4)).astype(np.float32)
+    Y = _spmm_via_pallas(A, X)
+    np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)
+
+
+def test_spmm_large_offsets(rng):
+    n = 4096
+    A, A_sp = _banded(n, [-1100, 0, 1100], rng)
+    X = rng.standard_normal((n, 8)).astype(np.float32)
+    Y = _spmm_via_pallas(A, X)
+    np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)
+
+
+def test_spmm_dispatch_interpret(rng, monkeypatch):
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_DIA", "interpret")
+    n = 512
+    A, A_sp = _banded(n, [-1, 0, 1], rng)
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    Y = np.asarray(A @ jnp.asarray(X))
+    np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)

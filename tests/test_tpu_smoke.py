@@ -83,6 +83,23 @@ def test_pallas_dia_kernel_on_chip(accel):
     np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-5)
 
 
+def test_pallas_dia_spmm_on_chip(accel):
+    from legate_sparse_tpu.ops import pallas_dia
+
+    A = _poisson(32)
+    packed = A._get_dia_pack()
+    assert packed is not None
+    X = np.linspace(-1.0, 1.0, A.shape[0] * 4).reshape(
+        A.shape[0], 4).astype(np.float32)
+    tile = pallas_dia._spmm_tile(packed, 4)
+    assert tile is not None
+    Y = np.asarray(pallas_dia.pallas_dia_spmm(
+        packed.rdata, packed.rmask, X, packed.offsets, packed.shape,
+        tile, interpret=False,
+    ))
+    np.testing.assert_allclose(Y, A.toscipy() @ X, rtol=1e-5, atol=1e-5)
+
+
 def test_cg_converges(accel):
     A = _poisson(16)
     b = np.ones(A.shape[0], dtype=np.float32)
