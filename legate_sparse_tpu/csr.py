@@ -916,10 +916,22 @@ def spgemm_csr_csr_csr(A: csr_array, B: csr_array) -> csr_array:
                 dia_a[1], dia_b[1], offs_c, A.shape, B.shape
             )
         ):
-            Cd = _dia_ops.dia_spgemm(
-                dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
-                A.shape, B.shape,
+            from .ops.pallas_dia import (
+                dia_spgemm_maybe_pallas, pallas_dia_active,
             )
+
+            Cd = (
+                dia_spgemm_maybe_pallas(
+                    dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
+                    A.shape, B.shape,
+                )
+                if pallas_dia_active() else None
+            )
+            if Cd is None:
+                Cd = _dia_ops.dia_spgemm(
+                    dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
+                    A.shape, B.shape,
+                )
             data, indices, indptr = _dia_ops.band_to_csr(
                 Cd, offs_c, (m, n), nnz_c
             )

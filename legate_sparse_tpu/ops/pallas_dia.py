@@ -134,28 +134,30 @@ def row_align(dia_data, offsets: Tuple[int, ...], shape: Tuple[int, int],
     return rdata, rmask
 
 
-def _flat_shift(w, s: int, lane, interpret: bool):
-    """xs with ``xs_flat[p] = w_flat[p + s]`` for a (R, L) block ``w``
-    (rows wrap modulo R — callers only read rows whose sources stay in
-    bounds).  Lowered as sublane+lane rolls plus a lane select."""
-    R = w.shape[0]
+def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
+    """xs with ``xs_flat[p] = w_flat[p + s]`` along the flattened last
+    two dims of ``w`` (.., R, L); leading dims (axis base > 0) are
+    batch.  Rows wrap modulo R — callers only read rows whose sources
+    stay in bounds.  Lowered as sublane+lane rolls plus a lane select
+    against the caller-built ``lane`` iota (same shape as ``w``)."""
+    R = w.shape[axis]
     q, r = divmod(s, L)
 
     if interpret:
-        roll = lambda a, amt, axis: jnp.roll(a, amt, axis)
+        roll = lambda a, amt, ax: jnp.roll(a, amt, ax)
     else:
         from jax.experimental.pallas import tpu as pltpu
 
-        roll = lambda a, amt, axis: pltpu.roll(a, amt, axis)
+        roll = lambda a, amt, ax: pltpu.roll(a, amt, ax)
 
     def rowroll(q_):
         amt = (R - q_) % R
-        return roll(w, amt, 0) if amt else w
+        return roll(w, amt, axis) if amt else w
 
     if r == 0:
         return rowroll(q)
-    a = roll(rowroll(q), L - r, 1)
-    b = roll(rowroll(q + 1), L - r, 1)
+    a = roll(rowroll(q), L - r, axis + 1)
+    b = roll(rowroll(q + 1), L - r, axis + 1)
     return jnp.where(lane < L - r, a, b)
 
 
@@ -370,7 +372,8 @@ def dia_spmm_maybe_pallas(packed, X):
     tile = _spmm_tile(packed, k)
     if tile is None:
         return None
-    key = (packed.offsets, tile, k, str(packed.rdata.dtype), interpret)
+    key = (packed.offsets, tile, k, str(packed.rdata.dtype),
+           packed.rmask is not None, packed.shape, interpret)
     if key in _SPMM_FAILED:
         return None
     # Never FIRST-attempt inside an outer trace (compile errors there
@@ -398,6 +401,175 @@ def dia_spmm_maybe_pallas(packed, X):
             f"({e!r:.200}); using XLA path\n"
         )
         _SPMM_FAILED.add(key)
+        return None
+
+
+def _make_spgemm_kernel(offs_a: Tuple[int, ...], offs_b: Tuple[int, ...],
+                        offs_c: Tuple[int, ...], shape_a, shape_b,
+                        tile: int, interpret: bool):
+    """Banded SpGEMM: C[oc, j] += A[oa, j-ob] * B[ob, j] over all
+    (oa, ob) pairs.  B and C are j-aligned; only A needs the roll-shift
+    (by -ob), so per B-diagonal ALL of A's diagonals shift together.
+    Exact bands only (the dispatch gates on no hole masks), so validity
+    is the static per-pair range [j_lo, j_hi)."""
+    m, k = shape_a
+    _, n = shape_b
+    Rt = tile // L
+    idx_c = {o: i for i, o in enumerate(offs_c)}
+
+    def kernel(am_ref, ac_ref, ap_ref, b_ref, c_ref):
+        import jax.experimental.pallas as pl
+
+        base = pl.program_id(0) * tile
+        wA = jnp.concatenate([am_ref[:], ac_ref[:], ap_ref[:]], axis=1)
+        lane3 = jax.lax.broadcasted_iota(
+            jnp.int32, (wA.shape[0], 3 * Rt, L), 2
+        )
+        row_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, L), 0)
+        lane_t = jax.lax.broadcasted_iota(jnp.int32, (Rt, L), 1)
+        gj = base + row_t * L + lane_t           # global output column
+        dtype = b_ref.dtype
+        acc_dtype = jnp.float32 if dtype != jnp.float64 else dtype
+        accs = [jnp.zeros((Rt, L), acc_dtype) for _ in offs_c]
+        for b_i, ob in enumerate(offs_b):
+            # One shift serves every A diagonal for this ob.
+            xsA = _flat_shift3(wA, -ob, lane3, interpret)[:, Rt: 2 * Rt, :]
+            bt = b_ref[b_i]
+            for a_i, oa in enumerate(offs_a):
+                oc = oa + ob
+                j_lo = max(0, ob, oc)
+                j_hi = min(n, k + ob, m + oc)
+                if j_hi <= j_lo:
+                    continue
+                valid = (gj >= j_lo) & (gj < j_hi)
+                contrib = jnp.where(valid, xsA[a_i] * bt,
+                                    jnp.zeros((), dtype))
+                ci = idx_c[oc]
+                accs[ci] = accs[ci] + contrib.astype(acc_dtype)
+        c_ref[:] = jnp.stack(accs).astype(dtype)
+
+    return kernel
+
+
+def _flat_shift3(w3, s: int, lane3, interpret: bool):
+    """Batched ``_flat_shift`` over a (nd, R, L) stack (axis base 1)."""
+    return _flat_shift(w3, s, lane3, interpret, axis=1)
+
+
+@partial(jax.jit, static_argnames=("offs_a", "offs_b", "offs_c",
+                                   "shape_a", "shape_b", "tile",
+                                   "interpret"))
+def pallas_dia_spgemm(a_data, b_data, offs_a: Tuple[int, ...],
+                      offs_b: Tuple[int, ...], offs_c: Tuple[int, ...],
+                      shape_a: Tuple[int, int],
+                      shape_b: Tuple[int, int], tile: int,
+                      interpret: bool = False):
+    """C_dia = A_dia @ B_dia (scipy column-aligned layout in and out,
+    C width = cols of B), Mosaic-rolled — the banded-SpGEMM analog of
+    ``pallas_dia_spmv``.  Returns (ndc, n)."""
+    import jax.experimental.pallas as pl
+
+    _, k = shape_a
+    n = shape_b[1]
+    Rt = tile // L
+    nda, ndb, ndc = len(offs_a), len(offs_b), len(offs_c)
+
+    # Pad both bands' widths to tile multiples; A's far enough that a
+    # clamped neighbor view always exists for the C grid.
+    pc = -(-n // tile) * tile
+    pa = -(-max(k, pc) // tile) * tile
+    nta = pa // tile
+    av = jnp.pad(a_data, ((0, 0), (0, pa - k))).reshape(nda, -1, L)
+    bv = jnp.pad(b_data, ((0, 0), (0, pc - n))).reshape(ndb, -1, L)
+
+    kernel = _make_spgemm_kernel(offs_a, offs_b, offs_c, shape_a,
+                                 shape_b, tile, interpret)
+    C = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((ndc, pc // L, L), b_data.dtype),
+        grid=(pc // tile,),
+        in_specs=[
+            pl.BlockSpec((nda, Rt, L),
+                         lambda i: (0, jnp.maximum(i - 1, 0), 0)),
+            pl.BlockSpec((nda, Rt, L),
+                         lambda i: (0, jnp.minimum(i, nta - 1), 0)),
+            pl.BlockSpec((nda, Rt, L),
+                         lambda i: (0, jnp.minimum(i + 1, nta - 1), 0)),
+            pl.BlockSpec((ndb, Rt, L), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ndc, Rt, L), lambda i: (0, i, 0)),
+        interpret=interpret,
+    )(av, av, av, bv)
+    return C.reshape(ndc, -1)[:, :n]
+
+
+_SPGEMM_FAILED: set = set()
+_SPGEMM_OK: set = set()
+
+
+def _spgemm_tile(offs_b, nda, ndb, ndc, dtype) -> Optional[int]:
+    """Tile for the banded SpGEMM kernel: must cover the B-offset
+    reach (A is shifted by -ob) and fit the working set in VMEM."""
+    max_ob = max(abs(o) for o in offs_b) if offs_b else 0
+    tile = choose_tile(max_ob)
+    if tile is None:
+        return None
+    itemsize = np.dtype(dtype).itemsize
+    vmem = (3 * nda + ndb + 2 * ndc) * tile * itemsize
+    while vmem > _VMEM_BUDGET and tile > TILE_MIN:
+        tile //= 2
+        vmem //= 2
+    if tile < max_ob or vmem > _VMEM_BUDGET:
+        return None
+    return tile
+
+
+def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
+                            shape_a, shape_b):
+    """Banded SpGEMM through the Pallas kernel, or None (XLA path)."""
+    mode = _mode()
+    if mode == "0":
+        return None
+    if np.dtype(a_data.dtype) not in (np.dtype(np.float32),
+                                      np.dtype(jnp.bfloat16)):
+        return None
+    interpret = mode == "interpret"
+    if not interpret:
+        try:
+            if jax.devices()[0].platform != "tpu":
+                return None
+        except Exception:
+            return None
+    tile = _spgemm_tile(offs_b, len(offs_a), len(offs_b), len(offs_c),
+                        a_data.dtype)
+    if tile is None:
+        return None
+    key = (offs_a, offs_b, tile, str(a_data.dtype), shape_a, shape_b,
+           interpret)
+    if key in _SPGEMM_FAILED:
+        return None
+    if key not in _SPGEMM_OK:
+        try:
+            from jax._src.core import trace_state_clean
+
+            if not trace_state_clean():
+                return None
+        except ImportError:
+            return None
+    try:
+        C = pallas_dia_spgemm(a_data, b_data, offs_a, offs_b, offs_c,
+                              shape_a, shape_b, tile,
+                              interpret=interpret)
+        _SPGEMM_OK.add(key)
+        return C
+    except Exception as e:
+        import sys
+
+        sys.stderr.write(
+            f"legate_sparse_tpu: pallas DIA SpGEMM unavailable "
+            f"({e!r:.200}); using XLA path\n"
+        )
+        _SPGEMM_FAILED.add(key)
         return None
 
 

@@ -217,3 +217,86 @@ def test_spmm_dispatch_interpret(rng, monkeypatch):
     X = rng.standard_normal((n, 5)).astype(np.float32)
     Y = np.asarray(A @ jnp.asarray(X))
     np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)
+
+
+# ---------------- banded SpGEMM variant ----------------
+
+def _exact_band(n, offsets, rng, m=None):
+    """Band with every in-bounds slot explicit (no holes)."""
+    m = n if m is None else m
+    diags = []
+    for o in offsets:
+        vals = rng.standard_normal(max(n, m)).astype(np.float32)
+        vals[vals == 0] = 1.0
+        diags.append(vals)
+    A_sp = scsp.diags(diags, offsets, shape=(n, m), format="csr",
+                      dtype=np.float32)
+    return sparse.csr_array(A_sp), A_sp
+
+
+def _spgemm_via_pallas(A, B):
+    from legate_sparse_tpu.ops.dia_ops import band_product_offsets
+
+    da, db = A._get_dia(), B._get_dia()
+    assert da is not None and da[2] is None
+    assert db is not None and db[2] is None
+    offs_c = band_product_offsets(da[1], db[1])
+    tile = pallas_dia._spgemm_tile(db[1], len(da[1]), len(db[1]),
+                                   len(offs_c), da[0].dtype)
+    assert tile is not None
+    return np.asarray(
+        pallas_dia.pallas_dia_spgemm(
+            da[0], db[0], da[1], db[1], offs_c, A.shape, B.shape,
+            tile, interpret=True,
+        )
+    ), offs_c
+
+
+def _dense_from_band(Cd, offs_c, shape):
+    out = np.zeros(shape)
+    m, n = shape
+    for d, o in enumerate(offs_c):
+        for j in range(max(0, o), min(n, m + o)):
+            out[j - o, j] = Cd[d, j]
+    return out
+
+
+@pytest.mark.parametrize("offsets", [(-1, 0, 1), (-3, 0, 2), (0,)])
+def test_spgemm_band_matches_scipy(offsets, rng):
+    n = 500
+    A, A_sp = _exact_band(n, list(offsets), rng)
+    B, B_sp = _exact_band(n, [-2, 0, 1], rng)
+    Cd, offs_c = _spgemm_via_pallas(A, B)
+    C_ref = (A_sp @ B_sp).toarray()
+    np.testing.assert_allclose(_dense_from_band(Cd, offs_c, (n, n)),
+                               C_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_spgemm_band_large_offsets(rng):
+    n = 4096
+    A, A_sp = _exact_band(n, [-640, 0, 640], rng)
+    B, B_sp = _exact_band(n, [-640, 0, 640], rng)
+    Cd, offs_c = _spgemm_via_pallas(A, B)
+    C_ref = (A_sp @ B_sp).toarray()
+    np.testing.assert_allclose(_dense_from_band(Cd, offs_c, (n, n)),
+                               C_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_spgemm_band_rectangular(rng):
+    A, A_sp = _exact_band(300, [-1, 0], rng, m=400)
+    B, B_sp = _exact_band(400, [0, 2], rng, m=350)
+    Cd, offs_c = _spgemm_via_pallas(A, B)
+    C_ref = (A_sp @ B_sp).toarray()
+    np.testing.assert_allclose(
+        _dense_from_band(Cd, offs_c, (300, 350)), C_ref,
+        rtol=2e-4, atol=2e-4)
+
+
+def test_spgemm_dispatch_interpret(rng, monkeypatch):
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_DIA", "interpret")
+    n = 600
+    A, A_sp = _exact_band(n, [-1, 0, 1], rng)
+    C = A @ A
+    C_ref = (A_sp @ A_sp).tocsr()
+    np.testing.assert_allclose(C.toscipy().toarray(), C_ref.toarray(),
+                               rtol=2e-4, atol=2e-4)
