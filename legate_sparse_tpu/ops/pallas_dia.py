@@ -533,6 +533,11 @@ def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
     if np.dtype(a_data.dtype) not in (np.dtype(np.float32),
                                       np.dtype(jnp.bfloat16)):
         return None
+    if a_data.dtype != b_data.dtype:
+        # The XLA fallback promotes to result_type(a, b); the kernel
+        # emits b's dtype — mixed inputs must not change result dtype
+        # by backend.
+        return None
     interpret = mode == "interpret"
     if not interpret:
         try:

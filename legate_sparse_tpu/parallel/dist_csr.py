@@ -36,6 +36,7 @@ last local row with value 0.
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from functools import lru_cache, partial
 from typing import Optional, Tuple
@@ -520,7 +521,8 @@ def _extend_x(x_local, halo: int, axis: int = 0):
 
 @lru_cache(maxsize=256)
 def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
-                 rps: int, n_rows: int, has_mask: bool):
+                 rps: int, n_rows: int, has_mask: bool,
+                 pallas_mode: str = "0"):
     """Cached shard_map callable for the banded dist SpMV.
 
     Structure-keyed caching is the Legion partition-cache analog: a
@@ -538,6 +540,10 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
         r_g = shard.astype(jnp.int64) * rps + jnp.arange(
             rps, dtype=jnp.int64
         )
+        if pallas_mode != "0":
+            y = _dia_shard_pallas(dd, dm, x_ext, r_g, pallas_mode)
+            if y is not None:
+                return y
         y = jnp.zeros((rps,), dtype=dd.dtype)
         for d, o in enumerate(offsets):
             seg = jax.lax.slice_in_dim(
@@ -557,6 +563,47 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
             y = y + jnp.where(valid, dd[d] * seg,
                               jnp.zeros((), dd.dtype))
         return y
+
+    def _dia_shard_pallas(dd, dm, x_ext, r_g, mode):
+        """Shard-local SpMV through the Mosaic band kernel
+        (``ops.pallas_dia``): the halo-extended window makes the local
+        problem a rectangular band with offsets shifted by +halo, and
+        ``dd`` is already row-aligned.  The global-bounds/ring-wrap
+        validity (and band holes) are merged into an explicit int8 mask
+        so IEEE non-finite-x semantics match the XLA branch exactly.
+
+        Opt-in (LEGATE_SPARSE_TPU_PALLAS_DIST=1|interpret): the shard
+        body always runs inside shard_map's trace, so a Mosaic compile
+        failure here surfaces at the outer compile with no fallback —
+        unlike the single-chip dispatch this route cannot self-heal.
+        Returns None (XLA branch) only for static ineligibility."""
+        from ..ops.pallas_dia import L as _LANES
+        from ..ops.pallas_dia import pallas_dia_spmv, supported
+
+        interpret = mode == "interpret"
+        offs2 = tuple(int(o) + halo for o in offsets)
+        tile = supported(offs2, dd.dtype, True)
+        if tile is None:
+            return None
+        rps_pad = -(-rps // tile) * tile
+        valid_cols = jnp.stack([
+            jnp.logical_and(
+                jnp.logical_and(r_g + o >= 0, r_g + o < n_rows),
+                r_g < n_rows,
+            )
+            for o in offsets
+        ])
+        if has_mask:
+            valid_cols = jnp.logical_and(valid_cols, dm)
+        rdata = jnp.pad(dd, ((0, 0), (0, rps_pad - rps)))
+        rmask = jnp.pad(valid_cols.astype(jnp.int8),
+                        ((0, 0), (0, rps_pad - rps)))
+        return pallas_dia_spmv(
+            rdata.reshape(len(offsets), -1, _LANES),
+            rmask.reshape(len(offsets), -1, _LANES),
+            x_ext, offs2, (rps, x_ext.shape[0]), tile,
+            interpret=interpret,
+        )
 
     in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS)) + (
         (P(ROW_AXIS, None, None),) if has_mask else ()
@@ -654,6 +701,7 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
         fn = _dia_spmv_fn(
             A.mesh, A.dia_offsets, halo, A.rows_per_shard, A.shape[0],
             has_mask,
+            os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIST", "0"),
         )
         args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
         return fn(*args)
