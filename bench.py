@@ -235,6 +235,50 @@ def main() -> None:
         sys.stderr.write(f"bench: banded config failed: {e!r}\n")
         result["error"] = repr(e)[:300]
 
+    # Solver evidence in the same JSON line: CG ms/iter on the pde
+    # operator (reference examples/pde.py headline).  Two maxiter
+    # variants, host-fetch synced; the delta cancels fixed costs.
+    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_CG", "0") != "1":
+        try:
+            import time as _time
+
+            import legate_sparse_tpu.linalg as linalg
+
+            grid = 1 << (10 if platform != "cpu" else 7)
+            ng = grid * grid
+            main = np.full(ng, 4.0, np.float32)
+            off1 = np.full(ng - 1, -1.0, np.float32)
+            off1[np.arange(1, grid) * grid - 1] = 0.0
+            offn = np.full(ng - grid, -1.0, np.float32)
+            A_cg = sparse.diags(
+                [main, off1, off1, offn, offn],
+                [0, 1, -1, grid, -grid],
+                shape=(ng, ng), format="csr", dtype=np.float32,
+            )
+            b = np.ones(ng, np.float32)
+
+            def timed(maxiter):
+                best = float("inf")
+                for rep in range(3):
+                    t0 = _time.perf_counter()
+                    xs, _ = linalg.cg(A_cg, b, rtol=0.0, maxiter=maxiter)
+                    _ = float(np.asarray(xs[0]))
+                    if rep:
+                        best = min(best, _time.perf_counter() - t0)
+                return best
+
+            t1, t2 = timed(100), timed(300)
+            if t2 > t1:
+                result["cg_grid"] = f"{grid}x{grid}"
+                result["cg_ms_per_iter"] = round((t2 - t1) / 200 * 1e3, 4)
+            else:
+                sys.stderr.write(
+                    f"bench: cg timing unresolvable "
+                    f"(t100={t1:.4f}s, t300={t2:.4f}s)\n"
+                )
+        except Exception as e:
+            sys.stderr.write(f"bench: cg config failed: {e!r}\n")
+
     if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_IRREGULAR", "0") != "1":
         try:
             A_ir = _irregular_config(sparse, max(n // 16, 1 << 16),

@@ -10,7 +10,7 @@ dimension, ``shard_map``-ped kernels, and explicit ICI collectives
 (``all_gather``/``psum``/``ppermute``).
 """
 
-from .mesh import make_row_mesh, row_spec  # noqa: F401
+from .mesh import init_distributed, make_row_mesh, row_spec  # noqa: F401
 from .dist_csr import (  # noqa: F401
     DistCSR,
     shard_csr,

@@ -581,6 +581,11 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
         from ..ops.pallas_dia import pallas_dia_spmv, supported
 
         interpret = mode == "interpret"
+        if jnp.result_type(dd.dtype, x_ext.dtype) != dd.dtype:
+            # XLA branch promotes (e.g. bf16 matrix * f32 x -> f32);
+            # the kernel emits rdata's dtype — result dtype must not
+            # depend on the env flag.
+            return None
         offs2 = tuple(int(o) + halo for o in offsets)
         tile = supported(offs2, dd.dtype, True)
         if tile is None:

@@ -23,10 +23,49 @@ ROW_AXIS = "rows"
 
 
 def make_row_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over all (or given) devices with axis name ``rows``."""
+    """1-D mesh over all (or given) devices with axis name ``rows``.
+
+    Multi-host: after ``init_distributed()``, ``jax.devices()`` spans
+    every host's chips in process order, so row blocks are contiguous
+    per host — halo ``ppermute`` rides ICI within a slice and only the
+    two shards at each slice boundary cross DCN.
+    """
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (ROW_AXIS,))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Join a multi-host run (the reference's network-backend analog).
+
+    The reference selects GASNet/UCX/MPI at build time
+    (``install.py:397-413``) and lets Legion move data over it; here
+    the one network bootstrap is ``jax.distributed.initialize`` — on
+    TPU pods all arguments are discovered from the environment, on
+    other clusters pass them explicitly.  After this, every
+    ``jax.Array`` sharded over ``make_row_mesh()`` spans the pod and
+    XLA routes collectives over ICI within a slice and DCN across
+    slices with no further configuration.
+
+    Safe to call more than once, including after a direct
+    ``jax.distributed.initialize`` elsewhere (both are no-ops then).
+    """
+    if getattr(init_distributed, "_done", False):
+        return
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        init_distributed._done = True
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    init_distributed._done = True
 
 
 def row_spec() -> PartitionSpec:

@@ -114,3 +114,19 @@ def test_blown_halo_falls_back_to_precise_not_all_gather():
         np.asarray(dist_spmv(dA, xs))[:n], A_sp @ x, rtol=1e-12,
         atol=1e-12,
     )
+
+
+def test_init_distributed_idempotent(monkeypatch):
+    from legate_sparse_tpu.parallel import mesh as mesh_mod
+
+    calls = []
+    monkeypatch.setattr(
+        "jax.distributed.initialize", lambda **kw: calls.append(kw)
+    )
+    monkeypatch.setattr(mesh_mod.init_distributed, "_done", False,
+                        raising=False)
+    mesh_mod.init_distributed(coordinator_address="host:1234",
+                              num_processes=2, process_id=0)
+    mesh_mod.init_distributed()  # second call is a no-op
+    assert len(calls) == 1
+    assert calls[0]["coordinator_address"] == "host:1234"
