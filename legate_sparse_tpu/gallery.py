@@ -119,14 +119,7 @@ def kron(A, B, format=None):
     mA, nA = A.shape
     mB, nB = B.shape
     cdt = coord_dtype_for(max(mA * mB, nA * nB, 1))
-    import jax
-
-    if cdt.itemsize == 8 and not jax.config.jax_enable_x64:
-        raise OverflowError(
-            "kron output indices need int64 but x64 is disabled "
-            "(LEGATE_SPARSE_TPU_X64=0); enable x64 for products this "
-            "large"
-        )
+    _require_representable(cdt)
     ra, ca, va = A.tocoo()
     rb, cb, vb = B.tocoo()
     ra = ra.astype(cdt)[:, None]
@@ -140,6 +133,18 @@ def kron(A, B, format=None):
 
     out = csr_array((vals, (rows, cols)), shape=(mA * mB, nA * nB))
     return out.asformat(format)
+
+
+def _require_representable(cdt) -> None:
+    """Raise instead of silently truncating int64 coordinates when x64
+    is disabled (same contract as ``kron``)."""
+    import jax
+
+    if np.dtype(cdt).itemsize == 8 and not jax.config.jax_enable_x64:
+        raise OverflowError(
+            "output indices need int64 but x64 is disabled "
+            "(LEGATE_SPARSE_TPU_X64=0); enable x64 for shapes this large"
+        )
 
 
 def _as_csr(A):
@@ -188,3 +193,154 @@ def tril(A, k=0, format=None):
 def triu(A, k=0, format=None):
     """Upper-triangular part (scipy ``triu`` semantics)."""
     return _tri_mask(A, int(k), keep_lower=False).asformat(format)
+
+
+def spdiags(data, diags_offsets, m=None, n=None, format=None):
+    """scipy.sparse.spdiags: banded constructor from a (nd, n) data
+    array in scipy DIA layout (``data[d, j]`` sits on column j)."""
+    data = np.atleast_2d(np.asarray(data))
+    if not (np.issubdtype(data.dtype, np.floating)
+            or np.issubdtype(data.dtype, np.complexfloating)):
+        # Same integer-input policy as ``diags``: scipy's doc example
+        # passes ints, and integer matrices can't reach the kernels.
+        data = data.astype(runtime.default_float)
+    if m is None and n is None:
+        m = n = data.shape[1]    # scipy >= 1.9 infers a square shape
+    if n is None:  # scipy also accepts spdiags(data, offs, (m, n))
+        m, n = int(m[0]), int(m[1])
+    else:
+        m, n = int(m), int(n)
+    offsets = np.atleast_1d(np.asarray(diags_offsets, dtype=np.int64))
+    if data.shape[1] < n:
+        data = np.pad(data, ((0, 0), (0, n - data.shape[1])))
+    result = dia_array(
+        (jnp.asarray(data[:, :n]), jnp.asarray(offsets)), shape=(m, n)
+    )
+    if format in (None, "dia"):
+        return result
+    return result.asformat(format)
+
+
+def vstack(blocks, format=None, dtype=None):
+    """Stack sparse matrices vertically (scipy ``vstack`` for CSR):
+    row-wise CSR concatenation — indices unchanged, indptr offset."""
+    from .csr import csr_array
+    from .utils import cast_to_common_type
+
+    mats = [_as_csr(b) for b in blocks]
+    cols = mats[0].shape[1]
+    if any(mat.shape[1] != cols for mat in mats):
+        raise ValueError("vstack: mismatching number of columns")
+    mats = list(cast_to_common_type(*mats))
+    data = jnp.concatenate([mat.data for mat in mats])
+    indices = jnp.concatenate([mat.indices for mat in mats])
+    parts = [mats[0].indptr]
+    offset = mats[0].indptr[-1]
+    for mat in mats[1:]:
+        parts.append(mat.indptr[1:] + offset)
+        offset = offset + mat.indptr[-1]
+    indptr = jnp.concatenate(parts)
+    rows = sum(mat.shape[0] for mat in mats)
+    out = csr_array._from_parts(
+        data, indices, indptr, (rows, cols),
+        canonical=all(mat.has_canonical_format for mat in mats),
+    )
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out.asformat(format)
+
+
+def hstack(blocks, format=None, dtype=None):
+    """Stack sparse matrices horizontally (scipy ``hstack``): COO
+    concatenation with column offsets, coalesced back to CSR."""
+    from .csr import csr_array
+    from .ops.convert import coo_to_csr
+    from .types import coord_dtype_for
+    from .utils import cast_to_common_type
+
+    mats = [_as_csr(b) for b in blocks]
+    rows = mats[0].shape[0]
+    if any(mat.shape[0] != rows for mat in mats):
+        raise ValueError("hstack: mismatching number of rows")
+    mats = list(cast_to_common_type(*mats))
+    cols = sum(mat.shape[1] for mat in mats)
+    cdt = coord_dtype_for(max(rows, cols))
+    _require_representable(cdt)
+    rr, cc, vv = [], [], []
+    offset = 0
+    for mat in mats:
+        r, c, v = mat.tocoo()
+        rr.append(r.astype(cdt))
+        cc.append(c.astype(cdt) + np.asarray(offset, dtype=cdt))
+        vv.append(v)
+        offset += mat.shape[1]
+    data, indices, indptr = coo_to_csr(
+        jnp.concatenate(rr), jnp.concatenate(cc), jnp.concatenate(vv),
+        rows,
+    )
+    # Blocks occupy disjoint column ranges in ascending order, so the
+    # output is canonical exactly when every input is (the stable row
+    # sort preserves per-block column order); else unknown.
+    out = csr_array._from_parts(
+        data, indices, indptr, (rows, cols),
+        canonical=(True if all(m.has_canonical_format for m in mats)
+                   else None),
+    )
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out.asformat(format)
+
+
+def block_diag(mats, format=None, dtype=None):
+    """Block-diagonal sparse matrix (scipy ``block_diag``)."""
+    from .csr import csr_array
+
+    from .types import coord_dtype_for
+
+    mats = [_as_csr(b) for b in mats]
+    cols = sum(mat.shape[1] for mat in mats)
+    _require_representable(coord_dtype_for(cols))
+    padded = []
+    col_before = 0
+    for mat in mats:
+        m_i, n_i = mat.shape
+        left = csr_array._from_parts(
+            mat.data, mat.indices + col_before,
+            mat.indptr, (m_i, cols),
+            canonical=mat._canonical,
+        )
+        padded.append(left)
+        col_before += n_i
+    out = vstack(padded)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out.asformat(format)
+
+
+def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
+           random_state=None, data_rvs=None):
+    """Random sparse matrix (scipy ``random`` signature incl. the
+    legacy ``random_state=`` spelling and ``data_rvs``; COO/CSR formats
+    return this package's csr_array)."""
+    from .csr import csr_array
+
+    m, n = int(m), int(n)
+    if rng is None:
+        rng = random_state
+    rng = rng if isinstance(rng, np.random.Generator) else (
+        np.random.default_rng(rng)
+    )
+    nnz = int(round(density * m * n))
+    nnz = min(nnz, m * n)
+    flat = rng.choice(m * n, size=nnz, replace=False)
+    rows = (flat // n).astype(np.int64)
+    cols = (flat % n).astype(np.int64)
+    out_dtype = (np.dtype(dtype) if dtype is not None
+                 else runtime.default_float)
+    vals = (np.asarray(data_rvs(nnz)) if data_rvs is not None
+            else rng.random(nnz)).astype(out_dtype)
+    order = np.lexsort((cols, rows))
+    A = csr_array(
+        (vals[order], (rows[order], cols[order])), shape=(m, n)
+    )
+    return A.asformat(format if format != "coo" else None)

@@ -4,7 +4,10 @@
 
 from .csr import csr_array, csr_matrix, spmv, spgemm_csr_csr_csr  # noqa: F401
 from .dia import dia_array, dia_matrix  # noqa: F401
-from .gallery import diags, eye, identity, kron, tril, triu  # noqa: F401
+from .gallery import (  # noqa: F401
+    block_diag, diags, eye, hstack, identity, kron, random, spdiags,
+    tril, triu, vstack,
+)
 from .io import load_npz, mmread, mmwrite, save_npz  # noqa: F401
 from .types import coord_ty, nnz_ty  # noqa: F401
 from .base import CompressedBase

@@ -131,3 +131,77 @@ def test_save_npz_accepts_dia_and_bf16():
     np.testing.assert_allclose(
         L.toarray(), np.asarray(A.todense(), dtype=np.float32)
     )
+
+
+# ---------------- stacking / random constructors ----------------
+
+def test_spdiags_matches_scipy():
+    data = np.array([[1, 2, 3, 4.0], [5, 6, 7, 8.0]])
+    ours = sparse.spdiags(data, [0, -1], 4, 4, format="csr")
+    theirs = scsp.spdiags(data, [0, -1], 4, 4).tocsr()
+    np.testing.assert_allclose(ours.toscipy().toarray(), theirs.toarray())
+
+
+def test_vstack_matches_scipy(rng):
+    A = scsp.random(5, 7, density=0.4, random_state=0).tocsr()
+    B = scsp.random(3, 7, density=0.5, random_state=1).tocsr()
+    ours = sparse.vstack([sparse.csr_array(A), sparse.csr_array(B)])
+    theirs = scsp.vstack([A, B]).tocsr()
+    np.testing.assert_allclose(ours.toscipy().toarray(), theirs.toarray())
+
+
+def test_hstack_matches_scipy(rng):
+    A = scsp.random(5, 7, density=0.4, random_state=0).tocsr()
+    B = scsp.random(5, 3, density=0.5, random_state=1).tocsr()
+    ours = sparse.hstack([sparse.csr_array(A), sparse.csr_array(B)])
+    theirs = scsp.hstack([A, B]).tocsr()
+    np.testing.assert_allclose(ours.toscipy().toarray(), theirs.toarray())
+
+
+def test_block_diag_matches_scipy(rng):
+    A = scsp.random(4, 5, density=0.5, random_state=0).tocsr()
+    B = scsp.random(3, 2, density=0.5, random_state=1).tocsr()
+    ours = sparse.block_diag([sparse.csr_array(A), sparse.csr_array(B)])
+    theirs = scsp.block_diag([A, B]).tocsr()
+    np.testing.assert_allclose(ours.toscipy().toarray(), theirs.toarray())
+
+
+def test_random_properties():
+    A = sparse.random(50, 40, density=0.1, format="csr", rng=0)
+    assert A.shape == (50, 40)
+    assert A.nnz == round(0.1 * 50 * 40)
+    dense = A.toscipy().toarray()
+    assert ((dense >= 0) & (dense < 1)).all()
+
+
+def test_spdiags_square_inference_and_int_input():
+    ours = sparse.spdiags(np.array([[1, 2, 3, 4]]), [0])
+    theirs = scsp.spdiags(np.array([[1, 2, 3, 4]]), [0])
+    assert ours.shape == theirs.shape == (4, 4)
+    y = np.asarray(ours.tocsr() @ np.ones(4, dtype=ours.dtype))
+    np.testing.assert_allclose(y, theirs @ np.ones(4))
+
+
+def test_random_legacy_kwargs():
+    A = sparse.random(30, 30, density=0.1, format="csr", random_state=42)
+    B = sparse.random(30, 30, density=0.1, format="csr",
+                      data_rvs=lambda k: np.full(k, 2.5), rng=3)
+    assert A.nnz == B.nnz == 90
+    assert (np.asarray(B.data) == 2.5).all()
+
+
+def test_hstack_non_canonical_inputs_not_mislabeled():
+    # COO input with duplicate coordinates stays un-coalesced; hstack
+    # must not stamp the result canonical (sum_duplicates would no-op).
+    A = sparse.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 3),
+    )
+    assert not A.has_canonical_format
+    H = sparse.hstack([A, A])
+    assert not H.has_canonical_format
+    H.sum_duplicates()
+    np.testing.assert_allclose(
+        H.toscipy().toarray(),
+        np.array([[0, 3.0, 0, 0, 3.0, 0], [0, 0, 0, 0, 0, 0]]),
+    )
