@@ -1,0 +1,190 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""CSC sparse array.
+
+Beyond-reference format (the reference exposes only CSR/DIA and lets
+its facade fall back to host scipy for CSC): a ``csc_array`` here is
+the CSR representation of the transpose plus CSC-view semantics, so
+every kernel — SpMV, SpMM, SpGEMM, conversions — reuses the CSR device
+paths with one transposition identity:
+
+    A (m, n) in CSC  ==  A.T stored CSR (n, m)
+    A @ x            ==  (x^T @ A)^T  -> csr_rmatvec on the stored CSR
+    A @ B            ==  (B.T @ A.T).T etc. (via tocsr for products)
+
+Construction from (data, indices, indptr) follows scipy's CSC layout:
+``indices`` are row ids per column extent.  That triple IS the CSR
+triple of A.T, so construction is free; ``tocsr()`` is one device
+transpose (reference analog: ``csr.py:512-542``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class csc_array:
+    """Compressed Sparse Column array (scipy ``csc_array`` surface)."""
+
+    format = "csc"
+
+    def __init__(self, arg, shape=None, dtype=None, copy: bool = False):
+        from .csr import csr_array
+
+        if isinstance(arg, csc_array):
+            self._t = csr_array(arg._t, dtype=dtype, copy=copy)
+            self.shape = arg.shape
+            return
+        if isinstance(arg, tuple) and len(arg) == 3:
+            # (data, indices, indptr) in CSC layout == CSR triple of A.T.
+            data, indices, indptr = arg
+            if shape is None:
+                raise ValueError("csc_array((data, indices, indptr)) "
+                                 "requires shape")
+            m, n = int(shape[0]), int(shape[1])
+            self._t = csr_array((data, indices, indptr), shape=(n, m),
+                                dtype=dtype, copy=copy)
+            self.shape = (m, n)
+            return
+        # Anything else (dense, scipy sparse, csr_array, COO tuple):
+        # normalize through csr_array then transpose.
+        if hasattr(arg, "tocsr") and not isinstance(arg, csr_array):
+            arg = arg.tocsr()
+        A = arg if isinstance(arg, csr_array) else csr_array(
+            arg, shape=shape, dtype=dtype
+        )
+        self._t = A.transpose()
+        self.shape = A.shape
+
+    # ---------------- properties ----------------
+    @property
+    def dtype(self) -> np.dtype:
+        return self._t.dtype
+
+    @property
+    def nnz(self) -> int:
+        return self._t.nnz
+
+    @property
+    def data(self):
+        return self._t.data
+
+    @property
+    def indices(self):
+        return self._t.indices
+
+    @property
+    def indptr(self):
+        return self._t.indptr
+
+    @property
+    def dim(self) -> int:
+        return 2
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # ---------------- conversions ----------------
+    def tocsr(self, copy: bool = False):
+        return self._t.transpose()
+
+    def tocsc(self, copy: bool = False):
+        return csc_array(self, copy=copy) if copy else self
+
+    def asformat(self, format, copy: bool = False):
+        if format in (None, "csc"):
+            return self
+        if format == "csr":
+            return self.tocsr()
+        return self.tocsr().asformat(format, copy=copy)
+
+    def toarray(self, order=None, out=None):
+        return np.asarray(self._t.todense()).T
+
+    def todense(self, order=None, out=None):
+        return self.toarray(order=order, out=out)
+
+    def toscipy(self):
+        return self._t.toscipy().T.tocsc()
+
+    def transpose(self, axes=None, copy: bool = False):
+        if axes is not None:
+            raise ValueError(
+                "Sparse matrices do not support an 'axes' parameter"
+            )
+        # Transpose of CSC is the stored CSR, viewed directly.
+        return (self._t.copy() if copy else self._t)
+
+    # ---------------- ops ----------------
+    def copy(self):
+        return csc_array(self, copy=True)
+
+    def astype(self, dtype, casting: str = "unsafe", copy: bool = True):
+        out = csc_array.__new__(csc_array)
+        out._t = self._t.astype(dtype, casting=casting, copy=copy)
+        out.shape = self.shape
+        return out
+
+    def conj(self, copy: bool = True):
+        out = csc_array.__new__(csc_array)
+        out._t = self._t.conj(copy=copy)
+        out.shape = self.shape
+        return out
+
+    def diagonal(self, k: int = 0):
+        # diag_k(A) == diag_{-k}(A.T)
+        return self._t.diagonal(-k)
+
+    def sum(self, axis=None, dtype=None, out=None):
+        if axis is None:
+            return self._t.sum(axis=None, dtype=dtype, out=out)
+        if axis in (0, -2):
+            return self._t.sum(axis=1, dtype=dtype, out=out)
+        if axis in (1, -1):
+            return self._t.sum(axis=0, dtype=dtype, out=out)
+        raise ValueError(f"invalid axis {axis}")
+
+    def dot(self, other, out=None):
+        other_arr = other
+        if not hasattr(other, "shape") or getattr(other, "ndim", None) \
+                in (1, 2) and not hasattr(other, "tocsr"):
+            other_arr = jnp.asarray(other)
+        if hasattr(other, "tocsr"):
+            return self.tocsr().dot(other, out=out)
+        return self.tocsr().dot(other_arr, out=out)
+
+    def __matmul__(self, other):
+        return self.dot(other)
+
+    def __mul__(self, other):
+        if np.isscalar(other):
+            out = csc_array.__new__(csc_array)
+            out._t = self._t * other
+            out.shape = self.shape
+            return out
+        return self.dot(other)
+
+    def __rmul__(self, other):
+        if np.isscalar(other):
+            return self.__mul__(other)
+        raise NotImplementedError("dense @ csc is not supported")
+
+    def __neg__(self):
+        return self * -1.0
+
+    def __repr__(self) -> str:
+        return (
+            f"<{self.shape[0]}x{self.shape[1]} sparse array of type "
+            f"'{self.dtype}' with {self.nnz} stored elements in "
+            f"Compressed Sparse Column format>"
+        )
+
+
+# scipy.sparse.*_matrix alias.
+class csc_matrix(csc_array):
+    pass

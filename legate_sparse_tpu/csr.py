@@ -536,7 +536,14 @@ class csr_array(CompressedBase, DenseSparseBase):
             return self.tocsr(copy=copy)
         if format == "dia":
             return self.todia(copy=copy)
+        if format == "csc":
+            return self.tocsc(copy=copy)
         raise ValueError(f"unsupported format: {format!r}")
+
+    def tocsc(self, copy: bool = False):
+        from .csc import csc_array
+
+        return csc_array(self)
 
     # ---------------- structure maintenance ----------------
     def getnnz(self, axis=None):
@@ -725,6 +732,11 @@ class csr_array(CompressedBase, DenseSparseBase):
     def dot(self, other, out=None):
         """SpMV / SpMM / SpGEMM dispatch (reference ``csr.py:419-493``)."""
         require_supported_dtype(self.dtype)
+        if _is_scipy_sparse(other):
+            other = csr_array(other)  # adopt scipy operand for SpGEMM
+        elif not isinstance(other, csr_array) and hasattr(other, "tocsr") \
+                and not hasattr(other, "__array__"):
+            other = other.tocsr()  # csc/dia operand -> CSR SpGEMM
         if isinstance(other, csr_array):
             if out is not None:
                 raise ValueError("out not supported for sparse-sparse matmul")

@@ -180,7 +180,9 @@ class dia_array(CompressedBase):
         from .utils import fill_out, require_supported_dtype
 
         require_supported_dtype(self.dtype)
-        if isinstance(other, CompressedBase):
+        from .utils import is_sparse_matrix
+
+        if is_sparse_matrix(other):
             return self.tocsr().dot(other)
         other = jnp.asarray(other)
         offsets = tuple(int(o) for o in np.asarray(self._offsets))

@@ -300,12 +300,14 @@ def block_diag(mats, format=None, dtype=None):
     mats = [_as_csr(b) for b in mats]
     cols = sum(mat.shape[1] for mat in mats)
     _require_representable(coord_dtype_for(cols))
+    cdt = coord_dtype_for(cols)
     padded = []
     col_before = 0
     for mat in mats:
         m_i, n_i = mat.shape
         left = csr_array._from_parts(
-            mat.data, mat.indices + col_before,
+            mat.data,
+            mat.indices.astype(cdt) + np.asarray(col_before, dtype=cdt),
             mat.indptr, (m_i, cols),
             canonical=mat._canonical,
         )
@@ -325,20 +327,30 @@ def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
     from .csr import csr_array
 
     m, n = int(m), int(n)
+    if not 0 <= density <= 1:
+        raise ValueError("density expected to be 0 <= density <= 1")
     if rng is None:
         rng = random_state
     rng = rng if isinstance(rng, np.random.Generator) else (
         np.random.default_rng(rng)
     )
-    nnz = int(round(density * m * n))
-    nnz = min(nnz, m * n)
+    nnz = min(int(round(density * m * n)), m * n)
     flat = rng.choice(m * n, size=nnz, replace=False)
     rows = (flat // n).astype(np.int64)
     cols = (flat % n).astype(np.int64)
     out_dtype = (np.dtype(dtype) if dtype is not None
                  else runtime.default_float)
-    vals = (np.asarray(data_rvs(nnz)) if data_rvs is not None
-            else rng.random(nnz)).astype(out_dtype)
+    if data_rvs is not None:
+        vals = np.asarray(data_rvs(nnz)).astype(out_dtype)
+    elif np.issubdtype(out_dtype, np.integer):
+        # scipy samples random integers for integer dtypes.
+        vals = rng.integers(
+            np.iinfo(out_dtype).min, np.iinfo(out_dtype).max, size=nnz
+        ).astype(out_dtype)
+    elif np.issubdtype(out_dtype, np.complexfloating):
+        vals = (rng.random(nnz) + 1j * rng.random(nnz)).astype(out_dtype)
+    else:
+        vals = rng.random(nnz).astype(out_dtype)
     order = np.lexsort((cols, rows))
     A = csr_array(
         (vals[order], (rows[order], cols[order])), shape=(m, n)

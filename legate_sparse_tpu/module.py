@@ -3,6 +3,7 @@
 """Public module namespace (reference: ``legate_sparse/module.py``)."""
 
 from .csr import csr_array, csr_matrix, spmv, spgemm_csr_csr_csr  # noqa: F401
+from .csc import csc_array, csc_matrix  # noqa: F401
 from .dia import dia_array, dia_matrix  # noqa: F401
 from .gallery import (  # noqa: F401
     block_diag, diags, eye, hstack, identity, kron, random, spdiags,
@@ -14,7 +15,9 @@ from .base import CompressedBase
 
 
 def is_sparse_matrix(o) -> bool:
-    return isinstance(o, CompressedBase)
+    from .utils import is_sparse_matrix as _impl
+
+    return _impl(o)
 
 
 def issparse(o) -> bool:
@@ -23,6 +26,12 @@ def issparse(o) -> bool:
 
 def isspmatrix(o) -> bool:
     return is_sparse_matrix(o)
+
+
+def isspmatrix_csc(o) -> bool:
+    from .csc import csc_array
+
+    return isinstance(o, csc_array)
 
 
 def isspmatrix_csr(o) -> bool:

@@ -23,8 +23,9 @@ from .types import SUPPORTED_DATATYPES
 
 def is_sparse_matrix(o: Any) -> bool:
     from .base import CompressedBase
+    from .csc import csc_array
 
-    return isinstance(o, CompressedBase)
+    return isinstance(o, (CompressedBase, csc_array))
 
 
 def find_common_type(*args) -> np.dtype:

@@ -1,0 +1,119 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""csc_array differential tests vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.fixture
+def pair(rng):
+    A_sp = scsp.random(40, 30, density=0.2, random_state=0,
+                       format="csc", dtype=np.float64)
+    return sparse.csc_array(A_sp), A_sp
+
+
+def test_from_scipy_roundtrip(pair):
+    A, A_sp = pair
+    assert A.shape == A_sp.shape
+    assert A.nnz == A_sp.nnz
+    np.testing.assert_allclose(A.toarray(), A_sp.toarray())
+    np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
+
+
+def test_from_triple():
+    A_sp = scsp.random(20, 25, density=0.3, random_state=1,
+                       format="csc", dtype=np.float64)
+    A = sparse.csc_array((A_sp.data, A_sp.indices, A_sp.indptr),
+                         shape=A_sp.shape)
+    np.testing.assert_allclose(A.toarray(), A_sp.toarray())
+
+
+def test_matvec_and_matmat(pair, rng):
+    A, A_sp = pair
+    x = rng.standard_normal(30)
+    np.testing.assert_allclose(np.asarray(A @ x), A_sp @ x, rtol=1e-10)
+    X = rng.standard_normal((30, 4))
+    np.testing.assert_allclose(np.asarray(A @ X), A_sp @ X, rtol=1e-10)
+
+
+def test_spgemm_mixed_formats(pair, rng):
+    A, A_sp = pair
+    B_sp = scsp.random(30, 20, density=0.2, random_state=2,
+                       format="csr", dtype=np.float64)
+    B = sparse.csr_array(B_sp)
+    C = A @ B                      # csc @ csr
+    np.testing.assert_allclose(C.toscipy().toarray(),
+                               (A_sp @ B_sp).toarray(), rtol=1e-10)
+    D = B.T @ A.T                  # csr @ csc-transpose interop
+    np.testing.assert_allclose(D.toscipy().toarray(),
+                               (B_sp.T @ A_sp.T).toarray(), rtol=1e-10)
+
+
+def test_transpose_and_diagonal(pair):
+    A, A_sp = pair
+    np.testing.assert_allclose(A.T.toscipy().toarray(),
+                               A_sp.T.toarray())
+    for k in (-2, 0, 3):
+        np.testing.assert_allclose(np.asarray(A.diagonal(k)),
+                                   A_sp.diagonal(k))
+
+
+def test_sum_axes(pair):
+    A, A_sp = pair
+    np.testing.assert_allclose(float(A.sum()), A_sp.sum())
+    np.testing.assert_allclose(np.asarray(A.sum(axis=0)).ravel(),
+                               np.asarray(A_sp.sum(axis=0)).ravel())
+    np.testing.assert_allclose(np.asarray(A.sum(axis=1)).ravel(),
+                               np.asarray(A_sp.sum(axis=1)).ravel())
+
+
+def test_format_conversions(pair):
+    A, A_sp = pair
+    assert sparse.issparse(A)
+    assert sparse.isspmatrix_csc(A)
+    R = A.tocsr()
+    assert sparse.isspmatrix_csr(R)
+    np.testing.assert_allclose(R.toscipy().toarray(), A_sp.toarray())
+    A2 = R.tocsc()
+    assert sparse.isspmatrix_csc(A2)
+    np.testing.assert_allclose(A2.toarray(), A_sp.toarray())
+    assert R.asformat("csc").shape == A.shape
+
+
+def test_scalar_ops(pair):
+    A, A_sp = pair
+    np.testing.assert_allclose((2.0 * A).toarray(), 2.0 * A_sp.toarray())
+    np.testing.assert_allclose((-A).toarray(), -A_sp.toarray())
+    np.testing.assert_allclose(A.astype(np.float32).toarray(),
+                               A_sp.toarray().astype(np.float32),
+                               rtol=1e-6)
+
+
+def test_cg_accepts_csc(pair, rng):
+    # Shared is_sparse_matrix must classify csc as sparse, else linalg
+    # wraps it as a dense operator and crashes.
+    import scipy.sparse as sp
+    from legate_sparse_tpu import linalg
+
+    n = 80
+    A_sp = (sp.random(n, n, density=0.2, random_state=3)
+            + sp.eye(n) * n).tocsc()
+    A_sp = (A_sp + A_sp.T) / 2
+    A = sparse.csc_array(A_sp)
+    b = rng.standard_normal(n)
+    x, it = linalg.cg(A, b, rtol=1e-8, maxiter=500)
+    np.testing.assert_allclose(
+        np.asarray(A @ np.asarray(x)), b, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_spgemm_scipy_operand(pair):
+    A, A_sp = pair
+    B_sp = scsp.random(30, 10, density=0.3, random_state=5)
+    C = A.tocsr() @ B_sp.tocsc()   # scipy csc operand
+    np.testing.assert_allclose(C.toscipy().toarray(),
+                               (A_sp @ B_sp).toarray(), rtol=1e-10)
