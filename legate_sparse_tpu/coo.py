@@ -1,0 +1,202 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""COO sparse array.
+
+Beyond-reference format (the reference's facade falls back to host
+scipy for COO): a device-resident (row, col, data) triple.  COO is the
+assembly format — construction, concatenation, IO — while compute
+routes through CSR (``tocsr()`` is one device stable-sort,
+``ops/convert.py:100``); that split matches scipy's own design.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class coo_array:
+    """Coordinate-format sparse array (scipy ``coo_array`` surface)."""
+
+    format = "coo"
+
+    def __init__(self, arg, shape=None, dtype=None, copy: bool = False):
+        from .csr import csr_array, _is_scipy_sparse
+        from .types import coord_dtype_for
+
+        if isinstance(arg, coo_array):
+            row, col, data = arg.row, arg.col, arg.data
+            shape = arg.shape if shape is None else tuple(shape)
+        elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(
+            arg[1], tuple
+        ):
+            data, (row, col) = arg
+            row = jnp.asarray(row)
+            col = jnp.asarray(col)
+            data = jnp.asarray(data)
+            if shape is None:
+                shape = (
+                    int(row.max()) + 1 if row.size else 0,
+                    int(col.max()) + 1 if col.size else 0,
+                )
+        elif _is_scipy_sparse(arg):
+            sc = arg.tocoo()
+            row, col, data = (jnp.asarray(sc.row), jnp.asarray(sc.col),
+                              jnp.asarray(sc.data))
+            shape = sc.shape if shape is None else tuple(shape)
+        elif hasattr(arg, "tocsr"):  # csr_array / dia_array / csc_array
+            base = arg if isinstance(arg, csr_array) else arg.tocsr()
+            row, col, data = base.tocoo()
+            shape = base.shape if shape is None else tuple(shape)
+        else:
+            dense = jnp.asarray(arg)
+            if dense.ndim != 2:
+                raise ValueError(
+                    f"coo_array requires a 2-D input, got ndim={dense.ndim}"
+                )
+            base = csr_array(dense)
+            row, col, data = base.tocoo()
+            shape = base.shape
+
+        self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
+        cdt = coord_dtype_for(max(self.shape) if self.shape else 1)
+        self.row = jnp.asarray(row).astype(cdt)
+        self.col = jnp.asarray(col).astype(cdt)
+        data = jnp.asarray(data)
+        if dtype is not None:
+            data = data.astype(np.dtype(dtype))
+        self.data = jnp.array(data) if copy else data
+
+    # ---------------- properties ----------------
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self.data.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return 2
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # ---------------- conversions ----------------
+    def tocsr(self, copy: bool = False):
+        from .csr import csr_array
+
+        return csr_array((self.data, (self.row, self.col)),
+                         shape=self.shape)
+
+    def tocsc(self, copy: bool = False):
+        return self.tocsr().tocsc()
+
+    def tocoo(self, copy: bool = False):
+        return coo_array(self, copy=copy) if copy else self
+
+    def asformat(self, format, copy: bool = False):
+        if format in (None, "coo"):
+            return self
+        return self.tocsr().asformat(format, copy=copy)
+
+    def toarray(self, order=None, out=None):
+        return np.asarray(self.tocsr().todense())
+
+    def todense(self, order=None, out=None):
+        return self.toarray(order=order, out=out)
+
+    def toscipy(self):
+        import scipy.sparse as sp
+
+        return sp.coo_array(
+            (np.asarray(self.data),
+             (np.asarray(self.row), np.asarray(self.col))),
+            shape=self.shape,
+        )
+
+    def transpose(self, axes=None, copy: bool = False):
+        if axes is not None:
+            raise ValueError(
+                "Sparse matrices do not support an 'axes' parameter"
+            )
+        out = coo_array.__new__(coo_array)
+        out.shape = (self.shape[1], self.shape[0])
+        out.row, out.col = self.col, self.row
+        out.data = jnp.array(self.data) if copy else self.data
+        return out
+
+    # ---------------- ops ----------------
+    def copy(self):
+        return coo_array(self, copy=True)
+
+    def astype(self, dtype, casting: str = "unsafe", copy: bool = True):
+        out = coo_array.__new__(coo_array)
+        out.shape = self.shape
+        out.row, out.col = self.row, self.col
+        out.data = self.data.astype(np.dtype(dtype))
+        return out
+
+    def conj(self, copy: bool = True):
+        out = coo_array.__new__(coo_array)
+        out.shape = self.shape
+        out.row, out.col = self.row, self.col
+        out.data = jnp.conj(self.data)
+        return out
+
+    def sum_duplicates(self):
+        """Coalesce duplicate coordinates in place (via CSR round trip)."""
+        A = self.tocsr()
+        A.sum_duplicates()
+        self.row, self.col, self.data = A.tocoo()
+
+    def diagonal(self, k: int = 0):
+        return self.tocsr().diagonal(k)
+
+    def sum(self, axis=None, dtype=None, out=None):
+        return self.tocsr().sum(axis=axis, dtype=dtype, out=out)
+
+    def dot(self, other, out=None):
+        return self.tocsr().dot(other, out=out)
+
+    def __matmul__(self, other):
+        return self.dot(other)
+
+    def __mul__(self, other):
+        if np.isscalar(other):
+            out = coo_array.__new__(coo_array)
+            out.shape = self.shape
+            out.row, out.col = self.row, self.col
+            out.data = self.data * other
+            return out
+        raise NotImplementedError(
+            "elementwise coo multiply is not supported; use @ for matmul"
+        )
+
+    def __rmul__(self, other):
+        if np.isscalar(other):
+            return self.__mul__(other)
+        raise NotImplementedError("dense @ coo is not supported")
+
+    def __neg__(self):
+        return self * -1.0
+
+    def __repr__(self) -> str:
+        return (
+            f"<{self.shape[0]}x{self.shape[1]} sparse array of type "
+            f"'{self.dtype}' with {self.nnz} stored elements in "
+            f"COOrdinate format>"
+        )
+
+
+class coo_matrix(coo_array):
+    pass

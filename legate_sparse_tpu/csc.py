@@ -9,8 +9,10 @@ every kernel — SpMV, SpMM, SpGEMM, conversions — reuses the CSR device
 paths with one transposition identity:
 
     A (m, n) in CSC  ==  A.T stored CSR (n, m)
-    A @ x            ==  (x^T @ A)^T  -> csr_rmatvec on the stored CSR
-    A @ B            ==  (B.T @ A.T).T etc. (via tocsr for products)
+
+Compute (matvec/matmat/SpGEMM) routes through ``tocsr()`` — one device
+stable-sort transpose, cached on first use — so iterative callers pay
+the conversion once and then hit the CSR structure-cached hot paths.
 
 Construction from (data, indices, indptr) follows scipy's CSC layout:
 ``indices`` are row ids per column extent.  That triple IS the CSR
@@ -50,13 +52,22 @@ class csc_array:
                                 dtype=dtype, copy=copy)
             self.shape = (m, n)
             return
-        # Anything else (dense, scipy sparse, csr_array, COO tuple):
+        from .csr import _is_scipy_sparse
+
+        if _is_scipy_sparse(arg):
+            # scipy CSC's triple IS the CSR triple of A.T: adopt the
+            # buffers with zero conversion.
+            sc = arg.tocsc()
+            m, n = sc.shape
+            self._t = csr_array((sc.data, sc.indices, sc.indptr),
+                                shape=(n, m), dtype=dtype, copy=copy)
+            self.shape = (m, n)
+            return
+        # Anything else (dense, csr_array, dia/coo, COO tuple):
         # normalize through csr_array then transpose.
         if hasattr(arg, "tocsr") and not isinstance(arg, csr_array):
             arg = arg.tocsr()
-        A = arg if isinstance(arg, csr_array) else csr_array(
-            arg, shape=shape, dtype=dtype
-        )
+        A = csr_array(arg, shape=shape, dtype=dtype, copy=copy)
         self._t = A.transpose()
         self.shape = A.shape
 
@@ -86,12 +97,21 @@ class csc_array:
         return 2
 
     @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
     def T(self):
         return self.transpose()
 
     # ---------------- conversions ----------------
     def tocsr(self, copy: bool = False):
-        return self._t.transpose()
+        # Cache the device transpose (one stable sort) on first use;
+        # hand out structure-sharing wrappers so callers mutating the
+        # result cannot corrupt the cache.
+        if getattr(self, "_csr", None) is None:
+            self._csr = self._t.transpose()
+        return self._csr._with_data(self._csr.data, copy=copy)
 
     def tocsc(self, copy: bool = False):
         return csc_array(self, copy=copy) if copy else self
@@ -117,8 +137,10 @@ class csc_array:
             raise ValueError(
                 "Sparse matrices do not support an 'axes' parameter"
             )
-        # Transpose of CSC is the stored CSR, viewed directly.
-        return (self._t.copy() if copy else self._t)
+        # Transpose of CSC is the stored CSR — hand out a structure-
+        # sharing wrapper, not the internal object (in-place mutation
+        # of the result must not rewrite this array).
+        return self._t._with_data(self._t.data, copy=copy)
 
     # ---------------- ops ----------------
     def copy(self):
@@ -150,13 +172,8 @@ class csc_array:
         raise ValueError(f"invalid axis {axis}")
 
     def dot(self, other, out=None):
-        other_arr = other
-        if not hasattr(other, "shape") or getattr(other, "ndim", None) \
-                in (1, 2) and not hasattr(other, "tocsr"):
-            other_arr = jnp.asarray(other)
-        if hasattr(other, "tocsr"):
-            return self.tocsr().dot(other, out=out)
-        return self.tocsr().dot(other_arr, out=out)
+        # csr_array.dot already normalizes scipy/sparse/dense operands.
+        return self.tocsr().dot(other, out=out)
 
     def __matmul__(self, other):
         return self.dot(other)
@@ -167,7 +184,9 @@ class csc_array:
             out._t = self._t * other
             out.shape = self.shape
             return out
-        return self.dot(other)
+        raise NotImplementedError(
+            "elementwise csc multiply is not supported; use @ for matmul"
+        )
 
     def __rmul__(self, other):
         if np.isscalar(other):

@@ -538,6 +538,10 @@ class csr_array(CompressedBase, DenseSparseBase):
             return self.todia(copy=copy)
         if format == "csc":
             return self.tocsc(copy=copy)
+        if format == "coo":
+            from .coo import coo_array
+
+            return coo_array(self)
         raise ValueError(f"unsupported format: {format!r}")
 
     def tocsc(self, copy: bool = False):

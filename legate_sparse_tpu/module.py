@@ -4,6 +4,7 @@
 
 from .csr import csr_array, csr_matrix, spmv, spgemm_csr_csr_csr  # noqa: F401
 from .csc import csc_array, csc_matrix  # noqa: F401
+from .coo import coo_array, coo_matrix  # noqa: F401
 from .dia import dia_array, dia_matrix  # noqa: F401
 from .gallery import (  # noqa: F401
     block_diag, diags, eye, hstack, identity, kron, random, spdiags,
@@ -26,6 +27,12 @@ def issparse(o) -> bool:
 
 def isspmatrix(o) -> bool:
     return is_sparse_matrix(o)
+
+
+def isspmatrix_coo(o) -> bool:
+    from .coo import coo_array
+
+    return isinstance(o, coo_array)
 
 
 def isspmatrix_csc(o) -> bool:

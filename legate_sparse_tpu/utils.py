@@ -23,9 +23,10 @@ from .types import SUPPORTED_DATATYPES
 
 def is_sparse_matrix(o: Any) -> bool:
     from .base import CompressedBase
+    from .coo import coo_array
     from .csc import csc_array
 
-    return isinstance(o, (CompressedBase, csc_array))
+    return isinstance(o, (CompressedBase, csc_array, coo_array))
 
 
 def find_common_type(*args) -> np.dtype:

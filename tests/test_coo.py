@@ -1,0 +1,84 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""coo_array differential tests vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.fixture
+def pair(rng):
+    A_sp = scsp.random(30, 40, density=0.15, random_state=0,
+                       format="coo", dtype=np.float64)
+    return sparse.coo_array(A_sp), A_sp
+
+
+def test_roundtrips(pair):
+    A, A_sp = pair
+    assert A.shape == A_sp.shape and A.nnz == A_sp.nnz
+    np.testing.assert_allclose(A.toarray(), A_sp.toarray())
+    np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
+    np.testing.assert_allclose(A.tocsr().toscipy().toarray(),
+                               A_sp.tocsr().toarray())
+    np.testing.assert_allclose(A.tocsc().toarray(), A_sp.toarray())
+
+
+def test_from_ijv_and_duplicates():
+    A = sparse.coo_array(
+        (np.array([1.0, 2.0, 3.0]),
+         (np.array([0, 0, 1]), np.array([2, 2, 0]))),
+        shape=(3, 4),
+    )
+    assert A.nnz == 3
+    A.sum_duplicates()
+    assert A.nnz == 2
+    dense = np.zeros((3, 4))
+    dense[0, 2] = 3.0
+    dense[1, 0] = 3.0
+    np.testing.assert_allclose(A.toarray(), dense)
+
+
+def test_matvec_and_transpose(pair, rng):
+    A, A_sp = pair
+    x = rng.standard_normal(40)
+    np.testing.assert_allclose(np.asarray(A @ x), A_sp @ x, rtol=1e-10)
+    np.testing.assert_allclose(A.T.toarray(), A_sp.T.toarray())
+    np.testing.assert_allclose((2.0 * A).toarray(), 2 * A_sp.toarray())
+
+
+def test_predicates_and_asformat(pair):
+    A, _ = pair
+    assert sparse.issparse(A)
+    assert sparse.isspmatrix_coo(A)
+    assert A.asformat("csr").format == "csr"
+    assert A.tocsr().asformat("coo").format == "coo"
+    from legate_sparse_tpu import linalg
+
+    op = linalg.make_linear_operator(A) if hasattr(
+        linalg, "make_linear_operator") else None
+
+
+def test_solver_accepts_coo(rng):
+    from legate_sparse_tpu import linalg
+
+    n = 60
+    A_sp = (scsp.random(n, n, density=0.2, random_state=1)
+            + scsp.eye(n) * n).tocoo()
+    A_sp = ((A_sp + A_sp.T) / 2).tocoo()
+    A = sparse.coo_array(A_sp)
+    b = rng.standard_normal(n)
+    x, it = linalg.cg(A, b, rtol=1e-8, maxiter=400)
+    np.testing.assert_allclose(np.asarray(A @ np.asarray(x)), b,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_coo_from_other_formats(pair):
+    A, A_sp = pair
+    C1 = sparse.coo_array(sparse.csc_array(A_sp.tocsc()))
+    np.testing.assert_allclose(C1.toarray(), A_sp.toarray())
+    C2 = sparse.coo_array(A.tocsr().todia()) if hasattr(
+        A.tocsr(), "todia") else None
+    assert A.ndim == 2

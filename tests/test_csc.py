@@ -117,3 +117,33 @@ def test_spgemm_scipy_operand(pair):
     C = A.tocsr() @ B_sp.tocsc()   # scipy csc operand
     np.testing.assert_allclose(C.toscipy().toarray(),
                                (A_sp @ B_sp).toarray(), rtol=1e-10)
+
+
+def test_transpose_mutation_does_not_alias(pair):
+    A, A_sp = pair
+    B = A.T
+    before = A.nnz
+    B.data = B.data.at[:].set(0.0) if hasattr(B.data, "at") else B.data
+    B.eliminate_zeros()
+    assert A.nnz == before  # A unchanged by mutating its transpose
+
+
+def test_ctor_dtype_applies_to_csr_input(pair):
+    A, _ = pair
+    C = sparse.csc_array(A.tocsr(), dtype=np.float32)
+    assert C.dtype == np.float32
+
+
+def test_elementwise_mul_raises(pair):
+    A, _ = pair
+    with pytest.raises(NotImplementedError):
+        _ = A * np.ones(A.shape[1])
+
+
+def test_tocsr_cached_and_isolated(pair):
+    A, A_sp = pair
+    R1 = A.tocsr()
+    R2 = A.tocsr()
+    assert R1 is not R2
+    R1.sum_duplicates()
+    np.testing.assert_allclose(R2.toscipy().toarray(), A_sp.toarray())
