@@ -839,6 +839,156 @@ class csr_array(CompressedBase, DenseSparseBase):
             return fill_out(Y, out)
         raise ValueError(f"cannot multiply csr_array by ndim={other_arr.ndim}")
 
+    # ---------------- indexing ----------------
+    def _select_rows(self, rows_idx) -> "csr_array":
+        import numpy as _np
+
+        rows_idx = _np.asarray(rows_idx, dtype=_np.int64)
+        if rows_idx.ndim != 1:
+            raise IndexError("row index arrays must be 1-D")
+        n_rows = self.shape[0]
+        if rows_idx.size and (
+            rows_idx.min() < -n_rows or rows_idx.max() >= n_rows
+        ):
+            raise IndexError("row index out of range")
+        rows_idx = _np.where(rows_idx < 0, rows_idx + n_rows, rows_idx)
+        idx_d = jnp.asarray(rows_idx)
+        counts = _np.asarray(
+            self._indptr[idx_d + 1] - self._indptr[idx_d]
+        )
+        nnz_out = int(counts.sum())
+        data, indices, indptr = _convert.select_rows(
+            self._data, self._indices, self._indptr,
+            jnp.asarray(rows_idx), nnz_out,
+        )
+        return csr_array._from_parts(
+            data, indices, indptr, (len(rows_idx), self.shape[1]),
+            canonical=self._canonical,
+        )
+
+    @staticmethod
+    def _checked_index(i: int, extent: int, axis: str) -> int:
+        if not -extent <= i < extent:
+            raise IndexError(
+                f"{axis} index {i} out of range for extent {extent}"
+            )
+        return i + extent if i < 0 else i
+
+    @staticmethod
+    def _bool_mask_to_idx(mask, extent: int, axis: str):
+        import numpy as _np
+
+        if mask.shape[0] != extent:
+            raise IndexError(
+                f"boolean {axis} mask length {mask.shape[0]} != {extent}"
+            )
+        return _np.nonzero(mask)[0]
+
+    def __getitem__(self, key):
+        """Row selection / element access (the scipy subset users hit
+        in practice; the reference supports no indexing at all):
+
+        - ``A[i]`` -> (1, cols) csr row (scipy semantics)
+        - ``A[i, j]`` -> scalar (sum of duplicates at that coordinate)
+        - ``A[i0:i1:step]`` / ``A[row_index_array]`` -> csr row subset
+        - ``A[:, j0:j1]`` / ``A[rows, :]`` etc. via one row pass + a
+          column mask compaction.
+        """
+        import numpy as _np
+
+        col_key = None
+        if isinstance(key, tuple):
+            if len(key) != 2:
+                raise IndexError("too many indices for 2-D sparse array")
+            key, col_key = key
+
+        # Element access A[i, j].
+        if (col_key is not None
+                and isinstance(key, (int, _np.integer))
+                and isinstance(col_key, (int, _np.integer))):
+            i = self._checked_index(int(key), self.shape[0], "row")
+            j = self._checked_index(int(col_key), self.shape[1], "column")
+            lo = int(self._indptr[i])
+            hi = int(self._indptr[i + 1])
+            seg = _np.asarray(self._indices[lo:hi])
+            vals = _np.asarray(self._data[lo:hi])
+            return self.dtype.type(vals[seg == j].sum())
+
+        # Normalize the row key to an index array (or full slice).
+        if isinstance(key, slice):
+            rows_idx = _np.arange(*key.indices(self.shape[0]))
+            full_rows = (key == slice(None))
+        elif isinstance(key, (int, _np.integer)):
+            rows_idx = _np.asarray([int(key)])
+            full_rows = False
+        else:
+            rows_idx = _np.asarray(key)
+            if rows_idx.dtype == bool:
+                rows_idx = self._bool_mask_to_idx(
+                    rows_idx, self.shape[0], "row"
+                )
+            full_rows = False
+            # numpy/scipy pointwise semantics: two index ARRAYS pick
+            # individual elements, not the outer-product submatrix.
+            if (col_key is not None
+                    and not isinstance(col_key, (slice, int, _np.integer))):
+                cols_pt = _np.asarray(col_key)
+                if cols_pt.dtype == bool:
+                    cols_pt = self._bool_mask_to_idx(
+                        cols_pt, self.shape[1], "column"
+                    )
+                if rows_idx.shape != cols_pt.shape:
+                    raise IndexError(
+                        "pointwise row/column index arrays must have "
+                        "the same shape"
+                    )
+                return _np.asarray(
+                    [self[int(i), int(j)]
+                     for i, j in zip(rows_idx, cols_pt)],
+                    dtype=self.dtype,
+                )
+
+        out = self if full_rows else self._select_rows(rows_idx)
+
+        if col_key is None or (isinstance(col_key, slice)
+                               and col_key == slice(None)):
+            return out
+
+        # Column restriction.  Integer/bool arrays may carry duplicates
+        # or arbitrary order, which a position remap cannot express —
+        # go through the transpose and reuse row selection (duplicate-
+        # capable).  Slices keep the cheaper mask + compact + rebase.
+        if not isinstance(col_key, (slice, int, _np.integer)):
+            cols_sel = _np.asarray(col_key)
+            if cols_sel.dtype == bool:
+                cols_sel = self._bool_mask_to_idx(
+                    cols_sel, self.shape[1], "column"
+                )
+            return out.transpose()._select_rows(cols_sel).transpose()
+        if isinstance(col_key, slice):
+            start, stop, step = col_key.indices(self.shape[1])
+            cols_sel = _np.arange(start, stop, step)
+        else:
+            cols_sel = _np.asarray([
+                self._checked_index(int(col_key), self.shape[1], "column")
+            ])
+        remap = _np.full(self.shape[1], -1, dtype=_np.int64)
+        remap[cols_sel] = _np.arange(len(cols_sel))
+        remap_d = jnp.asarray(remap)
+        new_cols = remap_d[out.indices]
+        keep = new_cols >= 0
+        nnz_new = int(jnp.sum(keep))
+        row_ids = _convert.row_ids_from_indptr(out.indptr, out.nnz)
+        data, cols2, rows_kept = _convert.compact_mask(
+            keep, (out.data, new_cols, row_ids), nnz_new
+        )
+        return csr_array._from_parts(
+            data, cols2.astype(coord_dtype_for(max(len(cols_sel), 1))),
+            _convert.indptr_from_row_ids(rows_kept, out.shape[0]),
+            (out.shape[0], len(cols_sel)),
+            canonical=None,
+        )
+
     def __str__(self) -> str:
         row_ids, cols, vals = self.tocoo()
         lines = [

@@ -154,3 +154,32 @@ def compact_mask(mask, arrays, nnz_out: int):
     """
     idx = jnp.nonzero(mask, size=nnz_out, fill_value=0)[0]
     return tuple(a[idx] for a in arrays)
+
+
+@partial(jax.jit, static_argnames=("nnz_out",))
+def select_rows(data, indices, indptr, rows_idx, nnz_out: int):
+    """Gather a row subset into a new CSR triple.
+
+    ``rows_idx`` (k,) row ids (any order, duplicates allowed);
+    ``nnz_out`` = the concrete total nnz of the selection (host-summed
+    by the caller — the framework's static-shape discipline).  Returns
+    (data, indices, indptr) of the (k, cols) result.
+    """
+    from ..types import nnz_ty
+
+    starts = indptr[rows_idx]                       # (k,)
+    counts = (indptr[rows_idx + 1] - starts)
+    new_indptr = jnp.concatenate(
+        [jnp.zeros((1,), nnz_ty),
+         jnp.cumsum(counts).astype(nnz_ty)]
+    )
+    k = rows_idx.shape[0]
+    out_row = jnp.repeat(
+        jnp.arange(k), counts, total_repeat_length=nnz_out
+    )
+    pos_in_row = (
+        jnp.arange(nnz_out, dtype=starts.dtype)
+        - new_indptr[out_row].astype(starts.dtype)
+    )
+    src = starts[out_row].astype(jnp.int64) + pos_in_row
+    return data[src], indices[src], new_indptr

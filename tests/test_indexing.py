@@ -1,0 +1,104 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""csr_array indexing differential tests vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.fixture
+def pair(rng):
+    A_sp = scsp.random(25, 18, density=0.25, random_state=0,
+                       format="csr", dtype=np.float64)
+    return sparse.csr_array(A_sp), A_sp
+
+
+def _eq(ours, theirs):
+    np.testing.assert_allclose(
+        ours.toscipy().toarray(), theirs.toarray()
+    )
+
+
+def test_single_row(pair):
+    A, A_sp = pair
+    _eq(A[3], A_sp[[3]])
+    _eq(A[-1], A_sp[[-1]])
+
+
+def test_element(pair):
+    A, A_sp = pair
+    for (i, j) in [(0, 0), (3, 7), (24, 17), (-1, -1)]:
+        assert A[i, j] == A_sp[i % 25, j % 18]
+
+
+def test_row_slices(pair):
+    A, A_sp = pair
+    _eq(A[2:10], A_sp[2:10])
+    _eq(A[::3], A_sp[::3])
+    _eq(A[10:2:-2], A_sp[10:2:-2])
+
+
+def test_row_arrays(pair):
+    A, A_sp = pair
+    idx = np.array([5, 1, 22, 1])
+    _eq(A[idx], A_sp[idx])
+    m = np.zeros(25, bool); m[[2, 9, 11]] = True
+    _eq(A[m], A_sp[m])
+
+
+def test_col_slices(pair):
+    A, A_sp = pair
+    _eq(A[:, 3:12], A_sp[:, 3:12])
+    _eq(A[2:8, ::2], A_sp[2:8, ::2])
+    _eq(A[:, np.array([0, 17, 4])], A_sp[:, np.array([0, 17, 4])])
+
+
+def test_row_and_col_combo(pair):
+    A, A_sp = pair
+    idx = np.array([4, 0, 19])
+    _eq(A[idx, 2:15], A_sp[idx, 2:15])
+    _eq(A[1:20:2, np.array([3, 3, 0])],
+        A_sp[1:20:2][:, np.array([3, 3, 0])])
+
+
+def test_duplicate_coordinate_element_sum():
+    A = sparse.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 3),
+    )
+    assert A[0, 1] == 3.0
+    assert A[1, 2] == 0.0
+
+
+def test_out_of_range_raises(pair):
+    A, _ = pair
+    with pytest.raises(IndexError):
+        _ = A[np.array([100])]
+
+
+def test_pointwise_array_pairs(pair):
+    A, A_sp = pair
+    rows = np.array([0, 3, 24])
+    cols = np.array([2, 7, 17])
+    ours = A[rows, cols]
+    theirs = np.asarray(A_sp[rows, cols]).ravel()
+    np.testing.assert_allclose(np.asarray(ours).ravel(), theirs)
+
+
+def test_element_out_of_range_raises(pair):
+    A, _ = pair
+    with pytest.raises(IndexError):
+        _ = A[100, 0]
+    with pytest.raises(IndexError):
+        _ = A[0, -100]
+
+
+def test_bool_mask_length_validated(pair):
+    A, _ = pair
+    with pytest.raises(IndexError):
+        _ = A[np.array([True, False])]
+    with pytest.raises(IndexError):
+        _ = A[:, np.zeros(5, bool)]
