@@ -73,6 +73,72 @@ class CompressedBase:
             return out
         return result
 
+    def _minmax(self, axis, op_name: str):
+        """Shared max/min: scipy semantics — implicit zeros participate
+        whenever a row/column/matrix is not completely dense."""
+        import jax
+
+        from .csr import csr_array
+        from .ops.convert import row_ids_from_indptr
+
+        if not isinstance(self, csr_array):
+            return getattr(self.tocsr(), op_name)(axis=axis)
+        if self.nnz and not self.has_canonical_format:
+            # scipy canonicalizes before min/max: duplicates must
+            # contribute their SUM, and the density test below counts
+            # coordinates, not stored slots.
+            self.sum_duplicates()
+        rows, cols = self.shape
+        data = self.data
+        zero = jnp.zeros((), data.dtype)
+        if np.issubdtype(np.dtype(data.dtype), np.integer):
+            info = np.iinfo(np.dtype(data.dtype))
+            init = info.min if op_name == "max" else info.max
+        else:
+            init = -np.inf if op_name == "max" else np.inf
+        if op_name == "max":
+            seg, scat, red = jax.ops.segment_max, "max", jnp.max
+            pick = jnp.maximum
+        else:
+            seg, scat, red = jax.ops.segment_min, "min", jnp.min
+            pick = jnp.minimum
+        if axis is None:
+            if self.nnz == 0:
+                return zero
+            r = red(data)
+            return pick(r, zero) if self.nnz < rows * cols else r
+        if axis in (1, -1):
+            row_ids = row_ids_from_indptr(self.indptr, int(self.nnz))
+            r = seg(data, row_ids, num_segments=rows,
+                    indices_are_sorted=True)
+            counts = jnp.diff(self.indptr)
+            r = jnp.where(counts > 0, r, zero)
+            return jnp.where(counts < cols, pick(r, zero), r)
+        if axis in (0, -2):
+            full = jnp.full((cols,), init, dtype=data.dtype)
+            r = getattr(full.at[self.indices], scat)(data)
+            counts = jnp.zeros((cols,), jnp.int32).at[self.indices].add(1)
+            r = jnp.where(counts > 0, r, zero)
+            return jnp.where(counts < rows, pick(r, zero), r)
+        raise ValueError(f"invalid axis {axis}")
+
+    def max(self, axis=None, out=None):
+        """Maximum (scipy semantics: implicit zeros count unless the
+        reduced extent is fully dense)."""
+        result = self._minmax(axis, "max")
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+
+    def min(self, axis=None, out=None):
+        """Minimum (scipy ``min`` semantics)."""
+        result = self._minmax(axis, "min")
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+
     def mean(self, axis=None, dtype=None, out=None):
         rows, cols = self.shape
         denom = {None: rows * cols, 0: rows, -2: rows, 1: cols, -1: cols}[axis]

@@ -839,7 +839,103 @@ class csr_array(CompressedBase, DenseSparseBase):
             return fill_out(Y, out)
         raise ValueError(f"cannot multiply csr_array by ndim={other_arr.ndim}")
 
+    def _invalidate_caches(self, structure_changed: bool) -> None:
+        """Drop stale structure caches after in-place mutation.  With
+        ``structure_changed`` False only value-derived caches reset
+        (sparsity pattern intact)."""
+        self._ell = None
+        self._dia = None
+        self._dia_pack = None
+        if structure_changed:
+            self._row_ids = None
+            self._ell_width = None
+            self._dia_offsets = None
+            self._canonical = None
+            self._sorted = None
+
+    def setdiag(self, values, k: int = 0) -> None:
+        """Set diagonal ``k`` in place (scipy ``setdiag``): existing
+        stored entries are overwritten on device; rows whose diagonal
+        slot has no stored entry get structure inserted (one COO
+        rebuild — the scipy 'changing the sparsity structure' case)."""
+        import numpy as _np
+
+        rows, cols = self.shape
+        if k <= -rows or k >= cols:
+            raise ValueError("k exceeds matrix dimensions")
+        length = min(rows + min(k, 0), cols - max(k, 0))
+        vals = jnp.asarray(values, dtype=self.dtype)
+        if vals.ndim == 0:
+            vals = jnp.full((length,), vals)
+        length = min(length, int(vals.shape[0]))
+        vals = vals[:length]
+        if self.nnz and not self.has_canonical_format:
+            self.sum_duplicates()
+
+        i0 = max(0, -k)
+        row_ids = self._get_row_ids()
+        on_diag = jnp.logical_and(
+            self._indices.astype(jnp.int64)
+            - row_ids.astype(jnp.int64) == k,
+            row_ids < i0 + length,
+        )
+        # Overwrite stored diagonal entries.
+        safe_rel = jnp.clip(row_ids.astype(jnp.int64) - i0, 0, length - 1)
+        new_data = jnp.where(on_diag, vals[safe_rel], self._data)
+
+        # Rows in [i0, i0+length) missing a stored diagonal slot.
+        has = _np.zeros(length, dtype=bool)
+        hit_rows = _np.asarray(row_ids)[_np.asarray(on_diag)]
+        has[hit_rows - i0] = True
+        missing = _np.nonzero(~has)[0]
+        if missing.size == 0:
+            self._data = new_data
+            self._invalidate_caches(structure_changed=False)
+            return
+        cdt = coord_dtype_for(max(self.shape))
+        add_rows = jnp.asarray(missing + i0, dtype=cdt)
+        add_cols = jnp.asarray(missing + i0 + k, dtype=cdt)
+        add_vals = vals[jnp.asarray(missing)]
+        r, c, _ = self.tocoo()
+        self._data, self._indices, self._indptr = _convert.coo_to_csr(
+            jnp.concatenate([r.astype(cdt), add_rows]),
+            jnp.concatenate([c.astype(cdt), add_cols]),
+            jnp.concatenate([new_data, add_vals]),
+            rows,
+        )
+        self._invalidate_caches(structure_changed=True)
+
     # ---------------- indexing ----------------
+    def _pointwise_get(self, rows_idx, cols_pt):
+        """Vectorized A[rows, cols] pointwise gather: three host
+        transfers total, then numpy searchsorted per pair (duplicates
+        summed, matching element access)."""
+        import numpy as _np
+
+        n_rows, n_cols = self.shape
+        rows_idx = _np.where(rows_idx < 0, rows_idx + n_rows, rows_idx)
+        cols_pt = _np.where(cols_pt < 0, cols_pt + n_cols, cols_pt)
+        if rows_idx.size and (
+            rows_idx.min() < 0 or rows_idx.max() >= n_rows
+            or cols_pt.min() < 0 or cols_pt.max() >= n_cols
+        ):
+            raise IndexError("pointwise index out of range")
+        indptr = _np.asarray(self._indptr)
+        indices = _np.asarray(self._indices)
+        data = _np.asarray(self._data)
+        out = _np.zeros(rows_idx.shape[0], dtype=self.dtype)
+        sorted_rows = bool(self.has_sorted_indices)
+        for t, (i, j) in enumerate(zip(rows_idx, cols_pt)):
+            lo, hi = int(indptr[i]), int(indptr[i + 1])
+            seg = indices[lo:hi]
+            if sorted_rows:
+                a = _np.searchsorted(seg, j, "left")
+                b = _np.searchsorted(seg, j, "right")
+                out[t] = data[lo + a: lo + b].sum()
+            else:
+                out[t] = data[lo:hi][seg == j].sum()
+        return out
+
     def _select_rows(self, rows_idx) -> "csr_array":
         import numpy as _np
 
@@ -942,13 +1038,14 @@ class csr_array(CompressedBase, DenseSparseBase):
                         "pointwise row/column index arrays must have "
                         "the same shape"
                     )
-                return _np.asarray(
-                    [self[int(i), int(j)]
-                     for i, j in zip(rows_idx, cols_pt)],
-                    dtype=self.dtype,
-                )
+                return self._pointwise_get(rows_idx, cols_pt)
 
-        out = self if full_rows else self._select_rows(rows_idx)
+        # Full row slice: hand out an independent wrapper (buffers are
+        # immutable jax arrays, so sharing them is safe; in-place
+        # mutators replace per-instance references) — scipy's A[:]
+        # copy semantics without the copy.
+        out = (self._with_data(self._data) if full_rows
+               else self._select_rows(rows_idx))
 
         if col_key is None or (isinstance(col_key, slice)
                                and col_key == slice(None)):

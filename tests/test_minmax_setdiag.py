@@ -1,0 +1,97 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""max/min reductions and setdiag vs scipy."""
+
+import numpy as np
+import pytest
+import scipy.sparse as scsp
+
+import legate_sparse_tpu as sparse
+
+
+@pytest.fixture
+def pair(rng):
+    A_sp = scsp.random(20, 15, density=0.3, random_state=0,
+                       format="csr", dtype=np.float64)
+    A_sp.data -= 0.5  # mixed signs so implicit zeros matter
+    return sparse.csr_array(A_sp), A_sp
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+@pytest.mark.parametrize("op", ["max", "min"])
+def test_minmax_matches_scipy(pair, axis, op):
+    A, A_sp = pair
+    ours = getattr(A, op)(axis=axis)
+    theirs = getattr(A_sp, op)(axis=axis)
+    if axis is None:
+        np.testing.assert_allclose(float(ours), theirs)
+    else:
+        np.testing.assert_allclose(np.asarray(ours),
+                                   np.asarray(theirs.todense()).ravel())
+
+
+def test_minmax_dense_row_excludes_zero():
+    # A fully dense row must NOT clamp max to 0.
+    A_sp = scsp.csr_array(np.array([[-1.0, -2.0], [0.0, -3.0]]))
+    A_sp.eliminate_zeros()
+    A = sparse.csr_array(A_sp)
+    np.testing.assert_allclose(np.asarray(A.max(axis=1)),
+                               np.asarray(A_sp.max(axis=1).todense()).ravel())
+
+
+@pytest.mark.parametrize("k", [0, 2, -3])
+def test_setdiag_overwrite_and_insert(pair, k, rng):
+    A, A_sp = pair
+    length = min(20 + min(k, 0), 15 - max(k, 0))
+    vals = rng.standard_normal(length)
+    A_sp = A_sp.copy()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        A_sp.setdiag(vals, k=k)
+    A.setdiag(vals, k=k)
+    np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
+    # matvec still works after the structural change
+    x = rng.standard_normal(15)
+    np.testing.assert_allclose(np.asarray(A @ x), A_sp @ x, rtol=1e-10)
+
+
+def test_setdiag_scalar_broadcast(pair):
+    A, A_sp = pair
+    A_sp = A_sp.copy()
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        A_sp.setdiag(7.5)
+    A.setdiag(7.5)
+    np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
+
+
+def test_setdiag_k_out_of_range(pair):
+    A, _ = pair
+    with pytest.raises(ValueError):
+        A.setdiag(1.0, k=15)
+
+
+def test_minmax_canonicalizes_duplicates():
+    A = sparse.csr_array(
+        (np.array([5.0, -5.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 3),
+    )
+    assert float(A.max()) == 0.0   # true value at (0,1) is 0.0
+    B = sparse.csr_array(
+        (np.array([1.0, 2.0]), (np.array([0, 0]), np.array([1, 1]))),
+        shape=(2, 3),
+    )
+    assert float(B.max()) == 3.0
+
+
+def test_full_slice_mutation_isolated(rng):
+    import scipy.sparse as scsp2
+
+    A_sp = scsp2.random(6, 6, density=0.5, random_state=0, format="csr")
+    A = sparse.csr_array(A_sp)
+    B = A[:]
+    B.setdiag(9.0)
+    np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
+    assert float(B.toscipy().toarray()[0, 0]) == 9.0
