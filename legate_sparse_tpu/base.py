@@ -79,7 +79,6 @@ class CompressedBase:
         import jax
 
         from .csr import csr_array
-        from .ops.convert import row_ids_from_indptr
 
         if not isinstance(self, csr_array):
             return getattr(self.tocsr(), op_name)(axis=axis)
@@ -89,6 +88,13 @@ class CompressedBase:
             # coordinates, not stored slots.
             self.sum_duplicates()
         rows, cols = self.shape
+        # scipy raises for zero-size reductions; match it.
+        if axis is None and rows * cols == 0:
+            raise ValueError("zero-size array to reduction operation")
+        if axis in (1, -1) and cols == 0 and rows > 0:
+            raise ValueError("zero-size array to reduction operation")
+        if axis in (0, -2) and rows == 0 and cols > 0:
+            raise ValueError("zero-size array to reduction operation")
         data = self.data
         zero = jnp.zeros((), data.dtype)
         if np.issubdtype(np.dtype(data.dtype), np.integer):
@@ -108,7 +114,7 @@ class CompressedBase:
             r = red(data)
             return pick(r, zero) if self.nnz < rows * cols else r
         if axis in (1, -1):
-            row_ids = row_ids_from_indptr(self.indptr, int(self.nnz))
+            row_ids = self._get_row_ids()
             r = seg(data, row_ids, num_segments=rows,
                     indices_are_sorted=True)
             counts = jnp.diff(self.indptr)

@@ -233,9 +233,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         if value.shape != self._data.shape:
             raise ValueError("cannot change nnz via data setter")
         self._data = value
-        self._ell = None  # packed values are stale; sparsity is not
-        self._dia = None
-        self._dia_pack = None
+        self._invalidate_caches(structure_changed=False)
 
     @property
     def indices(self):
@@ -247,13 +245,11 @@ class csr_array(CompressedBase, DenseSparseBase):
         if value.shape != self._indices.shape:
             raise ValueError("cannot change nnz via indices setter")
         self._indices = value
-        self._ell = None
-        self._ell_width = None
-        self._dia = None
-        self._dia_offsets = None
-        self._dia_pack = None
-        self._canonical = None
-        self._sorted = None
+        # Column indices changed but the row partition (indptr) did
+        # not: every cache except the per-nnz row ids is stale.
+        rid = self._row_ids
+        self._invalidate_caches(structure_changed=True)
+        self._row_ids = rid
 
     @property
     def indptr(self):
@@ -308,14 +304,9 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._data = data
         self._indices = indices.astype(self._indices.dtype)
         self._indptr = indptr
+        self._invalidate_caches(structure_changed=True)
         self._canonical = True
         self._sorted = True
-        self._row_ids = None
-        self._ell = None
-        self._ell_width = None
-        self._dia = None
-        self._dia_offsets = None
-        self._dia_pack = None
 
     def _canonicalized(self) -> "csr_array":
         if self.has_canonical_format:
@@ -913,8 +904,11 @@ class csr_array(CompressedBase, DenseSparseBase):
         import numpy as _np
 
         n_rows, n_cols = self.shape
-        rows_idx = _np.where(rows_idx < 0, rows_idx + n_rows, rows_idx)
-        cols_pt = _np.where(cols_pt < 0, cols_pt + n_cols, cols_pt)
+        out_shape = rows_idx.shape
+        rows_idx = _np.where(rows_idx < 0, rows_idx + n_rows,
+                             rows_idx).ravel()
+        cols_pt = _np.where(cols_pt < 0, cols_pt + n_cols,
+                            cols_pt).ravel()
         if rows_idx.size and (
             rows_idx.min() < 0 or rows_idx.max() >= n_rows
             or cols_pt.min() < 0 or cols_pt.max() >= n_cols
@@ -934,7 +928,7 @@ class csr_array(CompressedBase, DenseSparseBase):
                 out[t] = data[lo + a: lo + b].sum()
             else:
                 out[t] = data[lo:hi][seg == j].sum()
-        return out
+        return out.reshape(out_shape)
 
     def _select_rows(self, rows_idx) -> "csr_array":
         import numpy as _np

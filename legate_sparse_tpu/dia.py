@@ -59,6 +59,7 @@ class dia_array(CompressedBase):
             raise ValueError("offset array contains duplicate values")
         self._data = data
         self._offsets = offsets
+        self._pack = None  # cached Pallas band pack (built lazily)
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
 
     @property
@@ -173,10 +174,31 @@ class dia_array(CompressedBase):
         )
 
     # ---------------- products (DIA fast path) ----------------
+    def _get_pack(self):
+        """Cached Pallas band pack (same layout/dispatch as csr's
+        ``_get_dia_pack``; DIA has no holes, so the pack is unmasked —
+        every in-bounds slot is an entry, matching ``dia_spmv``)."""
+        from .csr import csr_array
+        from .ops import pallas_dia
+
+        if self._pack is not None:
+            return self._pack if self._pack is not False else None
+        if not csr_array._can_build_cache(self._data):
+            return None
+        offsets = tuple(int(o) for o in np.asarray(self._offsets))
+        packed = pallas_dia.pack_band(self._data, offsets, self.shape)
+        self._pack = packed if packed is not None else False
+        return packed
+
     def dot(self, other, out=None):
-        """SpMV/SpMM via shifted adds — the TPU-native banded fast path
-        (``ops/dia_ops.py``); sparse operands route through CSR."""
+        """SpMV/SpMM via the Mosaic band kernel on TPU (same dispatch
+        as csr's banded path), else shifted adds (``ops/dia_ops.py``);
+        sparse operands route through CSR."""
         from .ops.dia_ops import dia_spmm, dia_spmv
+        from .ops.pallas_dia import (
+            SPMM_MAX_K, dia_spmm_maybe_pallas, dia_spmv_maybe_pallas,
+            pallas_dia_active,
+        )
         from .utils import fill_out, require_supported_dtype
 
         require_supported_dtype(self.dtype)
@@ -195,7 +217,11 @@ class dia_array(CompressedBase):
                 raise ValueError(
                     f"dimension mismatch: {self.shape} @ {other.shape}"
                 )
-            y = dia_spmv(self._data, other, offsets, self.shape)
+            y = (dia_spmv_maybe_pallas(self._get_pack(), other)
+                 if (pallas_dia_active()
+                     and other.dtype == self._data.dtype) else None)
+            if y is None:
+                y = dia_spmv(self._data, other, offsets, self.shape)
             if squeeze:
                 y = y[:, None]
             return fill_out(y, out)
@@ -204,9 +230,13 @@ class dia_array(CompressedBase):
                 raise ValueError(
                     f"dimension mismatch: {self.shape} @ {other.shape}"
                 )
-            return fill_out(
-                dia_spmm(self._data, other, offsets, self.shape), out
-            )
+            Y = (dia_spmm_maybe_pallas(self._get_pack(), other)
+                 if (pallas_dia_active()
+                     and 0 < other.shape[1] <= SPMM_MAX_K
+                     and other.dtype == self._data.dtype) else None)
+            if Y is None:
+                Y = dia_spmm(self._data, other, offsets, self.shape)
+            return fill_out(Y, out)
         raise ValueError(f"cannot multiply dia_array by ndim={other.ndim}")
 
     def __matmul__(self, other):

@@ -95,3 +95,24 @@ def test_full_slice_mutation_isolated(rng):
     B.setdiag(9.0)
     np.testing.assert_allclose(A.toscipy().toarray(), A_sp.toarray())
     assert float(B.toscipy().toarray()[0, 0]) == 9.0
+
+
+def test_minmax_zero_size_raises():
+    A = sparse.csr_array(
+        (np.zeros(0), np.zeros(0, np.int32), np.zeros(6, np.int64)),
+        shape=(5, 0),
+    )
+    with pytest.raises(ValueError):
+        A.max()
+    with pytest.raises(ValueError):
+        A.max(axis=1)
+
+
+def test_pointwise_2d_index_arrays(pair):
+    A, A_sp = pair
+    rows = np.array([[0, 1], [2, 3]])
+    cols = np.array([[0, 1], [2, 3]])
+    ours = A[rows, cols]
+    assert ours.shape == (2, 2)
+    theirs = np.asarray(A_sp.todense())[rows, cols]
+    np.testing.assert_allclose(np.asarray(ours), theirs)

@@ -300,3 +300,21 @@ def test_spgemm_dispatch_interpret(rng, monkeypatch):
     C_ref = (A_sp @ A_sp).tocsr()
     np.testing.assert_allclose(C.toscipy().toarray(), C_ref.toarray(),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_dia_array_dispatch_interpret(rng, monkeypatch):
+    # dia_array.dot routes through the pallas kernel too (same
+    # dispatch as csr's banded path).
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_DIA", "interpret")
+    n = 800
+    data = rng.standard_normal((3, n)).astype(np.float32)
+    A = sparse.dia_array((jnp.asarray(data), jnp.asarray([-1, 0, 2])),
+                         shape=(n, n))
+    A_sp = scsp.dia_array((data, [-1, 0, 2]), shape=(n, n))
+    x = rng.standard_normal(n).astype(np.float32)
+    y = np.asarray(A @ jnp.asarray(x))
+    np.testing.assert_allclose(y, A_sp @ x, rtol=2e-5, atol=2e-5)
+    assert A._pack not in (None, False)
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    Y = np.asarray(A @ jnp.asarray(X))
+    np.testing.assert_allclose(Y, A_sp @ X, rtol=2e-5, atol=2e-5)
