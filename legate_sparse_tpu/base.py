@@ -57,12 +57,11 @@ class CompressedBase:
                 self.indices
             ].add(self.data)
         elif axis in (1, -1):
-            from .ops.convert import row_ids_from_indptr
             import jax
 
-            row_ids = row_ids_from_indptr(self.indptr, int(self.nnz))
             result = jax.ops.segment_sum(
-                self.data, row_ids, num_segments=rows, indices_are_sorted=True
+                self.data, self._get_row_ids(), num_segments=rows,
+                indices_are_sorted=True,
             )
         else:
             raise ValueError(f"invalid axis {axis}")

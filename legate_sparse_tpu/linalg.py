@@ -608,3 +608,57 @@ def bicgstab(
         if bool(state[9]):  # done flag: ||r|| < atol at the cadence
             break
     return state[0], iters
+
+
+def norm(A, ord=None, axis=None):
+    """Sparse matrix/vector norms (scipy.sparse.linalg.norm surface).
+
+    Matrix norms (``axis=None``): Frobenius (default/'fro'), 1 /
+    -1 (max/min absolute column sum), inf / -inf (max/min absolute row
+    sum), 2 (spectral — delegated to scipy on host, it needs an SVD).
+    ``axis=0``/``1`` give per-column/per-row vector norms (ord None/2 =
+    Euclidean, 1 = abs sum, inf = abs max).  Computed on device from
+    the stored values (duplicates canonicalized first).
+    """
+    from .utils import is_sparse_matrix
+
+    if not is_sparse_matrix(A):
+        raise TypeError("input is not a sparse matrix")
+    A = A.tocsr() if A.format != "csr" else A
+    if A.shape[0] == 0 or A.shape[1] == 0:
+        raise ValueError("zero-size array to reduction operation")
+    if A.nnz and not A.has_canonical_format:
+        A.sum_duplicates()
+
+    def absA():
+        return A._with_data(jnp.abs(A.data))
+
+    if axis is None:
+        if ord in (None, "fro", "f"):
+            return float(jnp.sqrt(jnp.sum(jnp.abs(A.data) ** 2)))
+        if ord == 1:
+            return float(jnp.max(absA().sum(axis=0)))
+        if ord == -1:
+            return float(jnp.min(absA().sum(axis=0)))
+        if ord in (np.inf, float("inf")):
+            return float(jnp.max(absA().sum(axis=1)))
+        if ord in (-np.inf, float("-inf")):
+            return float(jnp.min(absA().sum(axis=1)))
+        if ord == 2:
+            # Spectral norm needs an SVD; scipy computes it on host.
+            import scipy.sparse.linalg as _ssl
+
+            return float(_ssl.norm(A.toscipy(), ord=2))
+        raise ValueError(f"Invalid norm order {ord!r} for matrices")
+
+    if axis not in (0, 1, -1, -2):
+        raise ValueError(f"invalid axis {axis}")
+    axis = axis % 2
+    if ord in (None, 2):
+        sq = A._with_data(A.data * jnp.conj(A.data))
+        return jnp.sqrt(jnp.real(sq.sum(axis=axis)))
+    if ord == 1:
+        return absA().sum(axis=axis)
+    if ord in (np.inf, float("inf")):
+        return absA().max(axis=axis)
+    raise ValueError(f"Invalid norm order {ord!r} for vectors")

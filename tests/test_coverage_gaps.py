@@ -130,3 +130,50 @@ def test_init_distributed_idempotent(monkeypatch):
     mesh_mod.init_distributed()  # second call is a no-op
     assert len(calls) == 1
     assert calls[0]["coordinator_address"] == "host:1234"
+
+
+def test_sparse_norm_matches_scipy(rng):
+    import scipy.sparse as scsp
+    import scipy.sparse.linalg as ssl
+
+    import legate_sparse_tpu as sparse
+    from legate_sparse_tpu import linalg
+
+    A_sp = scsp.random(20, 15, density=0.3, random_state=0, format="csr")
+    A_sp.data -= 0.5
+    A = sparse.csr_array(A_sp)
+    for order in (None, "fro", 1, -1, np.inf, -np.inf):
+        np.testing.assert_allclose(
+            linalg.norm(A, ord=order), ssl.norm(A_sp, ord=order),
+            rtol=1e-12,
+        )
+    for axis in (0, 1):
+        for order in (None, 1, np.inf):
+            np.testing.assert_allclose(
+                np.asarray(linalg.norm(A, ord=order, axis=axis)),
+                ssl.norm(A_sp, ord=order, axis=axis),
+                rtol=1e-6,
+            )
+    with pytest.raises(ValueError):
+        linalg.norm(A, ord=0)
+    with pytest.raises(TypeError):
+        linalg.norm(np.ones((3, 3)))
+
+
+def test_sparse_norm_spectral_and_zero_size():
+    import scipy.sparse as scsp
+    import scipy.sparse.linalg as ssl
+
+    import legate_sparse_tpu as sparse
+    from legate_sparse_tpu import linalg
+
+    A_sp = scsp.random(12, 12, density=0.4, random_state=2, format="csr")
+    A = sparse.csr_array(A_sp)
+    np.testing.assert_allclose(linalg.norm(A, ord=2),
+                               ssl.norm(A_sp, ord=2), rtol=1e-9)
+    empty = sparse.csr_array(
+        (np.zeros(0), np.zeros(0, np.int32), np.zeros(6, np.int64)),
+        shape=(5, 0),
+    )
+    with pytest.raises(ValueError):
+        linalg.norm(empty)
