@@ -185,6 +185,17 @@ def parse_common_args():
     )
     args, _ = parser.parse_known_args()
 
+    if args.package in ("tpu", "legate"):
+        # Probe the accelerator BEFORE any jax backend init: a dead
+        # tunnel hangs indefinitely on first device use (it does not
+        # error), and the environment's sitecustomize re-overrides
+        # JAX_PLATFORMS, so env-pinning alone cannot save the run.
+        # Degrades to the cpu platform when unreachable — same policy
+        # as bench.py / __graft_entry__ / tests/conftest.py.
+        from legate_sparse_tpu import _platform
+
+        _platform.ensure_live_backend()
+
     if args.profile and args.package in ("tpu", "legate"):
         # tpu path only: the scipy baseline must stay JAX-free (and its
         # trace would carry none of the named_scope annotations anyway).

@@ -98,16 +98,28 @@ def _re_resolve_dtype_policy() -> None:
     jax.config.update("jax_enable_x64", settings.x64)
 
 
-def ensure_live_backend(timeout_s: int = 30, retries: int = 0) -> bool:
+def ensure_live_backend(timeout_s: int | None = None,
+                        retries: int | None = None) -> bool:
     """Probe the default accelerator in a subprocess (a dead tunnel
     hangs rather than errors); pin the cpu platform when unreachable.
     Returns True when the accelerator is live.
+
+    Defaults come from ``LEGATE_SPARSE_TPU_PROBE_TIMEOUT`` (seconds,
+    default 90 — first device init on a cold tunnel can exceed 30) and
+    ``LEGATE_SPARSE_TPU_PROBE_RETRIES`` (default 1), so every caller
+    (bench.py, examples, dryrun, conftest) classifies the same tunnel
+    the same way.
 
     Plain CPU hosts (cpu-pinned, or no TPU signal at all) skip the
     subprocess entirely — they'd pay a cold jax import for nothing.
     """
     import subprocess
     import time
+
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_TIMEOUT", "90"))
+    if retries is None:
+        retries = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_RETRIES", "1"))
 
     first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
     if first == "cpu":

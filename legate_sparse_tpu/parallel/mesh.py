@@ -22,8 +22,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 ROW_AXIS = "rows"
 
 
-def make_row_mesh(devices: Optional[Sequence] = None) -> Mesh:
+def make_row_mesh(devices: Optional[Sequence | int] = None) -> Mesh:
     """1-D mesh over all (or given) devices with axis name ``rows``.
+
+    ``devices`` may be a device sequence or an int count (the first
+    ``devices`` of ``jax.devices()``; errors if fewer are available).
 
     Multi-host: after ``init_distributed()``, ``jax.devices()`` spans
     every host's chips in process order, so row blocks are contiguous
@@ -32,6 +35,14 @@ def make_row_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """
     if devices is None:
         devices = jax.devices()
+    elif isinstance(devices, int):
+        avail = jax.devices()
+        if len(avail) < devices:
+            raise ValueError(
+                f"make_row_mesh({devices}): only {len(avail)} devices "
+                f"available"
+            )
+        devices = avail[:devices]
     return Mesh(np.asarray(devices), (ROW_AXIS,))
 
 

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Probes the accelerator tunnel every 5 min; touches /tmp/tpu_alive when up.
+while true; do
+  if timeout 60 python -c "import jax, jax.numpy as jnp; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds; assert float(jnp.ones((8, 128)).sum()) == 1024.0" 2>/dev/null; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ alive" >> /tmp/tpu_watch.log
+    touch /tmp/tpu_alive
+  else
+    date -u +"%Y-%m-%dT%H:%M:%SZ down" >> /tmp/tpu_watch.log
+    rm -f /tmp/tpu_alive
+  fi
+  sleep 300
+done
