@@ -153,6 +153,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._bsr = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
             f"indptr length {self._indptr.shape[0]} != rows+1 "
@@ -366,6 +367,56 @@ class csr_array(CompressedBase, DenseSparseBase):
         )
         return self._ell
 
+    def _get_bsr(self):
+        """Cached block-sparse (BSR) structure, or None.
+
+        The irregular-path kernel (``ops/bsr.py``): densified present
+        128x128 blocks streamed through the MXU, skipping absent
+        blocks.  Built only where it can win — on TPU (the XLA gather
+        SpMV runs ~2 orders of magnitude under roofline there; on CPU
+        the gather path is already fine and Pallas interpret mode is
+        pure-Python slow), for f32/bf16 values, within the
+        ``bsr_max_expand`` densification budget.  Matrices that are
+        banded never reach here (``_get_dia`` wins the dispatch).
+        ``LEGATE_SPARSE_TPU_BSR_FORCE=1`` builds it on any platform
+        (differential tests run the kernel in interpret mode).
+
+        Semantic note: densified zero slots inside *present* blocks
+        multiply x (scipy's own ``bsr_array`` semantics), so a
+        non-finite x entry in a column CSR never stores can produce
+        NaN where exact-CSR paths stay finite.  Under
+        ``LEGATE_SPARSE_TPU_CHECK_BOUNDS`` (which enables
+        ``jax_debug_nans``) BSR is therefore disabled.
+        """
+        if self._bsr is not None:
+            return self._bsr if self._bsr is not False else None
+        if not self._can_build_cache(self._data, self._indices,
+                                     self._indptr):
+            return None
+        from .settings import settings
+
+        if not settings.bsr_force and jax.devices()[0].platform != "tpu":
+            self._bsr = False
+            return None
+        if (settings.bsr_max_expand <= 0
+                or settings.check_bounds
+                or self.dtype not in (jnp.float32, jnp.bfloat16)
+                or not self.has_canonical_format):
+            self._bsr = False
+            return None
+        from .ops import bsr as _bsr_ops
+
+        pack = _bsr_ops.bsr_pack(
+            self._data, self._indices, self._indptr, self.shape,
+            settings.bsr_max_expand,
+        )
+        if pack is None:
+            self._bsr = False
+            return None
+        self._bsr = _bsr_ops.BsrStructure(*pack, *self.shape,
+                                          dtype=self.dtype)
+        return self._bsr
+
     def _get_dia(self):
         """Cached banded (DIA) structure, or None.
 
@@ -577,6 +628,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._bsr = None
 
     def sort_indices(self):
         """Sort column indices within each row in place (stable; no
@@ -597,6 +649,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._bsr = None
 
     def power(self, n, dtype=None):
         """Element-wise power (scipy semantics: duplicates are summed
@@ -750,7 +803,10 @@ class csr_array(CompressedBase, DenseSparseBase):
             A, x = cast_to_common_type(self, other_arr)
             src = self if A is self else None
             dia = src._get_dia() if src is not None else None
-            ell = (src._get_ell() if src is not None and dia is None
+            bsr = (src._get_bsr() if src is not None and dia is None
+                   else None)
+            ell = (src._get_ell()
+                   if src is not None and dia is None and bsr is None
                    else None)
             if dia is not None:
                 from .ops.pallas_dia import (
@@ -768,12 +824,12 @@ class csr_array(CompressedBase, DenseSparseBase):
                             dia_data, mask, x, offs, self.shape
                         )
                     )
+            elif bsr is not None:
+                y = bsr.matvec(
+                    x, interpret=jax.devices()[0].platform != "tpu"
+                )
             elif ell is not None:
-                from .ops.pallas_spmv import ell_spmv_maybe_pallas
-
-                y = ell_spmv_maybe_pallas(ell[0], ell[1], ell[2], x)
-                if y is None:
-                    y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
+                y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
             elif src is not None:
                 y = _spmv_ops.csr_spmv_rowids(
                     A.data, A.indices, src._get_row_ids(), x, self.shape[0]
@@ -837,6 +893,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell = None
         self._dia = None
         self._dia_pack = None
+        self._bsr = None
         if structure_changed:
             self._row_ids = None
             self._ell_width = None

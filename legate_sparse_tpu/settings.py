@@ -126,6 +126,18 @@ class Settings:
         self.dia_max_diags: int = int(
             os.environ.get("LEGATE_SPARSE_TPU_DIA_MAX_DIAGS", "128")
         )
+        # Irregular SpMV path: densify present 128x128 blocks and stream
+        # them through the MXU (ops/bsr.py), skipping absent blocks,
+        # when the densified size stays within this multiple of nnz.
+        # 128.0 ~= the break-even vs the XLA gather path on v5e (useful
+        # bandwidth law in ops/bsr.py docstring).  0 disables BSR.
+        self.bsr_max_expand: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_BSR_EXPAND", "128.0")
+        )
+        # Build the BSR structure on any platform (kernel runs in
+        # interpret mode off-TPU) — differential-testing hook.
+        self.bsr_force: bool = _env_bool("LEGATE_SPARSE_TPU_BSR_FORCE",
+                                         False)
 
 
 settings = Settings()

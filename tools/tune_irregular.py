@@ -1,0 +1,148 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""On-chip irregular-path shoot-out: XLA ELL gather vs block-sparse.
+
+Measures random-sparsity CSR SpMV (the reference's general path,
+``src/sparse/array/csr/spmv.cc:36-44``) through:
+1. the XLA ELL gather kernel (``ops/spmv.py::ell_spmv``),
+2. the Pallas BSR kernel (``ops/bsr.py``) across densities,
+3. a clustered config (dense 8x8 sub-blocks scattered randomly — the
+   FEM-node pattern) where BSR's per-present-block population, not
+   global density, sets the rate (IRREGULAR.md law).
+
+Appends a JSON block to TPU_EVIDENCE.md.  Run from the repo root when
+the accelerator answers: ``python tools/tune_irregular.py``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, "TPU_EVIDENCE.md")
+
+SHOOTOUT = r"""
+import json
+import numpy as np, jax, jax.numpy as jnp
+import scipy.sparse as sp
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+from legate_sparse_tpu.ops import spmv as spmv_ops
+from legate_sparse_tpu.ops.bsr import bsr_pack, BsrStructure
+
+out = {"platform": jax.devices()[0].platform, "configs": []}
+rng = np.random.default_rng(0)
+
+def measure(A_sp, label):
+    rows, cols = A_sp.shape
+    nnz = A_sp.nnz
+    x = jnp.asarray(rng.standard_normal(cols).astype(np.float32))
+    cfg = {"label": label, "rows": rows, "nnz": nnz,
+           "density": round(nnz / (rows * cols), 6)}
+    useful_bytes = nnz * 8  # value + col index, CSR-equivalent terms
+
+    # XLA ELL gather
+    W = max(int(np.diff(A_sp.indptr).max()), 1)
+    ell = spmv_ops.ell_pack_device(
+        jnp.asarray(A_sp.data.astype(np.float32)),
+        jnp.asarray(A_sp.indices.astype(np.int32)),
+        jnp.asarray(A_sp.indptr.astype(np.int32)), rows, W)
+    try:
+        ms = loop_ms_per_iter(
+            lambda v: spmv_ops.ell_spmv(ell[0], ell[1], ell[2], v),
+            x, k_lo=2, k_hi=6)
+        cfg["ell_xla_ms"] = round(ms, 3)
+        cfg["ell_xla_gbs"] = round(useful_bytes / ms / 1e6, 2)
+    except Exception as e:
+        cfg["ell_xla_error"] = repr(e)[:200]
+
+    # Pallas BSR
+    pack = bsr_pack(A_sp.data, A_sp.indices, A_sp.indptr, A_sp.shape,
+                    max_expand=1e9)
+    if pack is not None:
+        st = BsrStructure(*pack, rows, cols)
+        cfg["nblocks"] = st.nblocks
+        cfg["nnz_per_block"] = round(nnz / st.nblocks, 1)
+        try:
+            ms = loop_ms_per_iter(
+                lambda v: st.matvec(v, interpret=False), x, k_lo=3, k_hi=13)
+            cfg["bsr_ms"] = round(ms, 3)
+            cfg["bsr_gbs"] = round(useful_bytes / ms / 1e6, 2)
+            cfg["bsr_stream_gbs"] = round(
+                (st.nblocks * 128 * 128 * 4) / ms / 1e6, 1)
+        except Exception as e:
+            cfg["bsr_error"] = repr(e)[:300]
+    out["configs"].append(cfg)
+
+# Uniform random at increasing density, fixed 64 MB-ish footprint.
+for n, d in [(1 << 14, 0.005), (1 << 14, 0.02), (1 << 13, 0.08)]:
+    nnz = int(n * n * d)
+    r = rng.integers(0, n, nnz); c = rng.integers(0, n, nnz)
+    A = sp.coo_matrix((np.ones(nnz, np.float32), (r, c)),
+                      shape=(n, n)).tocsr()
+    A.sum_duplicates()
+    measure(A, f"uniform_{n}_{d}")
+
+# Clustered: dense 8x8 sub-blocks at random positions (FEM pattern),
+# ~27 blocks per block-row like a 3-D stencil.
+n = 1 << 15
+bs, per_row = 8, 27
+nb = (n // bs) * per_row
+br = np.repeat(np.arange(n // bs), per_row)
+bc = rng.integers(0, n // bs, nb)
+rr = (br[:, None] * bs + np.arange(bs)[None, :]).ravel()
+r = np.repeat(rr, bs)
+c = ((bc[:, None] * bs + np.arange(bs)[None, :])[:, None, :]
+     + np.zeros((1, bs, 1), np.int64)).ravel()
+A = sp.coo_matrix((np.ones(r.shape[0], np.float32), (r, c)),
+                  shape=(n, n)).tocsr()
+A.sum_duplicates()
+measure(A, "clustered_fem_8x8")
+
+# Hyper-sparse tail (the adversarial config): expect BSR over budget,
+# XLA gather is the ceiling; record it honestly.
+n = 1 << 22
+W = 11
+nnz = n * W
+r = np.repeat(np.arange(n), W)
+c = rng.integers(0, n, nnz)
+A = sp.coo_matrix((np.ones(nnz, np.float32), (r, c)), shape=(n, n)).tocsr()
+A.sum_duplicates()
+measure(A, "hyper_sparse_2e22_W11")
+
+print(json.dumps(out))
+"""
+
+
+def main() -> None:
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    code = ("from legate_sparse_tpu._platform import ACCEL_PROBE_CODE "
+            "as c; exec(c)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=90,
+                           capture_output=True, text=True, cwd=ROOT)
+        ok = r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        print(f"{stamp}: TPU unreachable; nothing recorded")
+        sys.exit(1)
+    try:
+        r = subprocess.run([sys.executable, "-c", SHOOTOUT], timeout=3600,
+                           capture_output=True, text=True, cwd=ROOT)
+        rc, out, err = r.returncode, r.stdout[-6000:], r.stderr[-2000:]
+    except subprocess.TimeoutExpired:
+        rc, out, err = 124, "", "timeout"
+    with open(OUT, "a") as f:
+        f.write(f"\n## Irregular shoot-out {stamp}\n"
+                f"### (rc={rc})\n```json\n{out.strip()}\n```\n")
+        if rc != 0:
+            f.write(f"stderr: `{err[-800:]}`\n")
+    print(f"recorded -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
