@@ -589,6 +589,20 @@ def _mode() -> str:
     return os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA", "1")
 
 
+def pallas_dist_mode() -> str:
+    """Mode for the *distributed* per-shard Pallas route: env override
+    (``LEGATE_SPARSE_TPU_PALLAS_DIST`` = 0|1|interpret), else default-on
+    on TPU and off elsewhere (interpret mode is pure-Python slow; tests
+    opt in explicitly)."""
+    v = os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIST")
+    if v is not None:
+        return v
+    try:
+        return "1" if jax.devices()[0].platform == "tpu" else "0"
+    except Exception:
+        return "0"
+
+
 def pallas_dia_active() -> bool:
     """Cheap pre-check so callers skip building the row-aligned pack
     (which doubles band storage) when the kernel can never run."""

@@ -19,4 +19,5 @@ from .dist_csr import (  # noqa: F401
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401
+from .dist_build import dist_diags, dist_poisson2d  # noqa: F401
 from .dist_gmg import DistGMG  # noqa: F401

@@ -215,13 +215,15 @@ def dist_diags(
     if halo >= 0:
         (dia_data,) = results
 
-    return DistCSR(
+    from .dist_csr import attach_dia_prepack
+
+    return attach_dia_prepack(DistCSR(
         data=data, cols=cols_b, counts=counts, row_ids=None,
         shape=(n, n), rows_per_shard=rps, halo=halo, ell=True, mesh=mesh,
         dia_data=dia_data,
         dia_offsets=(tuple(int(o) for o in offs.tolist())
                      if halo >= 0 else None),
-    )
+    ))
 
 
 def dist_poisson2d(N: int, mesh: Optional[Mesh] = None,

@@ -36,7 +36,6 @@ last local row with value 0.
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 from functools import lru_cache, partial
 from typing import Optional, Tuple
@@ -99,6 +98,15 @@ class DistCSR:
     # Explicit-entry mask blocks (R, num_diags, rps) for *holey* bands
     # (None = exact band, validity derivable from the offsets alone).
     dia_mask: Optional[jax.Array] = None
+    # Pre-blocked Mosaic layout for the per-shard Pallas band kernel,
+    # built once at shard time (the cached-partition analog — the shard
+    # body does zero packing per call): pdia_data (R, nd, rps_pad) is
+    # the tile-padded band, pdia_mask (int8, same shape) merges global
+    # bounds, padding rows and band holes.  ``pdia_tile`` is the grid
+    # tile (0 = no prepack -> XLA shifted-add branch).
+    pdia_data: Optional[jax.Array] = None
+    pdia_mask: Optional[jax.Array] = None
+    pdia_tile: int = 0
 
     @property
     def num_shards(self) -> int:
@@ -222,6 +230,52 @@ class DistCSR:
 
     def toscipy(self):
         return self.to_csr().toscipy()
+
+
+def attach_dia_prepack(dist: DistCSR) -> DistCSR:
+    """Pre-block the Mosaic band layout on a banded DistCSR, in place.
+
+    Built once per matrix — the shard body of the Pallas dist SpMV then
+    does zero packing per call (the cached-partition analog).  Shared
+    by every banded builder (``shard_csr``, ``dist_diags``, the banded
+    ``dist_spgemm`` product).  No-op when already built, not banded,
+    over the Mosaic budget (``supported``), or the Pallas dist route is
+    off (``pallas_dist_mode() == "0"`` — the default off-TPU — so pure
+    XLA runs never pay the doubled band memory).
+
+    The int8 mask merges global row/column bounds, padding rows and
+    band holes, so the ring-wrapped halo never injects non-finite
+    values (same IEEE invariant as the XLA branch).
+    """
+    from ..ops.pallas_dia import pallas_dist_mode, supported
+
+    if (dist.pdia_tile or dist.dia_data is None or dist.halo < 0
+            or dist.dia_offsets is None or pallas_dist_mode() == "0"):
+        return dist
+    offsets = dist.dia_offsets
+    offs2 = tuple(int(o) + dist.halo for o in offsets)
+    tile = supported(offs2, dist.dtype, True)
+    if tile is None:
+        return dist
+    R, nd, rps = dist.dia_data.shape
+    n_rows = dist.shape[0]
+    rps_pad = -(-rps // tile) * tile
+    r_g = jnp.arange(R * rps, dtype=jnp.int32).reshape(R, 1, rps)
+    offs_a = jnp.asarray(offsets, dtype=jnp.int32).reshape(1, nd, 1)
+    valid = ((r_g + offs_a >= 0) & (r_g + offs_a < n_rows)
+             & (r_g < n_rows))
+    if dist.dia_mask is not None:
+        valid = valid & (jnp.asarray(dist.dia_mask) != 0)
+    pad = ((0, 0), (0, 0), (0, rps_pad - rps))
+    spec = NamedSharding(dist.mesh, P(ROW_AXIS, None, None))
+    dist.pdia_data = jax.device_put(
+        jnp.pad(jnp.asarray(dist.dia_data), pad), spec
+    )
+    dist.pdia_mask = jax.device_put(
+        jnp.pad(valid.astype(jnp.int8), pad), spec
+    )
+    dist.pdia_tile = tile
+    return dist
 
 
 def _precise_gather_plan(indices, indptr, starts, ends, R, cps, cols):
@@ -437,7 +491,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             ell_cols = np.clip(reb, 0, rps + 2 * halo - 1).astype(
                 indices.dtype
             )
-        return DistCSR(
+        return attach_dia_prepack(DistCSR(
             data=put(ell_data), cols=put(ell_cols), counts=put(ell_counts),
             row_ids=None, shape=(rows, cols), rows_per_shard=rps,
             halo=halo, ell=True, mesh=mesh,
@@ -448,7 +502,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             dia_offsets=dia_offs,
             dia_mask=(put(dia_mask_blocks)
                       if dia_mask_blocks is not None else None),
-        )
+        ))
 
     # Padded-CSR fallback: (R, nnz_max) + static row ids.
     local_nnz = hi - lo
@@ -473,7 +527,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     elif halo >= 0:
         reb = idx_b - (starts - halo)[:, None]
         idx_b = np.clip(reb, 0, rps + 2 * halo - 1).astype(indices.dtype)
-    return DistCSR(
+    return attach_dia_prepack(DistCSR(
         data=put(data_b), cols=put(idx_b),
         counts=put(local_nnz.astype(np.int32)), row_ids=put(rid_b),
         shape=(rows, cols), rows_per_shard=rps, halo=halo, ell=False,
@@ -485,7 +539,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         dia_offsets=dia_offs,
         dia_mask=(put(dia_mask_blocks)
                   if dia_mask_blocks is not None else None),
-    )
+    ))
 
 
 def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
@@ -521,9 +575,9 @@ def _extend_x(x_local, halo: int, axis: int = 0):
 
 @lru_cache(maxsize=256)
 def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
-                 rps: int, n_rows: int, has_mask: bool,
-                 pallas_mode: str = "0"):
-    """Cached shard_map callable for the banded dist SpMV.
+                 rps: int, n_rows: int, has_mask: bool):
+    """Cached shard_map callable for the banded dist SpMV (XLA
+    shifted-add branch).
 
     Structure-keyed caching is the Legion partition-cache analog: a
     fresh closure per call would be a new jit identity, so repeated
@@ -540,10 +594,6 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
         r_g = shard.astype(jnp.int64) * rps + jnp.arange(
             rps, dtype=jnp.int64
         )
-        if pallas_mode != "0":
-            y = _dia_shard_pallas(dd, dm, x_ext, r_g, pallas_mode)
-            if y is not None:
-                return y
         y = jnp.zeros((rps,), dtype=dd.dtype)
         for d, o in enumerate(offsets):
             seg = jax.lax.slice_in_dim(
@@ -564,57 +614,53 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
                               jnp.zeros((), dd.dtype))
         return y
 
-    def _dia_shard_pallas(dd, dm, x_ext, r_g, mode):
-        """Shard-local SpMV through the Mosaic band kernel
-        (``ops.pallas_dia``): the halo-extended window makes the local
-        problem a rectangular band with offsets shifted by +halo, and
-        ``dd`` is already row-aligned.  The global-bounds/ring-wrap
-        validity (and band holes) are merged into an explicit int8 mask
-        so IEEE non-finite-x semantics match the XLA branch exactly.
-
-        Opt-in (LEGATE_SPARSE_TPU_PALLAS_DIST=1|interpret): the shard
-        body always runs inside shard_map's trace, so a Mosaic compile
-        failure here surfaces at the outer compile with no fallback —
-        unlike the single-chip dispatch this route cannot self-heal.
-        Returns None (XLA branch) only for static ineligibility."""
-        from ..ops.pallas_dia import L as _LANES
-        from ..ops.pallas_dia import pallas_dia_spmv, supported
-
-        interpret = mode == "interpret"
-        if jnp.result_type(dd.dtype, x_ext.dtype) != dd.dtype:
-            # XLA branch promotes (e.g. bf16 matrix * f32 x -> f32);
-            # the kernel emits rdata's dtype — result dtype must not
-            # depend on the env flag.
-            return None
-        offs2 = tuple(int(o) + halo for o in offsets)
-        tile = supported(offs2, dd.dtype, True)
-        if tile is None:
-            return None
-        rps_pad = -(-rps // tile) * tile
-        valid_cols = jnp.stack([
-            jnp.logical_and(
-                jnp.logical_and(r_g + o >= 0, r_g + o < n_rows),
-                r_g < n_rows,
-            )
-            for o in offsets
-        ])
-        if has_mask:
-            valid_cols = jnp.logical_and(valid_cols, dm)
-        rdata = jnp.pad(dd, ((0, 0), (0, rps_pad - rps)))
-        rmask = jnp.pad(valid_cols.astype(jnp.int8),
-                        ((0, 0), (0, rps_pad - rps)))
-        return pallas_dia_spmv(
-            rdata.reshape(len(offsets), -1, _LANES),
-            rmask.reshape(len(offsets), -1, _LANES),
-            x_ext, offs2, (rps, x_ext.shape[0]), tile,
-            interpret=interpret,
-        )
-
     in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS)) + (
         (P(ROW_AXIS, None, None),) if has_mask else ()
     )
     # jit wrapper: shard_map alone re-lowers per call; under jit the
     # compiled executable is cached on (this fn, shapes).
+    return jax.jit(shard_map(
+        dia_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS), check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=256)
+def _dia_spmv_pallas_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
+                        rps: int, tile: int, interpret: bool):
+    """Cached shard_map callable for the banded dist SpMV through the
+    per-shard Mosaic kernel over the **pre-blocked** layout
+    (``DistCSR.pdia_data``/``pdia_mask``, built once at ``shard_csr``
+    time — the cached-partition analog): the shard body is one halo
+    ``ppermute`` plus one ``pallas_dia_spmv`` call, zero packing.
+
+    The halo-extended window makes the local problem a rectangular band
+    with offsets shifted by +halo; global bounds, ring-wrap and band
+    holes are already merged into the int8 mask, so IEEE non-finite-x
+    semantics match the XLA branch exactly.  The shard body runs inside
+    shard_map's trace, so a Mosaic compile failure surfaces at the
+    outer compile — callers gate on ``supported()`` having produced the
+    prepack and on result-dtype equality.
+    """
+    from jax import shard_map
+
+    from ..ops.pallas_dia import L as _LANES
+    from ..ops.pallas_dia import pallas_dia_spmv
+
+    offs2 = tuple(int(o) + halo for o in offsets)
+    nd = len(offsets)
+
+    def dia_kernel(pdata, pmask, x_local):
+        x_ext = _extend_x(x_local, halo)
+        return pallas_dia_spmv(
+            pdata[0].reshape(nd, -1, _LANES),
+            pmask[0].reshape(nd, -1, _LANES),
+            x_ext, offs2, (rps, x_ext.shape[0]), tile,
+            interpret=interpret,
+        )
+
+    in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                P(ROW_AXIS))
     return jax.jit(shard_map(
         dia_kernel, mesh=mesh, in_specs=in_specs,
         out_specs=P(ROW_AXIS), check_vma=False,
@@ -702,11 +748,23 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     if A.dia_data is not None and halo >= 0 and not precise:
         # Banded fast path: halo exchange + static shifted-adds, zero
         # gathers (the per-shard analog of ``ops.dia_ops.dia_spmv``).
+        from ..ops.pallas_dia import pallas_dist_mode
+
+        mode = pallas_dist_mode()
+        if (mode != "0" and A.pdia_tile
+                and jnp.result_type(A.dtype, x.dtype) == A.dtype):
+            # Mosaic route over the pre-blocked layout (default on
+            # TPU).  The dtype gate keeps promotion semantics (e.g.
+            # bf16 matrix * f32 x -> f32) identical to the XLA branch.
+            fn = _dia_spmv_pallas_fn(
+                A.mesh, A.dia_offsets, halo, A.rows_per_shard,
+                A.pdia_tile, mode == "interpret",
+            )
+            return fn(A.pdia_data, A.pdia_mask, x)
         has_mask = A.dia_mask is not None
         fn = _dia_spmv_fn(
             A.mesh, A.dia_offsets, halo, A.rows_per_shard, A.shape[0],
             has_mask,
-            os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIST", "0"),
         )
         args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
         return fn(*args)

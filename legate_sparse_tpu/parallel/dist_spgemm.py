@@ -281,11 +281,13 @@ def _dist_band_spgemm(A: DistCSR, B: DistCSR):
     fn = _band_spgemm_fn(A.mesh, offs_a, offs_b, offs_c, n, rps, h,
                          halo_c)
     data, cols_b, counts, dia_data = fn(A.dia_data, B.dia_data)
-    return DistCSR(
+    from .dist_csr import attach_dia_prepack
+
+    return attach_dia_prepack(DistCSR(
         data=data, cols=cols_b, counts=counts, row_ids=None,
         shape=(n, n), rows_per_shard=rps, halo=halo_c, ell=True,
         mesh=A.mesh, dia_data=dia_data, dia_offsets=offs_c,
-    )
+    ))
 
 
 @lru_cache(maxsize=128)
