@@ -139,6 +139,10 @@ class DistGMG:
         self._append_params(cur, omega, power_iters)
         for _ in range(levels - 1):
             R_sp, dim = restrict(dim)
+            # Grid operators follow the system dtype (an f32 system
+            # must not upcast through f64 restriction values — the CG
+            # while_loop carry dtype would diverge).
+            R_sp = R_sp.astype(np.dtype(cur.dtype))
             P_sp = R_sp.T.tocsr()
             dR = shard_csr(sparse.csr_array(R_sp), mesh=cur.mesh)
             dP = shard_csr(sparse.csr_array(P_sp), mesh=cur.mesh)
