@@ -294,6 +294,115 @@ def main() -> None:
         except Exception as e:
             sys.stderr.write(f"bench: irregular config failed: {e!r}\n")
 
+    # Block-sparse (BSR) irregular path: moderate-density random matrix
+    # through the MXU block kernel (ops/bsr.py).  TPU only — interpret
+    # mode is pure-Python slow and measures nothing.
+    if (platform == "tpu"
+            and os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_BSR",
+                               "0") != "1"):
+        try:
+            from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+            from legate_sparse_tpu.ops.bsr import BsrStructure, bsr_pack
+
+            import scipy.sparse as sp
+
+            nb_n = 1 << 13
+            A_sp = sp.random(nb_n, nb_n, density=0.05, format="csr",
+                             random_state=np.random.default_rng(1),
+                             dtype=np.float32)
+            pack = bsr_pack(A_sp.data, A_sp.indices, A_sp.indptr,
+                            A_sp.shape, max_expand=1e9)
+            st = BsrStructure(*pack, nb_n, nb_n)
+            xb = jnp.ones((nb_n,), jnp.float32)
+            ms = loop_ms_per_iter(
+                lambda v: st.matvec(v, interpret=False), xb,
+                k_lo=3, k_hi=13,
+            )
+            result["bsr_ms"] = round(ms, 4)
+            # CSR-equivalent useful bytes (value + index per nnz).
+            result["bsr_gbs"] = round(
+                A_sp.nnz * 8 / (ms * 1e-3) / 1e9, 2
+            )
+            result["bsr_stream_gbs"] = round(
+                st.nblocks * 128 * 128 * 4 / (ms * 1e-3) / 1e9, 1
+            )
+        except Exception as e:
+            sys.stderr.write(f"bench: bsr config failed: {e!r}\n")
+
+    # Banded SpGEMM end-to-end (BASELINE config 4, reference
+    # ``examples/spgemm_microbenchmark.py:74-79``).  Host-coupled (nnz
+    # size oracle), so wall-time with a true result fetch.
+    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_SPGEMM", "0") != "1":
+        try:
+            import time as _time
+
+            n_gm = 1 << (20 if platform != "cpu" else 16)
+            A_gm = _banded_config(sparse, n_gm, nnz_per_row)
+            best = float("inf")
+            for rep in range(3):
+                t0 = _time.perf_counter()
+                C = A_gm @ A_gm
+                _ = float(np.asarray(C.data[0]))
+                if rep:
+                    best = min(best, _time.perf_counter() - t0)
+            result["spgemm_n"] = n_gm
+            result["spgemm_ms"] = round(best * 1e3, 2)
+        except Exception as e:
+            sys.stderr.write(f"bench: spgemm config failed: {e!r}\n")
+
+    # GMG-preconditioned CG ms/iter (BASELINE config 5, reference
+    # ``examples/gmg.py:397-417``) through the package-native
+    # distributed hierarchy on a 1-device mesh (the same code path that
+    # scales out).  Two maxiter variants; the delta cancels fixed costs.
+    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_GMG", "0") != "1":
+        try:
+            import time as _time
+
+            from legate_sparse_tpu.parallel import (
+                DistGMG, dist_cg, make_row_mesh, shard_csr,
+            )
+
+            grid = 1 << (9 if platform != "cpu" else 6)
+            ngm = grid * grid
+            main_d = np.full(ngm, 4.0, np.float32)
+            off1 = np.full(ngm - 1, -1.0, np.float32)
+            off1[np.arange(1, grid) * grid - 1] = 0.0
+            offn = np.full(ngm - grid, -1.0, np.float32)
+            A_g = sparse.diags(
+                [main_d, off1, off1, offn, offn],
+                [0, 1, -1, grid, -grid],
+                shape=(ngm, ngm), format="csr", dtype=np.float32,
+            )
+            mesh1 = make_row_mesh(1)
+            dA_g = shard_csr(A_g, mesh=mesh1)
+            gmg = DistGMG(dA_g, levels=3)
+            b_g = np.ones(ngm, np.float32)
+
+            def timed_gmg(maxiter):
+                best = float("inf")
+                for rep in range(3):
+                    t0 = _time.perf_counter()
+                    xs, _ = dist_cg(dA_g, b_g, M=gmg.cycle, rtol=0.0,
+                                    maxiter=maxiter)
+                    _ = float(np.asarray(xs[0]))
+                    if rep:
+                        best = min(best, _time.perf_counter() - t0)
+                return best
+
+            t1, t2 = timed_gmg(20), timed_gmg(60)
+            if t2 > t1:
+                result["gmg_grid"] = f"{grid}x{grid}"
+                result["gmg_cg_ms_per_iter"] = round(
+                    (t2 - t1) / 40 * 1e3, 4
+                )
+            else:
+                sys.stderr.write(
+                    f"bench: gmg timing unresolvable "
+                    f"(t20={t1:.4f}s, t60={t2:.4f}s)\n"
+                )
+        except Exception as e:
+            sys.stderr.write(f"bench: gmg config failed: {e!r}\n")
+
     print(json.dumps(result))
 
 

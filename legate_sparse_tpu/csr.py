@@ -572,8 +572,7 @@ class csr_array(CompressedBase, DenseSparseBase):
 
     def asformat(self, format, copy: bool = False):
         """Return this matrix in the given format, scipy ``asformat``
-        semantics ('csr' and 'dia'; there is no coo array class — use
-        ``tocoo()`` for the (row, col, data) view)."""
+        semantics ('csr', 'csc', 'coo', 'dia')."""
         if format is None or format == "csr":
             return self.tocsr(copy=copy)
         if format == "dia":
@@ -917,6 +916,9 @@ class csr_array(CompressedBase, DenseSparseBase):
             vals = jnp.full((length,), vals)
         length = min(length, int(vals.shape[0]))
         vals = vals[:length]
+        if length <= 0:
+            # Zero-length values: scipy's setdiag silently no-ops.
+            return
         if self.nnz and not self.has_canonical_format:
             self.sum_duplicates()
 
@@ -974,17 +976,43 @@ class csr_array(CompressedBase, DenseSparseBase):
         indptr = _np.asarray(self._indptr)
         indices = _np.asarray(self._indices)
         data = _np.asarray(self._data)
-        out = _np.zeros(rows_idx.shape[0], dtype=self.dtype)
-        sorted_rows = bool(self.has_sorted_indices)
-        for t, (i, j) in enumerate(zip(rows_idx, cols_pt)):
-            lo, hi = int(indptr[i]), int(indptr[i + 1])
-            seg = indices[lo:hi]
-            if sorted_rows:
-                a = _np.searchsorted(seg, j, "left")
-                b = _np.searchsorted(seg, j, "right")
-                out[t] = data[lo + a: lo + b].sum()
-            else:
-                out[t] = data[lo:hi][seg == j].sum()
+        if rows_idx.shape[0] <= 64:
+            # Small queries: per-row probes bounded by the row length —
+            # the global key build below is O(nnz) and would make a
+            # single A[i, j] scan the whole matrix.
+            out = _np.zeros(rows_idx.shape[0], dtype=self.dtype)
+            sorted_rows = bool(self.has_sorted_indices)
+            for t, (i, j) in enumerate(zip(rows_idx, cols_pt)):
+                lo, hi = int(indptr[i]), int(indptr[i + 1])
+                seg = indices[lo:hi]
+                if sorted_rows:
+                    a = _np.searchsorted(seg, j, "left")
+                    b = _np.searchsorted(seg, j, "right")
+                    out[t] = data[lo + a: lo + b].sum()
+                else:
+                    out[t] = data[lo:hi][seg == j].sum()
+            return out.reshape(out_shape)
+        # Batched queries: one global binary search instead of a Python
+        # loop per element — nnz keyed by row*ncols+col is globally
+        # sorted once, then every (i, j) is two vectorized probes.
+        row_ids = _np.repeat(
+            _np.arange(n_rows, dtype=_np.int64), _np.diff(indptr)
+        )
+        key = row_ids * _np.int64(n_cols) + indices.astype(_np.int64)
+        if not self.has_sorted_indices:
+            order = _np.argsort(key, kind="stable")
+            key = key[order]
+            data = data[order]
+        q = (rows_idx.astype(_np.int64) * _np.int64(n_cols)
+             + cols_pt.astype(_np.int64))
+        a = _np.searchsorted(key, q, "left")
+        b = _np.searchsorted(key, q, "right")
+        out = _np.zeros(q.shape[0], dtype=self.dtype)
+        single = (b - a) == 1
+        out[single] = data[a[single]]
+        # Duplicate groups (non-canonical matrices only) sum exactly.
+        for t in _np.nonzero(b - a > 1)[0]:
+            out[t] = data[a[t]: b[t]].sum()
         return out.reshape(out_shape)
 
     def _select_rows(self, rows_idx) -> "csr_array":
@@ -1035,7 +1063,11 @@ class csr_array(CompressedBase, DenseSparseBase):
         """Row selection / element access (the scipy subset users hit
         in practice; the reference supports no indexing at all):
 
-        - ``A[i]`` -> (1, cols) csr row (scipy semantics)
+        - ``A[i]`` / ``A[i, :]`` -> (1, cols) csr row.  DEVIATION:
+          scipy's ``csr_array`` (sparray) returns a 1-D result here;
+          this package has no 1-D sparse type, so row access is always
+          2-D (scipy's ``csr_matrix`` semantics).  Shape-sensitive
+          callers should ``.toarray().ravel()``.
         - ``A[i, j]`` -> scalar (sum of duplicates at that coordinate)
         - ``A[i0:i1:step]`` / ``A[row_index_array]`` -> csr row subset
         - ``A[:, j0:j1]`` / ``A[rows, :]`` etc. via one row pass + a

@@ -228,6 +228,8 @@ def vstack(blocks, format=None, dtype=None):
     from .utils import cast_to_common_type
 
     mats = [_as_csr(b) for b in blocks]
+    if not mats:
+        raise ValueError("blocks must not be empty")
     cols = mats[0].shape[1]
     if any(mat.shape[1] != cols for mat in mats):
         raise ValueError("vstack: mismatching number of columns")
@@ -259,6 +261,8 @@ def hstack(blocks, format=None, dtype=None):
     from .utils import cast_to_common_type
 
     mats = [_as_csr(b) for b in blocks]
+    if not mats:
+        raise ValueError("blocks must not be empty")
     rows = mats[0].shape[0]
     if any(mat.shape[0] != rows for mat in mats):
         raise ValueError("hstack: mismatching number of rows")
@@ -298,6 +302,8 @@ def block_diag(mats, format=None, dtype=None):
     from .types import coord_dtype_for
 
     mats = [_as_csr(b) for b in mats]
+    if not mats:
+        raise ValueError("blocks must not be empty")
     cols = sum(mat.shape[1] for mat in mats)
     _require_representable(coord_dtype_for(cols))
     cdt = coord_dtype_for(cols)
@@ -322,8 +328,8 @@ def block_diag(mats, format=None, dtype=None):
 def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
            random_state=None, data_rvs=None):
     """Random sparse matrix (scipy ``random`` signature incl. the
-    legacy ``random_state=`` spelling and ``data_rvs``; COO/CSR formats
-    return this package's csr_array)."""
+    legacy ``random_state=`` spelling and ``data_rvs``); the default
+    ``format="coo"`` returns a ``coo_array``, matching scipy."""
     from .csr import csr_array
 
     m, n = int(m), int(n)
@@ -355,4 +361,4 @@ def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
     A = csr_array(
         (vals[order], (rows[order], cols[order])), shape=(m, n)
     )
-    return A.asformat(format if format != "coo" else None)
+    return A.asformat(format)

@@ -617,8 +617,10 @@ def norm(A, ord=None, axis=None):
     -1 (max/min absolute column sum), inf / -inf (max/min absolute row
     sum), 2 (spectral — delegated to scipy on host, it needs an SVD).
     ``axis=0``/``1`` give per-column/per-row vector norms (ord None/2 =
-    Euclidean, 1 = abs sum, inf = abs max).  Computed on device from
-    the stored values (duplicates canonicalized first).
+    Euclidean, 1 = abs sum, inf = abs max, -inf = abs min including
+    implicit zeros, 0 = count of nonzeros), returned as numpy arrays
+    (scipy returns numpy).  Computed on device from the stored values
+    (duplicates canonicalized first).
     """
     from .utils import is_sparse_matrix
 
@@ -656,9 +658,25 @@ def norm(A, ord=None, axis=None):
     axis = axis % 2
     if ord in (None, 2):
         sq = A._with_data(A.data * jnp.conj(A.data))
-        return jnp.sqrt(jnp.real(sq.sum(axis=axis)))
+        return np.asarray(jnp.sqrt(jnp.real(sq.sum(axis=axis))))
     if ord == 1:
-        return absA().sum(axis=axis)
+        return np.asarray(absA().sum(axis=axis))
     if ord in (np.inf, float("inf")):
-        return absA().max(axis=axis)
+        return np.asarray(absA().max(axis=axis))
+    if ord in (-np.inf, float("-inf")):
+        # Min absolute value per row/column, implicit-zero aware: any
+        # row/column with fewer stored entries than its length has an
+        # implicit zero, so its min is 0 (scipy semantics via todense).
+        counts = np.asarray(A.getnnz(axis=axis))
+        # Reducing along ``axis`` spans shape[axis] elements per slice
+        # (axis=1: each row has ncols entries).
+        full = A.shape[axis]
+        m = np.asarray(absA().min(axis=axis))
+        return np.where(counts < full, np.minimum(m, 0.0), m)
+    if ord == 0:
+        # Count of explicit nonzero *values* (scipy counts (x != 0)).
+        nz = A._with_data(
+            (A.data != 0).astype(jnp.result_type(A.dtype, jnp.float32))
+        )
+        return np.asarray(nz.sum(axis=axis))
     raise ValueError(f"Invalid norm order {ord!r} for vectors")

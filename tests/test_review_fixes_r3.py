@@ -1,0 +1,78 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Round-3 advisor-finding regressions (ADVICE.md r2)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+
+
+def test_random_default_format_is_coo():
+    from legate_sparse_tpu.coo import coo_array
+
+    A = sparse.random(50, 40, density=0.1, random_state=0)
+    assert isinstance(A, coo_array)
+    assert sparse.random(50, 40, density=0.1, format="csr",
+                         random_state=0).format == "csr"
+
+
+def test_setdiag_empty_values_noop():
+    # scipy 1.17 silently no-ops on a zero-length values array.
+    A = sparse.eye(4, format="csr")
+    before = A.toarray().copy()
+    A.setdiag(np.array([]))
+    np.testing.assert_array_equal(A.toarray(), before)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("build", ["sparse", "dense3x2", "dense2x3"])
+def test_norm_axis_neg_inf_and_zero(axis, build):
+    rng = np.random.default_rng(0)
+    if build == "sparse":
+        A_sp = sp.random(9, 7, density=0.4, format="csr",
+                         random_state=rng)
+        if A_sp.nnz:
+            A_sp.data[0] = 0.0  # explicit zero: ord=0 must not count it
+    elif build == "dense3x2":
+        # Fully stored non-square: no implicit zeros anywhere, so
+        # ord=-inf must NOT collapse to 0 (dimension-mix regression).
+        A_sp = sp.csr_matrix(np.array([[1., 2.], [3., 4.], [5., 6.]]))
+    else:
+        A_sp = sp.csr_matrix(np.array([[1., 2., 7.], [3., 4., 8.]]))
+    A = sparse.csr_array(A_sp)
+    for order in (-np.inf, 0, 1, np.inf, None):
+        got = linalg.norm(A, ord=order, axis=axis)
+        want = sp.linalg.norm(A_sp, ord=order, axis=axis)
+        assert isinstance(got, np.ndarray)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-12)
+
+
+@pytest.mark.parametrize("fn", ["vstack", "hstack", "block_diag"])
+def test_stack_empty_blocks_raise(fn):
+    with pytest.raises(ValueError, match="empty"):
+        getattr(sparse, fn)([])
+
+
+@pytest.mark.parametrize("nq", [40, 200])  # small-loop and batched paths
+def test_pointwise_get_vectorized_matches_scipy(nq):
+    rng = np.random.default_rng(3)
+    A_sp = sp.random(64, 48, density=0.15, format="csr", random_state=rng)
+    A = sparse.csr_array(A_sp)
+    rows = rng.integers(-64, 64, size=nq)
+    cols = rng.integers(-48, 48, size=nq)
+    got = A._pointwise_get(rows.copy(), cols.copy())
+    want = np.array([A_sp[int(i) % 64, int(j) % 48]
+                     for i, j in zip(rows, cols)])
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_pointwise_get_duplicates_summed():
+    r = np.array([1, 1, 2])
+    c = np.array([3, 3, 0])
+    v = np.array([2.0, 5.0, 1.0])
+    A = sparse.csr_array((v, (r, c)), shape=(4, 5))
+    got = A._pointwise_get(np.array([1, 2, 0]), np.array([3, 0, 0]))
+    np.testing.assert_allclose(got, [7.0, 1.0, 0.0])
