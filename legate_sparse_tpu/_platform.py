@@ -121,6 +121,23 @@ def ensure_live_backend(timeout_s: int | None = None,
     if retries is None:
         retries = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_RETRIES", "1"))
 
+    # In-process state wins over the environment: pin_cpu() updates
+    # jax.config (the env var may still say an accelerator — e.g. the
+    # axon sitecustomize re-exports it), and a backend that already
+    # initialized in this process needs no subprocess probe at all.
+    if "jax" in sys.modules:
+        import jax
+        from jax._src import xla_bridge
+
+        cfg = (jax.config.jax_platforms or "").split(",")[0].strip()
+        if cfg == "cpu":
+            return False
+        if xla_bridge.backends_are_initialized():
+            try:
+                return jax.devices()[0].platform != "cpu"
+            except Exception:
+                return False
+
     first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
     if first == "cpu":
         return False

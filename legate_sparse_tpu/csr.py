@@ -848,7 +848,14 @@ class csr_array(CompressedBase, DenseSparseBase):
             A, X = cast_to_common_type(self, other_arr)
             src = self if A is self else None
             dia = src._get_dia() if src is not None else None
-            ell = (src._get_ell() if src is not None and dia is None
+            from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
+
+            bsr = (src._get_bsr()
+                   if src is not None and dia is None
+                   and 0 < X.shape[1] <= _BSR_MAX_K
+                   else None)
+            ell = (src._get_ell()
+                   if src is not None and dia is None and bsr is None
                    else None)
             if dia is not None:
                 from .ops.pallas_dia import (
@@ -872,6 +879,10 @@ class csr_array(CompressedBase, DenseSparseBase):
                             dia_data, mask, X, offs, self.shape
                         )
                     )
+            elif bsr is not None:
+                Y = bsr.matmat(
+                    X, interpret=jax.devices()[0].platform != "tpu"
+                )
             elif ell is not None:
                 Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
             elif src is not None:

@@ -156,6 +156,65 @@ def bsr_spmv_pallas(blkT, brow, bcol, x2d, nbr: int, nbc: int,
     )(brow, bcol, blkT, x2d)
 
 
+def _make_spmm_kernel(pl):
+    def kernel(brow_ref, bcol_ref, blk_ref, xt_ref, y_ref):
+        i = pl.program_id(0)
+        b = brow_ref[i]
+        prev = brow_ref[jnp.maximum(i - 1, 0)]
+        first = jnp.logical_or(i == 0, b != prev)
+
+        @pl.when(first)
+        def _():
+            y_ref[...] = jnp.zeros_like(y_ref)
+
+        xt = xt_ref[0]           # (k_pad, B): X chunk transposed
+        blkT = blk_ref[0]        # (B, B), blkT[c, r]
+        y_ref[...] += jnp.dot(
+            xt, blkT, preferred_element_type=y_ref.dtype
+        )[None]
+
+    return kernel
+
+
+# SpMM k cap: one (k, B) X chunk + (k, B) Y block must stay far inside
+# VMEM next to the 64 KiB data block.
+SPMM_MAX_K = 512
+
+
+@partial(jax.jit, static_argnames=("nbr", "nbc", "interpret"))
+def bsr_spmm_pallas(blkT, brow, bcol, xt3, nbr: int, nbc: int,
+                    interpret: bool = False):
+    """YT (nbr, k_pad, B) = A @ X over present blocks.
+
+    ``xt3`` is X transposed and chunked: (nbc, k_pad, B) with
+    ``xt3[c, :, l] = X[c*B + l, :]`` — the transposed layout makes the
+    per-block product ``xt(k,B) @ blkT(B,B)`` land lane-major, same
+    trick as the SpMV kernel's transposed blocks.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb = blkT.shape[0]
+    k_pad = xt3.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, B, B), lambda i, brow, bcol: (i, 0, 0)),
+            pl.BlockSpec((1, k_pad, B),
+                         lambda i, brow, bcol: (bcol[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, k_pad, B),
+                               lambda i, brow, bcol: (brow[i], 0, 0)),
+    )
+    return pl.pallas_call(
+        _make_spmm_kernel(pl),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nbr, k_pad, B), jnp.float32),
+        interpret=interpret,
+    )(brow, bcol, blkT, xt3)
+
+
 @partial(jax.jit, static_argnames=("nbr", "nbc"))
 def bsr_spmv_xla(blkT, brow, bcol, x2d, nbr: int, nbc: int):
     """XLA reference for the same BSR structure (differential testing
@@ -202,3 +261,34 @@ class BsrStructure:
             interpret=interpret,
         )
         return y2d.ravel()[: self.rows].astype(self.dtype)
+
+    def matmat(self, X, interpret: bool):
+        """Y = A @ X for dense (cols, k) X, k <= SPMM_MAX_K."""
+        X = jnp.asarray(X, dtype=self.dtype)
+        k = X.shape[1]
+        if k > SPMM_MAX_K:
+            raise ValueError(
+                f"BSR SpMM supports k <= {SPMM_MAX_K}, got {k} "
+                "(VMEM budget for the per-block X chunk)"
+            )
+        pad_r = self.nbc * B - self.cols
+        if pad_r:
+            X = jnp.concatenate(
+                [X, jnp.zeros((pad_r, k), dtype=self.dtype)]
+            )
+        # Sublane-tile multiple: 8 for f32, 16 for the packed bf16 tile.
+        sub = 16 if self.dtype == jnp.bfloat16 else 8
+        k_pad = max(-(-k // sub) * sub, sub)
+        if k_pad != k:
+            X = jnp.concatenate(
+                [X, jnp.zeros((X.shape[0], k_pad - k), self.dtype)],
+                axis=1,
+            )
+        # (nbc*B, k_pad) -> (nbc, k_pad, B) transposed chunks.
+        xt3 = jnp.swapaxes(X.reshape(self.nbc, B, k_pad), 1, 2)
+        yt3 = bsr_spmm_pallas(
+            self.blkT, self.brow, self.bcol, xt3, self.nbr, self.nbc,
+            interpret=interpret,
+        )
+        Y = jnp.swapaxes(yt3, 1, 2).reshape(self.nbr * B, k_pad)
+        return Y[: self.rows, :k].astype(self.dtype)

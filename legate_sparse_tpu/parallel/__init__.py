@@ -10,11 +10,19 @@ dimension, ``shard_map``-ped kernels, and explicit ICI collectives
 (``all_gather``/``psum``/``ppermute``).
 """
 
-from .mesh import init_distributed, make_row_mesh, row_spec  # noqa: F401
+from .mesh import (  # noqa: F401
+    factor_grid,
+    init_distributed,
+    make_grid_mesh,
+    make_row_mesh,
+    row_spec,
+)
 from .dist_csr import (  # noqa: F401
     DistCSR,
     shard_csr,
+    shard_dense,
     dist_spmv,
+    dist_spmm,
     dist_cg,
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401

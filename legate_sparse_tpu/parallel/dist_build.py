@@ -109,7 +109,9 @@ def dist_diags(
     if len(set(offs.tolist())) != len(offs):
         raise ValueError("duplicate offsets")
     W = len(offs)
-    R = int(np.prod(mesh.devices.shape))
+    # Row-shard count: the size of the "rows" axis only (a 2-D
+    # grid mesh replicates the matrix along "cols").
+    R = int(mesh.shape[ROW_AXIS])
     rps = math.ceil(n / R) if n else 1
     rows_p = R * rps
     starts = np.minimum(np.arange(R) * rps, n)

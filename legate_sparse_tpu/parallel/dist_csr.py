@@ -47,7 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..csr import csr_array
-from .mesh import ROW_AXIS, make_row_mesh
+from .mesh import COL_AXIS, ROW_AXIS, make_row_mesh
 
 
 @dataclass
@@ -370,7 +370,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         precise = settings.precise_images and not force_all_gather
     if mesh is None:
         mesh = make_row_mesh()
-    R = int(np.prod(mesh.devices.shape))
+    # Row-shard count: the size of the "rows" axis only (a 2-D
+    # grid mesh replicates the matrix along "cols").
+    R = int(mesh.shape[ROW_AXIS])
     rows, cols = A.shape
     rps = math.ceil(rows / R) if rows else 1
     indptr = np.asarray(A.indptr)
@@ -779,6 +781,122 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
         args = (A.data, A.cols, A.row_ids, A.counts) + (
             (A.gather_idx,) if precise else ()
         ) + (x,)
+    return fn(*args)
+
+
+def shard_dense(X, mesh: Mesh, rows_padded: int) -> jax.Array:
+    """Pad and shard a dense (rows, k) operand: rows over the "rows"
+    axis; columns over the "cols" axis too when ``mesh`` is a 2-D grid
+    (k padded to a multiple of the grid's column count)."""
+    X = jnp.asarray(X)
+    pad_r = rows_padded - X.shape[0]
+    if pad_r:
+        X = jnp.concatenate(
+            [X, jnp.zeros((pad_r, X.shape[1]), X.dtype)]
+        )
+    if COL_AXIS in mesh.shape:
+        C = int(mesh.shape[COL_AXIS])
+        pad_c = (-X.shape[1]) % C
+        if pad_c:
+            X = jnp.concatenate(
+                [X, jnp.zeros((X.shape[0], pad_c), X.dtype)], axis=1
+            )
+        return jax.device_put(X, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)))
+    return jax.device_put(X, NamedSharding(mesh, P(ROW_AXIS, None)))
+
+
+@lru_cache(maxsize=128)
+def _block_spmm_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
+                   rps: int, col_sharded: bool):
+    """Cached shard_map callable for distributed SpMM (Y = A @ X).
+
+    The 2-D-grid answer to the reference's projection functors
+    (``projections.cc:23-64``): X's rows follow A's row partition (the
+    same halo / all_gather / precise realizations as ``dist_spmv``, one
+    axis up), while X's *columns* shard over the grid's "cols" axis —
+    independent columns, so the column axis adds zero communication.
+    """
+    from jax import shard_map
+
+    from ..ops import spmv as _spmv_ops
+
+    xcol = COL_AXIS if col_sharded else None
+
+    def realize(x_local, gidx_local=None):
+        if precise:
+            parts = x_local[gidx_local]          # (R_dst, C, k_loc)
+            recv = jax.lax.all_to_all(
+                parts, ROW_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            return jnp.concatenate(
+                [recv.reshape(-1, x_local.shape[1]), x_local]
+            )
+        if halo >= 0:
+            return _extend_x(x_local, halo)      # axis 0
+        return jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+
+    if ell:
+        def kernel(data, cols, counts, *rest):
+            gidx = rest[0][0] if precise else None
+            X_local = rest[-1]
+            X_src = realize(X_local, gidx)
+            return _spmv_ops.ell_spmm(data[0], cols[0], counts[0], X_src)
+
+        in_specs = (
+            P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+            P(ROW_AXIS, None),
+        ) + ((P(ROW_AXIS, None, None),) if precise else ()) + (
+            P(ROW_AXIS, xcol),
+        )
+    else:
+        def kernel(data, cols, row_ids, counts, *rest):
+            gidx = rest[0][0] if precise else None
+            X_local = rest[-1]
+            X_src = realize(X_local, gidx)
+            d, c, rid, cnt = data[0], cols[0], row_ids[0], counts[0]
+            slot = jnp.arange(d.shape[0], dtype=jnp.int32)
+            prod = jnp.where(
+                (slot < cnt)[:, None], d[:, None] * X_src[c, :],
+                jnp.zeros((1, 1), d.dtype),
+            )
+            return jax.ops.segment_sum(
+                prod, rid, num_segments=rps, indices_are_sorted=True
+            )
+
+        in_specs = (
+            P(ROW_AXIS, None), P(ROW_AXIS, None), P(ROW_AXIS, None),
+            P(ROW_AXIS),
+        ) + ((P(ROW_AXIS, None, None),) if precise else ()) + (
+            P(ROW_AXIS, xcol),
+        )
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS, xcol), check_vma=False,
+    ))
+
+
+def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
+    """Y = A @ X for a dense (rows_padded, k) operand (jittable).
+
+    Same distribution contract as ``dist_spmv`` lifted one axis: X and
+    Y are row-block sharded over "rows"; on a 2-D grid mesh
+    (``make_grid_mesh``) their columns additionally shard over "cols",
+    with the sparse blocks replicated along that axis.  Use
+    ``shard_dense`` to lay out X.
+    """
+    A._require_blocks("dist_spmm")
+    precise = A.gather_idx is not None
+    col_sharded = COL_AXIS in A.mesh.shape
+    fn = _block_spmm_fn(A.mesh, A.halo, precise, A.ell,
+                        A.rows_per_shard, col_sharded)
+    if A.ell:
+        args = (A.data, A.cols, A.counts) + (
+            (A.gather_idx,) if precise else ()
+        ) + (X,)
+    else:
+        args = (A.data, A.cols, A.row_ids, A.counts) + (
+            (A.gather_idx,) if precise else ()
+        ) + (X,)
     return fn(*args)
 
 

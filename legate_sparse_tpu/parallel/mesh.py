@@ -20,6 +20,49 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 ROW_AXIS = "rows"
+COL_AXIS = "cols"
+
+
+def factor_grid(n: int) -> tuple[int, int]:
+    """Near-square factorization of ``n`` (the reference's
+    ``factor_int``, ``legate_sparse/utils.py:118-124``): returns
+    (r, c) with r * c == n and r <= c, r as large as possible."""
+    r = int(n ** 0.5)
+    while r > 1 and n % r:
+        r -= 1
+    return max(r, 1), n // max(r, 1)
+
+
+def make_grid_mesh(devices: Optional[Sequence | int] = None,
+                   shape: Optional[tuple[int, int]] = None) -> Mesh:
+    """2-D mesh with axes ("rows", "cols") — the analog of the
+    reference's 1-D-launch-onto-2-D-grid projection functors
+    (``projections.cc:23-64``): the sparse matrix row-shards over
+    "rows" while dense SpMM operands column-shard over "cols"
+    (independent columns — zero extra communication).  ``shape``
+    defaults to the near-square ``factor_grid`` of the device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    elif isinstance(devices, int):
+        avail = jax.devices()
+        if len(avail) < devices:
+            raise ValueError(
+                f"make_grid_mesh({devices}): only {len(avail)} devices "
+                f"available"
+            )
+        devices = avail[:devices]
+    devices = list(devices)
+    if shape is None:
+        shape = factor_grid(len(devices))
+    r, c = shape
+    if r * c != len(devices):
+        raise ValueError(
+            f"grid shape {shape} != device count {len(devices)}"
+        )
+    return Mesh(
+        np.asarray(devices).reshape(r, c), (ROW_AXIS, COL_AXIS)
+    )
 
 
 def make_row_mesh(devices: Optional[Sequence | int] = None) -> Mesh:

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""One-command multi-shape test driver (the ``legate.tester`` analog).
+
+The reference's ``test.py`` runs its suite across resource shapes
+(CPU/GPU counts) in one invocation (reference ``test.py:24-32``); here
+the resource axis is the virtual device-mesh shape: the full suite runs
+once per requested device count, plus optional slow and real-chip
+lanes.  Each lane is a fresh subprocess (jax's device count is frozen
+at backend init, so shapes cannot share a process).
+
+Usage:
+    python test.py                  # 8-device + 1-device lanes
+    python test.py --devices 8 4 1  # explicit shapes
+    python test.py --slow           # also the -m slow lane (8 devices)
+    python test.py --tpu            # also the real-chip -m tpu lane
+    python test.py -- -k spmv       # extra args forwarded to pytest
+
+Exit code: non-zero if any lane fails.  This box has one CPU core, so
+lanes run strictly sequentially (concurrent pytest multiplies wall
+time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def run_lane(name: str, env_extra: dict, args: list[str]) -> bool:
+    env = dict(os.environ)
+    env.update(env_extra)
+    t0 = time.time()
+    print(f"=== lane: {name} ===", flush=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", *args],
+        cwd=ROOT, env=env,
+    )
+    dt = time.time() - t0
+    status = "ok" if r.returncode == 0 else f"FAILED (rc={r.returncode})"
+    print(f"=== lane {name}: {status} in {dt:.0f}s ===", flush=True)
+    return r.returncode == 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, nargs="+", default=[8, 1],
+                    help="virtual device counts to run the suite at")
+    ap.add_argument("--slow", action="store_true",
+                    help="also run the -m slow lane (heavy shapes)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="also run the real-chip -m tpu lane")
+    ap.add_argument("rest", nargs="*",
+                    help="extra pytest args (after --)")
+    args = ap.parse_args()
+
+    ok = True
+    for n in args.devices:
+        ok &= run_lane(
+            f"{n}-device",
+            {"LEGATE_SPARSE_TPU_TEST_DEVICES": str(n)},
+            args.rest,
+        )
+    if args.slow:
+        ok &= run_lane(
+            "slow (8-device)",
+            {"LEGATE_SPARSE_TPU_TEST_DEVICES": "8"},
+            ["-m", "slow", *args.rest],
+        )
+    if args.tpu:
+        ok &= run_lane(
+            "tpu (real chip)",
+            {"LEGATE_SPARSE_TPU_TEST_PLATFORM": "tpu"},
+            ["-m", "tpu", *args.rest],
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

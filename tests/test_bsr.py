@@ -139,6 +139,33 @@ def test_csr_dispatch_prefers_dia_over_bsr(monkeypatch):
     np.testing.assert_allclose(y, As @ x, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("k", [1, 5, 16])
+def test_bsr_spmm_matches_scipy(k):
+    A = _random_csr(256, 200, 0.04, seed=21)
+    pack = bsr_pack(A.data, A.indices, A.indptr, A.shape, max_expand=1e9)
+    st = BsrStructure(*pack, 256, 200)
+    X = np.random.default_rng(22).standard_normal((200, k)).astype(
+        np.float32
+    )
+    Y = np.asarray(st.matmat(X, interpret=True))
+    np.testing.assert_allclose(Y, A @ X, rtol=1e-4, atol=1e-4)
+
+
+def test_csr_dispatch_bsr_spmm(monkeypatch):
+    import legate_sparse_tpu as lst
+    from legate_sparse_tpu.settings import settings
+
+    monkeypatch.setattr(settings, "bsr_force", True)
+    A = _random_csr(256, 256, 0.05, seed=23)
+    M = lst.csr_array(A)
+    assert M._get_bsr() is not None
+    X = np.random.default_rng(24).standard_normal((256, 6)).astype(
+        np.float32
+    )
+    Y = np.asarray(M @ X)
+    np.testing.assert_allclose(Y, A @ X, rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.tpu
 def test_bsr_on_chip():
     """Real-chip Mosaic lowering + correctness of the merged kernel."""

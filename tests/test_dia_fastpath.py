@@ -166,6 +166,7 @@ def test_dist_dia_masked_holey_band():
     np.testing.assert_array_equal(np.isinf(yi), np.isinf(ref))
 
 
+@pytest.mark.slow
 def test_dist_dia_only_matrix():
     """materialize_ell=False: solver-path consumers work off the DIA
     blocks alone; block consumers raise with guidance."""

@@ -23,10 +23,11 @@ needs_multi = pytest.mark.skipif(
 
 @needs_multi
 @pytest.mark.parametrize("n,offsets", [
-    (64, [0]),
+    pytest.param(64, [0], marks=pytest.mark.slow),
     (64, [-1, 0, 1]),
     (61, [-7, -1, 0, 1, 7]),       # non-divisible rows
-    (40, [-33, 0, 33]),            # reach > rps -> all_gather layout
+    pytest.param(40, [-33, 0, 33],  # reach > rps -> all_gather layout
+                 marks=pytest.mark.slow),
 ])
 def test_dist_diags_scalar_bands(n, offsets):
     bands = [float(i + 2) for i in range(len(offsets))]
@@ -82,6 +83,7 @@ def test_dist_poisson2d_matches_host_and_solves():
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_diags_spmv_matches_sharded_host_build():
     """dist_diags output behaves identically to shard_csr of the same
     matrix under dist_spmv (same layout invariants)."""

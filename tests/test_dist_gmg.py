@@ -68,6 +68,7 @@ def test_dist_gmg_cg_converges(gridop):
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_gmg_iteration_parity_with_single_device():
     """Distributed GMG+CG matches the single-device example's count."""
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))

@@ -89,6 +89,7 @@ def test_precise_images_through_spgemm_and_diagonal():
     )
 
 
+@pytest.mark.slow
 def test_chunked_spgemm_matches_single_shot(monkeypatch):
     rng = np.random.RandomState(11)
     A_sp = sp.random(60, 48, density=0.15, random_state=rng,
