@@ -17,12 +17,34 @@ import os
 # @pytest.mark.tpu smoke tests can run on a real chip.
 TEST_DEVICES = int(os.environ.get("LEGATE_SPARSE_TPU_TEST_DEVICES", "8"))
 
+# Persistent XLA compile cache: jit-compile time dominates suite wall
+# time on this 1-core box, and the compiled kernels are identical
+# across runs.  Must precede the first jaxlib load so the AOT-loader's
+# machine-feature log spam is suppressed (the recorded prefer-no-* XLA
+# tuning pseudo-features differ textually from the host report; same
+# machine).  LEGATE_SPARSE_TPU_TEST_CACHE=0 disables.
+_USE_CACHE = os.environ.get("LEGATE_SPARSE_TPU_TEST_CACHE", "1") != "0"
+_TEST_PLATFORM = os.environ.get("LEGATE_SPARSE_TPU_TEST_PLATFORM", "cpu")
+if _USE_CACHE and _TEST_PLATFORM == "cpu":
+    # CPU lane only: the real-chip lane must keep ERROR-level XLA/TPU
+    # runtime diagnostics visible (the tunnel's crash modes are only
+    # explained there).
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 if os.environ.get("LEGATE_SPARSE_TPU_TEST_PLATFORM", "cpu") == "cpu":
     from legate_sparse_tpu._platform import pin_cpu
 
     pin_cpu(TEST_DEVICES, override_env=False)
 
 import jax  # noqa: E402
+
+if _USE_CACHE:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

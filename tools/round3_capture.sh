@@ -1,0 +1,42 @@
+#!/bin/bash
+# One-shot round-3 on-chip capture: run the moment the tunnel answers.
+# Ordered most-important-first so a short tunnel window still records
+# the headline evidence (VERDICT r2 items 1, 3, 6, 7).
+#
+#   bash tools/round3_capture.sh
+#
+# Appends everything to TPU_EVIDENCE.md (via the python tools) and
+# captures bench/pde/sweep output under evidence/ for the record.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p evidence
+stamp=$(date -u +"%Y-%m-%dT%H:%M:%SZ")
+
+probe() {
+  timeout 90 python -c "from legate_sparse_tpu._platform import ACCEL_PROBE_CODE as c; exec(c)" >/dev/null 2>&1
+}
+
+if ! probe; then
+  echo "$stamp: TPU unreachable; aborting capture" | tee -a evidence/round3_capture.log
+  exit 1
+fi
+echo "$stamp: TPU alive; capturing" | tee -a evidence/round3_capture.log
+
+# 1. The full evidence sweep: bench.py (BENCH-contract metrics incl.
+#    spgemm/gmg/bsr), -m tpu lane, kernel shoot-out, CG 2048^2.
+timeout 5400 python tools/tpu_capture.py 2>&1 | tail -3 | tee -a evidence/round3_capture.log
+
+# 2. Irregular-path shoot-out (XLA ELL vs BSR across densities).
+timeout 3600 python tools/tune_irregular.py 2>&1 | tail -2 | tee -a evidence/round3_capture.log
+
+# 3. BASELINE config 3: pde.py at 4096^2 on the single chip.
+timeout 3600 python examples/pde.py -n 4096 -m 4096 -i 300 \
+  > evidence/pde_4096.txt 2>&1
+tail -3 evidence/pde_4096.txt | tee -a evidence/round3_capture.log
+
+# 4. BASELINE config 2 shape: SpMV sweep to 1e7+ rows.
+timeout 3600 python examples/spmv_microbenchmark.py \
+  --nmin 1m --nmax 16m -i 25 > evidence/spmv_sweep.txt 2>&1
+tail -6 evidence/spmv_sweep.txt | tee -a evidence/round3_capture.log
+
+echo "done: see TPU_EVIDENCE.md + evidence/" | tee -a evidence/round3_capture.log
