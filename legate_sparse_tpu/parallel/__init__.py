@@ -24,6 +24,8 @@ from .dist_csr import (  # noqa: F401
     dist_spmv,
     dist_spmm,
     dist_cg,
+    dist_gmres,
+    dist_bicgstab,
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401

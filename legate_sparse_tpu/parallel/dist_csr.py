@@ -900,6 +900,88 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     return fn(*args)
 
 
+def _padded_operator(A: DistCSR):
+    """The distributed matrix as a LinearOperator over padded sharded
+    vectors — lets every single-chip solver run distributed unchanged
+    (the reference gets this transparency from Legion arrays; here the
+    matvec is the shard_map'd ``dist_spmv`` and all reductions inside
+    the jitted solver loops lower to ``psum`` over the mesh)."""
+    from ..linalg import LinearOperator
+
+    n = A.rows_padded
+    return LinearOperator(shape=(n, n), matvec=A.matvec_fn(),
+                          dtype=A.dtype)
+
+
+def _padded_precond(M, A: DistCSR):
+    if M is None or not callable(M):
+        return M
+    from ..linalg import LinearOperator
+
+    n = A.rows_padded
+    return LinearOperator(shape=(n, n), matvec=M, dtype=A.dtype)
+
+
+def _shard_system(A: DistCSR, b, x0, maxiter, callback):
+    """Shared solver preamble: shard b/x0 to the padded length, default
+    the iteration budget, and truncate callback iterates to the true
+    row count."""
+    rows = A.shape[0]
+    b_sh = shard_vector(b, A.mesh, A.rows_padded)
+    x0_sh = (shard_vector(jnp.asarray(x0, dtype=b_sh.dtype), A.mesh,
+                          A.rows_padded)
+             if x0 is not None else None)
+    if maxiter is None:
+        maxiter = rows * 10
+    cb = (None if callback is None
+          else (lambda xk: callback(xk[:rows])))
+    return rows, b_sh, x0_sh, maxiter, cb
+
+
+def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
+               maxiter=None, M=None, callback=None, atol: float = 0.0,
+               callback_type=None, rtol: float = 1e-5):
+    """Distributed restarted GMRES: the single-chip solver
+    (``linalg.gmres``) over the padded sharded system.  Padding rows
+    are zero rows with zero right-hand side, so the Krylov space keeps
+    them at exactly 0 and residual norms match the unpadded system.
+    ``M`` may be a jittable callable on padded sharded vectors.
+    Returns ``(x[:rows], iters)``.
+    """
+    from ..linalg import gmres as _gmres
+
+    rows, b_sh, x0_sh, maxiter, cb = _shard_system(
+        A, b, x0, maxiter, callback
+    )
+    if callback_type == "pr_norm":
+        cb = callback   # scalar iterates: nothing to truncate
+    x, info = _gmres(
+        _padded_operator(A), b_sh, x0=x0_sh, tol=tol, restart=restart,
+        maxiter=maxiter, M=_padded_precond(M, A), callback=cb,
+        atol=atol, callback_type=callback_type, rtol=rtol,
+    )
+    return x[:rows], info
+
+
+def dist_bicgstab(A: DistCSR, b, x0=None, tol=None, maxiter=None,
+                  M=None, callback=None, atol: float = 0.0,
+                  rtol: float = 1e-5, conv_test_iters: int = 25):
+    """Distributed BiCGSTAB over the padded sharded system (see
+    ``dist_gmres`` for the padding argument).  Returns
+    ``(x[:rows], iters)``."""
+    from ..linalg import bicgstab as _bicgstab
+
+    rows, b_sh, x0_sh, maxiter, cb = _shard_system(
+        A, b, x0, maxiter, callback
+    )
+    x, info = _bicgstab(
+        _padded_operator(A), b_sh, x0=x0_sh, tol=tol, maxiter=maxiter,
+        M=_padded_precond(M, A), callback=cb, atol=atol, rtol=rtol,
+        conv_test_iters=conv_test_iters,
+    )
+    return x[:rows], info
+
+
 def dist_diagonal(A: DistCSR) -> jax.Array:
     """diag(A) as a row-block sharded padded vector (square A).
 

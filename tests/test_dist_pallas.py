@@ -97,6 +97,24 @@ def test_dist_prepack_on_builders(mesh, monkeypatch):
         assert C.pdia_tile > 0, "banded dist_spgemm product lost prepack"
 
 
+@pytest.mark.tpu
+def test_dist_prepack_kernel_on_chip(monkeypatch):
+    """The pre-blocked Mosaic dist kernel lowers and runs on a real
+    chip inside shard_map (1-device mesh; ring halo wraps to self and
+    must stay masked)."""
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU")
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_DIST", "1")
+    A = _poisson(32)
+    n = A.shape[0]
+    dA = shard_csr(A, mesh=make_row_mesh(jax.devices()[:1]))
+    assert dA.pdia_tile > 0
+    x = np.linspace(-1.0, 1.0, n).astype(np.float32)
+    xs = shard_vector(x, dA.mesh, dA.rows_padded)
+    y = np.asarray(dist_spmv(dA, xs))[:n]
+    np.testing.assert_allclose(y, A.toscipy() @ x, rtol=1e-4, atol=1e-4)
+
+
 def test_dist_dia_spmv_pallas_ieee_nonfinite(mesh, monkeypatch):
     # inf in a halo region another shard's rows never reference must
     # not leak NaN through the ring-wrapped exchange.

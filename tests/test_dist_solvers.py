@@ -1,0 +1,74 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Distributed GMRES / BiCGSTAB (8-device CPU mesh).
+
+The reference runs its solvers transparently on distributed arrays
+(Legion); here the single-chip solver loops run over padded sharded
+vectors with ``dist_spmv`` as the matvec — reductions lower to psum.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import (
+    dist_bicgstab, dist_gmres, make_row_mesh, shard_csr,
+)
+
+needs_multi = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs multiple devices"
+)
+
+
+def _nonsym(n):
+    """Banded, diagonally dominant, NON-symmetric (upwind convection)."""
+    return sparse.diags(
+        [-1.0, 4.0, -0.3, -1.0], [-1, 0, 1, 16],
+        shape=(n, n), format="csr", dtype=np.float64,
+    )
+
+
+def _ref(n):
+    return sp.diags([-1.0, 4.0, -0.3, -1.0], [-1, 0, 1, 16],
+                    shape=(n, n)).tocsr()
+
+
+@needs_multi
+def test_dist_gmres_converges():
+    n = 300  # deliberately not a multiple of the shard count
+    A = _nonsym(n)
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    rng = np.random.default_rng(0)
+    b = rng.random(n)
+    x, iters = dist_gmres(dA, b, rtol=1e-10, maxiter=600)
+    res = np.linalg.norm(_ref(n) @ np.asarray(x) - b)
+    assert res <= 1e-8 * np.linalg.norm(b)
+    assert x.shape == (n,)
+
+
+@needs_multi
+def test_dist_bicgstab_converges():
+    n = 300
+    A = _nonsym(n)
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    rng = np.random.default_rng(1)
+    b = rng.random(n)
+    x, iters = dist_bicgstab(dA, b, rtol=1e-10, maxiter=2000)
+    res = np.linalg.norm(_ref(n) @ np.asarray(x) - b)
+    assert res <= 1e-7 * np.linalg.norm(b)
+
+
+@needs_multi
+def test_dist_gmres_callback_sees_unpadded():
+    n = 300
+    dA = shard_csr(_nonsym(n), mesh=make_row_mesh())
+    b = np.ones(n)
+    seen = []
+    dist_gmres(dA, b, rtol=1e-8, maxiter=100,
+               callback=lambda xk: seen.append(np.asarray(xk).shape))
+    assert seen and all(s == (n,) for s in seen)
