@@ -33,6 +33,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from scipy.sparse import SparseEfficiencyWarning
+
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
 from .types import coord_dtype_for, nnz_ty
@@ -91,6 +93,17 @@ class csr_array(CompressedBase, DenseSparseBase):
             canonical = bool(arg.has_canonical_format)
             if dtype is not None:
                 data = data.astype(np.dtype(dtype))
+        elif (isinstance(arg, tuple) and len(arg) == 2
+              and all(isinstance(s, (int, np.integer)) for s in arg)):
+            # Empty matrix from a shape tuple (scipy ``csr_array((M, N))``).
+            shape = (int(arg[0]), int(arg[1]))
+            out_dtype = np.dtype(dtype) if dtype is not None else (
+                runtime.default_float
+            )
+            data = jnp.zeros((0,), dtype=out_dtype)
+            indices = jnp.zeros((0,), dtype=coord_dtype_for(max(shape)))
+            indptr = jnp.zeros((shape[0] + 1,), dtype=nnz_ty)
+            canonical = True
         elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
             # COO: (data, (row, col))
             data_in, (row, col) = arg
@@ -700,6 +713,145 @@ class csr_array(CompressedBase, DenseSparseBase):
 
     def copy(self):
         return csr_array(self, copy=True)
+
+    def trace(self, offset: int = 0):
+        """Sum along diagonal ``offset`` (scipy ``trace``)."""
+        return jnp.sum(self.diagonal(offset))
+
+    def count_nonzero(self, axis=None):
+        """Number of entries whose value is nonzero after duplicate
+        merging (scipy semantics: explicit/cancelled zeros are not
+        counted)."""
+        a = self._canonicalized()
+        nz = (a._data != 0)
+        if axis is None:
+            return int(jnp.sum(nz))
+        axis = int(axis) % 2
+        if axis == 0:
+            counts = jnp.zeros(
+                (a.shape[1],), jnp.int32
+            ).at[a._indices].add(nz.astype(jnp.int32))
+            return np.asarray(counts)
+        row_ids = _convert.row_ids_from_indptr(a._indptr, a.nnz)
+        return np.asarray(jax.ops.segment_sum(
+            nz.astype(jnp.int32), row_ids, num_segments=a.shape[0],
+            indices_are_sorted=True,
+        ))
+
+    def _minmax_binary(self, other, op):
+        """Element-wise maximum/minimum vs a scalar or sparse operand
+        over the union structure, implicit zeros included (scipy
+        ``maximum``/``minimum`` semantics)."""
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            # scipy materializes a dense result only for scalars that
+            # beat the implicit zeros; match its sparse-where-possible
+            # contract: op(v, s) at stored slots, op(0, s) elsewhere.
+            fill = op(0.0, float(other))
+            if fill != 0.0:
+                import warnings as _w
+
+                _w.warn(
+                    "Taking maximum/minimum with a scalar that is "
+                    "nonzero against the zero fill produces a dense "
+                    "result", SparseEfficiencyWarning, stacklevel=3,
+                )
+                dense = op(self.toarray(), other)
+                return csr_array(np.asarray(dense))
+            return self._with_data(op(self._data, other))
+        if _is_scipy_sparse(other):
+            other = csr_array(other)
+        if not isinstance(other, csr_array):
+            other = csr_array(jnp.asarray(other))
+        if other.shape != self.shape:
+            raise ValueError("inconsistent shapes")
+        a, b = cast_to_common_type(self._canonicalized(),
+                                   other._canonicalized())
+        rows, cols = a.shape
+        ra, ca, va = a.tocoo()
+        rb, cb, vb = b.tocoo()
+        # Union structure: where a key appears on one side only, the
+        # other side contributes its implicit zero.
+        row = jnp.concatenate([ra, rb])
+        col = jnp.concatenate([ca, cb])
+        key = row.astype(jnp.int64) * cols + col.astype(jnp.int64)
+        val = jnp.concatenate([va, vb])
+        order = jnp.argsort(key, stable=True)
+        key = key[order]
+        val = val[order]
+        nxt = jnp.concatenate([key[1:], jnp.full((1,), -1, key.dtype)])
+        prv = jnp.concatenate([jnp.full((1,), -1, key.dtype), key[:-1]])
+        paired = jnp.logical_or(key == nxt, key == prv)
+        pair_val = jnp.where(
+            key == nxt, op(val, jnp.roll(val, -1)), jnp.zeros_like(val)
+        )
+        single_val = op(val, jnp.zeros_like(val))
+        out_val = jnp.where(
+            paired,
+            jnp.where(key == nxt, pair_val, jnp.zeros_like(val)),
+            single_val,
+        )
+        out = csr_array(
+            (out_val, (row[order], col[order])), shape=self.shape
+        )
+        out.sum_duplicates()   # merges the zeroed pair slot
+        out.eliminate_zeros()
+        return out
+
+    def maximum(self, other):
+        return self._minmax_binary(other, jnp.maximum)
+
+    def minimum(self, other):
+        return self._minmax_binary(other, jnp.minimum)
+
+    def argmax(self, axis=None, out=None):
+        """Index of the maximum element, implicit zeros included (host
+        delegation — exact scipy tie-breaking; not a hot op)."""
+        return self.toscipy().argmax(axis=axis, out=out)
+
+    def argmin(self, axis=None, out=None):
+        return self.toscipy().argmin(axis=axis, out=out)
+
+    def reshape(self, *shape, order="C"):
+        """Reshape preserving entry count (host structural op).  Only
+        2-D targets: this package has no 1-D sparse type (scipy's
+        sparray returns 1-D for a single-int shape)."""
+        if len(shape) == 1:
+            if isinstance(shape[0], (int, np.integer)):
+                raise ValueError(
+                    "1-D reshape targets are not supported (no 1-D "
+                    "sparse type); pass a 2-D shape"
+                )
+            shape = tuple(shape[0])
+        if len(shape) != 2:
+            raise ValueError(f"expected a 2-D shape, got {shape}")
+        return csr_array(
+            self.toscipy().reshape(shape, order=order).tocsr()
+        )
+
+    def resize(self, *shape):
+        """In-place resize: entries outside the new shape are dropped
+        (scipy ``resize``)."""
+        if len(shape) == 1:
+            shape = tuple(shape[0])
+        nr, nc = (int(shape[0]), int(shape[1]))
+        r, c, v = self.tocoo()
+        keep = jnp.logical_and(r < nr, c < nc)
+        nnz_new = int(jnp.sum(keep))
+        r2, c2, v2 = _convert.compact_mask(keep, (r, c, v), nnz_new)
+        new = csr_array((v2, (r2, c2)), shape=(nr, nc))
+        self._data = new._data
+        self._indices = new._indices
+        self._indptr = new._indptr
+        self.shape = (nr, nc)
+        self._invalidate_caches(structure_changed=True)
+
+    def todok(self, copy: bool = False):
+        """Host conversion (no native DOK type — scipy's is returned)."""
+        return self.toscipy().todok(copy=copy)
+
+    def tolil(self, copy: bool = False):
+        """Host conversion (no native LIL type — scipy's is returned)."""
+        return self.toscipy().tolil(copy=copy)
 
     # ---------------- arithmetic ----------------
     def multiply(self, other):

@@ -362,3 +362,98 @@ def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
         (vals[order], (rows[order], cols[order])), shape=(m, n)
     )
     return A.asformat(format)
+
+
+def find(A):
+    """(row, col, values) of the nonzero entries (scipy ``find``):
+    duplicates summed, explicit zeros dropped, returned as numpy
+    arrays in row-major order."""
+    import jax.numpy as jnp
+
+    from .ops.convert import compact_mask
+
+    A = _as_csr(A)._canonicalized()
+    r, c, v = A.tocoo()
+    keep = v != 0
+    nnz = int(jnp.sum(keep))
+    r2, c2, v2 = compact_mask(keep, (r, c, v), nnz)
+    return np.asarray(r2), np.asarray(c2), np.asarray(v2)
+
+
+def bmat(blocks, format=None, dtype=None):
+    """Assemble a sparse matrix from a 2-D grid of sparse blocks
+    (scipy ``bmat``); ``None`` entries are zero blocks whose shape is
+    inferred from their row/column."""
+    from .csr import csr_array
+
+    rows_in = [list(r) for r in blocks]
+    if not rows_in or not rows_in[0]:
+        raise ValueError("blocks must be a non-empty 2-D grid")
+    R, C = len(rows_in), len(rows_in[0])
+    if any(len(r) != C for r in rows_in):
+        raise ValueError("blocks must have uniform row lengths")
+    heights = [None] * R
+    widths = [None] * C
+    mats = [[None] * C for _ in range(R)]
+    for i in range(R):
+        for j in range(C):
+            b = rows_in[i][j]
+            if b is None:
+                continue
+            m = _as_csr(b)
+            mats[i][j] = m
+            h, w = m.shape
+            if heights[i] is None:
+                heights[i] = h
+            elif heights[i] != h:
+                raise ValueError(
+                    f"blocks[{i},:] have incompatible row counts"
+                )
+            if widths[j] is None:
+                widths[j] = w
+            elif widths[j] != w:
+                raise ValueError(
+                    f"blocks[:,{j}] have incompatible column counts"
+                )
+    if any(h is None for h in heights) or any(w is None for w in widths):
+        raise ValueError(
+            "every block row and column needs at least one non-None block"
+        )
+    # Zero blocks take the common dtype of the real blocks so integer
+    # grids don't silently upcast to the default float (scipy infers
+    # dtype from the given blocks only).
+    common = np.result_type(
+        *[m.dtype for row in mats for m in row if m is not None]
+    )
+    out_rows = []
+    for i in range(R):
+        parts = [
+            mats[i][j] if mats[i][j] is not None
+            else csr_array((heights[i], widths[j]), dtype=common)
+            for j in range(C)
+        ]
+        out_rows.append(hstack(parts))
+    out = vstack(out_rows)
+    if dtype is not None:
+        out = out.astype(np.dtype(dtype))
+    return out.asformat(format)
+
+
+def block_array(blocks, *, format=None, dtype=None):
+    """scipy ``block_array``: ``bmat`` with keyword-only options."""
+    return bmat(blocks, format=format, dtype=dtype)
+
+
+def kronsum(A, B, format=None):
+    """Kronecker sum ``kron(A, I_m) + kron(I_n, B)`` for square A
+    (n x n) and B (m x m) (scipy ``kronsum``)."""
+    A = _as_csr(A)
+    B = _as_csr(B)
+    if A.shape[0] != A.shape[1]:
+        raise ValueError("A is not square")
+    if B.shape[0] != B.shape[1]:
+        raise ValueError("B is not square")
+    # scipy's operand order: kron(I_m, A) + kron(B, I_n).
+    L = kron(identity(B.shape[0], dtype=A.dtype), A)
+    R_ = kron(B, identity(A.shape[0], dtype=B.dtype))
+    return (L + R_).asformat(format)

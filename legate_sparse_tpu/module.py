@@ -7,8 +7,8 @@ from .csc import csc_array, csc_matrix  # noqa: F401
 from .coo import coo_array, coo_matrix  # noqa: F401
 from .dia import dia_array, dia_matrix  # noqa: F401
 from .gallery import (  # noqa: F401
-    block_diag, diags, eye, hstack, identity, kron, random, spdiags,
-    tril, triu, vstack,
+    block_array, block_diag, bmat, diags, eye, find, hstack, identity,
+    kron, kronsum, random, spdiags, tril, triu, vstack,
 )
 from .io import load_npz, mmread, mmwrite, save_npz  # noqa: F401
 from .types import coord_ty, nnz_ty  # noqa: F401

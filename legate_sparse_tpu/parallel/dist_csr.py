@@ -1097,17 +1097,13 @@ def dist_cg(
     """
     from ..linalg import _cg_loop, _get_atol_rtol
 
-    rows = A.shape[0]
-    b_sh = shard_vector(b, A.mesh, A.rows_padded)
-    x0_sh = (
-        shard_vector(jnp.asarray(x0, dtype=b_sh.dtype), A.mesh, A.rows_padded)
-        if x0 is not None
-        else jnp.zeros_like(b_sh)
+    rows, b_sh, x0_sh, maxiter, cb = _shard_system(
+        A, b, x0, maxiter, callback
     )
+    if x0_sh is None:
+        x0_sh = jnp.zeros_like(b_sh)
     bnrm2 = float(jnp.linalg.norm(b_sh))
     atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
-    if maxiter is None:
-        maxiter = rows * 10
     M_mv = M if M is not None else (lambda r: r)
     if callback is None:
         x, iters = _cg_loop(
@@ -1146,7 +1142,7 @@ def dist_cg(
         x = x + alpha * p
         r = r - alpha * q
         iters += 1
-        callback(x[:rows])
+        cb(x)
         if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
             jnp.linalg.norm(r)
         ) < atol:

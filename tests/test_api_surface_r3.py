@@ -1,0 +1,158 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Round-3 scipy-surface additions: find/bmat/block_array/kronsum,
+maximum/minimum/argmax/argmin/trace/count_nonzero/reshape/resize,
+shape-only constructor, todok/tolil host conversions.
+
+Differential model: scipy (a user switching from scipy.sparse must
+find these working)."""
+
+import warnings
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import legate_sparse_tpu as lst
+
+
+@pytest.fixture
+def pair():
+    A = lst.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(8, 8),
+                  format="csr")
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(8, 8)).tocsr()
+    return A, As
+
+
+def test_find(pair):
+    A, As = pair
+    r, c, v = lst.find(A)
+    rs, cs, vs = sp.find(As)
+    assert (np.sort(r * 8 + c) == np.sort(rs * 8 + cs)).all()
+    np.testing.assert_allclose(np.sort(v), np.sort(vs))
+
+
+def test_bmat_and_block_array(pair):
+    A, As = pair
+    np.testing.assert_allclose(
+        lst.bmat([[A, None], [None, A]]).toarray(),
+        sp.bmat([[As, None], [None, As]]).toarray(),
+    )
+    np.testing.assert_allclose(
+        lst.block_array([[A, A]]).toarray(),
+        sp.block_array([[As, As]]).toarray(),
+    )
+    with pytest.raises(ValueError):
+        lst.bmat([[None, None]])
+
+
+def test_kronsum(pair):
+    A, As = pair
+    np.testing.assert_allclose(
+        lst.kronsum(A, A).toarray(), sp.kronsum(As, As).toarray()
+    )
+
+
+def test_kronsum_asymmetric_operands():
+    """A != B catches the operand-order convention."""
+    A = np.array([[1.0, 2.0], [0.0, 3.0]])
+    B = np.array([[5.0, 0.0, 1.0], [0.0, 6.0, 0.0], [2.0, 0.0, 7.0]])
+    got = lst.kronsum(lst.csr_array(A), lst.csr_array(B)).toarray()
+    want = sp.kronsum(sp.csr_array(A), sp.csr_array(B)).toarray()
+    np.testing.assert_allclose(got, want)
+
+
+def test_bmat_integer_dtype_preserved():
+    Ai = sp.identity(3, dtype=np.int64, format="csr")
+    got = lst.bmat([[lst.csr_array(Ai), None], [None, lst.csr_array(Ai)]])
+    want = sp.bmat([[Ai, None], [None, Ai]])
+    assert got.dtype == want.dtype
+    np.testing.assert_allclose(got.toarray(), want.toarray())
+
+
+def test_count_nonzero_duplicates_cancel():
+    A = lst.csr_array(
+        (np.array([1.0, -1.0, 2.0]),
+         (np.array([0, 0, 1]), np.array([0, 0, 1]))),
+        shape=(2, 2),
+    )
+    assert A.count_nonzero() == 1
+
+
+def test_reshape_1d_rejected(pair):
+    A, _ = pair
+    with pytest.raises(ValueError):
+        A.reshape(64)
+
+
+def test_trace_count_nonzero(pair):
+    A, As = pair
+    assert float(A.trace()) == As.trace()
+    assert float(A.trace(1)) == As.trace(1)
+    assert A.count_nonzero() == As.count_nonzero()
+    for axis in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(A.count_nonzero(axis=axis)).ravel(),
+            np.asarray(As.count_nonzero(axis=axis)).ravel(),
+        )
+
+
+@pytest.mark.parametrize("op", ["maximum", "minimum"])
+def test_minmax_sparse_and_scalar(pair, op):
+    A, As = pair
+    other = sp.random(8, 8, density=0.3, format="csr", random_state=4)
+    got = getattr(A, op)(lst.csr_array(other))
+    want = getattr(As, op)(other)
+    np.testing.assert_allclose(got.toarray(), want.toarray())
+    np.testing.assert_allclose(
+        getattr(A, op)(0).toarray(), getattr(As, op)(0).toarray()
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s = -1.0 if op == "maximum" else 1.0
+        np.testing.assert_allclose(
+            getattr(A, op)(s).toarray(), getattr(As, op)(s).toarray()
+        )
+
+
+def test_argmax_argmin(pair):
+    A, As = pair
+    assert A.argmax() == As.argmax()
+    assert A.argmin() == As.argmin()
+    np.testing.assert_array_equal(
+        np.asarray(A.argmax(axis=1)).ravel(),
+        np.asarray(As.argmax(axis=1)).ravel(),
+    )
+
+
+def test_reshape_resize(pair):
+    A, As = pair
+    np.testing.assert_allclose(
+        A.reshape(4, 16).toarray(), As.toarray().reshape(4, 16)
+    )
+    B = lst.csr_array(A)
+    B.resize((5, 5))
+    Bs = As.copy()
+    Bs.resize((5, 5))
+    np.testing.assert_allclose(B.toarray(), Bs.toarray())
+    B2 = lst.csr_array(A)
+    B2.resize((12, 12))
+    Bs2 = As.copy()
+    Bs2.resize((12, 12))
+    np.testing.assert_allclose(B2.toarray(), Bs2.toarray())
+
+
+def test_dok_lil_host_conversions(pair):
+    A, As = pair
+    np.testing.assert_allclose(np.asarray(A.todok().toarray()),
+                               As.toarray())
+    np.testing.assert_allclose(np.asarray(A.tolil().toarray()),
+                               As.toarray())
+
+
+def test_shape_only_constructor():
+    Z = lst.csr_array((3, 4))
+    assert Z.shape == (3, 4) and Z.nnz == 0
+    np.testing.assert_allclose(Z.toarray(), np.zeros((3, 4)))
+    Zi = lst.csr_array((2, 2), dtype=np.float32)
+    assert Zi.dtype == np.float32
