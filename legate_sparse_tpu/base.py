@@ -18,7 +18,47 @@ import numpy as np
 import jax.numpy as jnp
 
 
-class CompressedBase:
+class CsrDelegateMixin:
+    """Operations every format supports by converting to CSR (where the
+    kernel implementations live); classes override any of these with a
+    native version.  Keeps the scipy surface uniform across
+    csr/csc/coo/dia without per-format reimplementation."""
+
+    def multiply(self, other):
+        return self.tocsr().multiply(other)
+
+    def power(self, n, dtype=None):
+        return self.tocsr().power(n, dtype=dtype)
+
+    def maximum(self, other):
+        return self.tocsr().maximum(other)
+
+    def minimum(self, other):
+        return self.tocsr().minimum(other)
+
+    def trace(self, offset: int = 0):
+        return self.tocsr().trace(offset)
+
+    def count_nonzero(self, axis=None):
+        return self.tocsr().count_nonzero(axis=axis)
+
+    def argmax(self, axis=None, out=None):
+        return self.tocsr().argmax(axis=axis, out=out)
+
+    def argmin(self, axis=None, out=None):
+        return self.tocsr().argmin(axis=axis, out=out)
+
+    def reshape(self, *shape, order="C"):
+        return self.tocsr().reshape(*shape, order=order)
+
+    def todok(self, copy: bool = False):
+        return self.tocsr().todok(copy=copy)
+
+    def tolil(self, copy: bool = False):
+        return self.tocsr().tolil(copy=copy)
+
+
+class CompressedBase(CsrDelegateMixin):
     """Base for csr/dia arrays: dtype casting, sums, zero-preserving ufuncs."""
 
     def asformat(self, format, copy: bool = False):

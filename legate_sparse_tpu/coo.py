@@ -18,7 +18,10 @@ import numpy as np
 import jax.numpy as jnp
 
 
-class coo_array:
+from .base import CsrDelegateMixin
+
+
+class coo_array(CsrDelegateMixin):
     """Coordinate-format sparse array (scipy ``coo_array`` surface)."""
 
     format = "coo"

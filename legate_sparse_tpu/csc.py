@@ -29,7 +29,10 @@ import numpy as np
 import jax.numpy as jnp
 
 
-class csc_array:
+from .base import CsrDelegateMixin
+
+
+class csc_array(CsrDelegateMixin):
     """Compressed Sparse Column array (scipy ``csc_array`` surface)."""
 
     format = "csc"

@@ -726,6 +726,8 @@ class csr_array(CompressedBase, DenseSparseBase):
         nz = (a._data != 0)
         if axis is None:
             return int(jnp.sum(nz))
+        if axis not in (0, 1, -1, -2):
+            raise ValueError(f"invalid axis {axis}")
         axis = int(axis) % 2
         if axis == 0:
             counts = jnp.zeros(
@@ -746,8 +748,11 @@ class csr_array(CompressedBase, DenseSparseBase):
             # scipy materializes a dense result only for scalars that
             # beat the implicit zeros; match its sparse-where-possible
             # contract: op(v, s) at stored slots, op(0, s) elsewhere.
-            fill = op(0.0, float(other))
-            if fill != 0.0:
+            # (Computed with the jnp op so complex scalars follow
+            # numpy's ordering rather than crashing on float().)
+            zero = jnp.zeros((), jnp.result_type(self.dtype, other))
+            fill = op(zero, other)
+            if bool(fill != 0):
                 import warnings as _w
 
                 _w.warn(
@@ -757,7 +762,8 @@ class csr_array(CompressedBase, DenseSparseBase):
                 )
                 dense = op(self.toarray(), other)
                 return csr_array(np.asarray(dense))
-            return self._with_data(op(self._data, other))
+            a = self._canonicalized()   # op distributes over values,
+            return a._with_data(op(a._data, other))  # not duplicates
         if _is_scipy_sparse(other):
             other = csr_array(other)
         if not isinstance(other, csr_array):
@@ -773,7 +779,14 @@ class csr_array(CompressedBase, DenseSparseBase):
         # other side contributes its implicit zero.
         row = jnp.concatenate([ra, rb])
         col = jnp.concatenate([ca, cb])
-        key = row.astype(jnp.int64) * cols + col.astype(jnp.int64)
+        key_dt = coord_dtype_for(rows * cols)
+        if np.dtype(key_dt).itemsize == 8 and not jax.config.jax_enable_x64:
+            raise OverflowError(
+                "maximum/minimum union keys need int64 but x64 is "
+                "disabled (LEGATE_SPARSE_TPU_X64=0); enable x64 for "
+                "shapes this large"
+            )
+        key = row.astype(key_dt) * cols + col.astype(key_dt)
         val = jnp.concatenate([va, vb])
         order = jnp.argsort(key, stable=True)
         key = key[order]

@@ -150,9 +150,37 @@ def test_dok_lil_host_conversions(pair):
                                As.toarray())
 
 
+@pytest.mark.parametrize("fmt", ["dia", "csc", "coo"])
+def test_csr_delegation_on_other_formats(fmt):
+    """csc/coo/dia carry the same method surface via CSR delegation."""
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(8, 8))
+    A = lst.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(8, 8),
+                  format="csr").asformat(fmt)
+    assert float(A.trace()) == As.diagonal().sum()
+    assert A.count_nonzero() == sp.csr_matrix(As).count_nonzero()
+    np.testing.assert_allclose(
+        np.asarray(A.maximum(0).toarray()),
+        np.maximum(As.toarray(), 0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.multiply(2.0).toarray()), As.toarray() * 2.0
+    )
+
+
 def test_shape_only_constructor():
     Z = lst.csr_array((3, 4))
     assert Z.shape == (3, 4) and Z.nnz == 0
     np.testing.assert_allclose(Z.toarray(), np.zeros((3, 4)))
     Zi = lst.csr_array((2, 2), dtype=np.float32)
     assert Zi.dtype == np.float32
+
+
+def test_minmax_scalar_duplicates_and_axis_validation():
+    A = lst.csr_array(
+        (np.array([1.0, -10.0]), (np.array([0, 0]), np.array([0, 0]))),
+        shape=(1, 1),
+    )
+    got = A.maximum(-5.0)
+    np.testing.assert_allclose(np.asarray(got.toarray()), [[-5.0]])
+    with pytest.raises(ValueError):
+        A.count_nonzero(axis=2)
