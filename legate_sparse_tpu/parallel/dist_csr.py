@@ -107,6 +107,14 @@ class DistCSR:
     pdia_data: Optional[jax.Array] = None
     pdia_mask: Optional[jax.Array] = None
     pdia_tile: int = 0
+    # Per-shard block-sparse pack for irregular (all_gather) matrices
+    # (``attach_bsr_prepack``): (R, nb_max, 128, 128) transposed
+    # blocks + (R, nb_max) block coordinates; ``bsr_grid`` = (nbr, nbc)
+    # of the per-shard block grid (None = no BSR route).
+    bsr_blocks: Optional[jax.Array] = None
+    bsr_brow: Optional[jax.Array] = None
+    bsr_bcol: Optional[jax.Array] = None
+    bsr_grid: Optional[Tuple[int, int]] = None
 
     @property
     def num_shards(self) -> int:
@@ -493,7 +501,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             ell_cols = np.clip(reb, 0, rps + 2 * halo - 1).astype(
                 indices.dtype
             )
-        return attach_dia_prepack(DistCSR(
+        dist = attach_dia_prepack(DistCSR(
             data=put(ell_data), cols=put(ell_cols), counts=put(ell_counts),
             row_ids=None, shape=(rows, cols), rows_per_shard=rps,
             halo=halo, ell=True, mesh=mesh,
@@ -505,6 +513,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             dia_mask=(put(dia_mask_blocks)
                       if dia_mask_blocks is not None else None),
         ))
+        return attach_bsr_prepack(
+            dist, host_ell=(ell_data, ell_cols, ell_counts)
+        )
 
     # Padded-CSR fallback: (R, nnz_max) + static row ids.
     local_nnz = hi - lo
@@ -772,6 +783,18 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
         return fn(*args)
 
     A._require_blocks("dist_spmv")
+    if (A.bsr_blocks is not None
+            and jnp.result_type(A.dtype, x.dtype) == A.dtype):
+        from ..ops.pallas_dia import pallas_dist_mode
+
+        mode = pallas_dist_mode()
+        if mode != "0":
+            nbr, nbc = A.bsr_grid
+            fn = _bsr_spmv_dist_fn(
+                A.mesh, A.rows_per_shard, nbr, nbc,
+                mode == "interpret",
+            )
+            return fn(A.bsr_blocks, A.bsr_brow, A.bsr_bcol, x)
     fn = _block_spmv_fn(A.mesh, halo, precise, A.ell, A.rows_per_shard)
     if A.ell:
         args = (A.data, A.cols, A.counts) + (
@@ -898,6 +921,114 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
             (A.gather_idx,) if precise else ()
         ) + (X,)
     return fn(*args)
+
+
+def attach_bsr_prepack(dist: DistCSR, host_ell=None) -> DistCSR:
+    """Per-shard block-sparse (BSR) pack for *irregular* distributed
+    matrices, in place — the distributed arm of ``ops/bsr.py``.
+
+    Applies to the all_gather realization (irregular matrices blow the
+    halo window, and cols are then global — exactly the BSR pack's
+    input).  Shards pack independently; block counts are padded to the
+    max with all-zero blocks (zero data contributes nothing wherever
+    its brow points).  Built only when the Pallas dist route is on and
+    every shard stays within the densification budget; disabled under
+    CHECK_BOUNDS like the single-chip BSR path (densified zeros
+    multiply x — see ``csr_array._get_bsr``).
+
+    ``host_ell`` is the (data, cols, counts) ELL pack as host numpy
+    when the caller still holds it (``shard_csr`` does) — passing it
+    avoids a device->host round trip of the whole pack.
+    """
+    from ..ops.bsr import MAX_BLOCKS, bsr_pack
+    from ..ops.bsr import B as _B
+    from ..ops.pallas_dia import pallas_dist_mode
+    from ..settings import settings
+
+    if (dist.bsr_blocks is not None
+            or dist.data is None or not dist.ell or dist.halo >= 0
+            or dist.gather_idx is not None
+            or pallas_dist_mode() == "0"
+            or settings.bsr_max_expand <= 0
+            or settings.check_bounds
+            or np.dtype(dist.dtype) not in (np.dtype(np.float32),)):
+        return dist
+    R = dist.num_shards
+    rps = dist.rows_per_shard
+    cols = dist.shape[1]
+    if host_ell is not None:
+        data_b, cols_b, counts_b = (np.asarray(a) for a in host_ell)
+    else:
+        data_b = np.asarray(dist.data)      # (R, rps, W)
+        cols_b = np.asarray(dist.cols)
+        counts_b = np.asarray(dist.counts)  # (R, rps)
+    packs = []
+    for s in range(R):
+        W = data_b.shape[2]
+        slot = np.arange(W)[None, :]
+        valid = slot < counts_b[s][:, None]
+        indptr = np.zeros(rps + 1, np.int64)
+        np.cumsum(counts_b[s], out=indptr[1:])
+        pack = bsr_pack(
+            data_b[s][valid], cols_b[s][valid].astype(np.int64),
+            indptr, (rps, cols), settings.bsr_max_expand,
+        )
+        if pack is None:
+            return dist
+        packs.append(pack)
+    nb_max = max(p[0].shape[0] for p in packs)
+    if nb_max > MAX_BLOCKS:
+        return dist
+    nbr = packs[0][3]
+    nbc = packs[0][4]
+    blk = np.zeros((R, nb_max, _B, _B), np.float32)
+    brow = np.zeros((R, nb_max), np.int32)
+    bcol = np.zeros((R, nb_max), np.int32)
+    for s, (bT, br, bc, _, _) in enumerate(packs):
+        nb = bT.shape[0]
+        blk[s, :nb] = bT
+        brow[s, :nb] = br
+        bcol[s, :nb] = bc
+        # Padding blocks: zero data accumulated into the last block-row
+        # (harmless), sorted order preserved.
+        brow[s, nb:] = br[-1] if nb else 0
+    spec3 = NamedSharding(dist.mesh, P(ROW_AXIS, None, None, None))
+    spec2 = NamedSharding(dist.mesh, P(ROW_AXIS, None))
+    dist.bsr_blocks = jax.device_put(jnp.asarray(blk), spec3)
+    dist.bsr_brow = jax.device_put(jnp.asarray(brow), spec2)
+    dist.bsr_bcol = jax.device_put(jnp.asarray(bcol), spec2)
+    dist.bsr_grid = (int(nbr), int(nbc))
+    return dist
+
+
+@lru_cache(maxsize=128)
+def _bsr_spmv_dist_fn(mesh: Mesh, rps: int, nbr: int, nbc: int,
+                      interpret: bool):
+    """Cached shard_map callable: all_gather x, then the per-shard
+    Pallas BSR kernel over the pre-packed blocks."""
+    from jax import shard_map
+
+    from ..ops.bsr import B as _B
+    from ..ops.bsr import bsr_spmv_pallas
+
+    def kernel(blk, brow, bcol, x_local):
+        x_full = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+        pad = nbc * _B - x_full.shape[0]
+        if pad > 0:
+            x_full = jnp.concatenate(
+                [x_full, jnp.zeros((pad,), x_full.dtype)]
+            )
+        x2d = x_full[: nbc * _B].reshape(nbc, _B)
+        y2d = bsr_spmv_pallas(blk[0], brow[0], bcol[0], x2d, nbr, nbc,
+                              interpret=interpret)
+        return y2d.ravel()[:rps]
+
+    in_specs = (P(ROW_AXIS, None, None, None), P(ROW_AXIS, None),
+                P(ROW_AXIS, None), P(ROW_AXIS))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+        check_vma=False,
+    ))
 
 
 def _padded_operator(A: DistCSR):
