@@ -115,6 +115,7 @@ class DistCSR:
     bsr_brow: Optional[jax.Array] = None
     bsr_bcol: Optional[jax.Array] = None
     bsr_grid: Optional[Tuple[int, int]] = None
+    bsr_tried: bool = False
 
     @property
     def num_shards(self) -> int:
@@ -513,9 +514,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             dia_mask=(put(dia_mask_blocks)
                       if dia_mask_blocks is not None else None),
         ))
-        return attach_bsr_prepack(
-            dist, host_ell=(ell_data, ell_cols, ell_counts)
-        )
+        return dist
 
     # Padded-CSR fallback: (R, nnz_max) + static row ids.
     local_nnz = hi - lo
@@ -783,6 +782,10 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
         return fn(*args)
 
     A._require_blocks("dist_spmv")
+    if not A.bsr_tried and A.bsr_blocks is None:
+        # Lazy build on first SpMV (mirrors csr_array._get_bsr): other
+        # consumers (dist_spmm/dist_spgemm) never pay the densification.
+        attach_bsr_prepack(A)
     if (A.bsr_blocks is not None
             and jnp.result_type(A.dtype, x.dtype) == A.dtype):
         from ..ops.pallas_dia import pallas_dist_mode
@@ -945,7 +948,7 @@ def attach_bsr_prepack(dist: DistCSR, host_ell=None) -> DistCSR:
     from ..ops.pallas_dia import pallas_dist_mode
     from ..settings import settings
 
-    if (dist.bsr_blocks is not None
+    if (dist.bsr_blocks is not None or dist.bsr_tried
             or dist.data is None or not dist.ell or dist.halo >= 0
             or dist.gather_idx is not None
             or pallas_dist_mode() == "0"
@@ -953,6 +956,7 @@ def attach_bsr_prepack(dist: DistCSR, host_ell=None) -> DistCSR:
             or settings.check_bounds
             or np.dtype(dist.dtype) not in (np.dtype(np.float32),)):
         return dist
+    dist.bsr_tried = True
     R = dist.num_shards
     rps = dist.rows_per_shard
     cols = dist.shape[1]
