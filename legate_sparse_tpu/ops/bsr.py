@@ -75,6 +75,19 @@ def bsr_pack(data, indices, indptr, shape, max_expand: float):
     nnz = data.shape[0]
     if nnz == 0 or rows == 0 or cols == 0 or max_expand <= 0:
         return None
+
+    # Native single-pass pack when the C++ helper is built (no global
+    # sort — exploits CSR row order); numpy fallback below.
+    from ..utils_native import native_bsr_pack
+
+    native = native_bsr_pack(
+        indptr, indices, data, rows, cols, float(max_expand), MAX_BLOCKS
+    )
+    if native == "over_budget":
+        return None
+    if native is not None:
+        return native
+
     nbr = -(-rows // B)
     nbc = -(-cols // B)
     r = np.repeat(np.arange(rows, dtype=np.int64),

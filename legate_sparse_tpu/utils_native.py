@@ -5,7 +5,8 @@
 The analog of the reference's CFFI boundary (reference:
 ``legate_sparse/config.py:49-113`` dlopens ``liblegate_sparse.so``),
 reduced to the pieces that genuinely belong in native code on a TPU
-stack: host-side IO parsing.  The library is optional — every entry
+stack: host-side IO parsing and the structure-static CSR->BSR pack
+(``src/bsr_pack.cc``).  The library is optional — every entry
 point has a numpy fallback and callers degrade gracefully.
 """
 
@@ -73,7 +74,9 @@ def _load() -> Optional[ctypes.CDLL]:
                 _bind(lib)
                 _LIB = lib
                 break
-            except OSError:
+            except (OSError, AttributeError):
+                # Unloadable, or a stale build missing newer symbols:
+                # degrade to the numpy fallbacks.
                 continue
     return _LIB
 
@@ -91,6 +94,26 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.lst_free.restype = None
     lib.lst_free.argtypes = [ctypes.c_void_p]
+    lib.lst_bsr_count.restype = ctypes.c_int
+    lib.lst_bsr_count.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,                   # rows, cols
+        ctypes.POINTER(ctypes.c_int64),                   # indptr
+        ctypes.POINTER(ctypes.c_int64),                   # indices
+        ctypes.c_double, ctypes.c_int64,                  # budget, cap
+        ctypes.POINTER(ctypes.c_int64),                   # out nb
+        ctypes.POINTER(ctypes.c_int64),                   # out nbr
+        ctypes.POINTER(ctypes.c_int64),                   # out nbc
+    ]
+    lib.lst_bsr_fill.restype = ctypes.c_int
+    lib.lst_bsr_fill.argtypes = [
+        ctypes.c_int64, ctypes.c_int64,                   # rows, cols
+        ctypes.POINTER(ctypes.c_int64),                   # indptr
+        ctypes.POINTER(ctypes.c_int64),                   # indices
+        ctypes.POINTER(ctypes.c_float),                   # data
+        ctypes.POINTER(ctypes.c_float),                   # blocks (out)
+        ctypes.POINTER(ctypes.c_int32),                   # brow (out)
+        ctypes.POINTER(ctypes.c_int32),                   # bcol (out)
+    ]
     lib.lst_coo_to_csr.restype = ctypes.c_int
     lib.lst_coo_to_csr.argtypes = [
         ctypes.c_int64,                      # nnz
@@ -168,3 +191,52 @@ def native_coo_to_csr(
     if rc != 0:
         return None
     return out_vals, out_cols, indptr
+
+
+def native_bsr_pack(
+    indptr: np.ndarray, indices: np.ndarray, data: np.ndarray,
+    rows: int, cols: int, max_expand: float, max_blocks: int,
+):
+    """Fast C++ CSR -> transposed-BSR densification (``ops/bsr.py``'s
+    host pack); exploits CSR row order so no global sort runs.
+
+    Returns ``(blkT, brow, bcol, nbr, nbc)``, ``"over_budget"`` when the
+    densification exceeds the budget (callers must NOT fall back to
+    numpy — same answer, slower), or None when the library is
+    unavailable / input unsupported (callers use the numpy pack).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int64)
+    nb = ctypes.c_int64()
+    nbr = ctypes.c_int64()
+    nbc = ctypes.c_int64()
+    as_p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+    rc = lib.lst_bsr_count(
+        ctypes.c_int64(rows), ctypes.c_int64(cols),
+        as_p(indptr, ctypes.c_int64), as_p(indices, ctypes.c_int64),
+        ctypes.c_double(max_expand), ctypes.c_int64(max_blocks),
+        ctypes.byref(nb), ctypes.byref(nbr), ctypes.byref(nbc),
+    )
+    if rc == 1:
+        return "over_budget"
+    if rc != 0:
+        return None
+    # Python owns the output buffers: no result copy.  (Data is
+    # converted only now — the reject path above never reads it.)
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    n_blocks = nb.value
+    blkT = np.zeros((n_blocks, 128, 128), dtype=np.float32)
+    brow = np.zeros((n_blocks,), dtype=np.int32)
+    bcol = np.zeros((n_blocks,), dtype=np.int32)
+    rc = lib.lst_bsr_fill(
+        ctypes.c_int64(rows), ctypes.c_int64(cols),
+        as_p(indptr, ctypes.c_int64), as_p(indices, ctypes.c_int64),
+        as_p(data, ctypes.c_float), as_p(blkT, ctypes.c_float),
+        as_p(brow, ctypes.c_int32), as_p(bcol, ctypes.c_int32),
+    )
+    if rc != 0:
+        return None
+    return blkT, brow, bcol, int(nbr.value), int(nbc.value)

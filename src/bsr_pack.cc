@@ -1,0 +1,119 @@
+// Copyright 2026.
+// SPDX-License-Identifier: Apache-2.0
+//
+// Native CSR -> transposed-BSR densification: the host side of the
+// block-sparse irregular SpMV path (legate_sparse_tpu/ops/bsr.py).
+// Exposed over the same plain C ABI as mtx_reader.cc and consumed via
+// ctypes (legate_sparse_tpu/utils_native.py); numpy fallbacks exist
+// for every entry point.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// CSR -> transposed-BSR densification (the host side of the block-sparse
+// irregular SpMV path, ops/bsr.py).  Exploits CSR ordering: block-rows
+// arrive sorted, so present blocks are discovered with one bitmap pass
+// per block-row and no global sort.  Emits blocks in (brow, bcol) order
+// with blkT[b][c][r] transposed storage and one zero block for every
+// empty block-row (the kernel's "output fully written" invariant).
+// Returns 0 = ok, 1 = over budget / too many blocks (caller falls
+// back), 2 = bad input.
+
+extern "C" int lst_bsr_count(int64_t rows, int64_t cols,
+                             const int64_t* indptr, const int64_t* indices,
+                             double max_expand, int64_t max_blocks,
+                             int64_t* out_nb, int64_t* out_nbr,
+                             int64_t* out_nbc) {
+  if (rows <= 0 || cols <= 0) return 2;
+  const int64_t B = 128;
+  const int64_t nbr = (rows + B - 1) / B;
+  const int64_t nbc = (cols + B - 1) / B;
+  const int64_t nnz = indptr[rows];
+  if (nnz <= 0) return 2;
+
+  // Count present blocks (bitmap per block-row); O(nnz), no sort.
+  std::vector<uint8_t> seen(static_cast<size_t>(nbc), 0);
+  std::vector<int64_t> touched;  // bcols hit in the current block-row
+  int64_t nb = 0;
+  for (int64_t br = 0; br < nbr; ++br) {
+    const int64_t r0 = br * B;
+    const int64_t r1 = std::min(r0 + B, rows);
+    int64_t found = 0;
+    for (int64_t i = indptr[r0]; i < indptr[r1]; ++i) {
+      const int64_t ci = indices[i];
+      if (ci < 0 || ci >= cols) return 2;
+      const int64_t bc = ci / B;
+      if (!seen[static_cast<size_t>(bc)]) {
+        seen[static_cast<size_t>(bc)] = 1;
+        touched.push_back(bc);
+        ++found;
+      }
+    }
+    for (int64_t bc : touched) seen[static_cast<size_t>(bc)] = 0;
+    touched.clear();
+    nb += (found == 0) ? 1 : found;  // empty block-row -> one zero block
+  }
+  if (nb > max_blocks) return 1;
+  const double dens = static_cast<double>(nb) * B * B;
+  if (dens > max_expand * static_cast<double>(nnz)) return 1;
+  *out_nb = nb;
+  *out_nbr = nbr;
+  *out_nbc = nbc;
+  return 0;
+}
+
+// Fill caller-allocated (zeroed) buffers: blocks nb*B*B f32,
+// brow/bcol nb i32.  Caller sizes them from lst_bsr_count.
+extern "C" int lst_bsr_fill(int64_t rows, int64_t cols,
+                            const int64_t* indptr, const int64_t* indices,
+                            const float* data, float* blocks,
+                            int32_t* brow, int32_t* bcol) {
+  if (rows <= 0 || cols <= 0) return 2;
+  const int64_t B = 128;
+  const int64_t nbr = (rows + B - 1) / B;
+  const int64_t nbc = (cols + B - 1) / B;
+  std::vector<int64_t> touched;
+
+  // Per block-row, map bcol -> block id, then scatter values into
+  // transposed slots blkT[b][c % B][r % B] (duplicates add).
+  std::vector<int64_t> slot_of(static_cast<size_t>(nbc), -1);
+  int64_t next_b = 0;
+  for (int64_t br = 0; br < nbr; ++br) {
+    const int64_t r0 = br * B;
+    const int64_t r1 = std::min(r0 + B, rows);
+    for (int64_t i = indptr[r0]; i < indptr[r1]; ++i) {
+      const int64_t bc = indices[i] / B;
+      if (slot_of[static_cast<size_t>(bc)] < 0) {
+        slot_of[static_cast<size_t>(bc)] = 1;  // mark; ids after sort
+        touched.push_back(bc);
+      }
+    }
+    if (touched.empty()) {
+      brow[next_b] = static_cast<int32_t>(br);
+      bcol[next_b] = 0;  // zero block keeps the row written
+      ++next_b;
+      continue;
+    }
+    std::sort(touched.begin(), touched.end());
+    for (int64_t bc : touched) {
+      slot_of[static_cast<size_t>(bc)] = next_b;
+      brow[next_b] = static_cast<int32_t>(br);
+      bcol[next_b] = static_cast<int32_t>(bc);
+      ++next_b;
+    }
+    for (int64_t r = r0; r < r1; ++r) {
+      for (int64_t i = indptr[r]; i < indptr[r + 1]; ++i) {
+        const int64_t c = indices[i];
+        const int64_t b = slot_of[static_cast<size_t>(c / B)];
+        blocks[(static_cast<size_t>(b) * B + (c % B)) * B + (r % B)] +=
+            data[i];
+      }
+    }
+    for (int64_t bc : touched) slot_of[static_cast<size_t>(bc)] = -1;
+    touched.clear();
+  }
+  return 0;
+}

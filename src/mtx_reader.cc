@@ -11,6 +11,7 @@
 // argsort, csr.py:183-219).  Errors return nonzero codes — callers fall
 // back to the numpy implementations.
 
+#include <algorithm>
 #include <cctype>
 #include <cinttypes>
 #include <cstdint>
@@ -217,3 +218,4 @@ int lst_coo_to_csr(int64_t nnz, int64_t rows_n, const int64_t* row,
 }
 
 }  // extern "C"
+

@@ -166,6 +166,39 @@ def test_csr_dispatch_bsr_spmm(monkeypatch):
     np.testing.assert_allclose(Y, A @ X, rtol=1e-4, atol=1e-4)
 
 
+def test_native_pack_matches_numpy():
+    """When the C++ helper is built, its single-pass pack must be
+    bit-identical to the numpy pack (budget decisions included)."""
+    from legate_sparse_tpu import utils_native as un
+    from legate_sparse_tpu.ops.bsr import MAX_BLOCKS
+
+    if not un.native_available():
+        pytest.skip("native helper not built")
+    A = _random_csr(700, 500, 0.03, seed=31)
+    nat = un.native_bsr_pack(A.indptr, A.indices, A.data, 700, 500,
+                             1e9, MAX_BLOCKS)
+    real_load = un._load
+    un._load = lambda: None   # force the numpy path
+    try:
+        ref = bsr_pack(A.data, A.indices, A.indptr, A.shape,
+                       max_expand=1e9)
+    finally:
+        un._load = real_load
+    np.testing.assert_array_equal(nat[0], ref[0])
+    np.testing.assert_array_equal(nat[1], ref[1])
+    np.testing.assert_array_equal(nat[2], ref[2])
+    assert nat[3:] == ref[3:]
+    # Budget decisions agree too.
+    assert un.native_bsr_pack(A.indptr, A.indices, A.data, 700, 500,
+                              1.0, MAX_BLOCKS) == "over_budget"
+    un._load = lambda: None
+    try:
+        assert bsr_pack(A.data, A.indices, A.indptr, A.shape,
+                        max_expand=1.0) is None
+    finally:
+        un._load = real_load
+
+
 @pytest.mark.tpu
 def test_bsr_on_chip():
     """Real-chip Mosaic lowering + correctness of the merged kernel."""
