@@ -38,6 +38,68 @@ def wrap(func, name: str | None = None):
     return wrapper
 
 
+def _to_scipy(x):
+    """Convert this package's sparse arrays (and containers of them) to
+    scipy objects a raw scipy function understands; everything else
+    passes through."""
+    if hasattr(x, "toscipy"):
+        return x.toscipy()
+    if hasattr(x, "tocsr") and hasattr(x, "nnz"):
+        import scipy.sparse as _sp
+
+        if not _sp.issparse(x):
+            # Sparse-like without a direct scipy conversion: via CSR.
+            return x.tocsr().toscipy()
+        return x        # already scipy
+    if isinstance(x, (list, tuple)):
+        converted = [_to_scipy(v) for v in x]
+        return type(x)(converted) if isinstance(x, tuple) else converted
+    return x
+
+
+def _from_scipy(x):
+    """Convert scipy sparse results back into this package's arrays
+    (format-preserving for the formats we implement natively)."""
+    import scipy.sparse as _sp
+
+    if _sp.issparse(x):
+        from . import coo, csc, csr, dia
+
+        by_fmt = {
+            "csr": csr.csr_array, "csc": csc.csc_array,
+            "coo": coo.coo_array, "dia": dia.dia_array,
+        }
+        ctor = by_fmt.get(getattr(x, "format", "csr"))
+        if ctor is None:
+            return csr.csr_array(x.tocsr())
+        if x.format == "dia":
+            return ctor((x.data, x.offsets), shape=x.shape)
+        return ctor(x)
+    if isinstance(x, tuple):
+        return tuple(_from_scipy(v) for v in x)
+    return x
+
+
+def scipy_fallback(func, name: str):
+    """Adapter for raw scipy fallbacks: this package's arrays convert
+    to scipy on the way in (scipy would otherwise coerce them to object
+    arrays and produce garbage) and sparse results convert back on the
+    way out.  A documented host-side escape hatch — device arrays round
+    trip through the host."""
+
+    scope = f"legate_sparse_tpu.{name}"
+
+    @functools.wraps(func)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        args = tuple(_to_scipy(a) for a in args)
+        kwargs = {k: _to_scipy(v) for k, v in kwargs.items()}
+        with jax.named_scope(scope):
+            return _from_scipy(func(*args, **kwargs))
+
+    wrapper._lst_scipy_fallback = True
+    return wrapper
+
+
 def clone_module(
     origin_module: pytypes.ModuleType,
     new_globals: Mapping[str, Any],
@@ -48,7 +110,8 @@ def clone_module(
     Mirrors reference ``coverage.py:59-85``: for every public symbol of
     the origin (scipy.sparse), if the caller's globals already define it,
     keep the native version (wrapped for provenance); otherwise install
-    the scipy fallback so the namespace is drop-in complete.
+    the scipy fallback — adapted so this package's arrays convert at
+    the boundary — so the namespace is drop-in complete.
     """
     mod_names = set(new_globals.keys())
     for attr in dir(origin_module):
@@ -61,7 +124,10 @@ def clone_module(
                 new_globals[attr] = wrap(native, attr)  # type: ignore[index]
             continue
         # scipy fallback (host-side; documented escape hatch).
-        new_globals[attr] = value  # type: ignore[index]
+        if callable(value) and not isinstance(value, type):
+            new_globals[attr] = scipy_fallback(value, attr)  # type: ignore[index]
+        else:
+            new_globals[attr] = value  # type: ignore[index]
 
 
 def clone_scipy_arr_kind(origin_class):

@@ -133,6 +133,17 @@ class dia_array(CompressedBase):
     def todia(self, copy: bool = False):
         return self.copy() if copy else self
 
+    def toscipy(self):
+        """Host scipy ``dia_array`` (format-preserving)."""
+        import numpy as _np
+
+        import scipy.sparse as _sp
+
+        return _sp.dia_array(
+            (_np.asarray(self.data), _np.asarray(self.offsets)),
+            shape=self.shape,
+        )
+
     def tocsr(self, copy: bool = False):
         """DIA -> CSR.
 

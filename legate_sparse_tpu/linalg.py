@@ -680,3 +680,26 @@ def norm(A, ord=None, axis=None):
         )
         return np.asarray(nz.sum(axis=axis))
     raise ValueError(f"Invalid norm order {ord!r} for vectors")
+
+
+def __getattr__(name):
+    """scipy.sparse.linalg fallback for names without a native
+    implementation (spsolve, splu, eigsh, lsqr, expm, ...): host-side
+    scipy with this package's arrays converted at the boundary.  The
+    reference offers no fallback here at all (its linalg is cg/gmres
+    only); a drop-in replacement must not strand the rest of a user's
+    solver code."""
+    import scipy.sparse.linalg as _ssl
+
+    from .coverage import scipy_fallback
+
+    try:
+        value = getattr(_ssl, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'legate_sparse_tpu.linalg' has no attribute {name!r}"
+        ) from None
+    if callable(value) and not isinstance(value, type):
+        value = scipy_fallback(value, f"linalg.{name}")
+    globals()[name] = value   # cache: stable identity, one wrapper
+    return value
