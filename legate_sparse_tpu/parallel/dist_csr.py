@@ -901,6 +901,40 @@ def _block_spmm_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
     ))
 
 
+@lru_cache(maxsize=128)
+def _dia_spmm_dist_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
+                      rps: int, tile: int, col_sharded: bool,
+                      interpret: bool):
+    """Cached shard_map callable: banded distributed SpMM through the
+    per-shard Mosaic band kernel over the pre-blocked layout (the SpMM
+    arm of ``_dia_spmv_pallas_fn``; row shifts of a 2-D X are sublane
+    rolls — cheaper than the SpMV lane decomposition)."""
+    from jax import shard_map
+
+    from ..ops.pallas_dia import L as _LANES
+    from ..ops.pallas_dia import pallas_dia_spmm
+
+    offs2 = tuple(int(o) + halo for o in offsets)
+    nd = len(offsets)
+    xcol = COL_AXIS if col_sharded else None
+
+    def kernel(pdata, pmask, X_local):
+        X_ext = _extend_x(X_local, halo)            # axis 0
+        return pallas_dia_spmm(
+            pdata[0].reshape(nd, -1, _LANES),
+            pmask[0].reshape(nd, -1, _LANES),
+            X_ext, offs2, (rps, X_ext.shape[0]), tile,
+            interpret=interpret,
+        )
+
+    in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                P(ROW_AXIS, xcol))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS, xcol), check_vma=False,
+    ))
+
+
 def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     """Y = A @ X for a dense (rows_padded, k) operand (jittable).
 
@@ -913,6 +947,24 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     A._require_blocks("dist_spmm")
     precise = A.gather_idx is not None
     col_sharded = COL_AXIS in A.mesh.shape
+    if (A.pdia_tile and A.halo >= 0 and not precise
+            and jnp.result_type(A.dtype, X.dtype) == A.dtype):
+        from ..ops.pallas_dia import _VMEM_BUDGET, pallas_dist_mode
+
+        mode = pallas_dist_mode()
+        k_loc = X.shape[1] // (int(A.mesh.shape[COL_AXIS])
+                               if col_sharded else 1)
+        nd = A.pdia_data.shape[1]
+        item = np.dtype(A.dtype).itemsize
+        # Per-grid-step VMEM: 3 X views + Y at (tile, k) plus the band.
+        vmem = A.pdia_tile * item * (3 + 1) * max(k_loc, 1) \
+            + nd * A.pdia_tile * (item + 1)
+        if mode != "0" and 0 < k_loc and vmem <= _VMEM_BUDGET:
+            fn = _dia_spmm_dist_fn(
+                A.mesh, A.dia_offsets, A.halo, A.rows_per_shard,
+                A.pdia_tile, col_sharded, mode == "interpret",
+            )
+            return fn(A.pdia_data, A.pdia_mask, X)
     fn = _block_spmm_fn(A.mesh, A.halo, precise, A.ell,
                         A.rows_per_shard, col_sharded)
     if A.ell:
