@@ -24,6 +24,10 @@ class CsrDelegateMixin:
     native version.  Keeps the scipy surface uniform across
     csr/csc/coo/dia without per-format reimplementation."""
 
+    # numpy must defer binary ops to the sparse operand (scipy sets the
+    # same priority), else ndarray.__mul__ coerces us to object arrays.
+    __array_priority__ = 10.1
+
     def multiply(self, other):
         return self.tocsr().multiply(other)
 

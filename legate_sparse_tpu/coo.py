@@ -175,20 +175,22 @@ class coo_array(CsrDelegateMixin):
         return self.dot(other)
 
     def __mul__(self, other):
-        if np.isscalar(other):
-            out = coo_array.__new__(coo_array)
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            out = type(self).__new__(type(self))
             out.shape = self.shape
             out.row, out.col = self.row, self.col
             out.data = self.data * other
             return out
-        raise NotImplementedError(
-            "elementwise coo multiply is not supported; use @ for matmul"
-        )
+        # sparray semantics: * is element-wise.
+        return self.multiply(other)
+
+    def multiply(self, other):
+        """Element-wise product in the operand's own format (scipy
+        semantics)."""
+        return self.tocsr().multiply(other).asformat("coo")
 
     def __rmul__(self, other):
-        if np.isscalar(other):
-            return self.__mul__(other)
-        raise NotImplementedError("dense @ coo is not supported")
+        return self.__mul__(other)   # element-wise * commutes
 
     def __neg__(self):
         return self * -1.0
@@ -202,4 +204,11 @@ class coo_array(CsrDelegateMixin):
 
 
 class coo_matrix(coo_array):
+    """spmatrix-flavored alias: ``*`` is matrix multiplication."""
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            return coo_array.__mul__(self, other)
+        return self.dot(other)
+
     pass

@@ -182,19 +182,21 @@ class csc_array(CsrDelegateMixin):
         return self.dot(other)
 
     def __mul__(self, other):
-        if np.isscalar(other):
-            out = csc_array.__new__(csc_array)
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            out = type(self).__new__(type(self))
             out._t = self._t * other
             out.shape = self.shape
             return out
-        raise NotImplementedError(
-            "elementwise csc multiply is not supported; use @ for matmul"
-        )
+        # sparray semantics: * is element-wise.
+        return self.multiply(other)
+
+    def multiply(self, other):
+        """Element-wise product, column-compressed result (scipy
+        returns the operand's own format)."""
+        return self.tocsr().multiply(other).tocsc()
 
     def __rmul__(self, other):
-        if np.isscalar(other):
-            return self.__mul__(other)
-        raise NotImplementedError("dense @ csc is not supported")
+        return self.__mul__(other)   # element-wise * commutes
 
     def __neg__(self):
         return self * -1.0
@@ -209,4 +211,11 @@ class csc_array(CsrDelegateMixin):
 
 # scipy.sparse.*_matrix alias.
 class csc_matrix(csc_array):
+    """spmatrix-flavored alias: ``*`` is matrix multiplication."""
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            return csc_array.__mul__(self, other)
+        return self.dot(other)
+
     pass

@@ -214,7 +214,7 @@ class csr_array(CompressedBase, DenseSparseBase):
     def _with_data(self, data, copy: bool = False):
         if copy:
             data = jnp.array(data)
-        out = csr_array._from_parts(
+        out = type(self)._from_parts(
             data, self._indices, self._indptr, self.shape,
             canonical=self._canonical,
         )
@@ -874,6 +874,9 @@ class csr_array(CompressedBase, DenseSparseBase):
             return self._with_data(self._data * other)
         if _is_scipy_sparse(other):
             other = csr_array(other)
+        elif not isinstance(other, csr_array) and hasattr(other, "tocsr") \
+                and not hasattr(other, "__array__"):
+            other = other.tocsr()   # csc/coo/dia operand
         if isinstance(other, csr_array):
             if other.shape != self.shape:
                 raise ValueError("inconsistent shapes for multiply")
@@ -892,9 +895,9 @@ class csr_array(CompressedBase, DenseSparseBase):
     def __mul__(self, other):
         if np.isscalar(other) or getattr(other, "ndim", None) == 0:
             return self._with_data(self._data * other)
-        raise NotImplementedError(
-            "csr * non-scalar: use .multiply() or @ for matmul"
-        )
+        # sparray semantics: ``*`` is element-wise (scipy's csr_array;
+        # the spmatrix subclass below overrides to matmul).
+        return self.multiply(other)
 
     def __rmul__(self, other):
         return self.__mul__(other)
@@ -1365,7 +1368,18 @@ class csr_array(CompressedBase, DenseSparseBase):
 
 # scipy.sparse.*_matrix alias (reference defines csr_matrix the same way).
 class csr_matrix(csr_array):
-    pass
+    """spmatrix-flavored alias: ``*`` means matrix multiplication
+    (scipy's csr_matrix), unlike the element-wise sparray ``*``."""
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            return self._with_data(self._data * other)
+        return self.dot(other)
+
+    def __rmul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            return self._with_data(self._data * other)
+        return NotImplemented
 
 
 def _elementwise_intersect_multiply(a: csr_array, b: csr_array) -> csr_array:

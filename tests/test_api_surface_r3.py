@@ -184,3 +184,53 @@ def test_minmax_scalar_duplicates_and_axis_validation():
     np.testing.assert_allclose(np.asarray(got.toarray()), [[-5.0]])
     with pytest.raises(ValueError):
         A.count_nonzero(axis=2)
+
+
+def test_mul_semantics_array_vs_matrix():
+    """sparray ``*`` is element-wise; spmatrix ``*`` is matmul."""
+    rng = np.random.default_rng(0)
+    As = sp.random(8, 8, density=0.4, format="csr", random_state=rng)
+    Bs = sp.random(8, 8, density=0.4, format="csr", random_state=rng)
+    A, B = lst.csr_array(As), lst.csr_array(Bs)
+    np.testing.assert_allclose(
+        np.asarray((A * B).toarray()),
+        (sp.csr_array(As) * sp.csr_array(Bs)).toarray(),
+    )
+    Am, Bm = lst.csr_matrix(As), lst.csr_matrix(Bs)
+    np.testing.assert_allclose(
+        np.asarray((Am * Bm).toarray()),
+        (sp.csr_matrix(As) * sp.csr_matrix(Bs)).toarray(), atol=1e-12,
+    )
+    # csc and coo follow the same split.
+    np.testing.assert_allclose(
+        np.asarray((A.tocsc() * B.tocsc()).toarray()),
+        (sp.csr_array(As) * sp.csr_array(Bs)).toarray(),
+    )
+    Cm = lst.csc_matrix(A.tocsc())
+    Dm = lst.csc_matrix(B.tocsc())
+    np.testing.assert_allclose(
+        np.asarray((Cm * Dm).toarray()), (As @ Bs).toarray(), atol=1e-12,
+    )
+    O = A.asformat("coo")
+    np.testing.assert_allclose(
+        np.asarray((O * B.asformat("coo")).toarray()),
+        (sp.csr_array(As) * sp.csr_array(Bs)).toarray(),
+    )
+
+
+def test_mul_class_preservation_and_rmul():
+    rng = np.random.default_rng(0)
+    As = sp.random(8, 8, density=0.4, format="csr", random_state=rng)
+    M = lst.csr_matrix(As)
+    assert type(M * 2).__name__ == "csr_matrix"   # stays matmul-flavored
+    np.testing.assert_allclose(
+        np.asarray((M * np.array(3.0)).toarray()), (As * 3).toarray()
+    )
+    C = lst.csr_array(As).tocsc()
+    np.testing.assert_allclose(                    # numpy defers to us
+        np.asarray((np.ones(8) * C).toarray()),
+        np.asarray((C * np.ones(8)).toarray()),
+    )
+    assert (C * C).format == "csc"                 # format-preserving
+    O = lst.csr_array(As).asformat("coo")
+    assert (O * O).format == "coo"

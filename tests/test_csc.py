@@ -134,10 +134,12 @@ def test_ctor_dtype_applies_to_csr_input(pair):
     assert C.dtype == np.float32
 
 
-def test_elementwise_mul_raises(pair):
-    A, _ = pair
-    with pytest.raises(NotImplementedError):
-        _ = A * np.ones(A.shape[1])
+def test_elementwise_mul_vector(pair):
+    A, A_sp = pair
+    got = A * np.ones(A.shape[1])
+    want = scsp.csc_array(A_sp) * np.ones(A.shape[1])
+    want = want.toarray() if hasattr(want, "toarray") else want
+    np.testing.assert_allclose(np.asarray(got.toarray()), want)
 
 
 def test_tocsr_cached_and_isolated(pair):
