@@ -128,6 +128,25 @@ def test_dist_spmm_row_mesh_matches_scipy():
     )
 
 
+def test_full_dist_stack_on_grid_mesh():
+    """SpGEMM, GMG hierarchy and preconditioned CG all run on a 2-D
+    grid mesh (sparse blocks replicated along the column axis)."""
+    devs = _mesh_or_skip(8)
+    from legate_sparse_tpu.parallel import DistGMG, dist_cg, dist_spgemm
+
+    mesh = make_grid_mesh(devs[:8])
+    n = 256
+    A = sparse.diags([-1.0, 4.0, -1.0], [-16, 0, 16], shape=(n, n),
+                     format="csr", dtype=np.float64)
+    As = sp.diags([-1.0, 4.0, -1.0], [-16, 0, 16], shape=(n, n)).tocsr()
+    dA = shard_csr(A, mesh=mesh)
+    C = dist_spgemm(dA, dA)
+    assert abs(C.to_csr().toscipy() - As @ As).max() < 1e-12
+    gmg = DistGMG(dA, levels=2)
+    x, _ = dist_cg(dA, np.ones(n), M=gmg.cycle, rtol=1e-8, maxiter=200)
+    assert np.linalg.norm(As @ np.asarray(x) - 1) < 1e-6
+
+
 def test_dist_spmm_all_gather_and_csr_fallback():
     """Non-banded matrix over budget for ELL: padded-CSR blocks +
     all_gather realization, on the grid mesh."""
