@@ -54,6 +54,12 @@ def _is_scipy_sparse(obj) -> bool:
     return _scipy_sparse is not None and _scipy_sparse.issparse(obj)
 
 
+def _is_sparse_like(obj) -> bool:
+    """Sparse-format object of this package or another library (has a
+    CSR conversion, is not dense-array-like)."""
+    return hasattr(obj, "tocsr") and not hasattr(obj, "__array__")
+
+
 class csr_array(CompressedBase, DenseSparseBase):
     """Compressed Sparse Row array backed by jax.Arrays.
 
@@ -874,8 +880,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             return self._with_data(self._data * other)
         if _is_scipy_sparse(other):
             other = csr_array(other)
-        elif not isinstance(other, csr_array) and hasattr(other, "tocsr") \
-                and not hasattr(other, "__array__"):
+        elif not isinstance(other, csr_array) and _is_sparse_like(other):
             other = other.tocsr()   # csc/coo/dia operand
         if isinstance(other, csr_array):
             if other.shape != self.shape:
@@ -905,7 +910,22 @@ class csr_array(CompressedBase, DenseSparseBase):
     def __truediv__(self, other):
         if np.isscalar(other) or getattr(other, "ndim", None) == 0:
             return self._with_data(self._data / other)
-        raise NotImplementedError("csr / non-scalar")
+        if _is_scipy_sparse(other) or _is_sparse_like(other):
+            if tuple(other.shape) != self.shape:
+                raise ValueError(
+                    f"inconsistent shapes {self.shape} and "
+                    f"{tuple(other.shape)}"
+                )
+            # scipy: sparse / sparse densifies (0/0 -> nan included).
+            other = other.toarray() if hasattr(other, "toarray") else other
+            return jnp.asarray(self.toarray()) / jnp.asarray(other)
+        # Dense divisor: division applies at stored entries only
+        # (implicit zeros stay zero — scipy returns sparse here too).
+        # Row/column-vector divisors broadcast like scipy.
+        recip = 1.0 / jnp.asarray(other)
+        if recip.ndim == 2 and recip.shape != self.shape:
+            recip = jnp.broadcast_to(recip, self.shape)
+        return self.multiply(recip)
 
     def __neg__(self):
         return self._with_data(-self._data)
@@ -949,8 +969,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         require_supported_dtype(self.dtype)
         if _is_scipy_sparse(other):
             other = csr_array(other)  # adopt scipy operand for SpGEMM
-        elif not isinstance(other, csr_array) and hasattr(other, "tocsr") \
-                and not hasattr(other, "__array__"):
+        elif not isinstance(other, csr_array) and _is_sparse_like(other):
             other = other.tocsr()  # csc/dia operand -> CSR SpGEMM
         if isinstance(other, csr_array):
             if out is not None:

@@ -234,3 +234,27 @@ def test_mul_class_preservation_and_rmul():
     assert (C * C).format == "csc"                 # format-preserving
     O = lst.csr_array(As).asformat("coo")
     assert (O * O).format == "coo"
+
+
+def test_truediv_dense_and_sparse():
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(4, 4)).tocsr()
+    A = lst.csr_array(As)
+    np.testing.assert_allclose(
+        np.asarray((A / np.full(4, 2.0)).toarray()),
+        (sp.csr_array(As) / np.full(4, 2.0)).toarray(),
+    )
+    np.testing.assert_allclose(
+        np.asarray(A / A), sp.csr_array(As) / sp.csr_array(As),
+        equal_nan=True,
+    )
+
+
+def test_truediv_shape_check_and_broadcast():
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(4, 4)).tocsr()
+    A = lst.csr_array(As)
+    with pytest.raises(ValueError):
+        A / lst.csr_array(sp.eye(3).tocsr())
+    np.testing.assert_allclose(
+        np.asarray((A / np.full((4, 1), 2.0)).toarray()),
+        (sp.csr_array(As) / np.full((4, 1), 2.0)).toarray(),
+    )
