@@ -705,8 +705,8 @@ class csr_array(CompressedBase, DenseSparseBase):
             self._data, self._indices, self._indptr, rows, cols
         )
         # Transpose of a canonical matrix is canonical; duplicates survive
-        # transposition otherwise.
-        return csr_array._from_parts(
+        # transposition otherwise.  type(self) keeps the spmatrix flavor.
+        return type(self)._from_parts(
             data, indices, indptr, (cols, rows), canonical=self._canonical
         )
 
@@ -718,7 +718,7 @@ class csr_array(CompressedBase, DenseSparseBase):
     conjugate = conj
 
     def copy(self):
-        return csr_array(self, copy=True)
+        return type(self)(self, copy=True)
 
     def trace(self, offset: int = 0):
         """Sum along diagonal ``offset`` (scipy ``trace``)."""
@@ -929,6 +929,117 @@ class csr_array(CompressedBase, DenseSparseBase):
 
     def __neg__(self):
         return self._with_data(-self._data)
+
+    def __abs__(self):
+        return self._with_data(jnp.abs(self._data))
+
+    def __pow__(self, n):
+        return self.power(n)
+
+    # -- element-wise comparisons (scipy semantics: a bool sparse array
+    #    storing the True positions).  Whether the True set is
+    #    dense-shaped depends on the implicit-zero pair: op(0, fill) —
+    #    those cases warn (like scipy) and materialize; the
+    #    sparse-shaped cases stay sparse end to end. --
+    def _compare(self, other, op):
+        scalar = np.isscalar(other) or getattr(other, "ndim", None) == 0
+        sparse_other = _is_scipy_sparse(other) or _is_sparse_like(other)
+        if sparse_other and tuple(other.shape) != self.shape:
+            raise ValueError("inconsistent shapes")
+        fill_true = bool(op(0.0, float(np.real(other)) if scalar
+                            else 0.0))
+        if fill_true:
+            warnings.warn(
+                "Comparing a sparse array using a comparison that is "
+                "True for implicit zeros is inefficient "
+                "(dense-shaped result)",
+                SparseEfficiencyWarning, stacklevel=3,
+            )
+        if scalar:
+            if fill_true:
+                res = op(np.asarray(self.toarray()), other)
+                return csr_array(np.asarray(res))
+            a = self._canonicalized()
+            out = a._with_data(op(a._data, other))
+            out = csr_array(out)   # bool result is plain sparray
+            out.eliminate_zeros()
+            return out
+        if sparse_other:
+            if fill_true:
+                res = op(np.asarray(self.toarray()),
+                         np.asarray(other.toarray()))
+                return csr_array(np.asarray(res))
+            return self._compare_sparse_union(other, op)
+        # Dense operand: dense-shaped by nature.
+        res = op(np.asarray(self.toarray()), np.asarray(other))
+        return csr_array(np.asarray(res))
+
+    def _compare_sparse_union(self, other, op):
+        """op over the union structure of two sparse operands (used for
+        the sparse-result comparisons: no dense materialization)."""
+        if not isinstance(other, csr_array):
+            other = csr_array(other) if _is_scipy_sparse(other) \
+                else other.tocsr()
+        a, b = (self._canonicalized(), other._canonicalized())
+        rows, cols = a.shape
+        ra, ca, va = a.tocoo()
+        rb, cb, vb = b.tocoo()
+        row = jnp.concatenate([ra, rb])
+        col = jnp.concatenate([ca, cb])
+        key_dt = coord_dtype_for(rows * cols)
+        if (np.dtype(key_dt).itemsize == 8
+                and not jax.config.jax_enable_x64):
+            raise OverflowError(
+                "comparison union keys need int64 but x64 is disabled"
+            )
+        key = row.astype(key_dt) * cols + col.astype(key_dt)
+        cha = jnp.concatenate([va, jnp.zeros_like(vb)])
+        chb = jnp.concatenate([jnp.zeros_like(va), vb])
+        order = jnp.argsort(key, stable=True)
+        key = key[order]
+        cha = cha[order]
+        chb = chb[order]
+        nxt = jnp.concatenate([key[1:], jnp.full((1,), -1, key.dtype)])
+        prv = jnp.concatenate([jnp.full((1,), -1, key.dtype), key[:-1]])
+        first = key != prv
+        # Merge pair channels onto the first slot of each key group.
+        va_m = cha + jnp.where(key == nxt, jnp.roll(cha, -1), 0)
+        vb_m = chb + jnp.where(key == nxt, jnp.roll(chb, -1), 0)
+        res = jnp.logical_and(first, op(va_m, vb_m))
+        out = csr_array(
+            (res, (row[order], col[order])), shape=self.shape
+        )
+        out.eliminate_zeros()
+        return out
+
+    def __eq__(self, other):
+        return self._compare(other, jnp.equal)
+
+    def __ne__(self, other):
+        return self._compare(other, jnp.not_equal)
+
+    def __lt__(self, other):
+        return self._compare(other, jnp.less)
+
+    def __gt__(self, other):
+        return self._compare(other, jnp.greater)
+
+    def __le__(self, other):
+        return self._compare(other, jnp.less_equal)
+
+    def __ge__(self, other):
+        return self._compare(other, jnp.greater_equal)
+
+    # Defining __eq__ clears the default hash; sparse arrays are
+    # mutable and unhashable, same as scipy's.
+    __hash__ = None
+
+    def nonzero(self):
+        """(row, col) of nonzero entries (scipy ``nonzero``)."""
+        from .gallery import find as _find
+
+        r, c, _v = _find(self)
+        return r, c
 
     def _add_sub(self, other, sign):
         if not isinstance(other, csr_array):
@@ -1388,7 +1499,39 @@ class csr_array(CompressedBase, DenseSparseBase):
 # scipy.sparse.*_matrix alias (reference defines csr_matrix the same way).
 class csr_matrix(csr_array):
     """spmatrix-flavored alias: ``*`` means matrix multiplication
-    (scipy's csr_matrix), unlike the element-wise sparray ``*``."""
+    (scipy's csr_matrix), unlike the element-wise sparray ``*``; the
+    legacy getrow/getcol/getH accessors exist here only, as in scipy."""
+
+    def __pow__(self, n):
+        # spmatrix semantics: matrix power (scipy's csr_matrix ** n),
+        # not the element-wise sparray power.
+        if not isinstance(n, (int, np.integer)) or n < 0:
+            raise ValueError("matrix power requires a non-negative int")
+        if self.shape[0] != self.shape[1]:
+            raise TypeError("matrix is not square")
+        from .gallery import identity as _identity
+
+        result = csr_matrix(
+            _identity(self.shape[0], dtype=self.dtype, format="csr")
+        )
+        base = self
+        n = int(n)
+        while n:
+            if n & 1:
+                result = csr_matrix(result.dot(base))
+            n >>= 1
+            if n:
+                base = csr_matrix(base.dot(base))
+        return result
+
+    def getrow(self, i):
+        return csr_matrix(self[int(i), :])
+
+    def getcol(self, j):
+        return csr_matrix(self[:, int(j)])
+
+    def getH(self):
+        return self.conj().transpose()
 
     def __mul__(self, other):
         if np.isscalar(other) or getattr(other, "ndim", None) == 0:

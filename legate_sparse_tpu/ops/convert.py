@@ -93,6 +93,10 @@ def csr_to_dense(data, indices, indptr, shape):
     rows, cols = shape
     row_ids = row_ids_from_indptr(indptr, data.shape[0])
     out = jnp.zeros(shape, dtype=data.dtype)
+    if data.dtype == jnp.bool_:
+        # Scatter-add rejects bool; duplicates accumulate as logical
+        # or (max), matching "nonzero wins" semantics.
+        return out.at[row_ids, indices].max(data, mode="drop")
     return out.at[row_ids, indices].add(data, mode="drop")
 
 

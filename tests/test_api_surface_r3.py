@@ -258,3 +258,90 @@ def test_truediv_shape_check_and_broadcast():
         np.asarray((A / np.full((4, 1), 2.0)).toarray()),
         (sp.csr_array(As) / np.full((4, 1), 2.0)).toarray(),
     )
+
+
+def test_comparisons_pow_abs_nonzero():
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(4, 4)).tocsr()
+    Bs = As.copy()
+    Bs[0, 0] = 5.0
+    A, B = lst.csr_array(As), lst.csr_array(Bs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for ours, theirs in [
+            (A == B, sp.csr_array(As) == sp.csr_array(Bs)),
+            (A != B, sp.csr_array(As) != sp.csr_array(Bs)),
+            (A < B, sp.csr_array(As) < sp.csr_array(Bs)),
+            (A >= B, sp.csr_array(As) >= sp.csr_array(Bs)),
+            (A == 1.0, sp.csr_array(As) == 1.0),
+            (A > 0, sp.csr_array(As) > 0),
+        ]:
+            np.testing.assert_array_equal(
+                np.asarray(ours.toarray()), theirs.toarray()
+            )
+            assert ours.dtype == np.bool_
+    np.testing.assert_allclose(
+        np.asarray((A ** 2).toarray()), (sp.csr_array(As) ** 2).toarray()
+    )
+    np.testing.assert_allclose(
+        np.asarray(abs(A).toarray()), abs(sp.csr_array(As)).toarray()
+    )
+    r, c = A.nonzero()
+    rs, cs = As.nonzero()
+    assert (np.sort(r * 4 + c) == np.sort(rs * 4 + cs)).all()
+    M = lst.csr_matrix(As)
+    np.testing.assert_allclose(
+        np.asarray(M.getrow(1).toarray()),
+        sp.csr_matrix(As).getrow(1).toarray(),
+    )
+    np.testing.assert_allclose(
+        np.asarray(M.getcol(2).toarray()),
+        sp.csr_matrix(As).getcol(2).toarray(),
+    )
+    np.testing.assert_allclose(
+        np.asarray(M.getH().toarray()), sp.csr_matrix(As).getH().toarray()
+    )
+
+
+def test_matrix_power_and_class_flavor():
+    As = sp.csr_matrix(np.array([[1.0, 2.0], [0.0, 3.0]]))
+    M = lst.csr_matrix(As)
+    np.testing.assert_allclose(
+        np.asarray((M ** 2).toarray()), (As ** 2).toarray()
+    )
+    for obj in (M ** 2, M.getH(), M.getrow(0), M.getcol(1), M.T,
+                M.copy(), M * 2):
+        assert type(obj).__name__ == "csr_matrix", type(obj)
+    # sparray ** stays element-wise.
+    A = lst.csr_array(As)
+    np.testing.assert_allclose(
+        np.asarray((A ** 2).toarray()), (sp.csr_array(As) ** 2).toarray()
+    )
+
+
+def test_comparison_warning_parity():
+    As = sp.csr_matrix(np.array([[1.0, 2.0], [0.0, 3.0]]))
+    A = lst.csr_array(As)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        A == 1.0   # noqa: B015 - sparse result, no warning
+        assert not rec
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        A < 1.0    # noqa: B015 - implicit zeros compare True
+        assert rec
+
+
+def test_sparse_union_comparison_no_densify():
+    """Sparse-result comparisons work at scales where densifying would
+    allocate tens of GB."""
+    n = 200_000
+    rng = np.random.default_rng(0)
+    r = rng.integers(0, n, 500)
+    c = rng.integers(0, n, 500)
+    A = lst.csr_array(
+        (np.ones(500), (r, c)), shape=(n, n)
+    )
+    res = A != A
+    assert res.nnz == 0
+    res2 = A > A * 0.5
+    assert res2.nnz == A._canonicalized().nnz
