@@ -55,11 +55,53 @@ class CsrDelegateMixin:
     def reshape(self, *shape, order="C"):
         return self.tocsr().reshape(*shape, order=order)
 
+    def tocoo(self, copy: bool = False):
+        return self.tocsr().tocoo(copy=copy)
+
     def todok(self, copy: bool = False):
         return self.tocsr().todok(copy=copy)
 
     def tolil(self, copy: bool = False):
         return self.tocsr().tolil(copy=copy)
+
+    # Element-wise comparisons (scipy semantics, via the CSR kernels).
+    # Defining __eq__ clears hashing — sparse arrays are mutable and
+    # unhashable, same as scipy's.
+    __hash__ = None
+
+    def __eq__(self, other):
+        return self.tocsr() == other
+
+    def __ne__(self, other):
+        return self.tocsr() != other
+
+    def __lt__(self, other):
+        return self.tocsr() < other
+
+    def __gt__(self, other):
+        return self.tocsr() > other
+
+    def __le__(self, other):
+        return self.tocsr() <= other
+
+    def __ge__(self, other):
+        return self.tocsr() >= other
+
+    def __abs__(self):
+        return abs(self.tocsr())
+
+    def __pow__(self, n):
+        import numpy as _np
+
+        if _np.isscalar(n) and n == 0:
+            raise NotImplementedError(
+                "zero power is not supported as it would densify the "
+                "matrix; use np.ones(A.shape, dtype=A.dtype)"
+            )
+        return self.power(n)
+
+    def nonzero(self):
+        return self.tocsr().nonzero()
 
 
 class CompressedBase(CsrDelegateMixin):

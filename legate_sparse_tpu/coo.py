@@ -52,7 +52,7 @@ class coo_array(CsrDelegateMixin):
             shape = sc.shape if shape is None else tuple(shape)
         elif hasattr(arg, "tocsr"):  # csr_array / dia_array / csc_array
             base = arg if isinstance(arg, csr_array) else arg.tocsr()
-            row, col, data = base.tocoo()
+            row, col, data = base._coo_parts()
             shape = base.shape if shape is None else tuple(shape)
         else:
             dense = jnp.asarray(arg)
@@ -61,7 +61,7 @@ class coo_array(CsrDelegateMixin):
                     f"coo_array requires a 2-D input, got ndim={dense.ndim}"
                 )
             base = csr_array(dense)
-            row, col, data = base.tocoo()
+            row, col, data = base._coo_parts()
             shape = base.shape
 
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
@@ -103,6 +103,9 @@ class coo_array(CsrDelegateMixin):
 
     def tocsc(self, copy: bool = False):
         return self.tocsr().tocsc()
+
+    def _coo_parts(self):
+        return self.row, self.col, self.data
 
     def tocoo(self, copy: bool = False):
         return coo_array(self, copy=copy) if copy else self
@@ -160,7 +163,7 @@ class coo_array(CsrDelegateMixin):
         """Coalesce duplicate coordinates in place (via CSR round trip)."""
         A = self.tocsr()
         A.sum_duplicates()
-        self.row, self.col, self.data = A.tocoo()
+        self.row, self.col, self.data = A._coo_parts()
 
     def diagonal(self, k: int = 0):
         return self.tocsr().diagonal(k)
@@ -204,6 +207,14 @@ class coo_array(CsrDelegateMixin):
 
 
 class coo_matrix(coo_array):
+    def __pow__(self, n):
+        # spmatrix semantics: matrix power.
+        from .csr import csr_matrix
+
+        out = (csr_matrix(self.tocsr()) ** n).asformat("coo")
+        out.__class__ = type(self)   # keep the matrix flavor
+        return out
+
     """spmatrix-flavored alias: ``*`` is matrix multiplication."""
 
     def __mul__(self, other):

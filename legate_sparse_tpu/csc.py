@@ -211,6 +211,14 @@ class csc_array(CsrDelegateMixin):
 
 # scipy.sparse.*_matrix alias.
 class csc_matrix(csc_array):
+    def __pow__(self, n):
+        # spmatrix semantics: matrix power.
+        from .csr import csr_matrix
+
+        out = (csr_matrix(self.tocsr()) ** n).asformat("csc")
+        out.__class__ = type(self)   # keep the matrix flavor
+        return out
+
     """spmatrix-flavored alias: ``*`` is matrix multiplication."""
 
     def __mul__(self, other):

@@ -317,7 +317,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         """Merge duplicate (row, col) entries in place (scipy contract)."""
         if self.has_canonical_format:
             return
-        row_ids, cols, vals = self.tocoo()
+        row_ids, cols, vals = self._coo_parts()
         data, indices, indptr = _spgemm_ops.coalesce_coo(
             row_ids, cols, vals, self.shape[0]
         )
@@ -547,10 +547,17 @@ class csr_array(CompressedBase, DenseSparseBase):
     def tocsr(self, copy: bool = False):
         return self.copy() if copy else self
 
-    def tocoo(self, copy: bool = False):
-        """Return (row, col, data) coordinate view as jax arrays."""
+    def _coo_parts(self):
+        """(row, col, data) coordinate view as jax arrays (internal —
+        the public ``tocoo`` returns a ``coo_array`` like scipy)."""
         row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
         return row_ids.astype(self._indices.dtype), self._indices, self._data
+
+    def tocoo(self, copy: bool = False):
+        """COO-format view (scipy ``tocoo`` semantics)."""
+        from .coo import coo_array
+
+        return coo_array(self)
 
     def toscipy(self):
         """Interop: materialize as a scipy.sparse.csr_array on host."""
@@ -779,8 +786,8 @@ class csr_array(CompressedBase, DenseSparseBase):
         a, b = cast_to_common_type(self._canonicalized(),
                                    other._canonicalized())
         rows, cols = a.shape
-        ra, ca, va = a.tocoo()
-        rb, cb, vb = b.tocoo()
+        ra, ca, va = a._coo_parts()
+        rb, cb, vb = b._coo_parts()
         # Union structure: where a key appears on one side only, the
         # other side contributes its implicit zero.
         row = jnp.concatenate([ra, rb])
@@ -853,7 +860,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         if len(shape) == 1:
             shape = tuple(shape[0])
         nr, nc = (int(shape[0]), int(shape[1]))
-        r, c, v = self.tocoo()
+        r, c, v = self._coo_parts()
         keep = jnp.logical_and(r < nr, c < nc)
         nnz_new = int(jnp.sum(keep))
         r2, c2, v2 = _convert.compact_mask(keep, (r, c, v), nnz_new)
@@ -934,6 +941,11 @@ class csr_array(CompressedBase, DenseSparseBase):
         return self._with_data(jnp.abs(self._data))
 
     def __pow__(self, n):
+        if np.isscalar(n) and n == 0:
+            raise NotImplementedError(
+                "zero power is not supported as it would densify the "
+                "matrix; use np.ones(A.shape, dtype=A.dtype)"
+            )
         return self.power(n)
 
     # -- element-wise comparisons (scipy semantics: a bool sparse array
@@ -942,12 +954,18 @@ class csr_array(CompressedBase, DenseSparseBase):
     #    those cases warn (like scipy) and materialize; the
     #    sparse-shaped cases stay sparse end to end. --
     def _compare(self, other, op):
+        cls = type(self)
         scalar = np.isscalar(other) or getattr(other, "ndim", None) == 0
         sparse_other = _is_scipy_sparse(other) or _is_sparse_like(other)
         if sparse_other and tuple(other.shape) != self.shape:
             raise ValueError("inconsistent shapes")
-        fill_true = bool(op(0.0, float(np.real(other)) if scalar
-                            else 0.0))
+        if not scalar and not sparse_other:
+            # Dense operand: scipy returns a dense bool ndarray.
+            return np.asarray(
+                op(np.asarray(self.toarray()), np.asarray(other))
+            )
+        # Implicit-zero pair (full scalar value — complex included).
+        fill_true = bool(np.asarray(op(0, other if scalar else 0)))
         if fill_true:
             warnings.warn(
                 "Comparing a sparse array using a comparison that is "
@@ -958,57 +976,50 @@ class csr_array(CompressedBase, DenseSparseBase):
         if scalar:
             if fill_true:
                 res = op(np.asarray(self.toarray()), other)
-                return csr_array(np.asarray(res))
+                return cls(np.asarray(res))
             a = self._canonicalized()
-            out = a._with_data(op(a._data, other))
-            out = csr_array(out)   # bool result is plain sparray
+            out = cls(a._with_data(op(a._data, other)))
             out.eliminate_zeros()
             return out
-        if sparse_other:
-            if fill_true:
-                res = op(np.asarray(self.toarray()),
-                         np.asarray(other.toarray()))
-                return csr_array(np.asarray(res))
-            return self._compare_sparse_union(other, op)
-        # Dense operand: dense-shaped by nature.
-        res = op(np.asarray(self.toarray()), np.asarray(other))
-        return csr_array(np.asarray(res))
+        if fill_true:
+            res = op(np.asarray(self.toarray()),
+                     np.asarray(other.toarray()))
+            return cls(np.asarray(res))
+        return self._compare_sparse_union(other, op)
 
     def _compare_sparse_union(self, other, op):
         """op over the union structure of two sparse operands (used for
-        the sparse-result comparisons: no dense materialization)."""
+        the sparse-result comparisons: no dense materialization).  Two-
+        key sort — no fused integer key, safe for any rows*cols under
+        x64-off (same pattern as ``_elementwise_intersect_multiply``)."""
         if not isinstance(other, csr_array):
             other = csr_array(other) if _is_scipy_sparse(other) \
                 else other.tocsr()
         a, b = (self._canonicalized(), other._canonicalized())
         rows, cols = a.shape
-        ra, ca, va = a.tocoo()
-        rb, cb, vb = b.tocoo()
+        ra, ca, va = a._coo_parts()
+        rb, cb, vb = b._coo_parts()
         row = jnp.concatenate([ra, rb])
         col = jnp.concatenate([ca, cb])
-        key_dt = coord_dtype_for(rows * cols)
-        if (np.dtype(key_dt).itemsize == 8
-                and not jax.config.jax_enable_x64):
-            raise OverflowError(
-                "comparison union keys need int64 but x64 is disabled"
-            )
-        key = row.astype(key_dt) * cols + col.astype(key_dt)
         cha = jnp.concatenate([va, jnp.zeros_like(vb)])
         chb = jnp.concatenate([jnp.zeros_like(va), vb])
-        order = jnp.argsort(key, stable=True)
-        key = key[order]
-        cha = cha[order]
-        chb = chb[order]
-        nxt = jnp.concatenate([key[1:], jnp.full((1,), -1, key.dtype)])
-        prv = jnp.concatenate([jnp.full((1,), -1, key.dtype), key[:-1]])
-        first = key != prv
-        # Merge pair channels onto the first slot of each key group.
-        va_m = cha + jnp.where(key == nxt, jnp.roll(cha, -1), 0)
-        vb_m = chb + jnp.where(key == nxt, jnp.roll(chb, -1), 0)
-        res = jnp.logical_and(first, op(va_m, vb_m))
-        out = csr_array(
-            (res, (row[order], col[order])), shape=self.shape
+        row, col, cha, chb = jax.lax.sort(
+            [row, col, cha, chb], num_keys=2, is_stable=True
         )
+        same_next = jnp.concatenate([
+            jnp.logical_and(row[1:] == row[:-1], col[1:] == col[:-1]),
+            jnp.zeros((1,), bool),
+        ])
+        same_prev = jnp.concatenate([
+            jnp.zeros((1,), bool),
+            jnp.logical_and(row[1:] == row[:-1], col[1:] == col[:-1]),
+        ])
+        first = jnp.logical_not(same_prev)
+        # Merge pair channels onto the first slot of each key group.
+        va_m = cha + jnp.where(same_next, jnp.roll(cha, -1), 0)
+        vb_m = chb + jnp.where(same_next, jnp.roll(chb, -1), 0)
+        res = jnp.logical_and(first, op(va_m, vb_m))
+        out = type(self)((res, (row, col)), shape=self.shape)
         out.eliminate_zeros()
         return out
 
@@ -1053,8 +1064,8 @@ class csr_array(CompressedBase, DenseSparseBase):
             raise ValueError("inconsistent shapes")
         a, b = cast_to_common_type(self, other)
         rows, cols = self.shape
-        ra, ca, va = a.tocoo()
-        rb, cb, vb = b.tocoo()
+        ra, ca, va = a._coo_parts()
+        rb, cb, vb = b._coo_parts()
         row = jnp.concatenate([ra, rb])
         col = jnp.concatenate([ca, cb])
         val = jnp.concatenate([va, sign * vb])
@@ -1255,7 +1266,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         add_rows = jnp.asarray(missing + i0, dtype=cdt)
         add_cols = jnp.asarray(missing + i0 + k, dtype=cdt)
         add_vals = vals[jnp.asarray(missing)]
-        r, c, _ = self.tocoo()
+        r, c, _ = self._coo_parts()
         self._data, self._indices, self._indptr = _convert.coo_to_csr(
             jnp.concatenate([r.astype(cdt), add_rows]),
             jnp.concatenate([c.astype(cdt), add_cols]),
@@ -1479,7 +1490,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         )
 
     def __str__(self) -> str:
-        row_ids, cols, vals = self.tocoo()
+        row_ids, cols, vals = self._coo_parts()
         lines = [
             f"  ({int(r)}, {int(c)})\t{v}"
             for r, c, v in zip(
@@ -1554,8 +1565,8 @@ def _elementwise_intersect_multiply(a: csr_array, b: csr_array) -> csr_array:
     fused integer key — safe for any rows*cols.
     """
     rows, cols = a.shape
-    ra, ca, va = a.tocoo()
-    rb, cb, vb = b.tocoo()
+    ra, ca, va = a._coo_parts()
+    rb, cb, vb = b._coo_parts()
     r = jnp.concatenate([ra, rb])
     c = jnp.concatenate([ca, cb])
     ch_a = jnp.concatenate([va, jnp.zeros_like(vb)])

@@ -267,4 +267,12 @@ class dia_array(CompressedBase):
 
 
 class dia_matrix(dia_array):
+    def __pow__(self, n):
+        # spmatrix semantics: matrix power.
+        from .csr import csr_matrix
+
+        out = (csr_matrix(self.tocsr()) ** n).asformat("dia")
+        out.__class__ = type(self)   # keep the matrix flavor
+        return out
+
     pass

@@ -120,8 +120,8 @@ def kron(A, B, format=None):
     mB, nB = B.shape
     cdt = coord_dtype_for(max(mA * mB, nA * nB, 1))
     _require_representable(cdt)
-    ra, ca, va = A.tocoo()
-    rb, cb, vb = B.tocoo()
+    ra, ca, va = A._coo_parts()
+    rb, cb, vb = B._coo_parts()
     ra = ra.astype(cdt)[:, None]
     ca = ca.astype(cdt)[:, None]
     rb = rb.astype(cdt)[None, :]
@@ -273,7 +273,7 @@ def hstack(blocks, format=None, dtype=None):
     rr, cc, vv = [], [], []
     offset = 0
     for mat in mats:
-        r, c, v = mat.tocoo()
+        r, c, v = mat._coo_parts()
         rr.append(r.astype(cdt))
         cc.append(c.astype(cdt) + np.asarray(offset, dtype=cdt))
         vv.append(v)
@@ -373,7 +373,7 @@ def find(A):
     from .ops.convert import compact_mask
 
     A = _as_csr(A)._canonicalized()
-    r, c, v = A.tocoo()
+    r, c, v = A._coo_parts()
     keep = v != 0
     nnz = int(jnp.sum(keep))
     r2, c2, v2 = compact_mask(keep, (r, c, v), nnz)

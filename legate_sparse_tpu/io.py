@@ -118,7 +118,7 @@ def mmwrite(target, a) -> None:
 
     if not isinstance(a, _csr):
         a = _csr(a)
-    rows, cols, vals = a.tocoo()
+    rows, cols, vals = a._coo_parts()
     rows = np.asarray(rows)
     cols = np.asarray(cols)
     vals = np.asarray(vals)

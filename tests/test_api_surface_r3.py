@@ -345,3 +345,25 @@ def test_sparse_union_comparison_no_densify():
     assert res.nnz == 0
     res2 = A > A * 0.5
     assert res2.nnz == A._canonicalized().nnz
+
+
+def test_tocoo_returns_coo_array():
+    As = sp.csr_matrix(np.array([[1.0, 2.0], [0.0, 3.0]]))
+    co = lst.csr_array(As).tocoo()
+    assert type(co).__name__ == "coo_array"
+    np.testing.assert_allclose(np.asarray(co.toarray()), As.toarray())
+    # csc/dia get tocoo via delegation too.
+    assert type(lst.csr_array(As).tocsc().tocoo()).__name__ == "coo_array"
+
+
+def test_matrix_power_other_formats():
+    As = sp.csr_matrix(np.array([[1.0, 2.0], [0.0, 3.0]]))
+    for name, to in [("csc", "tocsc"), ("coo", "tocoo"), ("dia", "todia")]:
+        M = getattr(lst, f"{name}_matrix")(
+            getattr(lst.csr_array(As), to)()
+        )
+        got = M ** 2
+        np.testing.assert_allclose(
+            np.asarray(got.toarray()), (As @ As).toarray()
+        )
+        assert type(got).__name__ == f"{name}_matrix"
