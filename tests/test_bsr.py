@@ -212,3 +212,20 @@ def test_bsr_on_chip():
     x = np.random.default_rng(12).standard_normal(1024).astype(np.float32)
     y = np.asarray(st.matvec(x, interpret=False))
     np.testing.assert_allclose(y, A @ x, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.tpu
+def test_bsr_spmm_on_chip():
+    """Mosaic lowering of the BSR SpMM kernel on a real chip."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("no TPU")
+    A = _random_csr(1024, 1024, 0.02, seed=41)
+    pack = bsr_pack(A.data, A.indices, A.indptr, A.shape, max_expand=1e9)
+    st = BsrStructure(*pack, 1024, 1024)
+    X = np.random.default_rng(42).standard_normal((1024, 8)).astype(
+        np.float32
+    )
+    Y = np.asarray(st.matmat(X, interpret=False))
+    np.testing.assert_allclose(Y, A @ X, rtol=1e-3, atol=1e-3)
