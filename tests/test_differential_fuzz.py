@@ -1,0 +1,90 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Randomized differential battery vs scipy: many ops, pooled shapes
+(so jit compiles amortize), seeded for reproducibility.  Slow lane —
+the unit files cover each op; this net catches cross-op regressions."""
+
+import warnings
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import legate_sparse_tpu as lst
+
+pytestmark = pytest.mark.slow
+
+SHAPES = [(12, 12), (8, 15)]
+
+
+def _chk(fails, trial, name, got, want, tol=1e-9):
+    g = np.asarray(got.toarray() if hasattr(got, "toarray") else got)
+    w = np.asarray(want.toarray() if hasattr(want, "toarray") else want)
+    if g.shape != w.shape or not np.allclose(g, w, atol=tol,
+                                             equal_nan=True):
+        fails.append((trial, name))
+
+
+def test_differential_battery():
+    rng = np.random.default_rng(99)
+    fails = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(6):
+            m, n = SHAPES[trial % 2]
+            d = float(rng.uniform(0.05, 0.5))
+            As = sp.random(m, n, density=d, format="csr",
+                           random_state=rng)
+            Bs = sp.random(m, n, density=d, format="csr",
+                           random_state=rng)
+            A, B = lst.csr_array(As), lst.csr_array(Bs)
+            _chk(fails, trial, "add", A + B, As + Bs)
+            _chk(fails, trial, "sub", A - B, As - Bs)
+            _chk(fails, trial, "mul_elem", A * B,
+                 sp.csr_array(As) * sp.csr_array(Bs))
+            _chk(fails, trial, "maximum", A.maximum(B), As.maximum(Bs))
+            _chk(fails, trial, "minimum", A.minimum(B), As.minimum(Bs))
+            _chk(fails, trial, "multiply", A.multiply(B),
+                 As.multiply(Bs))
+            _chk(fails, trial, "ne", A != B,
+                 sp.csr_array(As) != sp.csr_array(Bs))
+            _chk(fails, trial, "sum0", A.sum(axis=0),
+                 np.asarray(As.sum(axis=0)).ravel())
+            _chk(fails, trial, "sum1", A.sum(axis=1),
+                 np.asarray(As.sum(axis=1)).ravel())
+            _chk(fails, trial, "max1", A.max(axis=1),
+                 As.max(axis=1).toarray().ravel())
+            _chk(fails, trial, "T", A.T, As.T)
+            _chk(fails, trial, "tocsc", A.tocsc(), As.tocsc())
+            _chk(fails, trial, "tril", lst.tril(A, k=1),
+                 sp.tril(As, k=1))
+            if m == n:
+                _chk(fails, trial, "diag", A.diagonal(), As.diagonal())
+                _chk(fails, trial, "spgemm",
+                     A @ lst.csr_array(Bs.T.tocsr()), As @ Bs.T.tocsr())
+            x = rng.standard_normal(n)
+            _chk(fails, trial, "spmv", A @ x, As @ x)
+            X = rng.standard_normal((n, 3))
+            _chk(fails, trial, "spmm", A @ X, As @ X)
+    assert not fails, fails
+
+
+def test_degenerate_shapes():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fails = []
+        Es = sp.csr_array((3, 4))
+        E = lst.csr_array((3, 4))
+        _chk(fails, 0, "empty+", E + E, Es + Es)
+        _chk(fails, 0, "emptyT", E.T, Es.T)
+        _chk(fails, 0, "empty spmv", E @ np.ones(4), Es @ np.ones(4))
+        Rs = sp.random(1, 9, density=0.5, format="csr", random_state=1)
+        R = lst.csr_array(Rs)
+        _chk(fails, 0, "row spmv", R @ np.ones(9), Rs @ np.ones(9))
+        _chk(fails, 0, "rowT", R.T, Rs.T)
+        Cs = sp.random(9, 1, density=0.5, format="csr", random_state=2)
+        C = lst.csr_array(Cs)
+        _chk(fails, 0, "col spmv", C @ np.ones(1), Cs @ np.ones(1))
+        _chk(fails, 0, "col sum0", C.sum(axis=0),
+             np.asarray(Cs.sum(axis=0)).ravel())
+        assert not fails, fails
