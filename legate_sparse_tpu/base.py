@@ -64,6 +64,72 @@ class CsrDelegateMixin:
     def tolil(self, copy: bool = False):
         return self.tocsr().tolil(copy=copy)
 
+    # Arithmetic (formats with a native implementation override; the
+    # rest go through CSR where the kernels live).  Scalar scaling
+    # stays in the operand's own format via _with_data when available.
+    # *_matrix flavors set this True: their ``*`` is matmul, and
+    # CSR-routed results keep the spmatrix flavor.
+    _is_spmatrix = False
+
+    def _flavored(self, out):
+        if self._is_spmatrix:
+            from .csr import csr_array, csr_matrix
+
+            if type(out) is csr_array:
+                out.__class__ = csr_matrix
+        return out
+
+    def __mul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            if hasattr(self, "_with_data"):
+                return self._with_data(self.data * other)
+            return self._flavored(self.tocsr() * other)
+        if self._is_spmatrix:
+            return self._flavored(self.tocsr() @ other)  # spmatrix: matmul
+        return self.multiply(other)
+
+    def __rmul__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            return self.__mul__(other)
+        if self._is_spmatrix:
+            # scipy spmatrix: x * A is x @ A (row-vector matmul).
+            other = np.asarray(other)
+            AT = self.tocsr().transpose()
+            if other.ndim == 1:
+                return np.asarray(AT @ other)
+            return np.asarray((AT @ other.T)).T
+        return self.__mul__(other)   # element-wise * commutes
+
+    def __neg__(self):
+        if hasattr(self, "_with_data"):
+            return self._with_data(-self.data)  # dtype-preserving
+        return self._flavored(-self.tocsr())
+
+    def __truediv__(self, other):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            if hasattr(self, "_with_data"):
+                return self._with_data(self.data / other)
+        return self._flavored(self.tocsr() / other)
+
+    def __add__(self, other):
+        if np.isscalar(other) and other == 0:
+            return self.copy()   # sum()/accumulate start at 0
+        return self._flavored(self.tocsr() + other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._flavored(self.tocsr() - other)
+
+    def __matmul__(self, other):
+        return self.tocsr() @ other
+
+    def __rmatmul__(self, other):
+        raise NotImplementedError(
+            f"dense @ {type(self).__name__} is not supported"
+        )
+
     # Element-wise comparisons (scipy semantics, via the CSR kernels).
     # Defining __eq__ clears hashing — sparse arrays are mutable and
     # unhashable, same as scipy's.

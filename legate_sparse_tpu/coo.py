@@ -207,6 +207,7 @@ class coo_array(CsrDelegateMixin):
 
 
 class coo_matrix(coo_array):
+    _is_spmatrix = True
     def __pow__(self, n):
         # spmatrix semantics: matrix power.
         from .csr import csr_matrix

@@ -211,6 +211,7 @@ class csc_array(CsrDelegateMixin):
 
 # scipy.sparse.*_matrix alias.
 class csc_matrix(csc_array):
+    _is_spmatrix = True
     def __pow__(self, n):
         # spmatrix semantics: matrix power.
         from .csr import csr_matrix

@@ -1056,6 +1056,8 @@ class csr_array(CompressedBase, DenseSparseBase):
         if not isinstance(other, csr_array):
             if _is_scipy_sparse(other):
                 other = csr_array(other)
+            elif _is_sparse_like(other):
+                other = other.tocsr()   # csc/coo/dia operand
             else:
                 raise NotImplementedError(
                     "sparse +/- dense is not supported; densify explicitly"
@@ -1071,7 +1073,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         val = jnp.concatenate([va, sign * vb])
         # Merge duplicates through the shared coalesce machinery.
         data, indices, indptr = _spgemm_ops.coalesce_coo(row, col, val, rows)
-        return csr_array._from_parts(data, indices, indptr, self.shape)
+        return type(self)._from_parts(data, indices, indptr, self.shape)
 
     def __add__(self, other):
         return self._add_sub(other, 1)
@@ -1513,6 +1515,8 @@ class csr_matrix(csr_array):
     (scipy's csr_matrix), unlike the element-wise sparray ``*``; the
     legacy getrow/getcol/getH accessors exist here only, as in scipy."""
 
+    _is_spmatrix = True
+
     def __pow__(self, n):
         # spmatrix semantics: matrix power (scipy's csr_matrix ** n),
         # not the element-wise sparray power.
@@ -1552,7 +1556,12 @@ class csr_matrix(csr_array):
     def __rmul__(self, other):
         if np.isscalar(other) or getattr(other, "ndim", None) == 0:
             return self._with_data(self._data * other)
-        return NotImplemented
+        # scipy spmatrix: x * A is x @ A (row-vector matmul).
+        other = np.asarray(other)
+        AT = self.transpose()
+        if other.ndim == 1:
+            return np.asarray(AT @ other)
+        return np.asarray(AT @ other.T).T
 
 
 def _elementwise_intersect_multiply(a: csr_array, b: csr_array) -> csr_array:

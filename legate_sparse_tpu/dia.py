@@ -89,7 +89,8 @@ class dia_array(CompressedBase):
                          copy=True)
 
     def _with_data(self, data, copy: bool = False):
-        return dia_array((data, self._offsets), shape=self.shape, copy=copy)
+        return type(self)((data, self._offsets), shape=self.shape,
+                          copy=copy)
 
     def astype(self, dtype, casting: str = "unsafe", copy: bool = True):
         dtype = np.dtype(dtype)
@@ -267,6 +268,7 @@ class dia_array(CompressedBase):
 
 
 class dia_matrix(dia_array):
+    _is_spmatrix = True
     def __pow__(self, n):
         # spmatrix semantics: matrix power.
         from .csr import csr_matrix

@@ -367,3 +367,43 @@ def test_matrix_power_other_formats():
             np.asarray(got.toarray()), (As @ As).toarray()
         )
         assert type(got).__name__ == f"{name}_matrix"
+
+
+def test_dia_csc_arithmetic_surface():
+    As = sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(5, 5))
+    D = lst.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(5, 5))
+    np.testing.assert_allclose(
+        np.asarray((D * 2.0).toarray()), (As * 2).toarray()
+    )
+    assert (D * 2.0).format == "dia"
+    for got, want in [(2.0 * D, As * 2), (-D, -As), (D / 2, As / 2),
+                      (D + D, As + As), (D - D, As - As)]:
+        np.testing.assert_allclose(
+            np.asarray(got.toarray()), want.toarray()
+        )
+    C = D.tocsr().tocsc()
+    np.testing.assert_allclose(
+        np.asarray((C + C).toarray()), (As + As).toarray()
+    )
+
+
+def test_dia_matrix_spmatrix_semantics():
+    As_d = sp.dia_matrix(
+        sp.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(5, 5))
+    )
+    D = lst.diags([1.0, -2.0, 1.0], [-1, 0, 1], shape=(5, 5))
+    M = lst.dia_matrix(D)
+    np.testing.assert_allclose(
+        np.asarray((M * M).toarray()), (As_d * As_d).toarray()
+    )
+    assert type(M * 2.0).__name__ == "dia_matrix"
+    assert type(-M).__name__ == "dia_matrix"
+    x = np.arange(5.0)
+    np.testing.assert_allclose(np.asarray(x * M), x * As_d)
+    assert type(M + M).__name__ == "csr_matrix"
+    assert (-D.astype(np.int32)).dtype == np.int32
+    np.testing.assert_allclose(
+        np.asarray(sum([D, D]).toarray()), (As_d * 2).toarray()
+    )
+    with pytest.raises(NotImplementedError):
+        np.ones((5, 5)) @ D
