@@ -52,6 +52,13 @@ import numpy as np
 PROBE_TIMEOUT_S = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_TIMEOUT", "90"))
 PROBE_RETRIES = int(os.environ.get("LEGATE_SPARSE_TPU_PROBE_RETRIES", "1"))
 
+# Global wall-clock budget: after this many seconds, remaining optional
+# phases are skipped (recorded in the JSON) so the contract line always
+# lands inside the driver's timeout.  The first on-chip capture attempt
+# (2026-07-31) showed tunnel-remote phases can take many minutes each —
+# host->device uploads ride the network tunnel.
+DEADLINE_S = float(os.environ.get("LEGATE_SPARSE_TPU_BENCH_DEADLINE", "1800"))
+
 
 def _probe_accelerator() -> bool:
     """Can a fresh process initialize the default (accelerator) backend
@@ -171,6 +178,17 @@ def _time_spmv_ms(A, x, normalize: bool, k_lo: int, k_hi: int) -> float:
 
 
 def main() -> None:
+    import time as _time_mod
+
+    t_start = _time_mod.perf_counter()
+
+    def past_deadline(result, phase: str) -> bool:
+        elapsed = _time_mod.perf_counter() - t_start
+        if elapsed > DEADLINE_S:
+            result.setdefault("skipped_after_deadline", []).append(phase)
+            return True
+        return False
+
     use_accel = _probe_accelerator()
     if not use_accel:
         from legate_sparse_tpu._platform import pin_cpu
@@ -238,7 +256,8 @@ def main() -> None:
     # Solver evidence in the same JSON line: CG ms/iter on the pde
     # operator (reference examples/pde.py headline).  Two maxiter
     # variants, host-fetch synced; the delta cancels fixed costs.
-    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_CG", "0") != "1":
+    if (os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_CG", "0") != "1"
+            and not past_deadline(result, "cg")):
         try:
             import time as _time
 
@@ -279,7 +298,8 @@ def main() -> None:
         except Exception as e:
             sys.stderr.write(f"bench: cg config failed: {e!r}\n")
 
-    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_IRREGULAR", "0") != "1":
+    if (os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_IRREGULAR", "0") != "1"
+            and not past_deadline(result, "irregular")):
         try:
             A_ir = _irregular_config(sparse, max(n // 16, 1 << 16),
                                      nnz_per_row)
@@ -299,7 +319,8 @@ def main() -> None:
     # mode is pure-Python slow and measures nothing.
     if (platform == "tpu"
             and os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_BSR",
-                               "0") != "1"):
+                               "0") != "1"
+            and not past_deadline(result, "bsr")):
         try:
             from legate_sparse_tpu.bench_timing import loop_ms_per_iter
             from legate_sparse_tpu.ops.bsr import BsrStructure, bsr_pack
@@ -332,7 +353,8 @@ def main() -> None:
     # Banded SpGEMM end-to-end (BASELINE config 4, reference
     # ``examples/spgemm_microbenchmark.py:74-79``).  Host-coupled (nnz
     # size oracle), so wall-time with a true result fetch.
-    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_SPGEMM", "0") != "1":
+    if (os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_SPGEMM", "0") != "1"
+            and not past_deadline(result, "spgemm")):
         try:
             import time as _time
 
@@ -354,7 +376,8 @@ def main() -> None:
     # ``examples/gmg.py:397-417``) through the package-native
     # distributed hierarchy on a 1-device mesh (the same code path that
     # scales out).  Two maxiter variants; the delta cancels fixed costs.
-    if os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_GMG", "0") != "1":
+    if (os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_GMG", "0") != "1"
+            and not past_deadline(result, "gmg")):
         try:
             import time as _time
 
@@ -403,6 +426,7 @@ def main() -> None:
         except Exception as e:
             sys.stderr.write(f"bench: gmg config failed: {e!r}\n")
 
+    result["bench_wall_s"] = round(_time_mod.perf_counter() - t_start, 1)
     print(json.dumps(result))
 
 

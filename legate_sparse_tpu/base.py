@@ -122,6 +122,15 @@ class CsrDelegateMixin:
     def __sub__(self, other):
         return self._flavored(self.tocsr() - other)
 
+    def __rsub__(self, other):
+        if np.isscalar(other) and other == 0:
+            return self.__neg__()
+        # dense - sparse densifies in scipy; keep the explicit-densify
+        # policy used everywhere else on this surface.
+        raise NotImplementedError(
+            "dense - sparse is not supported; densify explicitly"
+        )
+
     def __matmul__(self, other):
         return self.tocsr() @ other
 

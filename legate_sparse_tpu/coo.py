@@ -192,8 +192,10 @@ class coo_array(CsrDelegateMixin):
         semantics)."""
         return self.tocsr().multiply(other).asformat("coo")
 
-    def __rmul__(self, other):
-        return self.__mul__(other)   # element-wise * commutes
+    # __rmul__ intentionally NOT overridden: CsrDelegateMixin.__rmul__
+    # routes scalars back here and handles the spmatrix x*A = x@A case
+    # (a local "element-wise commutes" override silently computed A@x
+    # for coo_matrix).
 
     def __neg__(self):
         return self * -1.0

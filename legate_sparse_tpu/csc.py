@@ -195,8 +195,8 @@ class csc_array(CsrDelegateMixin):
         returns the operand's own format)."""
         return self.tocsr().multiply(other).tocsc()
 
-    def __rmul__(self, other):
-        return self.__mul__(other)   # element-wise * commutes
+    # __rmul__ intentionally NOT overridden: CsrDelegateMixin.__rmul__
+    # routes scalars back here and handles the spmatrix x*A = x@A case.
 
     def __neg__(self):
         return self * -1.0

@@ -902,6 +902,13 @@ class csr_array(CompressedBase, DenseSparseBase):
             return self._with_data(self._data * other[row_ids, self._indices])
         if other.ndim == 1 and other.shape[0] == self.shape[1]:
             return self._with_data(self._data * other[self._indices])
+        # scipy broadcasting: a (1, n) row or (m, 1) column vector
+        # scales columns / rows without densifying.
+        if other.ndim == 2 and other.shape == (1, self.shape[1]):
+            return self._with_data(self._data * other[0, self._indices])
+        if other.ndim == 2 and other.shape == (self.shape[0], 1):
+            row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
+            return self._with_data(self._data * other[row_ids, 0])
         raise ValueError(f"inconsistent shapes for multiply: {other.shape}")
 
     def __mul__(self, other):
@@ -1054,6 +1061,8 @@ class csr_array(CompressedBase, DenseSparseBase):
 
     def _add_sub(self, other, sign):
         if not isinstance(other, csr_array):
+            if np.isscalar(other) and other == 0:
+                return self.copy()   # sum()/accumulate start at 0
             if _is_scipy_sparse(other):
                 other = csr_array(other)
             elif _is_sparse_like(other):

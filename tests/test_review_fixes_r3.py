@@ -76,3 +76,47 @@ def test_pointwise_get_duplicates_summed():
     A = sparse.csr_array((v, (r, c)), shape=(4, 5))
     got = A._pointwise_get(np.array([1, 2, 0]), np.array([3, 0, 0]))
     np.testing.assert_allclose(got, [7.0, 1.0, 0.0])
+
+
+# ---- round-3 mid-round review findings ----
+
+@pytest.mark.parametrize("fmt", ["csr", "coo", "csc", "dia"])
+def test_spmatrix_rmul_is_vec_matmul(fmt):
+    # x * M for *_matrix flavors is x @ M (scipy spmatrix semantics);
+    # coo/csc used to shadow the mixin and silently compute M @ x.
+    rng = np.random.default_rng(0)
+    D = rng.standard_normal((5, 7)).astype(np.float32)
+    D[D < 0.3] = 0
+    x = rng.standard_normal(5).astype(np.float32)
+    S = getattr(sp, fmt + "_matrix")(D)
+    M = getattr(sparse, fmt + "_matrix")(sparse.csr_array(D).asformat(fmt))
+    np.testing.assert_allclose(np.asarray(x * M).ravel(),
+                               np.asarray(x * S).ravel(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("fmt", ["csr", "coo", "csc", "dia"])
+def test_sum_list_and_rsub_zero(fmt):
+    # sum([A, B]) hits 0 + A -> __radd__(0); 0 - A must negate.
+    rng = np.random.default_rng(1)
+    D = rng.standard_normal((6, 4)).astype(np.float32)
+    D[D < 0.2] = 0
+    A = sparse.csr_array(D).asformat(fmt)
+    np.testing.assert_allclose(sum([A, A]).toarray(), 2 * D, rtol=1e-5)
+    np.testing.assert_allclose((0 - A).toarray(), -D, rtol=1e-6)
+    with pytest.raises(NotImplementedError):
+        _ = np.ones_like(D) - A
+
+
+def test_multiply_broadcast_row_col_vectors():
+    # scipy multiply broadcasts (1, n) and (m, 1) without densifying.
+    rng = np.random.default_rng(2)
+    D = rng.standard_normal((5, 7)).astype(np.float32)
+    D[D < 0.3] = 0
+    row = rng.standard_normal(7).astype(np.float32)
+    col = rng.standard_normal(5).astype(np.float32)
+    A = sparse.csr_array(D)
+    S = sp.csr_array(D)
+    np.testing.assert_allclose(A.multiply(row[None, :]).toarray(),
+                               S.multiply(row[None, :]).toarray(), rtol=1e-5)
+    np.testing.assert_allclose(A.multiply(col[:, None]).toarray(),
+                               S.multiply(col[:, None]).toarray(), rtol=1e-5)

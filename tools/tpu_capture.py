@@ -3,17 +3,21 @@
 """One-shot TPU evidence capture: run when the chip is reachable.
 
 Probes the accelerator (bounded subprocess, one real op round trip),
-then records in sequence:
+then records in sequence (most-important-first, so a tunnel drop or
+timeout mid-run still keeps everything already measured):
 1. bench.py JSON line (the driver-contract metric),
-2. the @pytest.mark.tpu smoke lane ON the chip
-   (LEGATE_SPARSE_TPU_TEST_PLATFORM=tpu),
-3. SpMV kernel shoot-out: Pallas DIA vs XLA DIA vs XLA ELL,
+2. SpMV kernel shoot-out: Pallas DIA vs XLA DIA vs XLA ELL,
    loop-delta timed (block_until_ready lies on this tunnel — see
    ``legate_sparse_tpu/bench_timing.py``),
-4. CG ms/iter on the pde operator (2048^2 grid, f32).
+3. the @pytest.mark.tpu smoke lane ON the chip
+   (LEGATE_SPARSE_TPU_TEST_PLATFORM=tpu),
+4. SpGEMM end-to-end,
+5. CG ms/iter on the pde operator (2048^2 grid, f32).
 
-Appends everything to TPU_EVIDENCE.md with a timestamp so perf claims
-in the repo are backed by recorded runs.
+Every phase's result is APPENDED TO TPU_EVIDENCE.md THE MOMENT IT
+FINISHES (the first capture attempt on 2026-07-31 buffered all phases
+in memory and lost 90 minutes of on-chip data to the outer timeout),
+with per-phase wall times so slow-tunnel behavior is itself recorded.
 
 Usage: python tools/tpu_capture.py  (from the repo root)
 """
@@ -21,10 +25,10 @@ Usage: python tools/tpu_capture.py  (from the repo root)
 from __future__ import annotations
 
 import datetime
-import json
 import os
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "TPU_EVIDENCE.md")
@@ -44,17 +48,67 @@ def probe(timeout_s: int = 90) -> bool:
         return False
 
 
-def run(cmd, timeout_s, env_extra=None):
+def append(text: str) -> None:
+    if not os.path.exists(OUT):
+        text = ("# TPU evidence log\n\nRecorded runs on the real chip "
+                "backing the perf claims in README.md / code comments.\n"
+                + text)
+    with open(OUT, "a") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def run_phase(title: str, cmd, timeout_s, env_extra=None,
+              tail_lines: int | None = None) -> int:
     env = dict(os.environ)
     if env_extra:
         env.update(env_extra)
+    t0 = time.perf_counter()
     try:
         r = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
                            text=True, cwd=ROOT, env=env)
-        return r.returncode, r.stdout[-4000:], r.stderr[-2000:]
-    except subprocess.TimeoutExpired:
-        return 124, "", "timeout"
+        rc, out, err = r.returncode, r.stdout[-4000:], r.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        # Keep whatever the phase printed before the timeout — phases
+        # print partial JSON mid-script for exactly this case.
+        def _txt(b):
+            if b is None:
+                return ""
+            return b.decode("utf-8", "replace") if isinstance(b, bytes) else b
+        rc = 124
+        out = _txt(e.stdout)[-4000:]
+        err = (_txt(e.stderr)[-1500:] + f"\ntimeout after {timeout_s}s")
+    dt = time.perf_counter() - t0
+    body = out.strip()
+    if tail_lines is not None:
+        body = "\n".join(body.splitlines()[-tail_lines:])
+    block = (f"### {title} (rc={rc}, wall={dt:.0f}s)\n"
+             f"```json\n{body}\n```\n")
+    if rc != 0:
+        block += f"stderr: `{err[-600:]}`\n"
+    append(block)
+    print(f"{title}: rc={rc} wall={dt:.0f}s", flush=True)
+    return rc
 
+
+# Tunnel characterization: upload bandwidth + dispatch/fetch latency,
+# so phase budgets below are explainable from first principles.
+TUNNEL_PROBE = r"""
+import json, time
+import numpy as np, jax, jax.numpy as jnp
+res = {"platform": jax.devices()[0].platform}
+z = jnp.zeros((8, 128)); float(z.sum())  # backend warm
+t0 = time.perf_counter(); float(jnp.ones((1,)).sum())
+res["scalar_fetch_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+for mb in (16, 64):
+    a = np.ones((mb << 20) // 4, np.float32)
+    t0 = time.perf_counter()
+    d = jax.device_put(a); float(d[-1])
+    dt = time.perf_counter() - t0
+    res[f"upload_{mb}mb_mbps"] = round(mb / dt, 1)
+print(json.dumps(res))
+"""
 
 KERNEL_TIMING = r"""
 import json
@@ -116,7 +170,7 @@ import legate_sparse_tpu as sparse
 
 res = {"platform": jax.devices()[0].platform}
 
-def end_to_end_ms(f, reps=3):
+def end_to_end_ms(f, reps=2):
     # SpGEMM is host-coupled (nnz size oracle blocks), so time the
     # whole user-visible call with a true result fetch; best-of-reps
     # after a warmup.  Includes ~one RPC round trip of fixed cost.
@@ -137,6 +191,7 @@ diags = [np.full(n - abs(o), val, dtype=np.float32) for o in offs]
 A = sparse.diags(diags, offs, shape=(n, n), format="csr", dtype=np.float32)
 res["banded_n"] = n
 res["banded_spgemm_ms"] = end_to_end_ms(lambda: A @ A)
+print(json.dumps(res))
 
 m = 1 << 17
 rng = np.random.default_rng(0)
@@ -180,11 +235,11 @@ def timed(maxiter):
             best = min(best, time.perf_counter() - t0)
     return best
 
-dt, dt2 = timed(200), timed(400)
+dt, dt2 = timed(100), timed(300)
 if dt2 <= dt:
     print(json.dumps({"grid": f"{N}x{N}", "rows": n,
                       "error": "unresolvable timing",
-                      "t200_s": round(dt, 4), "t400_s": round(dt2, 4)}))
+                      "t100_s": round(dt, 4), "t300_s": round(dt2, 4)}))
 else:
     per_iter = (dt2 - dt) / 200    # fixed dispatch+fetch cost cancels
     print(json.dumps({"grid": f"{N}x{N}", "rows": n,
@@ -198,43 +253,28 @@ def main() -> None:
     if not probe():
         print(f"{stamp}: TPU unreachable; nothing recorded")
         sys.exit(1)
-    lines = [f"\n## Capture {stamp}\n"]
+    append(f"\n## Capture {stamp}\n")
 
-    rc, out, err = run([sys.executable, "bench.py"], 1800)
-    lines.append(f"### bench.py (rc={rc})\n```json\n{out.strip()}\n```\n")
-    if rc != 0:
-        lines.append(f"stderr: `{err[-500:]}`\n")
+    run_phase("tunnel characterization",
+              [sys.executable, "-c", TUNNEL_PROBE], 600)
 
-    rc, out, err = run(
-        [sys.executable, "-m", "pytest", "-m", "tpu", "tests/", "-q"],
-        900, env_extra={"LEGATE_SPARSE_TPU_TEST_PLATFORM": "tpu"},
-    )
-    tail = "\n".join(out.strip().splitlines()[-3:])
-    lines.append(f"### tpu smoke lane (rc={rc})\n```\n{tail}\n```\n")
-    if rc != 0:
-        lines.append(f"stderr: `{err[-500:]}`\n")
+    run_phase("bench.py", [sys.executable, "bench.py"], 2700)
 
-    rc, out, err = run([sys.executable, "-c", KERNEL_TIMING], 1800)
-    lines.append(f"### kernel timings (rc={rc})\n```json\n{out.strip()}\n```\n")
-    if rc != 0:
-        lines.append(f"stderr: `{err[-500:]}`\n")
+    run_phase("kernel timings 2^22",
+              [sys.executable, "-c", KERNEL_TIMING], 1500)
 
-    rc, out, err = run([sys.executable, "-c", CG_TIMING], 1800)
-    lines.append(f"### CG pde 2048^2 f32 (rc={rc})\n```json\n{out.strip()}\n```\n")
-    if rc != 0:
-        lines.append(f"stderr: `{err[-500:]}`\n")
+    run_phase("tpu smoke lane",
+              [sys.executable, "-m", "pytest", "-m", "tpu", "tests/", "-q"],
+              1200,
+              env_extra={"LEGATE_SPARSE_TPU_TEST_PLATFORM": "tpu"},
+              tail_lines=3)
 
-    rc, out, err = run([sys.executable, "-c", SPGEMM_TIMING], 1800)
-    lines.append(f"### SpGEMM end-to-end (rc={rc})\n```json\n{out.strip()}\n```\n")
-    if rc != 0:
-        lines.append(f"stderr: `{err[-500:]}`\n")
+    run_phase("SpGEMM end-to-end",
+              [sys.executable, "-c", SPGEMM_TIMING], 1500)
 
-    header = "" if os.path.exists(OUT) else (
-        "# TPU evidence log\n\nRecorded runs on the real chip backing "
-        "the perf claims in README.md / code comments.\n"
-    )
-    with open(OUT, "a") as f:
-        f.write(header + "".join(lines))
+    run_phase("CG pde 2048^2 f32",
+              [sys.executable, "-c", CG_TIMING], 1500)
+
     print(f"recorded -> {OUT}")
 
 

@@ -130,8 +130,11 @@ def main() -> None:
     if not ok:
         print(f"{stamp}: TPU unreachable; nothing recorded")
         sys.exit(1)
+    inner_timeout = int(os.environ.get(
+        "LEGATE_SPARSE_TPU_SHOOTOUT_TIMEOUT", "3000"))
     try:
-        r = subprocess.run([sys.executable, "-c", SHOOTOUT], timeout=3600,
+        r = subprocess.run([sys.executable, "-c", SHOOTOUT],
+                           timeout=inner_timeout,
                            capture_output=True, text=True, cwd=ROOT)
         rc, out, err = r.returncode, r.stdout[-6000:], r.stderr[-2000:]
     except subprocess.TimeoutExpired:
