@@ -682,9 +682,14 @@ def norm(A, ord=None, axis=None):
     raise ValueError(f"Invalid norm order {ord!r} for vectors")
 
 
+# Device-native eigensolvers (module attributes take priority over the
+# __getattr__ fallback below, so these shadow the host-scipy versions).
+from .eigen import eigsh, lobpcg, svds  # noqa: E402
+
+
 def __getattr__(name):
     """scipy.sparse.linalg fallback for names without a native
-    implementation (spsolve, splu, eigsh, lsqr, expm, ...): host-side
+    implementation (spsolve, splu, lsqr, expm, ...): host-side
     scipy with this package's arrays converted at the boundary.  The
     reference offers no fallback here at all (its linalg is cg/gmres
     only); a drop-in replacement must not strand the rest of a user's

@@ -1,31 +1,40 @@
 #!/bin/bash
 # Probes the accelerator tunnel every 3 min; touches /tmp/tpu_alive when
 # up and — the part that matters — fires tools/round3_capture.sh the
-# first time a probe answers.  One-shot: after a capture chain COMPLETES
-# (marker file), later alive probes just log.  A stale lock (watcher or
-# capture killed mid-run) is reclaimed after 4h so an interrupted run
-# retries on the next window.  The capture tool appends each phase's
-# result to TPU_EVIDENCE.md as it finishes, so even a short tunnel
-# window records something.
+# first time a probe answers.  One-shot: after a capture chain records
+# on-chip data (exit 0 -> marker file), later alive probes just log.
+#
+# Lock protocol: the lock dir carries the owner watcher's PID.  A lock
+# is reclaimed only when that owner is dead AND no round3_capture.sh
+# process is still running (a killed watcher can orphan a live capture
+# chain — reclaiming under it would interleave two captures).  The EXIT
+# trap removes the lock only if this process owns it.
 cd "$(dirname "$0")/.."
 mkdir -p evidence
 LOCK=/tmp/tpu_capture.lock
 DONE=/tmp/tpu_capture.done
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+cleanup() {
+  if [ "$(cat "$LOCK/pid" 2>/dev/null)" = "$$" ]; then
+    rm -rf "$LOCK"
+  fi
+}
+trap cleanup EXIT
 while true; do
   if timeout 60 python -c "import jax, jax.numpy as jnp; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds; assert float(jnp.ones((8, 128)).sum()) == 1024.0" 2>/dev/null; then
     date -u +"%Y-%m-%dT%H:%M:%SZ alive" >> /tmp/tpu_watch.log
     touch /tmp/tpu_alive
     if [ ! -e "$DONE" ]; then
-      # Reclaim a lock older than 4h: its owner is dead or wedged.
-      if [ -d "$LOCK" ] && [ -n "$(find "$LOCK" -maxdepth 0 -mmin +240 2>/dev/null)" ]; then
-        rmdir "$LOCK" 2>/dev/null
+      owner=$(cat "$LOCK/pid" 2>/dev/null)
+      if [ -d "$LOCK" ] && [ -n "$owner" ] && ! kill -0 "$owner" 2>/dev/null \
+         && ! pgrep -f "tools/round3_capture.sh" >/dev/null 2>&1; then
+        rm -rf "$LOCK"   # dead owner, no orphaned capture: reclaim
       fi
       if mkdir "$LOCK" 2>/dev/null; then
+        echo $$ > "$LOCK/pid"
         if bash tools/round3_capture.sh >> evidence/round3_capture.log 2>&1; then
           touch "$DONE"
         fi
-        rmdir "$LOCK" 2>/dev/null
+        rm -rf "$LOCK"
       fi
     fi
   else
