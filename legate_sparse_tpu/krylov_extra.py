@@ -1,0 +1,315 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Device-native MINRES and LSQR.
+
+Same design as the cg/gmres/bicgstab family in ``linalg.py`` (reference
+has neither solver — its linalg surface is cg/gmres only): the whole
+solve is ONE jitted ``lax.while_loop`` with no host sync per iteration,
+tolerances and iteration budgets carried as dynamic state so retuned
+solves reuse the compiled loop.
+
+- ``minres``: Paige & Saunders Lanczos + Givens QR for symmetric
+  (possibly indefinite) systems, optional SPD preconditioner M and
+  diagonal ``shift`` (solves ``(A - shift*I) x = b``).
+- ``lsqr``: Golub-Kahan bidiagonalization for least-squares /
+  rectangular systems with Tikhonov ``damp``; needs matvec + rmatvec
+  (both live on device — for sparse operands rmatvec is the cached
+  transpose SpMV).
+
+Scalar recurrences (Givens coefficients, norm estimates) are O(1) per
+step and fuse into the matvec program; the MXU/VPU work stays the SpMV.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["minres", "lsqr"]
+
+
+def _sym_ortho(a, b):
+    """Stable Givens rotation (c, s, r) with r = hypot(a, b)."""
+    r = jnp.hypot(a, b)
+    safe = jnp.where(r == 0, jnp.ones_like(r), r)
+    c = jnp.where(r == 0, jnp.ones_like(a), a / safe)
+    s = jnp.where(r == 0, jnp.zeros_like(b), b / safe)
+    return c, s, r
+
+
+# ------------------------------------------------------------------ MINRES
+
+
+def _minres_loop(A_mv, M_mv, b, x0, shift, atol, maxiter,
+                 conv_test_iters: int):
+    dtype = b.dtype
+    rdt = jnp.real(b).dtype
+
+    def op(v):
+        return A_mv(v) - shift * v
+
+    r1 = b - op(x0)
+    y = M_mv(r1)
+    beta1 = jnp.sqrt(jnp.maximum(jnp.real(jnp.vdot(r1, y)), 0)).astype(rdt)
+
+    def cond(st):
+        return jnp.logical_and(st["iters"] < st["miter"],
+                               jnp.logical_not(st["done"]))
+
+    def body(st):
+        iters = st["iters"] + 1
+        safe_beta = jnp.where(st["beta"] == 0, 1.0, st["beta"])
+        v = st["y"] / safe_beta.astype(dtype)
+        y = op(v)
+        y = y - (st["beta"] / jnp.where(st["oldb"] == 0, 1.0,
+                                        st["oldb"])).astype(dtype) \
+            * jnp.where(st["iters"] == 0, jnp.zeros_like(y), st["r1"])
+        alfa = jnp.real(jnp.vdot(v, y)).astype(rdt)
+        y = y - (alfa / safe_beta).astype(dtype) * st["r2"]
+        r1, r2 = st["r2"], y
+        y = M_mv(r2)
+        oldb = st["beta"]
+        beta = jnp.sqrt(jnp.maximum(jnp.real(jnp.vdot(r2, y)), 0)) \
+            .astype(rdt)
+
+        # Givens QR update of the tridiagonal.
+        oldeps = st["epsln"]
+        delta = st["cs"] * st["dbar"] + st["sn"] * alfa
+        gbar = st["sn"] * st["dbar"] - st["cs"] * alfa
+        epsln = st["sn"] * beta
+        dbar = -st["cs"] * beta
+        cs, sn, gamma = _sym_ortho(gbar, beta)
+        gamma = jnp.maximum(gamma, jnp.finfo(rdt).eps)
+        phi = cs * st["phibar"]
+        phibar = sn * st["phibar"]
+
+        # Solution update.
+        denom = (1.0 / gamma).astype(dtype)
+        w1, w2 = st["w2"], st["w"]
+        w = (v - oldeps.astype(dtype) * w1 - delta.astype(dtype) * w2) \
+            * denom
+        x = st["x"] + phi.astype(dtype) * w
+
+        check = jnp.logical_or(iters % conv_test_iters == 0,
+                               iters >= st["miter"] - 1)
+        done = jnp.logical_or(
+            st["done"],
+            jnp.logical_and(check, phibar <= st["atol"]))
+        return dict(x=x, r1=r1, r2=r2, y=y, w=w, w2=w2, oldb=oldb,
+                    beta=beta, dbar=dbar, epsln=epsln, phibar=phibar,
+                    cs=cs, sn=sn, iters=iters, done=done,
+                    atol=st["atol"], miter=st["miter"])
+
+    st0 = dict(
+        x=x0, r1=r1, r2=r1, y=y,
+        w=jnp.zeros_like(b), w2=jnp.zeros_like(b),
+        oldb=jnp.zeros((), rdt), beta=beta1,
+        dbar=jnp.zeros((), rdt), epsln=jnp.zeros((), rdt),
+        phibar=beta1,
+        cs=jnp.asarray(-1.0, rdt), sn=jnp.zeros((), rdt),
+        iters=jnp.asarray(0, jnp.int64),
+        done=jnp.asarray(beta1 == 0),
+        atol=jnp.asarray(atol, rdt),
+        miter=jnp.asarray(maxiter, jnp.int64),
+    )
+    out = jax.lax.while_loop(cond, body, st0)
+    return out["x"], out["iters"]
+
+
+def minres(A, b, x0=None, *, shift=0.0, tol=None, maxiter=None, M=None,
+           callback=None, rtol=1e-5, atol=0.0, conv_test_iters: int = 25,
+           **kwargs):
+    """MINRES for symmetric (indefinite OK) ``(A - shift I) x = b``
+    (scipy-shaped; returns ``(x, iters)`` like this package's cg).
+
+    The preconditioner M must be SPD (scipy's requirement too).  Whole
+    solve is one jitted while_loop; ``callback``/diagnostic kwargs
+    (``show``/``check``) delegate to host scipy.
+    """
+    from .coverage import scipy_fallback
+    from .linalg import (IdentityOperator, _get_atol_rtol,
+                         make_linear_operator)
+
+    if callback is not None or kwargs:
+        import scipy.sparse.linalg as _ssl
+
+        # Keep the native return convention (x, iters) — count the
+        # callback invocations instead of surfacing scipy's info code.
+        count = [0]
+
+        def counting_callback(xk):
+            count[0] += 1
+            callback(xk)
+
+        x_out, _info = scipy_fallback(_ssl.minres, "linalg.minres")(
+            A, b, x0=x0, shift=shift, maxiter=maxiter, M=M,
+            callback=counting_callback,
+            rtol=(tol if tol is not None else rtol), **kwargs)
+        return x_out, count[0]
+
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    n = b.shape[0]
+    A_op = make_linear_operator(A)
+    M_op = (IdentityOperator(A_op.shape, dtype=A_op.dtype)
+            if M is None else make_linear_operator(M))
+    bnrm = float(jnp.linalg.norm(b))
+    atol, _ = _get_atol_rtol(bnrm, tol, atol, rtol)
+    if maxiter is None:
+        maxiter = 5 * n
+    x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+         else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+    shift = jnp.asarray(shift, dtype=b.dtype)
+    return _minres_loop(A_op.matvec, M_op.matvec, b, x, shift,
+                        atol, int(maxiter), int(conv_test_iters))
+
+
+# -------------------------------------------------------------------- LSQR
+
+
+def _lsqr_loop(A_mv, At_mv, b, x0, damp, atol, btol, maxiter,
+               conv_test_iters: int):
+    dtype = b.dtype
+    rdt = jnp.real(b).dtype
+    eps = jnp.finfo(rdt).eps
+
+    def normalize(v):
+        nrm = jnp.linalg.norm(v).astype(rdt)
+        return v / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+
+    u0 = b - A_mv(x0)
+    u, beta0 = normalize(u0)
+    v, alfa0 = normalize(At_mv(u))
+
+    def cond(st):
+        return jnp.logical_and(st["iters"] < st["miter"],
+                               jnp.logical_not(st["done"]))
+
+    def body(st):
+        iters = st["iters"] + 1
+        # Bidiagonalization step.
+        u, beta = normalize(A_mv(st["v"]) - st["alfa"].astype(dtype)
+                            * st["u"])
+        v, alfa = normalize(At_mv(u) - beta.astype(dtype) * st["v"])
+
+        # Eliminate the damping term.
+        rhobar1 = jnp.sqrt(st["rhobar"] ** 2 + st["damp"] ** 2)
+        cs1 = st["rhobar"] / jnp.where(rhobar1 == 0, 1.0, rhobar1)
+        sn1 = st["damp"] / jnp.where(rhobar1 == 0, 1.0, rhobar1)
+        psi = sn1 * st["phibar"]
+        phibar1 = cs1 * st["phibar"]
+
+        # Givens rotation on the bidiagonal.
+        cs, sn, rho = _sym_ortho(rhobar1, beta)
+        rho_safe = jnp.where(rho == 0, 1.0, rho)
+        theta = sn * alfa
+        rhobar = -cs * alfa
+        phi = cs * phibar1
+        phibar = sn * phibar1
+
+        x = st["x"] + (phi / rho_safe).astype(dtype) * st["w"]
+        w = v - (theta / rho_safe).astype(dtype) * st["w"]
+
+        # Norm estimates (Frobenius accumulation).
+        anorm = jnp.sqrt(st["anorm2"])
+        anorm2 = st["anorm2"] + st["alfa"] ** 2 + beta ** 2 \
+            + st["damp"] ** 2
+        rnorm = jnp.sqrt(phibar ** 2 + st["psi2"] + psi ** 2)
+        psi2 = st["psi2"] + psi ** 2
+        arnorm = alfa * jnp.abs(sn * phi)
+        xnorm = jnp.linalg.norm(x).astype(rdt)
+
+        # scipy stopping rules 1 & 2 (recorded so the caller can report
+        # which one fired as istop).
+        check = jnp.logical_or(iters % conv_test_iters == 0,
+                               iters >= st["miter"] - 1)
+        tol1 = st["btol"] * st["bnorm"] + st["atol"] * anorm * xnorm
+        stop1 = jnp.logical_or(st["stop1"],
+                               jnp.logical_and(check, rnorm <= tol1))
+        stop2 = jnp.logical_or(
+            st["stop2"],
+            jnp.logical_and(check,
+                            arnorm <= st["atol"] * anorm * rnorm + eps))
+        done = jnp.logical_or(st["done"], jnp.logical_or(stop1, stop2))
+        return dict(x=x, u=u, v=v, w=w, alfa=alfa, rhobar=rhobar,
+                    phibar=phibar, anorm2=anorm2, psi2=psi2,
+                    rnorm=rnorm, arnorm=arnorm, xnorm=xnorm,
+                    iters=iters, done=done, stop1=stop1, stop2=stop2,
+                    damp=st["damp"],
+                    atol=st["atol"], btol=st["btol"],
+                    bnorm=st["bnorm"], miter=st["miter"])
+
+    st0 = dict(
+        x=x0, u=u, v=v, w=v,
+        alfa=alfa0, rhobar=alfa0, phibar=beta0,
+        anorm2=jnp.zeros((), rdt), psi2=jnp.zeros((), rdt),
+        rnorm=beta0, arnorm=alfa0 * beta0,
+        xnorm=jnp.linalg.norm(x0).astype(rdt),
+        iters=jnp.asarray(0, jnp.int64),
+        done=jnp.asarray(jnp.logical_or(beta0 == 0, alfa0 == 0)),
+        stop1=jnp.asarray(False), stop2=jnp.asarray(False),
+        damp=jnp.asarray(damp, rdt),
+        atol=jnp.asarray(atol, rdt), btol=jnp.asarray(btol, rdt),
+        bnorm=jnp.linalg.norm(b).astype(rdt),
+        miter=jnp.asarray(maxiter, jnp.int64),
+    )
+    out = jax.lax.while_loop(cond, body, st0)
+    return out
+
+
+def lsqr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
+         iter_lim=None, show=False, calc_var=False, x0=None,
+         conv_test_iters: int = 10):
+    """Least-squares solve of ``min ||A x - b||^2 + damp^2 ||x||^2``
+    (scipy ``lsqr``; Golub-Kahan bidiagonalization).
+
+    Returns the scipy-shaped 10-tuple ``(x, istop, itn, r1norm, r2norm,
+    anorm, acond, arnorm, xnorm, var)``.  ``acond`` is not estimated
+    (returned 0 — scipy's value is itself an estimate); ``var`` is
+    zeros(n) as with scipy's ``calc_var=False``, and ``calc_var=True``
+    delegates to host scipy.
+    """
+    from .coverage import scipy_fallback
+    from .linalg import make_linear_operator
+
+    if calc_var or show:
+        import scipy.sparse.linalg as _ssl
+
+        return scipy_fallback(_ssl.lsqr, "linalg.lsqr")(
+            A, b, damp=damp, atol=atol, btol=btol, conlim=conlim,
+            iter_lim=iter_lim, show=show, calc_var=calc_var, x0=x0)
+
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    A_op = make_linear_operator(A)
+    m, n = A_op.shape
+    if iter_lim is None:
+        iter_lim = 2 * n
+    x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+         else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+    if float(jnp.linalg.norm(b)) == 0.0:
+        # scipy: b = 0 yields the exact solution x = 0, istop = 0.
+        return (np.zeros(n, dtype=np.asarray(b).dtype), 0, 0, 0.0, 0.0,
+                0.0, 0.0, 0.0, 0.0, np.zeros(n))
+    out = _lsqr_loop(A_op.matvec, A_op.rmatvec, b, x, float(damp),
+                     float(atol), float(btol), int(iter_lim),
+                     int(conv_test_iters))
+    itn = int(out["iters"])
+    r2norm = float(out["rnorm"])
+    psi2 = float(out["psi2"])
+    r1norm = float(np.sqrt(max(r2norm ** 2 - psi2, 0.0)))
+    # scipy istop: 1 = Ax=b solved to tolerance (rule 1), 2 = least-
+    # squares solution found (rule 2), 7 = iteration limit.
+    if bool(out["stop1"]):
+        istop = 1
+    elif bool(out["stop2"]):
+        istop = 2
+    else:
+        istop = 7
+    return (np.asarray(out["x"]), istop, itn, r1norm, r2norm,
+            float(np.sqrt(out["anorm2"])), 0.0, float(out["arnorm"]),
+            float(out["xnorm"]), np.zeros(n))

@@ -682,14 +682,16 @@ def norm(A, ord=None, axis=None):
     raise ValueError(f"Invalid norm order {ord!r} for vectors")
 
 
-# Device-native eigensolvers (module attributes take priority over the
-# __getattr__ fallback below, so these shadow the host-scipy versions).
+# Device-native eigensolvers and extra Krylov solvers (module
+# attributes take priority over the __getattr__ fallback below, so
+# these shadow the host-scipy versions).
 from .eigen import eigsh, lobpcg, svds  # noqa: E402
+from .krylov_extra import lsqr, minres  # noqa: E402
 
 
 def __getattr__(name):
     """scipy.sparse.linalg fallback for names without a native
-    implementation (spsolve, splu, lsqr, expm, ...): host-side
+    implementation (spsolve, splu, expm, lsmr, ...): host-side
     scipy with this package's arrays converted at the boundary.  The
     reference offers no fallback here at all (its linalg is cg/gmres
     only); a drop-in replacement must not strand the rest of a user's

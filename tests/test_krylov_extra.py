@@ -1,0 +1,113 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Device-native MINRES / LSQR vs scipy (krylov_extra.py).
+
+The reference's solver family is cg/gmres only (reference
+``legate_sparse/linalg.py``); these extend it with the symmetric-
+indefinite and least-squares solvers, differential-tested like
+test_cg_solve.py.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.linalg as ssl
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+
+
+def _indefinite(n, seed=0):
+    rng = np.random.default_rng(seed)
+    d = rng.standard_normal(n) * 3
+    A_sp = sp.diags([np.full(n - 1, 1.0), d, np.full(n - 1, 1.0)],
+                    [-1, 0, 1], format="csr")
+    return A_sp, sparse.csr_array(A_sp), rng.standard_normal(n)
+
+
+def test_minres_symmetric_indefinite():
+    A_sp, A, b = _indefinite(300)
+    x, it = linalg.minres(A, b, rtol=1e-10, maxiter=3000)
+    res = np.linalg.norm(A_sp @ np.asarray(x) - b) / np.linalg.norm(b)
+    assert res < 1e-8
+    assert int(it) <= 3000
+
+
+def test_minres_shift():
+    A_sp, A, b = _indefinite(200, seed=1)
+    x, _ = linalg.minres(A, b, shift=0.5, rtol=1e-10, maxiter=3000)
+    res = np.linalg.norm((A_sp - 0.5 * sp.eye(200)) @ np.asarray(x) - b)
+    assert res / np.linalg.norm(b) < 1e-8
+
+
+def test_minres_preconditioned():
+    A_sp, A, b = _indefinite(300)
+    d = A_sp.diagonal()
+    Minv = sparse.csr_array(
+        sp.diags([1.0 / (np.abs(d) + 1.0)], [0], format="csr"))
+    x, _ = linalg.minres(A, b, M=Minv, rtol=1e-10, maxiter=3000)
+    res = np.linalg.norm(A_sp @ np.asarray(x) - b) / np.linalg.norm(b)
+    assert res < 1e-8
+
+
+def test_minres_callback_falls_back():
+    A_sp, A, b = _indefinite(60, seed=2)
+    seen = []
+    x, info = linalg.minres(A, b, rtol=1e-8, maxiter=500,
+                            callback=lambda xk: seen.append(1))
+    assert len(seen) > 0
+    res = np.linalg.norm(A_sp @ np.asarray(x) - b) / np.linalg.norm(b)
+    assert res < 1e-5
+
+
+@pytest.mark.parametrize("damp", [0.0, 0.7])
+def test_lsqr_overdetermined(damp):
+    rng = np.random.default_rng(0)
+    B_sp = (sp.random(400, 120, density=0.05, format="csr",
+                      random_state=rng)
+            + sp.vstack([sp.eye(120), sp.csr_matrix((280, 120))])).tocsr()
+    b = rng.standard_normal(400)
+    out = linalg.lsqr(sparse.csr_array(B_sp), b, damp=damp,
+                      atol=1e-12, btol=1e-12, iter_lim=2000)
+    ref = ssl.lsqr(B_sp, b, damp=damp, atol=1e-12, btol=1e-12,
+                   iter_lim=2000)
+    np.testing.assert_allclose(out[0], ref[0], rtol=1e-6, atol=1e-9)
+    # r2norm agreement (residual incl. damping term).
+    np.testing.assert_allclose(out[4], ref[4], rtol=1e-6)
+
+
+def test_lsqr_underdetermined_and_x0():
+    rng = np.random.default_rng(3)
+    B_sp = sp.random(50, 150, density=0.15, format="csr",
+                     random_state=rng)
+    b = rng.standard_normal(50)
+    out = linalg.lsqr(sparse.csr_array(B_sp), b, atol=1e-12, btol=1e-12,
+                      iter_lim=500)
+    # Minimum-norm least squares: residual must match scipy's.
+    ref = ssl.lsqr(B_sp, b, atol=1e-12, btol=1e-12, iter_lim=500)
+    np.testing.assert_allclose(
+        np.linalg.norm(B_sp @ out[0] - b),
+        np.linalg.norm(B_sp @ ref[0] - b), rtol=1e-6, atol=1e-9)
+    # warm start accepted
+    out2 = linalg.lsqr(sparse.csr_array(B_sp), b, x0=out[0],
+                       atol=1e-12, btol=1e-12, iter_lim=500)
+    assert out2[2] <= out[2]
+
+
+def test_lsqr_istop_semantics():
+    # istop must mirror scipy: 1 compatible-system, 2 least-squares,
+    # 0 for b = 0, 7 at the iteration limit; var is zeros(n).
+    rng = np.random.default_rng(0)
+    B_sp = (sp.random(400, 120, density=0.05, format="csr",
+                      random_state=rng)
+            + sp.vstack([sp.eye(120), sp.csr_matrix((280, 120))])).tocsr()
+    B = sparse.csr_array(B_sp)
+    b = rng.standard_normal(400)
+    out = linalg.lsqr(B, b, atol=1e-12, btol=1e-12, iter_lim=2000)
+    assert out[1] == 2 and out[9].shape == (120,)
+    bc = B_sp @ rng.standard_normal(120)
+    assert linalg.lsqr(B, bc, atol=1e-10, btol=1e-10,
+                       iter_lim=2000)[1] == 1
+    out0 = linalg.lsqr(B, np.zeros(400))
+    assert out0[1] == 0 and np.all(out0[0] == 0)
+    assert linalg.lsqr(B, b, atol=1e-14, btol=1e-14, iter_lim=3)[1] == 7
