@@ -136,12 +136,14 @@ def minres(A, b, x0=None, *, shift=0.0, tol=None, maxiter=None, M=None,
         import scipy.sparse.linalg as _ssl
 
         # Keep the native return convention (x, iters) — count the
-        # callback invocations instead of surfacing scipy's info code.
+        # iterations via scipy's per-iteration callback hook (also when
+        # the user passed none and we're here for show/check kwargs).
         count = [0]
 
         def counting_callback(xk):
             count[0] += 1
-            callback(xk)
+            if callback is not None:
+                callback(xk)
 
         x_out, _info = scipy_fallback(_ssl.minres, "linalg.minres")(
             A, b, x0=x0, shift=shift, maxiter=maxiter, M=M,
@@ -303,11 +305,14 @@ def lsqr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     psi2 = float(out["psi2"])
     r1norm = float(np.sqrt(max(r2norm ** 2 - psi2, 0.0)))
     # scipy istop: 1 = Ax=b solved to tolerance (rule 1), 2 = least-
-    # squares solution found (rule 2), 7 = iteration limit.
+    # squares solution found (rule 2), 0 = exact at entry (x0 solves
+    # the system, or b orthogonal to range(A)), 7 = iteration limit.
     if bool(out["stop1"]):
         istop = 1
     elif bool(out["stop2"]):
         istop = 2
+    elif itn == 0:
+        istop = 0
     else:
         istop = 7
     return (np.asarray(out["x"]), istop, itn, r1norm, r2norm,

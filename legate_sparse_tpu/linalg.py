@@ -185,9 +185,14 @@ class IdentityOperator(LinearOperator):
 
 def make_linear_operator(A) -> LinearOperator:
     """Promote matrices/callables to LinearOperator (reference
-    ``linalg.py:417-431``)."""
+    ``linalg.py:417-431``).  scipy sparse operands convert to the
+    package's csr so every native solver accepts them directly."""
+    from .csr import _is_scipy_sparse
+
     if isinstance(A, LinearOperator):
         return A
+    if _is_scipy_sparse(A):
+        A = csr_array(A)
     if is_sparse_matrix(A):
         if not isinstance(A, csr_array):
             A = A.tocsr()

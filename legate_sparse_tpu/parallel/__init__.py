@@ -26,6 +26,7 @@ from .dist_csr import (  # noqa: F401
     dist_cg,
     dist_gmres,
     dist_bicgstab,
+    dist_minres,
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401

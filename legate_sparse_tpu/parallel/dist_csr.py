@@ -1169,6 +1169,28 @@ def dist_bicgstab(A: DistCSR, b, x0=None, tol=None, maxiter=None,
     return x[:rows], info
 
 
+def dist_minres(A: DistCSR, b, x0=None, shift=0.0, tol=None,
+                maxiter=None, M=None, callback=None, atol: float = 0.0,
+                rtol: float = 1e-5, conv_test_iters: int = 25):
+    """Distributed MINRES over the padded sharded system (see
+    ``dist_gmres`` for the padding argument — padded rows are zero rows
+    with zero rhs, and MINRES tolerates the resulting singular-but-
+    consistent system by construction).  For symmetric indefinite
+    operators the reference has no equivalent solver at any scale.
+    Returns ``(x[:rows], iters)``."""
+    from ..linalg import minres as _minres
+
+    rows, b_sh, x0_sh, maxiter, cb = _shard_system(
+        A, b, x0, maxiter, callback
+    )
+    x, info = _minres(
+        _padded_operator(A), b_sh, x0=x0_sh, shift=shift, tol=tol,
+        maxiter=maxiter, M=_padded_precond(M, A), callback=cb,
+        atol=atol, rtol=rtol, conv_test_iters=conv_test_iters,
+    )
+    return x[:rows], info
+
+
 def dist_diagonal(A: DistCSR) -> jax.Array:
     """diag(A) as a row-block sharded padded vector (square A).
 

@@ -72,3 +72,29 @@ def test_dist_gmres_callback_sees_unpadded():
     dist_gmres(dA, b, rtol=1e-8, maxiter=100,
                callback=lambda xk: seen.append(np.asarray(xk).shape))
     assert seen and all(s == (n,) for s in seen)
+
+
+@needs_multi
+def test_dist_minres_symmetric_indefinite():
+    # Symmetric but INDEFINITE banded operator: cg is inapplicable,
+    # minres converges; padded rows stay exactly zero.
+    n = 300
+    rng = np.random.default_rng(2)
+    d = rng.standard_normal(n) * 3
+    A_sp = sp.diags([np.full(n - 1, 1.0), d, np.full(n - 1, 1.0)],
+                    [-1, 0, 1], format="csr")
+    A = sparse.csr_array(A_sp)
+    from legate_sparse_tpu.parallel import dist_minres
+
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    b = rng.standard_normal(n)
+    x, iters = dist_minres(dA, b, rtol=1e-10, maxiter=3000)
+    res = np.linalg.norm(A_sp @ np.asarray(x) - b)
+    assert res <= 1e-7 * np.linalg.norm(b)
+    assert x.shape == (n,)
+
+    # Shifted solve: (A - 0.5 I) x = b.
+    x2, _ = dist_minres(dA, b, shift=0.5, rtol=1e-10, maxiter=3000)
+    res2 = np.linalg.norm((A_sp - 0.5 * sp.eye(n)) @ np.asarray(x2) - b)
+    assert res2 <= 1e-7 * np.linalg.norm(b)

@@ -111,3 +111,41 @@ def test_lsqr_istop_semantics():
     out0 = linalg.lsqr(B, np.zeros(400))
     assert out0[1] == 0 and np.all(out0[0] == 0)
     assert linalg.lsqr(B, b, atol=1e-14, btol=1e-14, iter_lim=3)[1] == 7
+
+
+def test_native_solvers_accept_scipy_sparse():
+    # make_linear_operator converts scipy operands, so native solver
+    # paths (not just the __getattr__ fallback) take them directly.
+    rng = np.random.default_rng(4)
+    n = 120
+    d = rng.standard_normal(n) * 3
+    A_sp = sp.diags([np.full(n - 1, 1.0), d, np.full(n - 1, 1.0)],
+                    [-1, 0, 1], format="csr")
+    b = rng.standard_normal(n)
+    x, _ = linalg.minres(A_sp, b, rtol=1e-9, maxiter=3000)
+    assert np.linalg.norm(A_sp @ np.asarray(x) - b) < 1e-7
+    out = linalg.lsqr(A_sp, b, atol=1e-10, btol=1e-10)
+    assert out[1] in (1, 2)
+    w = linalg.eigsh(A_sp, k=2, which="LA", return_eigenvectors=False)
+    assert w.shape == (2,)
+
+
+def test_minres_diagnostic_kwargs_no_callback():
+    # show/check route through host scipy without a user callback;
+    # the iteration count must still come back.
+    A_sp = sp.diags([np.full(50, 4.0)], [0], format="csr")
+    b = np.ones(50)
+    x, it = linalg.minres(sparse.csr_array(A_sp), b, rtol=1e-8,
+                          maxiter=200, check=True)
+    assert it > 0
+    assert np.linalg.norm(A_sp @ np.asarray(x) - b) < 1e-5
+
+
+def test_lsqr_exact_x0_istop_zero():
+    rng = np.random.default_rng(5)
+    B_sp = sp.random(60, 40, density=0.2, format="csr", random_state=rng)
+    xs = rng.standard_normal(40)
+    b = B_sp @ xs
+    out = linalg.lsqr(sparse.csr_array(B_sp), b, x0=xs,
+                      atol=1e-8, btol=1e-8)
+    assert out[1] == 0 and out[2] == 0
