@@ -691,6 +691,7 @@ def norm(A, ord=None, axis=None):
 # attributes take priority over the __getattr__ fallback below, so
 # these shadow the host-scipy versions).
 from .eigen import eigsh, lobpcg, svds  # noqa: E402
+from .expm import expm_multiply  # noqa: E402
 from .krylov_extra import lsqr, minres  # noqa: E402
 
 

@@ -1177,7 +1177,12 @@ def dist_minres(A: DistCSR, b, x0=None, shift=0.0, tol=None,
     with zero rhs, and MINRES tolerates the resulting singular-but-
     consistent system by construction).  For symmetric indefinite
     operators the reference has no equivalent solver at any scale.
-    Returns ``(x[:rows], iters)``."""
+    Returns ``(x[:rows], iters)``.
+
+    NOTE: passing ``callback`` routes the solve through host scipy's
+    Python iteration loop (one device round trip per iteration) —
+    unlike dist_cg/dist_gmres whose callbacks stay native.  Use it for
+    diagnostics, not production runs."""
     from ..linalg import minres as _minres
 
     rows, b_sh, x0_sh, maxiter, cb = _shard_system(
