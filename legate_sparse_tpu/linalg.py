@@ -693,6 +693,7 @@ def norm(A, ord=None, axis=None):
 from .eigen import eigsh, lobpcg, svds  # noqa: E402
 from .expm import expm_multiply  # noqa: E402
 from .krylov_extra import lsqr, minres  # noqa: E402
+from .precond import block_jacobi, jacobi  # noqa: E402
 
 
 def __getattr__(name):
