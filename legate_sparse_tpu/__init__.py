@@ -36,4 +36,14 @@ __version__ = "25.07.0"
 # namespace-complete (reference ``__init__.py:26``).
 clone_module(_scipy_sparse, globals())
 
+# clone_module re-exported scipy's csgraph module object verbatim
+# (non-callable), which rejects this package's arrays; replace it with
+# the adapted facade (native laplacian/connected_components + boundary-
+# converted fallbacks).  NOTE: `from . import csgraph` would return the
+# existing (scipy) attribute without importing the submodule — the
+# absolute import forces ours and rebinds the package attribute.
+import legate_sparse_tpu.csgraph  # noqa: F401,E402
+
+csgraph = legate_sparse_tpu.csgraph
+
 del _scipy_sparse, clone_module

@@ -1,0 +1,183 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""scipy.sparse.csgraph facade: native device algorithms + adapted
+fallbacks.
+
+The cloned top-level namespace used to re-export scipy's csgraph
+module object unchanged, so ``sparse.csgraph.connected_components(A)``
+rejected this package's arrays ("graph should have two dimensions").
+This module makes the namespace drop-in: every csgraph callable takes
+package arrays (converted at the boundary for host fallbacks), and the
+bulk-parallel algorithms run natively on device:
+
+- ``laplacian``: L = D - A from one degree reduction (SpMV-shaped).
+- ``connected_components`` (undirected/weak): min-label propagation —
+  each sweep is two scatter-min ops over the edge list, O(diameter)
+  sweeps, all inside one jitted while_loop.  A graph BFS/union-find is
+  sequential; label propagation is the TPU-shaped equivalent.
+
+The reference has no graph surface at all (exhaustive tree read,
+SURVEY §2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["connected_components", "laplacian"]
+
+
+def _as_package_csr(graph):
+    from .csr import _is_scipy_sparse, csr_array
+
+    if _is_scipy_sparse(graph):
+        return csr_array(graph)
+    if hasattr(graph, "tocsr") and hasattr(graph, "nnz"):
+        return graph.tocsr()
+    return csr_array(jnp.asarray(graph))
+
+
+def _narrow_indices(x):
+    """scipy.sparse.csgraph's Cython kernels are int32-indexed; narrow
+    int64 index arrays when they fit (raw scipy rejects them outright —
+    'Buffer dtype mismatch' — so this is a strict usability win)."""
+    import scipy.sparse as _sp
+
+    if (_sp.issparse(x) and x.format == "csr"
+            and x.indices.dtype == np.int64
+            and x.shape[1] <= np.iinfo(np.int32).max
+            and x.nnz <= np.iinfo(np.int32).max):
+        return _sp.csr_array(
+            (x.data, x.indices.astype(np.int32),
+             x.indptr.astype(np.int32)), shape=x.shape)
+    return x
+
+
+def _host_fallback(name):
+    import functools
+
+    import scipy.sparse.csgraph as _csg
+
+    from .coverage import scipy_fallback
+
+    inner = scipy_fallback(getattr(_csg, name), f"csgraph.{name}")
+
+    @functools.wraps(inner)
+    def wrapper(*args, **kwargs):
+        from .coverage import _to_scipy
+
+        args = tuple(_narrow_indices(_to_scipy(a)) for a in args)
+        kwargs = {k: _narrow_indices(_to_scipy(v))
+                  for k, v in kwargs.items()}
+        return inner(*args, **kwargs)
+
+    return wrapper
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _label_propagation(rows, cols, n: int):
+    """Min-label propagation over an undirected edge list.  Converges
+    to per-component minimum node ids in O(diameter) sweeps."""
+    labels0 = jnp.arange(n, dtype=jnp.int64)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        new = labels.at[rows].min(labels[cols])
+        new = new.at[cols].min(new[rows])
+        return new, jnp.any(new != labels)
+
+    labels, _ = jax.lax.while_loop(
+        cond, body, (labels0, jnp.asarray(True)))
+    return labels
+
+
+def connected_components(csgraph, directed=True, connection="weak",
+                         return_labels=True):
+    """Number of connected components (+ labels) — scipy signature.
+
+    Undirected graphs and directed/'weak' run natively (weak
+    connectivity ignores edge direction, so both reduce to the same
+    symmetrized propagation).  Directed 'strong' delegates to host
+    scipy (Tarjan is inherently sequential).
+    """
+    if directed and connection == "strong":
+        return _host_fallback("connected_components")(
+            csgraph, directed=directed, connection=connection,
+            return_labels=return_labels)
+    A = _as_package_csr(csgraph)
+    n = A.shape[0]
+    if A.shape[0] != A.shape[1]:
+        raise ValueError("graph must be square")
+    if n == 0:
+        return (0, np.zeros(0, dtype=np.int32)) if return_labels else 0
+    rows = A._get_row_ids()
+    cols = A._indices
+    raw = np.asarray(_label_propagation(rows, cols, n))
+    # scipy labels components 0..k-1 in order of first appearance.
+    # Raw labels are component-minimum node ids, whose first occurrence
+    # is the id itself — so np.unique's sorted order IS first-
+    # appearance order and `inverse` is already the scipy labeling.
+    uniq, inverse = np.unique(raw, return_inverse=True)
+    labels = inverse.astype(np.int32)
+    return (len(uniq), labels) if return_labels else len(uniq)
+
+
+def laplacian(csgraph, normed=False, return_diag=False,
+              use_out_degree=False, *, copy=True, form="array",
+              dtype=None, symmetrized=False):
+    """Graph Laplacian L = D - A (scipy signature), built on device
+    from one degree reduction.  ``form != 'array'`` (callable/LO forms)
+    delegates to host scipy."""
+    if form != "array":
+        return _host_fallback("laplacian")(
+            csgraph, normed=normed, return_diag=return_diag,
+            use_out_degree=use_out_degree, copy=copy, form=form,
+            dtype=dtype, symmetrized=symmetrized)
+    A = _as_package_csr(csgraph)
+    if A.shape[0] != A.shape[1]:
+        raise ValueError("csgraph must be a square matrix or array")
+    if dtype is not None:
+        A = A.astype(dtype)
+    elif not np.issubdtype(np.dtype(A.dtype), np.floating) and normed:
+        A = A.astype(np.float64)
+    if symmetrized:
+        A = A + A.T.tocsr()
+    # scipy semantics (``_laplacian_sparse``): degrees EXCLUDE
+    # self-loops, and the result diagonal is overwritten outright.
+    axis = 1 if use_out_degree else 0
+    d = (jnp.asarray(A.sum(axis=axis)).reshape(-1)
+         - jnp.asarray(A.diagonal()))
+    row_ids = A._get_row_ids()
+    if not normed:
+        L = A._with_data(-A._data)
+        L.setdiag(np.asarray(d))
+        return (L, np.asarray(d)) if return_diag else L
+    isolated = d == 0
+    w = jnp.where(isolated, 1.0, jnp.sqrt(jnp.where(isolated, 1.0, d)))
+    L = A._with_data(-A._data / (w[row_ids] * w[A._indices]))
+    L.setdiag(np.asarray(1.0 - isolated.astype(w.dtype)))
+    return (L, np.asarray(w)) if return_diag else L
+
+
+def __getattr__(name):
+    import scipy.sparse.csgraph as _csg
+
+    try:
+        value = getattr(_csg, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'legate_sparse_tpu.csgraph' has no attribute "
+            f"{name!r}") from None
+    if callable(value) and not isinstance(value, type):
+        value = _host_fallback(name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,98 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""csgraph facade: native device algorithms + adapted fallbacks.
+
+The reference has no graph surface (SURVEY §2); scipy.sparse.csgraph
+is part of the drop-in story, so the namespace must take package
+arrays.  Differential tests vs host scipy.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as scsg
+
+import legate_sparse_tpu as sparse
+
+
+def _graph(n=200, density=0.01, seed=0, sym=True):
+    rng = np.random.default_rng(seed)
+    E = sp.random(n, n, density=density, format="csr", random_state=rng)
+    if sym:
+        E = ((E + E.T) > 0).astype(np.float64)
+    else:
+        E = (E > 0).astype(np.float64)
+    return E.tocsr(), sparse.csr_array(E.tocsr())
+
+
+def test_connected_components_undirected():
+    E, A = _graph()
+    k, labels = sparse.csgraph.connected_components(A, directed=False)
+    k_ref, l_ref = scsg.connected_components(E, directed=False)
+    assert k == k_ref
+    np.testing.assert_array_equal(labels, l_ref)
+
+
+def test_connected_components_weak_and_strong():
+    E, A = _graph(density=0.008, sym=False)
+    for connection in ("weak", "strong"):
+        k, labels = sparse.csgraph.connected_components(
+            A, directed=True, connection=connection)
+        k_ref, l_ref = scsg.connected_components(
+            E, directed=True, connection=connection)
+        assert k == k_ref
+        np.testing.assert_array_equal(labels, l_ref)
+
+
+def test_connected_components_count_only_and_isolated():
+    # Two explicit components + an isolated node.
+    rows = np.array([0, 1, 3, 4])
+    cols = np.array([1, 0, 4, 3])
+    A = sparse.csr_array((np.ones(4), (rows, cols)), shape=(6, 6))
+    k = sparse.csgraph.connected_components(A, directed=False,
+                                            return_labels=False)
+    assert k == 4   # {0,1}, {3,4}, {2}, {5}
+
+
+@pytest.mark.parametrize("kw", [
+    {}, {"normed": True}, {"use_out_degree": True},
+    {"symmetrized": True}, {"dtype": np.float32},
+])
+def test_laplacian_matches_scipy(kw):
+    # Asymmetric graph: row sums != column sums, so a swapped degree
+    # axis (in- vs out-degree) cannot slip through.
+    E, A = _graph(seed=1, density=0.02, sym=False)
+    got = sparse.csgraph.laplacian(A, return_diag=True, **kw)
+    ref = scsg.laplacian(E, return_diag=True, **kw)
+    np.testing.assert_allclose(got[0].toarray(), ref[0].toarray(),
+                               atol=1e-6)
+    np.testing.assert_allclose(got[1], ref[1], atol=1e-6)
+
+
+def test_laplacian_self_loops():
+    # Degrees exclude self-loops; diagonal is overwritten (scipy
+    # ``_laplacian_sparse`` semantics).
+    E, _ = _graph(n=60, seed=2)
+    S = (E + 3.0 * sp.eye(60)).tocsr()
+    A = sparse.csr_array(S)
+    for kw in ({}, {"normed": True}):
+        got = sparse.csgraph.laplacian(A, return_diag=True, **kw)
+        ref = scsg.laplacian(S, return_diag=True, **kw)
+        np.testing.assert_allclose(got[0].toarray(), ref[0].toarray(),
+                                   atol=1e-12)
+        np.testing.assert_allclose(got[1], ref[1])
+
+
+def test_fallbacks_take_package_arrays():
+    # scipy's csgraph Cython is int32-indexed; the boundary narrows
+    # our int64 indices (raw scipy rejects int64 outright).
+    E, A = _graph(seed=3)
+    np.testing.assert_allclose(
+        sparse.csgraph.minimum_spanning_tree(A).toarray(),
+        scsg.minimum_spanning_tree(E).toarray())
+    np.testing.assert_allclose(
+        sparse.csgraph.dijkstra(A, indices=[0, 5]),
+        scsg.dijkstra(E, indices=[0, 5]))
+    np.testing.assert_allclose(
+        sparse.csgraph.shortest_path(A, method="D", unweighted=True),
+        scsg.shortest_path(E, method="D", unweighted=True))
