@@ -46,4 +46,4 @@ import legate_sparse_tpu.csgraph  # noqa: F401,E402
 
 csgraph = legate_sparse_tpu.csgraph
 
-del _scipy_sparse, clone_module
+del _scipy_sparse, clone_module, legate_sparse_tpu

@@ -63,19 +63,20 @@ def _host_fallback(name):
 
     import scipy.sparse.csgraph as _csg
 
-    from .coverage import scipy_fallback
+    from .coverage import _from_scipy, _to_scipy
 
-    inner = scipy_fallback(getattr(_csg, name), f"csgraph.{name}")
+    func = getattr(_csg, name)
+    scope = f"legate_sparse_tpu.csgraph.{name}"
 
-    @functools.wraps(inner)
+    @functools.wraps(func)
     def wrapper(*args, **kwargs):
-        from .coverage import _to_scipy
-
         args = tuple(_narrow_indices(_to_scipy(a)) for a in args)
         kwargs = {k: _narrow_indices(_to_scipy(v))
                   for k, v in kwargs.items()}
-        return inner(*args, **kwargs)
+        with jax.named_scope(scope):
+            return _from_scipy(func(*args, **kwargs))
 
+    wrapper._lst_scipy_fallback = True
     return wrapper
 
 
@@ -109,6 +110,9 @@ def connected_components(csgraph, directed=True, connection="weak",
     symmetrized propagation).  Directed 'strong' delegates to host
     scipy (Tarjan is inherently sequential).
     """
+    connection = str(connection).lower()
+    if connection not in ("weak", "strong"):
+        raise ValueError("connection must be 'weak' or 'strong'")
     if directed and connection == "strong":
         return _host_fallback("connected_components")(
             csgraph, directed=directed, connection=connection,
@@ -147,10 +151,10 @@ def laplacian(csgraph, normed=False, return_diag=False,
         raise ValueError("csgraph must be a square matrix or array")
     if dtype is not None:
         A = A.astype(dtype)
-    elif not np.issubdtype(np.dtype(A.dtype), np.floating) and normed:
-        A = A.astype(np.float64)
+    elif normed and not np.issubdtype(np.dtype(A.dtype), np.inexact):
+        A = A.astype(np.float64)   # int input; complex is preserved
     if symmetrized:
-        A = A + A.T.tocsr()
+        A = A + A.T.conj().tocsr()   # scipy: m += m.T.conj()
     # scipy semantics (``_laplacian_sparse``): degrees EXCLUDE
     # self-loops, and the result diagonal is overwritten outright.
     axis = 1 if use_out_degree else 0

@@ -62,7 +62,7 @@ def _host_fallback(name):
 # ---------------------------------------------------------------- Lanczos
 
 
-def _lanczos(matvec, v0, m: int):
+def _lanczos(matvec, v0, mask, m: int):
     """m-step Lanczos with full (twice-applied) reorthogonalization.
 
     Returns (V, alphas, betas): V is (m, n) with orthonormal rows,
@@ -96,6 +96,11 @@ def _lanczos(matvec, v0, m: int):
             jnp.abs(jnp.real(alpha)), 1.0)
         fresh = jax.random.normal(jax.random.fold_in(key0, j), (n,),
                                   rdtype).astype(dtype)
+        if mask is not None:
+            # Restart inside the valid subspace only (padded/masked
+            # entries must stay exactly zero — distributed operators
+            # carry inert padding rows).
+            fresh = fresh * mask
         for _ in range(2):
             fresh = fresh - V.T @ (jnp.conj(V) @ fresh)
         fresh = fresh / jnp.maximum(jnp.linalg.norm(fresh), eps)
@@ -113,18 +118,20 @@ def _lanczos(matvec, v0, m: int):
 
 
 def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
-                   return_eigenvectors):
+                   return_eigenvectors, mask=None, max_rank=None):
     import scipy.linalg as _sl
 
     rdtype = np.dtype(np.float64 if dtype.itemsize >= 8 else np.float32)
     if v0 is None:
         rng = np.random.default_rng(0)
         v0 = rng.standard_normal(n)
-    v0 = jnp.asarray(np.asarray(v0), dtype=dtype)
+    # jnp.asarray keeps device (incl. sharded) arrays in place.
+    v0 = jnp.asarray(v0, dtype=dtype)
     v0 = v0 / jnp.linalg.norm(v0)
 
-    m = int(ncv) if ncv is not None else min(n, max(2 * k + 1, 20))
-    m = min(max(m, k + 1), n)
+    rank = int(max_rank) if max_rank is not None else n
+    m = int(ncv) if ncv is not None else min(rank, max(2 * k + 1, 20))
+    m = min(max(m, k + 1), rank)
     # matvec is a closure: static (hashable) so the scan jits around it.
     lanczos = jax.jit(_lanczos, static_argnums=(0,),
                       static_argnames=("m",))
@@ -135,7 +142,7 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
     atol = float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
     tries = int(maxiter) if maxiter is not None else 6
     for _ in range(max(tries, 1)):
-        V, alphas, betas = lanczos(matvec, v0, m=m)
+        V, alphas, betas = lanczos(matvec, v0, mask, m=m)
         a = np.real(np.asarray(alphas)).astype(np.float64)
         b_all = np.real(np.asarray(betas)).astype(np.float64)
         b = b_all[:-1]            # off-diagonal of T
@@ -155,9 +162,9 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
         # recurrence beta, not T's last off-diagonal.
         resid = np.abs(beta_last) * np.abs(y_k[-1, :])
         scale = np.maximum(np.abs(w_k), 1.0)
-        if np.all(resid <= atol * scale) or m >= n:
+        if np.all(resid <= atol * scale) or m >= rank:
             break
-        m = min(n, 2 * m)
+        m = min(rank, 2 * m)
     w_k = w_k.astype(rdtype)
     if not return_eigenvectors:
         return w_k

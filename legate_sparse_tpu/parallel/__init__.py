@@ -27,6 +27,7 @@ from .dist_csr import (  # noqa: F401
     dist_gmres,
     dist_bicgstab,
     dist_minres,
+    dist_eigsh,
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401

@@ -187,3 +187,28 @@ def test_pde_distributed_operator_and_solve(tpu_backend):
     x, iters = dist_cg(dA, b, rtol=1e-10)
     res = np.linalg.norm(b - A_host.toscipy() @ np.asarray(x))
     assert res <= 1e-8 * np.linalg.norm(b)
+
+
+def test_spectral_example_pipeline(tpu_backend):
+    """spectral.py pipeline: clustered graph -> components ->
+    normalized Laplacian -> smallest eigenpairs, vs host scipy."""
+    import spectral
+
+    import scipy.sparse.csgraph as scsg
+    import scipy.sparse.linalg as ssl
+
+    import legate_sparse_tpu as lst
+    import legate_sparse_tpu.linalg as llinalg
+
+    rng = np.random.default_rng(0)
+    host_A = spectral.clustered_graph(400, 4, p_in=0.05, p_out=0.002,
+                                      rng=rng)
+    A = lst.csr_array(host_A)
+    k, _ = lst.csgraph.connected_components(A, directed=False)
+    k_ref, _ = scsg.connected_components(host_A, directed=False)
+    assert k == k_ref
+    L = lst.csgraph.laplacian(A, normed=True)
+    w, _ = llinalg.eigsh(L, k=5, which="SA")
+    w_ref = ssl.eigsh(scsg.laplacian(host_A, normed=True).tocsc(),
+                      k=5, which="SA", return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), atol=1e-8)
