@@ -20,12 +20,10 @@ Run:
 """
 
 import argparse
-import sys
 
 import numpy
 
-sys.path.insert(0, ".")
-from common import parse_common_args  # noqa: E402
+from common import parse_common_args
 
 
 def clustered_graph(n: int, clusters: int, p_in: float, p_out: float,
@@ -97,7 +95,7 @@ def main():
     near_zero = int((w < 1e-8).sum())
     print(f"near-zero eigenvalues: {near_zero} "
           f"(= components: {near_zero == ncomp})")
-    if args.clusters <= args.k:
+    if args.clusters < args.k:
         gap = w[args.clusters] - w[args.clusters - 1]
         print(f"spectral gap after {args.clusters} clusters: {gap:.4f}")
 

@@ -59,24 +59,26 @@ def _narrow_indices(x):
 
 
 def _host_fallback(name):
+    """Compose the shared boundary adapter with csgraph-specific index
+    narrowing.  The outer wrapper converts package arrays AND narrows;
+    scipy_fallback's own ``_to_scipy`` then passes the already-scipy
+    operands through unchanged (idempotent), so the boundary behavior
+    stays defined in exactly one place (``coverage.scipy_fallback``)."""
     import functools
 
     import scipy.sparse.csgraph as _csg
 
-    from .coverage import _from_scipy, _to_scipy
+    from .coverage import _to_scipy, scipy_fallback
 
-    func = getattr(_csg, name)
-    scope = f"legate_sparse_tpu.csgraph.{name}"
+    inner = scipy_fallback(getattr(_csg, name), f"csgraph.{name}")
 
-    @functools.wraps(func)
+    @functools.wraps(inner)
     def wrapper(*args, **kwargs):
         args = tuple(_narrow_indices(_to_scipy(a)) for a in args)
         kwargs = {k: _narrow_indices(_to_scipy(v))
                   for k, v in kwargs.items()}
-        with jax.named_scope(scope):
-            return _from_scipy(func(*args, **kwargs))
+        return inner(*args, **kwargs)
 
-    wrapper._lst_scipy_fallback = True
     return wrapper
 
 

@@ -106,3 +106,47 @@ def test_cg_converges(accel):
     x, info = linalg.cg(A, b, rtol=1e-5, maxiter=2000)
     res = np.linalg.norm(np.asarray(A @ np.asarray(x)) - b)
     assert res < 1e-2 * np.linalg.norm(b)
+
+
+def test_eigsh_on_chip(accel):
+    # The Lanczos scan (matvec chain + reorthogonalization) on chip.
+    A = _poisson(16)
+    w, _ = linalg.eigsh(A, k=3, which="SA", tol=1e-4)
+    import scipy.sparse.linalg as ssl
+
+    w_ref = ssl.eigsh(A.toscipy().astype(np.float64), k=3, which="SA",
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-3)
+
+
+def test_minres_on_chip(accel):
+    A = _poisson(16)
+    b = np.ones(A.shape[0], dtype=np.float32)
+    x, _ = linalg.minres(A, b, rtol=1e-5, maxiter=2000)
+    res = np.linalg.norm(np.asarray(A @ np.asarray(x)) - b)
+    assert res < 1e-2 * np.linalg.norm(b)
+
+
+def test_expm_multiply_on_chip(accel):
+    # Taylor fori_loop chain (SpMV per term) on chip.
+    A = _poisson(12)
+    L = A * np.float32(-0.05)    # decaying semigroup
+    b = np.ones(L.shape[0], dtype=np.float32)
+    got = linalg.expm_multiply(L, b)
+    import scipy.sparse.linalg as ssl
+
+    ref = ssl.expm_multiply(L.toscipy().astype(np.float64),
+                            b.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_connected_components_on_chip(accel):
+    # Label-propagation while_loop (scatter-min sweeps) on chip.
+    rows = np.array([0, 1, 3, 4])
+    cols = np.array([1, 0, 4, 3])
+    A = sparse.csr_array((np.ones(4, np.float32), (rows, cols)),
+                         shape=(6, 6))
+    k, labels = sparse.csgraph.connected_components(A, directed=False)
+    assert k == 4
+    assert labels[0] == labels[1] and labels[3] == labels[4]
