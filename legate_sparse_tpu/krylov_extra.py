@@ -1,12 +1,12 @@
 # Copyright 2026.
 # SPDX-License-Identifier: Apache-2.0
-"""Device-native MINRES and LSQR.
+"""Device-native MINRES, LSQR, and LSMR.
 
 Same design as the cg/gmres/bicgstab family in ``linalg.py`` (reference
-has neither solver — its linalg surface is cg/gmres only): the whole
-solve is ONE jitted ``lax.while_loop`` with no host sync per iteration,
-tolerances and iteration budgets carried as dynamic state so retuned
-solves reuse the compiled loop.
+has none of these solvers — its linalg surface is cg/gmres only): the
+whole solve is ONE jitted ``lax.while_loop`` with no host sync per
+iteration, tolerances and iteration budgets carried as dynamic state so
+retuned solves reuse the compiled loop.
 
 - ``minres``: Paige & Saunders Lanczos + Givens QR for symmetric
   (possibly indefinite) systems, optional SPD preconditioner M and
@@ -15,6 +15,9 @@ solves reuse the compiled loop.
   rectangular systems with Tikhonov ``damp``; needs matvec + rmatvec
   (both live on device — for sparse operands rmatvec is the cached
   transpose SpMV).
+- ``lsmr``: the same bidiagonalization with a second Givens chain
+  minimizing ``||A^T r||`` (Fong & Saunders) — the least-squares analog
+  of MINRES where LSQR is the analog of CG.
 
 Scalar recurrences (Givens coefficients, norm estimates) are O(1) per
 step and fuse into the matvec program; the MXU/VPU work stays the SpMV.
@@ -27,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["minres", "lsqr"]
+__all__ = ["minres", "lsqr", "lsmr"]
 
 
 def _sym_ortho(a, b):
@@ -318,3 +321,200 @@ def lsqr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     return (np.asarray(out["x"]), istop, itn, r1norm, r2norm,
             float(np.sqrt(out["anorm2"])), 0.0, float(out["arnorm"]),
             float(out["xnorm"]), np.zeros(n))
+
+
+# -------------------------------------------------------------------- LSMR
+
+
+def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
+               conv_test_iters: int):
+    """Fong & Saunders LSMR: Golub-Kahan bidiagonalization with a
+    second Givens chain minimizing ||A^T r|| — the least-squares analog
+    of MINRES where LSQR is the analog of CG.  One jitted while_loop;
+    all per-step work beyond the two matvecs is scalar."""
+    dtype = b.dtype
+    rdt = jnp.real(b).dtype
+    eps = jnp.finfo(rdt).eps
+
+    def normalize(v):
+        nrm = jnp.linalg.norm(v).astype(rdt)
+        return v / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+
+    u, beta0 = normalize(b - A_mv(x0))
+    v, alpha0 = normalize(At_mv(u))
+
+    def cond(st):
+        return jnp.logical_and(st["iters"] < st["miter"],
+                               jnp.logical_not(st["done"]))
+
+    def body(st):
+        iters = st["iters"] + 1
+        u, beta = normalize(A_mv(st["v"]) - st["alpha"].astype(dtype)
+                            * st["u"])
+        v, alpha = normalize(At_mv(u) - beta.astype(dtype) * st["v"])
+
+        chat, shat, alphahat = _sym_ortho(st["alphabar"], st["damp"])
+
+        rhoold = st["rho"]
+        c, s, rho = _sym_ortho(alphahat, beta)
+        thetanew = s * alpha
+        alphabar = c * alpha
+
+        rhobarold = st["rhobar"]
+        zetaold = st["zeta"]
+        thetabar = st["sbar"] * rho
+        rhotemp = st["cbar"] * rho
+        cbar, sbar, rhobar = _sym_ortho(rhotemp, thetanew)
+        zeta = cbar * st["zetabar"]
+        zetabar = -sbar * st["zetabar"]
+
+        denom_h = jnp.where(rhoold * rhobarold == 0, 1.0,
+                            rhoold * rhobarold)
+        hbar = st["h"] - (thetabar * rho / denom_h).astype(dtype) \
+            * st["hbar"]
+        denom_x = jnp.where(rho * rhobar == 0, 1.0, rho * rhobar)
+        x = st["x"] + (zeta / denom_x).astype(dtype) * hbar
+        h = v - (thetanew / jnp.where(rho == 0, 1.0, rho)).astype(dtype) \
+            * st["h"]
+
+        # ||r|| estimate (the paper's second triangular solve).
+        betaacute = chat * st["betadd"]
+        betacheck = -shat * st["betadd"]
+        betahat = c * betaacute
+        betadd = -s * betaacute
+        thetatildeold = st["thetatilde"]
+        ctildeold, stildeold, rhotildeold = _sym_ortho(
+            st["rhodold"], thetabar)
+        thetatilde = stildeold * rhobar
+        rhodold = ctildeold * rhobar
+        betad = -stildeold * st["betad"] + ctildeold * betahat
+        tautildeold = (zetaold - thetatildeold * st["tautildeold"]) \
+            / jnp.where(rhotildeold == 0, 1.0, rhotildeold)
+        taud = (zeta - thetatilde * tautildeold) \
+            / jnp.where(rhodold == 0, 1.0, rhodold)
+        d2 = st["d2"] + betacheck ** 2
+        normr = jnp.sqrt(d2 + (betad - taud) ** 2 + betadd ** 2)
+
+        # scipy's exact accumulator ordering: beta^2 enters normA for
+        # THIS iteration's tests, alpha^2 only for the next.
+        normA = jnp.sqrt(st["normA2"] + beta ** 2)
+        normA2 = st["normA2"] + beta ** 2 + alpha ** 2
+        normar = jnp.abs(zetabar)
+        normx = jnp.linalg.norm(x).astype(rdt)
+        maxrbar = jnp.maximum(st["maxrbar"], rhobarold)
+        minrbar = jnp.where(iters > 1,
+                            jnp.minimum(st["minrbar"], rhobarold),
+                            st["minrbar"])
+        condA = (jnp.maximum(maxrbar, rhotemp)
+                 / jnp.maximum(jnp.minimum(minrbar, rhotemp), eps))
+
+        check = jnp.logical_or(iters % conv_test_iters == 0,
+                               iters >= st["miter"] - 1)
+        stop1 = jnp.logical_or(
+            st["stop1"],
+            jnp.logical_and(check, normr <= st["btol"] * st["bnorm"]
+                            + st["atol"] * normA * normx))
+        stop2 = jnp.logical_or(
+            st["stop2"],
+            jnp.logical_and(check,
+                            normar <= st["atol"] * normA * normr + eps))
+        stop3 = jnp.logical_or(
+            st["stop3"],
+            jnp.logical_and(check,
+                            jnp.logical_and(st["ctol"] > 0,
+                                            1.0 / condA <= st["ctol"])))
+        done = jnp.logical_or(
+            st["done"],
+            jnp.logical_or(stop1, jnp.logical_or(stop2, stop3)))
+        return dict(x=x, u=u, v=v, h=h, hbar=hbar, alpha=alpha,
+                    alphabar=alphabar, rho=rho, rhobar=rhobar,
+                    cbar=cbar, sbar=sbar, zeta=zeta, zetabar=zetabar,
+                    betadd=betadd, betad=betad, rhodold=rhodold,
+                    tautildeold=tautildeold, thetatilde=thetatilde,
+                    d2=d2, normA2=normA2, normA=normA, normr=normr,
+                    normar=normar,
+                    normx=normx, maxrbar=maxrbar, minrbar=minrbar,
+                    rhotemp=rhotemp,
+                    iters=iters, done=done, stop1=stop1, stop2=stop2,
+                    stop3=stop3, ctol=st["ctol"],
+                    damp=st["damp"], atol=st["atol"], btol=st["btol"],
+                    bnorm=st["bnorm"], miter=st["miter"])
+
+    st0 = dict(
+        x=x0, u=u, v=v, h=v, hbar=jnp.zeros_like(v),
+        alpha=alpha0, alphabar=alpha0,
+        rho=jnp.ones((), rdt), rhobar=jnp.ones((), rdt),
+        cbar=jnp.ones((), rdt), sbar=jnp.zeros((), rdt),
+        zeta=jnp.zeros((), rdt), zetabar=alpha0 * beta0,
+        betadd=beta0, betad=jnp.zeros((), rdt),
+        rhodold=jnp.ones((), rdt), tautildeold=jnp.zeros((), rdt),
+        thetatilde=jnp.zeros((), rdt), d2=jnp.zeros((), rdt),
+        normA2=alpha0 ** 2, normA=alpha0,
+        normr=beta0, normar=alpha0 * beta0,
+        normx=jnp.linalg.norm(x0).astype(rdt),
+        maxrbar=jnp.zeros((), rdt),
+        minrbar=jnp.asarray(np.finfo(np.float64).max, rdt),
+        rhotemp=jnp.ones((), rdt),
+        iters=jnp.asarray(0, jnp.int64),
+        done=jnp.asarray(jnp.logical_or(beta0 == 0, alpha0 == 0)),
+        stop1=jnp.asarray(False), stop2=jnp.asarray(False),
+        stop3=jnp.asarray(False),
+        ctol=jnp.asarray(0.0 if conlim <= 0 else 1.0 / conlim, rdt),
+        damp=jnp.asarray(damp, rdt),
+        atol=jnp.asarray(atol, rdt), btol=jnp.asarray(btol, rdt),
+        bnorm=jnp.linalg.norm(b).astype(rdt),
+        miter=jnp.asarray(maxiter, jnp.int64),
+    )
+    return jax.lax.while_loop(cond, body, st0)
+
+
+def lsmr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
+         maxiter=None, show=False, x0=None, conv_test_iters: int = 10):
+    """Iterative least squares minimizing ||A^T r|| (scipy ``lsmr``).
+
+    Returns the scipy-shaped 8-tuple ``(x, istop, itn, normr, normar,
+    norma, conda, normx)`` with scipy's istop semantics (1 compatible,
+    2 least-squares, 3 condition-limit, 0 zero rhs / exact at entry,
+    7 iteration limit).  ``show`` delegates to host scipy.
+    """
+    from .coverage import scipy_fallback
+    from .linalg import make_linear_operator
+
+    if show:
+        import scipy.sparse.linalg as _ssl
+
+        return scipy_fallback(_ssl.lsmr, "linalg.lsmr")(
+            A, b, damp=damp, atol=atol, btol=btol, conlim=conlim,
+            maxiter=maxiter, show=show, x0=x0)
+
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    A_op = make_linear_operator(A)
+    m, n = A_op.shape
+    if maxiter is None:
+        maxiter = min(m, n)   # scipy's lsmr default
+    x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
+         else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+    if float(jnp.linalg.norm(b)) == 0.0:
+        return (np.zeros(n, dtype=np.asarray(b).dtype), 0, 0, 0.0, 0.0,
+                0.0, 0.0, 0.0)
+    out = _lsmr_loop(A_op.matvec, A_op.rmatvec, b, x, float(damp),
+                     float(atol), float(btol), float(conlim),
+                     int(maxiter), int(conv_test_iters))
+    itn = int(out["iters"])
+    conda = float(jnp.maximum(out["maxrbar"], out["rhotemp"])
+                  / jnp.minimum(out["minrbar"], out["rhotemp"]))
+    if bool(out["stop1"]):
+        istop = 1
+    elif bool(out["stop2"]):
+        istop = 2
+    elif bool(out["stop3"]):
+        istop = 3
+    elif itn == 0:
+        istop = 0
+    else:
+        istop = 7
+    return (np.asarray(out["x"]), istop, itn, float(out["normr"]),
+            float(out["normar"]), float(out["normA"]),
+            conda, float(out["normx"]))

@@ -692,13 +692,13 @@ def norm(A, ord=None, axis=None):
 # these shadow the host-scipy versions).
 from .eigen import eigsh, lobpcg, svds  # noqa: E402
 from .expm import expm_multiply  # noqa: E402
-from .krylov_extra import lsqr, minres  # noqa: E402
+from .krylov_extra import lsmr, lsqr, minres  # noqa: E402
 from .precond import block_jacobi, jacobi  # noqa: E402
 
 
 def __getattr__(name):
     """scipy.sparse.linalg fallback for names without a native
-    implementation (spsolve, splu, expm, lsmr, ...): host-side
+    implementation (spsolve, splu, expm, tfqmr, ...): host-side
     scipy with this package's arrays converted at the boundary.  The
     reference offers no fallback here at all (its linalg is cg/gmres
     only); a drop-in replacement must not strand the rest of a user's

@@ -149,3 +149,59 @@ def test_lsqr_exact_x0_istop_zero():
     out = linalg.lsqr(sparse.csr_array(B_sp), b, x0=xs,
                       atol=1e-8, btol=1e-8)
     assert out[1] == 0 and out[2] == 0
+
+
+@pytest.mark.parametrize("damp", [0.0, 0.7])
+def test_lsmr_matches_scipy(damp):
+    rng = np.random.default_rng(0)
+    B_sp = (sp.random(400, 120, density=0.05, format="csr",
+                      random_state=rng)
+            + sp.vstack([sp.eye(120), sp.csr_matrix((280, 120))])).tocsr()
+    b = rng.standard_normal(400)
+    out = linalg.lsmr(sparse.csr_array(B_sp), b, damp=damp,
+                      atol=1e-12, btol=1e-12, maxiter=2000)
+    ref = ssl.lsmr(B_sp, b, damp=damp, atol=1e-12, btol=1e-12,
+                   maxiter=2000)
+    np.testing.assert_allclose(out[0], ref[0], rtol=1e-7, atol=1e-10)
+    assert out[1] == ref[1]
+    np.testing.assert_allclose(out[3], ref[3], rtol=1e-6)  # normr
+
+
+def test_lsmr_istop_and_edge_cases():
+    rng = np.random.default_rng(1)
+    B_sp = (sp.random(200, 80, density=0.08, format="csr",
+                      random_state=rng)
+            + sp.vstack([sp.eye(80), sp.csr_matrix((120, 80))])).tocsr()
+    B = sparse.csr_array(B_sp)
+    # Compatible system -> istop 1 like scipy.
+    xs = rng.standard_normal(80)
+    out1 = linalg.lsmr(B, B_sp @ xs, atol=1e-10, btol=1e-10,
+                       maxiter=2000)
+    assert out1[1] == ssl.lsmr(B_sp, B_sp @ xs, atol=1e-10, btol=1e-10,
+                               maxiter=2000)[1] == 1
+    # Zero rhs -> istop 0, x = 0.
+    out0 = linalg.lsmr(B, np.zeros(200))
+    assert out0[1] == 0 and np.all(out0[0] == 0)
+    # Underdetermined: residual matches scipy.
+    C_sp = sp.random(40, 120, density=0.15, format="csr",
+                     random_state=rng)
+    bc = rng.standard_normal(40)
+    out = linalg.lsmr(sparse.csr_array(C_sp), bc, atol=1e-12,
+                      btol=1e-12, maxiter=1000)
+    ref = ssl.lsmr(C_sp, bc, atol=1e-12, btol=1e-12, maxiter=1000)
+    np.testing.assert_allclose(
+        np.linalg.norm(C_sp @ out[0] - bc),
+        np.linalg.norm(C_sp @ ref[0] - bc), atol=1e-7)
+
+
+def test_lsmr_conlim_istop3():
+    # Ill-conditioned diagonal: scipy halts with istop=3 at the
+    # condition limit; so must the native loop.
+    rng = np.random.default_rng(2)
+    d = np.concatenate([np.ones(50), np.full(10, 1e-9)])
+    I_sp = sp.diags([d], [0], format="csr")
+    b = rng.standard_normal(60)
+    out = linalg.lsmr(sparse.csr_array(I_sp), b, conlim=1e8, atol=0,
+                      btol=0, maxiter=500, conv_test_iters=1)
+    ref = ssl.lsmr(I_sp, b, conlim=1e8, atol=0, btol=0, maxiter=500)
+    assert out[1] == ref[1] == 3
