@@ -30,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["minres", "lsqr", "lsmr"]
+__all__ = ["minres", "lsqr", "lsmr", "differentiable_solve"]
 
 
 def _sym_ortho(a, b):
@@ -40,6 +40,20 @@ def _sym_ortho(a, b):
     c = jnp.where(r == 0, jnp.ones_like(a), a / safe)
     s = jnp.where(r == 0, jnp.zeros_like(b), b / safe)
     return c, s, r
+
+
+def _make_normalize(dtype, rdt):
+    """Shared bidiagonalization normalizer: (v/||v||, ||v||) with the
+    zero-vector guarded (used by both the LSQR and LSMR loops)."""
+    def normalize(v):
+        nrm = jnp.linalg.norm(v).astype(rdt)
+        return v / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+
+    return normalize
+
+
+def _safe_denom(x):
+    return jnp.where(x == 0, jnp.ones_like(x), x)
 
 
 # ------------------------------------------------------------------ MINRES
@@ -181,9 +195,7 @@ def _lsqr_loop(A_mv, At_mv, b, x0, damp, atol, btol, maxiter,
     rdt = jnp.real(b).dtype
     eps = jnp.finfo(rdt).eps
 
-    def normalize(v):
-        nrm = jnp.linalg.norm(v).astype(rdt)
-        return v / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+    normalize = _make_normalize(dtype, rdt)
 
     u0 = b - A_mv(x0)
     u, beta0 = normalize(u0)
@@ -336,9 +348,7 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
     rdt = jnp.real(b).dtype
     eps = jnp.finfo(rdt).eps
 
-    def normalize(v):
-        nrm = jnp.linalg.norm(v).astype(rdt)
-        return v / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+    normalize = _make_normalize(dtype, rdt)
 
     u, beta0 = normalize(b - A_mv(x0))
     v, alpha0 = normalize(At_mv(u))
@@ -408,24 +418,32 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
         condA = (jnp.maximum(maxrbar, rhotemp)
                  / jnp.maximum(jnp.minimum(minrbar, rhotemp), eps))
 
+        # scipy's scale-invariant stopping tests (lsmr.py): test1/2/3
+        # plus the machine-precision istop 4/5/6 variants — an additive
+        # absolute eps would mis-fire on small-scale data.
         check = jnp.logical_or(iters % conv_test_iters == 0,
                                iters >= st["miter"] - 1)
-        stop1 = jnp.logical_or(
-            st["stop1"],
-            jnp.logical_and(check, normr <= st["btol"] * st["bnorm"]
-                            + st["atol"] * normA * normx))
-        stop2 = jnp.logical_or(
-            st["stop2"],
-            jnp.logical_and(check,
-                            normar <= st["atol"] * normA * normr + eps))
-        stop3 = jnp.logical_or(
-            st["stop3"],
-            jnp.logical_and(check,
-                            jnp.logical_and(st["ctol"] > 0,
-                                            1.0 / condA <= st["ctol"])))
+        test1 = normr / _safe_denom(st["bnorm"])
+        test2 = normar / _safe_denom(normA * normr)
+        test3 = 1.0 / _safe_denom(condA)
+        t1 = test1 / (1.0 + normA * normx / _safe_denom(st["bnorm"]))
+        rtol_ = st["btol"] + st["atol"] * normA * normx \
+            / _safe_denom(st["bnorm"])
+
+        def latch(prev, fired):
+            return jnp.logical_or(prev, jnp.logical_and(check, fired))
+
+        stop1 = latch(st["stop1"], test1 <= rtol_)
+        stop2 = latch(st["stop2"], test2 <= st["atol"])
+        stop3 = latch(st["stop3"],
+                      jnp.logical_and(st["ctol"] > 0,
+                                      test3 <= st["ctol"]))
+        stop4 = latch(st["stop4"], 1.0 + t1 <= 1.0)
+        stop5 = latch(st["stop5"], 1.0 + test2 <= 1.0)
+        stop6 = latch(st["stop6"], 1.0 + test3 <= 1.0)
         done = jnp.logical_or(
             st["done"],
-            jnp.logical_or(stop1, jnp.logical_or(stop2, stop3)))
+            stop1 | stop2 | stop3 | stop4 | stop5 | stop6)
         return dict(x=x, u=u, v=v, h=h, hbar=hbar, alpha=alpha,
                     alphabar=alphabar, rho=rho, rhobar=rhobar,
                     cbar=cbar, sbar=sbar, zeta=zeta, zetabar=zetabar,
@@ -436,7 +454,8 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
                     normx=normx, maxrbar=maxrbar, minrbar=minrbar,
                     rhotemp=rhotemp,
                     iters=iters, done=done, stop1=stop1, stop2=stop2,
-                    stop3=stop3, ctol=st["ctol"],
+                    stop3=stop3, stop4=stop4, stop5=stop5, stop6=stop6,
+                    ctol=st["ctol"],
                     damp=st["damp"], atol=st["atol"], btol=st["btol"],
                     bnorm=st["bnorm"], miter=st["miter"])
 
@@ -458,7 +477,8 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
         iters=jnp.asarray(0, jnp.int64),
         done=jnp.asarray(jnp.logical_or(beta0 == 0, alpha0 == 0)),
         stop1=jnp.asarray(False), stop2=jnp.asarray(False),
-        stop3=jnp.asarray(False),
+        stop3=jnp.asarray(False), stop4=jnp.asarray(False),
+        stop5=jnp.asarray(False), stop6=jnp.asarray(False),
         ctol=jnp.asarray(0.0 if conlim <= 0 else 1.0 / conlim, rdt),
         damp=jnp.asarray(damp, rdt),
         atol=jnp.asarray(atol, rdt), btol=jnp.asarray(btol, rdt),
@@ -496,7 +516,10 @@ def lsmr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
         maxiter = min(m, n)   # scipy's lsmr default
     x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
          else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
-    if float(jnp.linalg.norm(b)) == 0.0:
+    if x0 is None and float(jnp.linalg.norm(b)) == 0.0:
+        # normar = alpha0*beta0 = 0 at entry: scipy returns x=0
+        # immediately.  With a nonzero x0 the residual -A@x0 is a real
+        # system and the loop must run (scipy has no b==0 shortcut).
         return (np.zeros(n, dtype=np.asarray(b).dtype), 0, 0, 0.0, 0.0,
                 0.0, 0.0, 0.0)
     out = _lsmr_loop(A_op.matvec, A_op.rmatvec, b, x, float(damp),
@@ -505,16 +528,84 @@ def lsmr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     itn = int(out["iters"])
     conda = float(jnp.maximum(out["maxrbar"], out["rhotemp"])
                   / jnp.minimum(out["minrbar"], out["rhotemp"]))
-    if bool(out["stop1"]):
-        istop = 1
-    elif bool(out["stop2"]):
-        istop = 2
-    elif bool(out["stop3"]):
-        istop = 3
-    elif itn == 0:
+    # scipy assigns istop 7..1 in sequence so the smallest fired rule
+    # wins; 4/5/6 are the machine-precision variants of 1/2/3.
+    istop = 7
+    for flag, code in (("stop6", 6), ("stop5", 5), ("stop4", 4),
+                       ("stop3", 3), ("stop2", 2), ("stop1", 1)):
+        if bool(out[flag]):
+            istop = code
+    if istop == 7 and itn == 0:
         istop = 0
-    else:
-        istop = 7
     return (np.asarray(out["x"]), istop, itn, float(out["normr"]),
             float(out["normar"]), float(out["normA"]),
             conda, float(out["normx"]))
+
+
+# -------------------------------------------------- differentiable solve
+
+
+def differentiable_solve(A, b, method="cg", M=None, rtol=None,
+                         atol=0.0, maxiter=None,
+                         conv_test_iters: int = 25):
+    """Sparse linear solve that participates in ``jax.grad`` /
+    ``jax.vjp`` (a JAX-native extra — neither the reference nor scipy
+    has an autodiff story for iterative solvers).
+
+    Built on ``lax.custom_linear_solve``: the forward solve runs this
+    package's jitted CG/MINRES while_loop, and the reverse pass solves
+    the transposed system with the same loop (for symmetric operators
+    the very same solve), so ``grad`` of any scalar loss through ``x =
+    solve(A, b)`` costs one extra solve instead of differentiating
+    through solver iterations (which ``while_loop`` cannot reverse).
+
+    Differentiable w.r.t. ``b``.  ``A``/``M`` are closed over as
+    constants (sparse structures are not pytree leaves).  ``method``:
+    'cg' (SPD) or 'minres' (symmetric indefinite); both imply a
+    symmetric operator, which is what makes the transpose solve free.
+    """
+    from .linalg import (IdentityOperator, _cg_loop,
+                         make_linear_operator)
+
+    if method not in ("cg", "minres"):
+        raise ValueError(
+            f"method={method!r}: differentiable_solve supports 'cg' "
+            "and 'minres' (symmetric operators)")
+    b = jnp.asarray(b)
+    if b.ndim == 2 and b.shape[1] == 1:
+        b = b.reshape(-1)
+    n = b.shape[0]
+    A_op = make_linear_operator(A)
+    if A_op.shape[0] != A_op.shape[1]:
+        raise ValueError("expected square matrix")
+    M_op = (IdentityOperator(A_op.shape, dtype=A_op.dtype)
+            if M is None else make_linear_operator(M))
+    if maxiter is None:
+        maxiter = 10 * n
+    if rtol is None:
+        # Attainable in the working precision: 1e-10 stagnates forever
+        # in float32 (the TPU-typical non-x64 mode) — scale to eps.
+        rtol = float(np.sqrt(np.finfo(
+            np.dtype(jnp.real(b).dtype)).eps) * 1e-2)
+    x0 = jnp.zeros(n, dtype=b.dtype)
+
+    def mv(x):
+        return A_op.matvec(x)
+
+    def solve_fn(matvec, rhs):
+        # Tolerance relative to THIS rhs (the reverse pass solves for
+        # the cotangent, whose scale differs from b's).
+        a_tol = jnp.maximum(
+            jnp.asarray(atol, jnp.real(rhs).dtype),
+            rtol * jnp.linalg.norm(rhs).astype(jnp.real(rhs).dtype))
+        if method == "cg":
+            x, _ = _cg_loop(matvec, M_op.matvec, rhs, x0, a_tol,
+                            maxiter, conv_test_iters)
+        else:
+            x, _ = _minres_loop(matvec, M_op.matvec, rhs, x0,
+                                jnp.zeros((), rhs.dtype), a_tol,
+                                maxiter, conv_test_iters)
+        return x
+
+    return jax.lax.custom_linear_solve(
+        mv, b, solve_fn, transpose_solve=solve_fn, symmetric=True)

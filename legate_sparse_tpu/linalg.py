@@ -692,7 +692,8 @@ def norm(A, ord=None, axis=None):
 # these shadow the host-scipy versions).
 from .eigen import eigsh, lobpcg, svds  # noqa: E402
 from .expm import expm_multiply  # noqa: E402
-from .krylov_extra import lsmr, lsqr, minres  # noqa: E402
+from .krylov_extra import (differentiable_solve, lsmr, lsqr,  # noqa: E402
+                           minres)
 from .precond import block_jacobi, jacobi  # noqa: E402
 
 

@@ -88,3 +88,86 @@ def test_degenerate_shapes():
         _chk(fails, 0, "col sum0", C.sum(axis=0),
              np.asarray(Cs.sum(axis=0)).ravel())
         assert not fails, fails
+
+
+def test_solver_eigensolver_battery():
+    """Randomized cross-check of the round-3 linalg surface: minres,
+    lsqr, lsmr, eigsh, svds, expm_multiply, block_jacobi-preconditioned
+    cg, and csgraph — one pooled loop, seeded."""
+    import scipy.sparse.csgraph as scsg
+    import scipy.sparse.linalg as ssl
+
+    import legate_sparse_tpu.linalg as linalg
+
+    rng = np.random.default_rng(7)
+    fails = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(3):
+            n = int(rng.integers(40, 90))
+            # SPD + a symmetric indefinite variant.
+            R = sp.random(n, n, density=0.15, format="csr",
+                          random_state=rng)
+            S = (R + R.T).tocsr()
+            spd = (S @ S.T + n * sp.eye(n)).tocsr()
+            b = rng.standard_normal(n)
+
+            x, _ = linalg.minres(lst.csr_array(S), b, rtol=1e-10,
+                                 maxiter=6000)
+            _chk(fails, trial, "minres",
+                 np.linalg.norm(S @ np.asarray(x) - b)
+                 / np.linalg.norm(b), 0.0, tol=1e-6)
+
+            M = linalg.block_jacobi(lst.csr_array(spd), block_size=16)
+            xp, _ = linalg.cg(lst.csr_array(spd), b, M=M, rtol=1e-10,
+                              maxiter=4000, conv_test_iters=5)
+            _chk(fails, trial, "pcg",
+                 np.linalg.norm(spd @ np.asarray(xp) - b)
+                 / np.linalg.norm(b), 0.0, tol=1e-6)
+
+            w = linalg.eigsh(lst.csr_array(spd), k=3, which="LA",
+                             return_eigenvectors=False)
+            w_ref = ssl.eigsh(spd, k=3, which="LA",
+                              return_eigenvectors=False)
+            _chk(fails, trial, "eigsh", np.sort(w), np.sort(w_ref),
+                 tol=1e-6)
+
+            m2 = int(rng.integers(50, 90))
+            T = sp.random(m2, n, density=0.2, format="csr",
+                          random_state=rng) + sp.vstack(
+                [sp.eye(n), sp.csr_matrix((m2 - n, n))]
+            ) if m2 >= n else sp.random(m2, n, density=0.2,
+                                        format="csr", random_state=rng)
+            T = T.tocsr()
+            bt = rng.standard_normal(m2)
+            for name, fn in (("lsqr", linalg.lsqr),
+                             ("lsmr", linalg.lsmr)):
+                ref_fn = getattr(ssl, name)
+                o = fn(lst.csr_array(T), bt, atol=1e-12, btol=1e-12)
+                r = ref_fn(T, bt, atol=1e-12, btol=1e-12)
+                _chk(fails, trial, name + "_resid",
+                     np.linalg.norm(T @ o[0] - bt),
+                     np.linalg.norm(T @ r[0] - bt), tol=1e-5)
+
+            s = linalg.svds(lst.csr_array(T), k=3,
+                            return_singular_vectors=False)
+            s_ref = ssl.svds(T, k=3, return_singular_vectors=False)
+            _chk(fails, trial, "svds", np.sort(s), np.sort(s_ref),
+                 tol=1e-6)
+
+            L = (S - sp.diags([S.diagonal()], [0])).tocsr() * 0.1
+            _chk(fails, trial, "expm",
+                 linalg.expm_multiply(lst.csr_array(L), b),
+                 ssl.expm_multiply(L, b), tol=1e-8)
+
+            G = ((abs(R) + abs(R.T)) > 0.5).astype(np.float64).tocsr()
+            kcc, lab = lst.csgraph.connected_components(
+                lst.csr_array(G), directed=False)
+            kcc_r, lab_r = scsg.connected_components(G, directed=False)
+            _chk(fails, trial, "cc_k", kcc, kcc_r)
+            _chk(fails, trial, "cc_labels", lab, lab_r)
+            _chk(fails, trial, "laplacian",
+                 lst.csgraph.laplacian(lst.csr_array(G),
+                                       normed=True).toarray(),
+                 scsg.laplacian(G, normed=True).toarray(), tol=1e-10)
+    assert not fails, fails

@@ -205,3 +205,94 @@ def test_lsmr_conlim_istop3():
                       btol=0, maxiter=500, conv_test_iters=1)
     ref = ssl.lsmr(I_sp, b, conlim=1e8, atol=0, btol=0, maxiter=500)
     assert out[1] == ref[1] == 3
+
+
+def test_differentiable_solve_grad():
+    # grad of <c, A^-1 b> wrt b is A^-1 c for symmetric A; the reverse
+    # pass is one extra solve via lax.custom_linear_solve.
+    import jax
+    import jax.numpy as jnp
+
+    N = 24
+    n = N * N
+    main = np.full(n, 4.0)
+    off1 = np.full(n - 1, -1.0)
+    off1[np.arange(1, N) * N - 1] = 0.0
+    offn = np.full(n - N, -1.0)
+    A = sparse.diags([main, off1, off1, offn, offn],
+                     [0, 1, -1, N, -N], shape=(n, n), format="csr",
+                     dtype=np.float64)
+    rng = np.random.default_rng(0)
+    b = jnp.asarray(rng.standard_normal(n))
+    c = jnp.asarray(rng.standard_normal(n))
+    g = jax.grad(
+        lambda bb: jnp.vdot(c, linalg.differentiable_solve(A, bb)))(b)
+    want = np.asarray(linalg.differentiable_solve(A, c))
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-7)
+
+
+def test_differentiable_solve_minres_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    n = 150
+    d = rng.standard_normal(n) * 3
+    S_sp = sp.diags([np.full(n - 1, 1.0), d, np.full(n - 1, 1.0)],
+                    [-1, 0, 1], format="csr")
+    S = sparse.csr_array(S_sp)
+    b = jnp.asarray(rng.standard_normal(n))
+    f = jax.jit(lambda bb: linalg.differentiable_solve(
+        S, bb, method="minres", maxiter=5000).sum())
+    g = jax.grad(f)(b)
+    want = np.linalg.solve(S_sp.toarray().T, np.ones(n))
+    np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5)
+    with pytest.raises(ValueError, match="supports 'cg'"):
+        linalg.differentiable_solve(S, b, method="gmres")
+
+
+def test_lsmr_scale_invariant_stopping():
+    # An additive absolute-eps term in the stopping tests would
+    # mis-fire on tiny-scale data; scipy's tests are relative.
+    rng = np.random.default_rng(3)
+    B_sp = (sp.random(200, 80, density=0.08, format="csr",
+                      random_state=rng)
+            + sp.vstack([sp.eye(80), sp.csr_matrix((120, 80))])).tocsr()
+    b = rng.standard_normal(200)
+    out = linalg.lsmr(sparse.csr_array(1e-12 * B_sp), 1e-12 * b,
+                      atol=1e-12, btol=1e-12, conlim=0, maxiter=2000)
+    ref = ssl.lsmr(1e-12 * B_sp, 1e-12 * b, atol=1e-12, btol=1e-12,
+                   conlim=0, maxiter=2000)
+    assert out[1] == ref[1]
+    np.testing.assert_allclose(out[0], ref[0], rtol=1e-6)
+    # atol=btol=0: machine-precision istop 4/5, not an iteration-limit
+    # burnout.
+    out0 = linalg.lsmr(sparse.csr_array(B_sp), b, atol=0, btol=0,
+                       maxiter=2000, conv_test_iters=1)
+    assert out0[1] in (4, 5)
+    # b = 0 with x0: no shortcut; same minimizer as scipy.
+    x0v = rng.standard_normal(80)
+    o = linalg.lsmr(sparse.csr_array(B_sp), np.zeros(200), x0=x0v,
+                    atol=1e-10, btol=1e-10)
+    r = ssl.lsmr(B_sp, np.zeros(200), x0=x0v, atol=1e-10, btol=1e-10)
+    np.testing.assert_allclose(o[0], r[0], atol=1e-8)
+
+
+def test_differentiable_solve_f32_default_tolerance():
+    # The default rtol must be attainable in float32 (1e-10 stagnates).
+    import jax.numpy as jnp
+
+    N = 16
+    n = N * N
+    main = np.full(n, 4.0, np.float32)
+    off1 = np.full(n - 1, -1.0, np.float32)
+    off1[np.arange(1, N) * N - 1] = 0.0
+    offn = np.full(n - N, -1.0, np.float32)
+    A = sparse.diags([main, off1, off1, offn, offn],
+                     [0, 1, -1, N, -N], shape=(n, n), format="csr",
+                     dtype=np.float32)
+    b = jnp.asarray(np.random.default_rng(0).standard_normal(n),
+                    jnp.float32)
+    x = linalg.differentiable_solve(A, b)
+    assert float(np.linalg.norm(np.asarray(A @ x) - np.asarray(b))) \
+        < 1e-3
