@@ -1,12 +1,17 @@
 # Copyright 2026.
 # SPDX-License-Identifier: Apache-2.0
-"""Device-native sparse eigensolvers: ``eigsh``, ``lobpcg``, ``svds``.
+"""Device-native sparse eigensolvers: ``eigs``, ``eigsh``, ``lobpcg``,
+``svds``.
 
 The reference's linalg surface stops at cg/gmres (its ``linalg.py`` has
 no eigensolvers); this package's scipy-compatibility layer previously
-served ``eigsh``/``lobpcg``/``svds`` through host scipy at the module
+served the eigensolver names through host scipy at the module
 boundary.  These are the native TPU paths for the common cases:
 
+- ``eigs``: non-symmetric restarted Arnoldi — the full Hessenberg
+  recurrence (MGS applied twice) as one jitted ``lax.scan``, real
+  arithmetic for real operators; only the small (m, m) ``eig`` runs on
+  host.
 - ``eigsh``: m-step Lanczos with full reorthogonalization.  The matvec
   chain runs as one jitted ``lax.scan`` on device (SpMV is the hot op);
   only the m x m tridiagonal eigenproblem is solved on host (O(m^2)
@@ -31,7 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["eigsh", "lobpcg", "svds"]
+__all__ = ["eigs", "eigsh", "lobpcg", "svds"]
 
 
 def _operator_parts(A):
@@ -57,6 +62,31 @@ def _host_fallback(name):
     from .coverage import scipy_fallback
 
     return scipy_fallback(getattr(_ssl, name), f"linalg.{name}")
+
+
+def _restart_direction(V, key0, j, n, rdtype, dtype, mask=None):
+    """Fresh random direction orthogonal to the rows of V — the shared
+    breakdown restart for the Lanczos and Arnoldi scans (an invariant
+    subspace was found; the zero vector would fabricate spectrum)."""
+    eps = jnp.finfo(rdtype).eps
+    fresh = jax.random.normal(jax.random.fold_in(key0, j), (n,),
+                              rdtype).astype(dtype)
+    if mask is not None:
+        fresh = fresh * mask
+    for _ in range(2):
+        fresh = fresh - V.T @ (jnp.conj(V) @ fresh)
+    return fresh / jnp.maximum(jnp.linalg.norm(fresh), eps)
+
+
+def _escalation_params(tol, rdtype, ncv, k, rank, maxiter,
+                       min_extra: int = 1):
+    """Shared host-side escalation knobs for the eigsh/eigs drivers:
+    (atol, first subspace size m, retry count)."""
+    atol = float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
+    m = int(ncv) if ncv is not None else min(rank, max(2 * k + 1, 20))
+    m = min(max(m, k + min_extra), rank)
+    tries = max(int(maxiter) if maxiter is not None else 6, 1)
+    return atol, m, tries
 
 
 # ---------------------------------------------------------------- Lanczos
@@ -94,16 +124,11 @@ def _lanczos(matvec, v0, mask, m: int):
         # the zero vector padding T with fabricated zero eigenvalues.
         broke = jnp.real(beta_next) <= 100 * eps * jnp.maximum(
             jnp.abs(jnp.real(alpha)), 1.0)
-        fresh = jax.random.normal(jax.random.fold_in(key0, j), (n,),
-                                  rdtype).astype(dtype)
-        if mask is not None:
-            # Restart inside the valid subspace only (padded/masked
-            # entries must stay exactly zero — distributed operators
-            # carry inert padding rows).
-            fresh = fresh * mask
-        for _ in range(2):
-            fresh = fresh - V.T @ (jnp.conj(V) @ fresh)
-        fresh = fresh / jnp.maximum(jnp.linalg.norm(fresh), eps)
+        # Restart inside the valid subspace only (padded/masked entries
+        # must stay exactly zero — distributed operators carry inert
+        # padding rows).
+        fresh = _restart_direction(V, key0, j, n, rdtype, dtype,
+                                   mask=mask)
         beta_next = jnp.where(broke, jnp.zeros((), dtype), beta_next)
         v_next = jnp.where(
             broke, fresh,
@@ -130,8 +155,6 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
     v0 = v0 / jnp.linalg.norm(v0)
 
     rank = int(max_rank) if max_rank is not None else n
-    m = int(ncv) if ncv is not None else min(rank, max(2 * k + 1, 20))
-    m = min(max(m, k + 1), rank)
     # matvec is a closure: static (hashable) so the scan jits around it.
     lanczos = jax.jit(_lanczos, static_argnums=(0,),
                       static_argnames=("m",))
@@ -139,9 +162,9 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
     # Escalate the subspace until the Ritz residuals converge (scipy's
     # implicit restarts analog, kept host-side and simple: each retry
     # doubles m; n caps it).  tol=0 means machine precision (scipy).
-    atol = float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
-    tries = int(maxiter) if maxiter is not None else 6
-    for _ in range(max(tries, 1)):
+    atol, m, tries = _escalation_params(tol, rdtype, ncv, k, rank,
+                                        maxiter)
+    for _ in range(tries):
         V, alphas, betas = lanczos(matvec, v0, mask, m=m)
         a = np.real(np.asarray(alphas)).astype(np.float64)
         b_all = np.real(np.asarray(betas)).astype(np.float64)
@@ -293,3 +316,135 @@ def svds(A, k=6, ncv=None, tol=0, which="LM", v0=None, maxiter=None,
     AV = np.asarray(jax.vmap(op.matvec, in_axes=1, out_axes=1)(Vj))
     U = AV / np.where(s > 0, s, 1.0)[None, :]
     return U, s, V.T
+
+
+# ---------------------------------------------------------------- Arnoldi
+
+
+def _arnoldi(matvec, v0, m: int):
+    """m-step Arnoldi with full (twice-applied) reorthogonalization.
+
+    Returns (V, H): V is (m, n) orthonormal, H is the (m + 1, m) upper
+    Hessenberg with H[j+1, j] the recurrence norms.  One ``lax.scan``
+    (same shape as ``_lanczos``, but the projection coefficients feed
+    the full Hessenberg column rather than a tridiagonal pair).
+    """
+    n = v0.shape[0]
+    dtype = v0.dtype
+    rdtype = jnp.finfo(dtype).dtype
+    eps = jnp.finfo(rdtype).eps
+    key0 = jax.random.PRNGKey(11)
+
+    def step(carry, j):
+        V, v = carry
+        V = V.at[j].set(v)
+        w = matvec(v)
+        # Modified-Gram-Schmidt-by-blocks, applied twice.
+        h = jnp.conj(V) @ w
+        w = w - V.T @ h
+        h2 = jnp.conj(V) @ w
+        w = w - V.T @ h2
+        h = h + h2
+        beta = jnp.linalg.norm(w).astype(rdtype)
+        broke = beta <= 100 * eps * jnp.maximum(
+            jnp.max(jnp.abs(h)), 1.0)
+        fresh = _restart_direction(V, key0, j, n, rdtype, dtype)
+        beta_out = jnp.where(broke, jnp.zeros((), rdtype), beta)
+        v_next = jnp.where(
+            broke, fresh,
+            w / jnp.where(beta == 0, 1.0, beta).astype(dtype))
+        # Hessenberg column j: projections h[0..j] on top, the
+        # recurrence norm at SUBDIAGONAL position j+1 (h[j+1] is ~0 by
+        # orthogonality, so a scatter-add is a clean set).
+        col = jnp.concatenate([h, jnp.zeros((1,), dtype)])
+        col = col.at[j + 1].add(beta_out.astype(dtype))
+        return (V, v_next), col
+
+    V0 = jnp.zeros((m, n), dtype=dtype)
+    (V, _), cols = jax.lax.scan(step, (V0, v0), jnp.arange(m))
+    # cols[j] is the length-(m+1) Hessenberg column j (entries beyond
+    # j+1 are ~0 by orthogonality).
+    H = cols.T
+    return V, H
+
+
+def _select_ritz(w, k, which):
+    if which == "LM":
+        sel = np.argsort(np.abs(w))[-k:]
+    elif which == "LR":
+        sel = np.argsort(np.real(w))[-k:]
+    elif which == "SR":
+        sel = np.argsort(np.real(w))[:k]
+    elif which == "LI":
+        sel = np.argsort(np.imag(w))[-k:]
+    else:  # SI
+        sel = np.argsort(np.imag(w))[:k]
+    return sel
+
+
+def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
+         maxiter=None, tol=0, return_eigenvectors=True, **kwargs):
+    """k eigenpairs of a general (non-symmetric) operator (scipy
+    ``eigs``).  Native restarted Arnoldi for the standard problem with
+    ``which`` in {LM, LR, SR, LI, SI}; generalized (``M``),
+    shift-invert (``sigma``), and SM delegate to host scipy (which
+    serves SM via shift-invert itself).  Eigenvalues return complex,
+    like scipy."""
+    if (M is not None or sigma is not None
+            or which not in ("LM", "LR", "SR", "LI", "SI") or kwargs):
+        return _host_fallback("eigs")(
+            A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
+            maxiter=maxiter, tol=tol,
+            return_eigenvectors=return_eigenvectors, **kwargs)
+    matvec, m_rows, n_cols, dtype = _operator_parts(A)
+    if m_rows != n_cols:
+        raise ValueError("expected square matrix")
+    n = n_cols
+    if not (0 < k < n - 1):
+        raise ValueError(f"k={k} must satisfy 0 < k < n - 1 = {n - 1}")
+
+    # Real operators run the whole recurrence in REAL arithmetic (the
+    # Krylov basis of a real operator from a real start is real — a
+    # complex basis would double matvec cost and memory); only the
+    # small host eig and the Ritz combination go complex.
+    cdtype = np.result_type(dtype, np.complex64)
+    basis_dtype = dtype
+    mv = matvec
+    if v0 is None:
+        v0 = np.random.default_rng(0).standard_normal(n)
+    elif (np.iscomplexobj(np.asarray(v0))
+          and not np.issubdtype(dtype, np.complexfloating)):
+        # Complex start on a real operator: complex basis, two real
+        # matvecs per step (the only case that needs them).
+        basis_dtype = cdtype
+
+        def mv(x):
+            return (matvec(jnp.real(x).astype(dtype)).astype(cdtype)
+                    + 1j * matvec(jnp.imag(x).astype(dtype))
+                    .astype(cdtype))
+    v0 = jnp.asarray(v0, dtype=basis_dtype)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    rdtype = np.finfo(cdtype).dtype
+    arnoldi = jax.jit(_arnoldi, static_argnums=(0,),
+                      static_argnames=("m",))
+    atol, m, tries = _escalation_params(tol, rdtype, ncv, k, n,
+                                        maxiter, min_extra=2)
+    for _ in range(tries):
+        V, H = arnoldi(mv, v0, m=m)
+        Hm = np.asarray(H)[:m, :m]
+        beta_last = float(abs(np.asarray(H)[m, m - 1]))
+        w, y = np.linalg.eig(Hm)
+        sel = _select_ritz(w, k, which)
+        w_k = w[sel]
+        y_k = y[:, sel]
+        resid = beta_last * np.abs(y_k[-1, :])
+        scale = np.maximum(np.abs(w_k), 1.0)
+        if np.all(resid <= atol * scale) or m >= n:
+            break
+        m = min(n, 2 * m)
+    if not return_eigenvectors:
+        return w_k
+    X = np.asarray(jnp.einsum("mn,mk->nk", V,
+                              jnp.asarray(y_k, dtype=cdtype)))
+    return w_k, X

@@ -690,7 +690,7 @@ def norm(A, ord=None, axis=None):
 # Device-native eigensolvers and extra Krylov solvers (module
 # attributes take priority over the __getattr__ fallback below, so
 # these shadow the host-scipy versions).
-from .eigen import eigsh, lobpcg, svds  # noqa: E402
+from .eigen import eigs, eigsh, lobpcg, svds  # noqa: E402
 from .expm import expm_multiply  # noqa: E402
 from .krylov_extra import (differentiable_solve, lsmr, lsqr,  # noqa: E402
                            minres)
