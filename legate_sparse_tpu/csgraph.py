@@ -15,6 +15,15 @@ bulk-parallel algorithms run natively on device:
   each sweep is two scatter-min ops over the edge list, O(diameter)
   sweeps, all inside one jitted while_loop.  A graph BFS/union-find is
   sequential; label propagation is the TPU-shaped equivalent.
+- ``shortest_path`` / ``bellman_ford`` / ``dijkstra`` / ``johnson``:
+  min-plus relaxation — each sweep is one vectorized gather + scatter-
+  min over the edge list for ALL sources at once (a min-plus SpMM),
+  inside one jitted while_loop; a priority queue is inherently
+  sequential, edge relaxation is the TPU-shaped equivalent and is
+  correct for negative weights too (so ``dijkstra`` here matches
+  ``johnson`` instead of silently degrading).
+- ``floyd_warshall``: the classic k-loop as a ``fori_loop`` of rank-1
+  min-plus outer updates on the dense (n, n) distance matrix.
 
 The reference has no graph surface at all (exhaustive tree read,
 SURVEY §2).
@@ -29,7 +38,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["connected_components", "laplacian"]
+__all__ = [
+    "connected_components", "laplacian", "shortest_path",
+    "bellman_ford", "dijkstra", "johnson", "floyd_warshall",
+    "NegativeCycleError",
+]
+
+# scipy's exception class so callers' except clauses work unchanged.
+from scipy.sparse.csgraph import NegativeCycleError  # noqa: E402
+
+_UNREACHABLE = -9999  # scipy's predecessor/source sentinel
 
 
 def _as_package_csr(graph):
@@ -172,6 +190,247 @@ def laplacian(csgraph, normed=False, return_diag=False,
     L = A._with_data(-A._data / (w[row_ids] * w[A._indices]))
     L.setdiag(np.asarray(1.0 - isolated.astype(w.dtype)))
     return (L, np.asarray(w)) if return_diag else L
+
+
+# ---------------------------------------------------------------------------
+# Shortest paths: min-plus relaxation (all sources at once) + Floyd-Warshall.
+# ---------------------------------------------------------------------------
+
+def _graph_edges(csgraph, directed, unweighted):
+    """Edge list (rows, cols, w) of the traversal graph.  Stored zeros
+    ARE edges (scipy semantics, verified); ``directed=False`` appends
+    the reversed edges — scatter-min relaxation then takes the min of
+    the two directions automatically."""
+    from .runtime import runtime
+
+    A = _as_package_csr(csgraph)
+    if A.shape[0] != A.shape[1]:
+        raise ValueError("graph must be a square matrix or array")
+    n = A.shape[0]
+    rows = A._get_row_ids()
+    cols = A._indices
+    fdt = runtime.default_float
+    if unweighted:
+        w = jnp.ones(rows.shape, dtype=fdt)
+    else:
+        w = A._data.astype(fdt) if A._data.dtype != fdt else A._data
+    if not directed:
+        rows, cols = jnp.concatenate([rows, cols]), jnp.concatenate(
+            [cols, rows])
+        w = jnp.concatenate([w, w])
+    return rows, cols, w, n
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _relax_all(rows, cols, w, sources, n: int):
+    """Bellman-Ford for all sources at once.  One sweep = gather the
+    tentative distances at every edge tail (for every source) + one
+    scatter-min into the heads: a min-plus sparse-times-dense product.
+    Runs at most n sweeps; a sweep that still improves after n-1 of
+    them can only mean a reachable negative cycle."""
+    S = sources.shape[0]
+    dist0 = jnp.full((S, n), jnp.inf, dtype=w.dtype)
+    dist0 = dist0.at[jnp.arange(S), sources].set(0.0)
+
+    def body(state):
+        dist, sweep, _ = state
+        new = dist.at[:, cols].min(dist[:, rows] + w[None, :])
+        return new, sweep + 1, jnp.any(new < dist)
+
+    def cond(state):
+        _, sweep, changed = state
+        return changed & (sweep < n)
+
+    dist, _, _ = jax.lax.while_loop(
+        cond, body, (dist0, jnp.asarray(0), jnp.asarray(True)))
+    extra = dist.at[:, cols].min(dist[:, rows] + w[None, :])
+    return dist, jnp.any(extra < dist)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _predecessors(rows, cols, w, dist, sources, n: int):
+    """Predecessor matrix consistent with a converged distance matrix:
+    node j's predecessor (per source) is the smallest-indexed edge tail
+    u with dist[u] + w == dist[j].  One gather + one scatter-min."""
+    S = dist.shape[0]
+    tail = dist[:, rows]
+    # inf + w == inf would mark edges between unreachable nodes as
+    # "tight"; scipy keeps -9999 there
+    tight = jnp.isfinite(tail) & (tail + w[None, :] == dist[:, cols])
+    cand = jnp.where(tight, rows[None, :], n)
+    pred = jnp.full((S, n), n, dtype=rows.dtype).at[:, cols].min(cand)
+    pred = jnp.where(pred == n, _UNREACHABLE, pred)
+    return pred.at[jnp.arange(S), sources].set(_UNREACHABLE)
+
+
+def _resolve_indices(indices, n):
+    """(sources array, squeeze?) per scipy: None → all nodes, scalar →
+    1-D result, negative wraps, out of range raises."""
+    if indices is None:
+        return np.arange(n, dtype=np.int64), False
+    idx = np.asarray(indices, dtype=np.int64)
+    scalar = idx.ndim == 0
+    idx = np.atleast_1d(idx)
+    if idx.size and (np.any(idx < -n) or np.any(idx >= n)):
+        raise ValueError("indices out of range 0...N")
+    return idx % max(n, 1), scalar
+
+
+def _minplus_paths(csgraph, directed, indices, return_predecessors,
+                   unweighted, limit=None, edges=None):
+    rows, cols, w, n = (edges if edges is not None
+                        else _graph_edges(csgraph, directed, unweighted))
+    src, scalar = _resolve_indices(indices, n)
+    if n == 0 or src.size == 0:
+        dist = np.zeros((src.size, n))
+        pred = np.full((src.size, n), _UNREACHABLE, dtype=np.int32)
+    else:
+        jsrc = jnp.asarray(src)
+        ddist, neg = _relax_all(rows, cols, w, jsrc, n)
+        if bool(neg):
+            raise NegativeCycleError(
+                "Negative cycle detected on the graph")
+        if return_predecessors:
+            pred = np.asarray(
+                _predecessors(rows, cols, w, ddist, jsrc, n),
+                dtype=np.int32)
+        dist = np.asarray(ddist, dtype=np.float64)
+    if limit is not None and limit != np.inf:
+        # any prefix of a within-limit path is within limit for
+        # non-negative weights, so post-filtering equals scipy's
+        # in-search cutoff
+        over = dist > limit
+        dist = np.where(over, np.inf, dist)
+        if return_predecessors:
+            pred = np.where(over, np.int32(_UNREACHABLE), pred)
+    if scalar:
+        dist = dist[0]
+        if return_predecessors:
+            pred = pred[0]
+    return (dist, pred) if return_predecessors else dist
+
+
+def bellman_ford(csgraph, directed=True, indices=None,
+                 return_predecessors=False, unweighted=False,
+                 overwrite=False):
+    """Bellman-Ford shortest paths (scipy signature), computed as
+    jitted min-plus edge relaxation for all sources simultaneously.
+    Raises :class:`NegativeCycleError` like scipy."""
+    return _minplus_paths(csgraph, directed, indices,
+                          return_predecessors, unweighted)
+
+
+def dijkstra(csgraph, directed=True, indices=None,
+             return_predecessors=False, unweighted=False,
+             limit=np.inf, min_only=False):
+    """Dijkstra-compatible shortest paths (scipy signature).  A binary
+    heap is inherently sequential; the same distances come out of the
+    min-plus relaxation sweep, which also stays correct under negative
+    weights (scipy's dijkstra only warns and degrades there — we keep
+    the warning for parity but return the exact answer)."""
+    edges = _graph_edges(csgraph, directed, unweighted)
+    w_ = edges[2]
+    if w_.size and bool(jnp.any(w_ < 0)):
+        import warnings
+
+        warnings.warn("Graph has negative weights: dijkstra will give "
+                      "inaccurate results if the graph contains "
+                      "negative cycles. Consider johnson or "
+                      "bellman_ford.", UserWarning, stacklevel=2)
+    res = _minplus_paths(csgraph, directed, indices,
+                         return_predecessors=return_predecessors,
+                         unweighted=unweighted, limit=limit,
+                         edges=edges)
+    if not min_only:
+        return res
+    # min_only: collapse the per-source rows to the elementwise best
+    # source; scipy returns (dist, predecessors, sources).
+    dist, pred = res if return_predecessors else (res, None)
+    dist2 = np.atleast_2d(dist)
+    src, _ = _resolve_indices(indices, dist2.shape[1])
+    win = np.argmin(dist2, axis=0)
+    ar = np.arange(dist2.shape[1])
+    best = dist2[win, ar]
+    sources = np.where(np.isinf(best), _UNREACHABLE,
+                       src[win]).astype(np.int32)
+    if not return_predecessors:
+        return best
+    return best, np.atleast_2d(pred)[win, ar], sources
+
+
+def johnson(csgraph, directed=True, indices=None,
+            return_predecessors=False, unweighted=False):
+    """Johnson's algorithm (scipy signature).  Its whole point is
+    making negative weights safe for a heap — the min-plus relaxation
+    already is, so this is the same kernel as :func:`bellman_ford`."""
+    return _minplus_paths(csgraph, directed, indices,
+                          return_predecessors, unweighted)
+
+
+@partial(jax.jit, static_argnames=("n", "want_pred"))
+def _fw_kernel(dense, pred0, n: int, want_pred: bool):
+    def body(k, state):
+        dist, pred = state
+        via = dist[:, k][:, None] + dist[k, :][None, :]
+        better = via < dist
+        dist = jnp.where(better, via, dist)
+        if want_pred:
+            pred = jnp.where(better, pred[k, :][None, :], pred)
+        return dist, pred
+
+    return jax.lax.fori_loop(0, n, body, (dense, pred0))
+
+
+def floyd_warshall(csgraph, directed=True, return_predecessors=False,
+                   unweighted=False, overwrite=False):
+    """Floyd-Warshall all-pairs shortest paths (scipy signature): the
+    k-loop is a ``fori_loop`` of rank-1 min-plus outer-product updates
+    on the dense (n, n) distance matrix — each step is one broadcast
+    add + elementwise min, ideal VPU shape."""
+    rows, cols, w, n = _graph_edges(csgraph, directed, unweighted)
+    if n == 0:
+        dist = np.zeros((0, 0))
+        return (dist, np.zeros((0, 0), np.int32)) \
+            if return_predecessors else dist
+    dense = jnp.full((n, n), jnp.inf, dtype=w.dtype)
+    dense = dense.at[rows, cols].min(w)
+    diag = jnp.minimum(jnp.diagonal(dense), 0.0)  # self-loops can only
+    dense = dense.at[jnp.arange(n), jnp.arange(n)].set(diag)  # lower 0
+    if return_predecessors:
+        pred0 = jnp.where(
+            jnp.isfinite(dense)
+            & (jnp.arange(n)[:, None] != jnp.arange(n)[None, :]),
+            jnp.arange(n, dtype=jnp.int32)[:, None],
+            jnp.int32(_UNREACHABLE))
+    else:
+        pred0 = jnp.zeros((1, 1), dtype=jnp.int32)
+    dist, pred = _fw_kernel(dense, pred0, n, return_predecessors)
+    if bool(jnp.any(jnp.diagonal(dist) < 0)):
+        raise NegativeCycleError(
+            "Negative cycle detected on the graph")
+    dist = np.asarray(dist, dtype=np.float64)
+    if return_predecessors:
+        return dist, np.asarray(pred, dtype=np.int32)
+    return dist
+
+
+def shortest_path(csgraph, method="auto", directed=True,
+                  return_predecessors=False, unweighted=False,
+                  overwrite=False, indices=None):
+    """Dispatch front-end matching ``scipy.sparse.csgraph
+    .shortest_path``.  'FW' runs the dense kernel; 'D'/'BF'/'J' and
+    'auto' run the min-plus relaxation (correct for every weight sign,
+    so 'auto' never needs scipy's heuristics)."""
+    if method == "FW":
+        if indices is not None:
+            raise ValueError("Cannot specify indices with method == 'FW'")
+        return floyd_warshall(csgraph, directed=directed,
+                              return_predecessors=return_predecessors,
+                              unweighted=unweighted, overwrite=overwrite)
+    if method not in ("auto", "D", "BF", "J"):
+        raise ValueError(f"unrecognized method '{method}'")
+    return _minplus_paths(csgraph, directed, indices,
+                          return_predecessors, unweighted)
 
 
 def __getattr__(name):

@@ -90,9 +90,143 @@ def test_fallbacks_take_package_arrays():
     np.testing.assert_allclose(
         sparse.csgraph.minimum_spanning_tree(A).toarray(),
         scsg.minimum_spanning_tree(E).toarray())
+
+
+def _weighted(n=80, density=0.06, seed=4, negative=False):
+    rng = np.random.default_rng(seed)
+    E = sp.random(n, n, density=density, format="csr", random_state=rng)
+    w = rng.uniform(0.5, 3.0, size=E.nnz)
+    if negative:
+        # a few negative edges but no negative cycles (only edges
+        # u -> v with u < v go negative: a DAG subset can't cycle)
+        r, c = E.tocoo().row, E.tocoo().col
+        w = np.where((r < c) & (rng.random(E.nnz) < 0.2), -w * 0.1, w)
+    E = sp.csr_array((w, E.indices, E.indptr), shape=(n, n))
+    return E, sparse.csr_array(E)
+
+
+@pytest.mark.parametrize("method", ["auto", "D", "BF", "J", "FW"])
+@pytest.mark.parametrize("directed", [True, False])
+def test_shortest_path_matches_scipy(method, directed):
+    E, A = _weighted()
+    got = sparse.csgraph.shortest_path(A, method=method,
+                                       directed=directed)
+    ref = scsg.shortest_path(E, method=method, directed=directed)
+    np.testing.assert_allclose(got, ref, rtol=1e-10)
+
+
+def test_shortest_path_unweighted_and_indices():
+    E, A = _weighted(seed=5)
     np.testing.assert_allclose(
-        sparse.csgraph.dijkstra(A, indices=[0, 5]),
-        scsg.dijkstra(E, indices=[0, 5]))
-    np.testing.assert_allclose(
-        sparse.csgraph.shortest_path(A, method="D", unweighted=True),
+        sparse.csgraph.shortest_path(A, unweighted=True),
         scsg.shortest_path(E, method="D", unweighted=True))
+    np.testing.assert_allclose(
+        sparse.csgraph.bellman_ford(A, indices=[3, 7]),
+        scsg.bellman_ford(E, indices=[3, 7]))
+    # scalar index → 1-D result, scipy shape semantics
+    got = sparse.csgraph.dijkstra(A, indices=2)
+    ref = scsg.dijkstra(E, indices=2)
+    assert got.shape == ref.shape == (E.shape[0],)
+    np.testing.assert_allclose(got, ref)
+
+
+def test_negative_weights_and_cycle():
+    E, A = _weighted(seed=6, negative=True)
+    for fn, sfn in [(sparse.csgraph.bellman_ford, scsg.bellman_ford),
+                    (sparse.csgraph.johnson, scsg.johnson),
+                    (sparse.csgraph.floyd_warshall,
+                     scsg.floyd_warshall)]:
+        np.testing.assert_allclose(fn(A), sfn(E), rtol=1e-10,
+                                   atol=1e-12)
+    # explicit negative cycle raises scipy's exception class
+    C = sparse.csr_array((np.array([1.0, -3.0]),
+                          (np.array([0, 1]), np.array([1, 0]))),
+                         shape=(2, 2))
+    with pytest.raises(scsg.NegativeCycleError):
+        sparse.csgraph.bellman_ford(C)
+    with pytest.raises(scsg.NegativeCycleError):
+        sparse.csgraph.floyd_warshall(C)
+
+
+def _check_predecessors(dist, pred, E, directed):
+    """Predecessor matrices are implementation-specific under ties;
+    check consistency instead of equality: every reachable non-source
+    node's predecessor edge must exist and be tight."""
+    G = E.toarray()
+    if not directed:
+        both = np.where(G != 0, G, np.inf)
+        both = np.minimum(both, both.T)
+    else:
+        both = np.where(G != 0, G, np.inf)
+    # stored zeros are edges; rebuild edge weights from sparse struct
+    coo = E.tocoo()
+    W = np.full_like(G, np.inf, dtype=float)
+    W[coo.row, coo.col] = coo.data
+    if not directed:
+        W = np.minimum(W, W.T)
+    n = G.shape[0]
+    for i in range(dist.shape[0]):
+        for j in range(n):
+            p = pred[i, j]
+            if p == -9999:
+                continue
+            assert np.isfinite(W[p, j])
+            np.testing.assert_allclose(dist[i, p] + W[p, j],
+                                       dist[i, j], rtol=1e-10)
+
+
+@pytest.mark.parametrize("directed", [True, False])
+def test_predecessors_consistent(directed):
+    E, A = _weighted(n=40, density=0.1, seed=7)
+    dist, pred = sparse.csgraph.shortest_path(
+        A, return_predecessors=True, directed=directed)
+    ref_d = scsg.shortest_path(E, directed=directed)
+    np.testing.assert_allclose(dist, ref_d, rtol=1e-10)
+    _check_predecessors(dist, pred, E, directed)
+    dist, pred = sparse.csgraph.floyd_warshall(
+        A, return_predecessors=True, directed=directed)
+    np.testing.assert_allclose(dist, ref_d, rtol=1e-10)
+    _check_predecessors(dist, pred, E, directed)
+
+
+def test_dijkstra_limit_and_min_only():
+    E, A = _weighted(n=60, density=0.08, seed=8)
+    np.testing.assert_allclose(
+        sparse.csgraph.dijkstra(A, limit=2.5),
+        scsg.dijkstra(E, limit=2.5))
+    d_got = sparse.csgraph.dijkstra(A, indices=[0, 9], min_only=True)
+    d_ref = scsg.dijkstra(E, indices=[0, 9], min_only=True)
+    np.testing.assert_allclose(d_got, d_ref)
+    got = sparse.csgraph.dijkstra(A, indices=[0, 9], min_only=True,
+                                  return_predecessors=True)
+    ref = scsg.dijkstra(E, indices=[0, 9], min_only=True,
+                        return_predecessors=True)
+    np.testing.assert_allclose(got[0], ref[0])
+    np.testing.assert_array_equal(got[2], ref[2])
+
+
+def test_unreachable_predecessors_and_bad_indices():
+    # edge 1->2 only; from source 0 everything is unreachable, and the
+    # inf+w==inf tightness trap must not invent pred[2]=1
+    A = sparse.csr_array((np.array([1.0]), (np.array([1]),
+                                            np.array([2]))), shape=(3, 3))
+    dist, pred = sparse.csgraph.bellman_ford(A, indices=[0],
+                                             return_predecessors=True)
+    np.testing.assert_array_equal(pred, [[-9999, -9999, -9999]])
+    assert np.isinf(dist[0, 1]) and np.isinf(dist[0, 2])
+    # scipy index semantics: negative wraps, out-of-range raises
+    d = sparse.csgraph.dijkstra(A, indices=-2)
+    np.testing.assert_allclose(d, [np.inf, 0.0, 1.0])
+    with pytest.raises(ValueError):
+        sparse.csgraph.dijkstra(A, indices=[3])
+
+
+def test_shortest_path_stored_zero_edges():
+    # stored zeros ARE edges (verified scipy semantics)
+    B = sp.csr_array((np.array([1.0, 0.0, 2.0]), np.array([1, 2, 2]),
+                      np.array([0, 2, 3, 3])), shape=(3, 3))
+    A = sparse.csr_array(B)
+    np.testing.assert_allclose(sparse.csgraph.shortest_path(A),
+                               scsg.shortest_path(B))
+    np.testing.assert_allclose(
+        sparse.csgraph.floyd_warshall(A), scsg.floyd_warshall(B))
