@@ -89,6 +89,27 @@ def _escalation_params(tol, rdtype, ncv, k, rank, maxiter,
     return atol, m, tries
 
 
+def _require_converged(resid, atol, scale, m, cap, w_k, X=None):
+    """scipy parity on escalation exhaustion: raise
+    ``ArpackNoConvergence`` (carrying the converged subset) instead of
+    silently returning unconverged Ritz pairs.  ``m >= cap`` means the
+    Krylov space is the whole (masked) space — exact up to roundoff,
+    never an error."""
+    ok = resid <= atol * scale
+    if bool(np.all(ok)) or m >= cap:
+        return
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    raise ArpackNoConvergence(
+        f"ARPACK-style error: no convergence "
+        f"({int(ok.sum())}/{ok.size} eigenvalues converged; "
+        f"subspace m={m}, cap={cap})",
+        np.asarray(w_k)[ok],
+        (np.asarray(X)[:, ok] if X is not None
+         else np.empty((0, int(ok.sum())))),
+    )
+
+
 # ---------------------------------------------------------------- Lanczos
 
 
@@ -189,9 +210,13 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
             break
         m = min(rank, 2 * m)
     w_k = w_k.astype(rdtype)
+    converged = bool(np.all(resid <= atol * scale)) or m >= rank
+    if converged and not return_eigenvectors:
+        return w_k          # skip forming X entirely
+    X = np.asarray(jnp.einsum("mn,mk->nk", V, jnp.asarray(y_k, dtype=dtype)))
+    _require_converged(resid, atol, scale, m, rank, w_k, X)
     if not return_eigenvectors:
         return w_k
-    X = np.asarray(jnp.einsum("mn,mk->nk", V, jnp.asarray(y_k, dtype=dtype)))
     return w_k, X
 
 
@@ -443,8 +468,12 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         if np.all(resid <= atol * scale) or m >= n:
             break
         m = min(n, 2 * m)
-    if not return_eigenvectors:
-        return w_k
+    converged = bool(np.all(resid <= atol * scale)) or m >= n
+    if converged and not return_eigenvectors:
+        return w_k          # skip forming X entirely
     X = np.asarray(jnp.einsum("mn,mk->nk", V,
                               jnp.asarray(y_k, dtype=cdtype)))
+    _require_converged(resid, atol, scale, m, n, w_k, X)
+    if not return_eigenvectors:
+        return w_k
     return w_k, X

@@ -195,3 +195,23 @@ def test_eigs_complex_pairs_and_complex_operator():
     wh, Xh = linalg.eigs(sparse.csr_array(H_sp), k=3, which="LM")
     resid_h = np.linalg.norm(H_sp @ Xh - Xh * wh[None, :], axis=0)
     assert np.all(resid_h < 1e-6)
+
+
+def test_no_convergence_raises_like_scipy():
+    # A Krylov subspace too small to converge with escalation capped at
+    # one try must raise scipy's exception class, not silently return
+    # unconverged Ritz pairs (scipy _lanczos/_arnoldi parity).
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    rng = np.random.default_rng(3)
+    n = 400
+    A_sp = sp.csr_array(
+        sp.random(n, n, density=0.05, random_state=rng) + 5 * sp.eye(n))
+    with pytest.raises(ArpackNoConvergence) as ei:
+        linalg.eigs(sparse.csr_array(A_sp), k=4, ncv=6, maxiter=1,
+                    tol=1e-14)
+    assert ei.value.eigenvalues.ndim == 1     # converged subset carried
+    S_sp = sp.csr_array((A_sp + A_sp.T) / 2)
+    with pytest.raises(ArpackNoConvergence):
+        linalg.eigsh(sparse.csr_array(S_sp), k=4, ncv=6, maxiter=1,
+                     tol=1e-14)
