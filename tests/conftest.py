@@ -43,11 +43,54 @@ if _USE_CACHE:
         "jax_compilation_cache_dir",
         os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # Only compiles >= 1 s are persisted.  XLA:CPU executable
+    # (de)serialization segfaulted the suite three times (2026-07-31,
+    # stacks in git history: put/get_executable_and_time under
+    # compress_coo / spgemm_csr_csr_csr_impl) and the crash is
+    # suite-context-dependent — not reproducible in isolation, so not
+    # reportable upstream with a repro.  The sub-second executables it
+    # struck are cheap to recompile; the multi-device shard_map and
+    # solver compiles that dominate suite wall time (10-60 s each)
+    # stay cached, which preserves nearly all of the warm-run win.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The full suite compiles thousands of XLA:CPU executables; each holds
+# several JIT code mmaps, and one pytest process crosses the kernel's
+# default vm.max_map_count (65530) at ~450 tests — the next mmap
+# failure SEGFAULTS inside backend_compile_and_load (observed at
+# 59k maps, 2026-07-31).  Two defenses: best-effort raise of the limit
+# (root-only; ignored elsewhere), and an adaptive cache flush that
+# drops executables before the ceiling.  clear_caches() recompiles
+# later reuses — the persistent compile cache absorbs the big ones.
+try:
+    with open("/proc/sys/vm/max_map_count", "r+") as _f:
+        if int(_f.read()) < 262144:
+            _f.seek(0)
+            _f.write("262144")
+except OSError:
+    pass
+
+_MAPS_SOFT_LIMIT = 45000
+
+
+def _map_count() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return f.read().count(b"\n")
+    except OSError:
+        return 0
+
+
+@pytest.fixture(autouse=True)
+def _vma_guard():
+    yield
+    if _map_count() > _MAPS_SOFT_LIMIT:
+        import jax as _jax
+
+        _jax.clear_caches()
 
 
 @pytest.fixture
