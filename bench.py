@@ -72,6 +72,48 @@ def _probe_accelerator() -> bool:
     )
 
 
+_CANARY_CODE = r"""
+import sys
+import numpy as np
+import jax.numpy as jnp
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+n = 1 << int(sys.argv[1])
+half = 5
+offsets = list(range(-half, half + 1))
+diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
+A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
+                 dtype=np.float32)
+x = jnp.ones((n,), dtype=jnp.float32)
+float(jnp.sum(A @ x))                      # eager launch
+loop_ms_per_iter(lambda v: A @ v, x, k_lo=2, k_hi=6)   # looped program
+print("canary-ok")
+"""
+
+
+def _pallas_canary(log2n: int, timeout_s: int = 600) -> str:
+    """Run the exact banded Pallas path (eager + chained loop) in a
+    throwaway subprocess: "ok" | "crash" | "timeout".
+
+    The 2026-07-31 on-chip capture showed the production kernel can
+    fault the TPU worker ("TPU worker process crashed"); a fault inside
+    the measurement process would cost the whole contract line, so the
+    canary takes the hit instead and the caller degrades to the XLA
+    band path (and to CPU when the worker doesn't come back).
+    """
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _CANARY_CODE, str(log2n)],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    return "ok" if ("canary-ok" in (r.stdout or "")
+                    and r.returncode == 0) else "crash"
+
+
 def _stream_bandwidth() -> float:
     """Measured triad bandwidth (GB/s): x' = a*x + y, 2^26 f32 lanes —
     512 MB working set so VMEM (~128 MB) cannot cache it."""
@@ -190,6 +232,22 @@ def main() -> None:
         return False
 
     use_accel = _probe_accelerator()
+    canary = None
+    if (use_accel
+            and os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA", "1") != "0"
+            and os.environ.get("LEGATE_SPARSE_TPU_BENCH_CANARY", "1") != "0"):
+        log2n = int(os.environ.get("LEGATE_SPARSE_TPU_BENCH_LOG2_ROWS", "24"))
+        canary = _pallas_canary(log2n)
+        if canary != "ok":
+            sys.stderr.write(
+                f"bench: pallas canary verdict '{canary}'; disabling the "
+                f"Pallas DIA path for this run\n"
+            )
+            os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
+            # Crash OR timeout can mean the worker went down with the
+            # canary (the observed on-chip failures present as both);
+            # only continue on TPU if a fresh probe still answers.
+            use_accel = _probe_accelerator()
     if not use_accel:
         from legate_sparse_tpu._platform import pin_cpu
 
@@ -216,6 +274,8 @@ def main() -> None:
         "vs_baseline": None,
         "platform": platform,
     }
+    if canary is not None:
+        result["pallas_canary"] = canary
 
     # On CPU shrink everything: the fallback exists to record *a* number.
     default_log2 = "24" if platform != "cpu" else "20"

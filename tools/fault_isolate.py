@@ -1,0 +1,140 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Isolate the on-chip "TPU worker crashed" fault seen in bench.py.
+
+Runs one configuration per SUBPROCESS (a worker crash kills only that
+probe), most-diagnostic-first, and appends each verdict to
+TPU_EVIDENCE.md the moment it lands.  Configurations walk the exact
+bench path (diags -> csr -> SpMV dispatch; the bench band is exact, so
+the kernel runs unmasked) across sizes x {pallas, xla}, and each
+probe reports eager launches AND the chained-fori_loop composition
+separately — the pack-time eager validation passed on-chip while
+bench's looped timing crashed the worker, so the composition is a
+prime suspect.
+
+Usage: python tools/fault_isolate.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TPU_EVIDENCE.md")
+
+PROBE = r"""
+import json, os, sys, time
+import numpy as np
+log2 = int(sys.argv[1])
+mode = sys.argv[2]            # pallas | xla
+if mode == "xla":
+    os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
+import jax
+import jax.numpy as jnp
+import legate_sparse_tpu as sparse
+
+n = 1 << log2
+nnz_per_row = 11
+offsets = list(range(-(nnz_per_row // 2), nnz_per_row // 2 + 1))
+diagonals = [np.full(n - abs(o), 1.0 + o * 0.01, dtype=np.float32)
+             for o in offsets]
+t0 = time.time()
+A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
+                 dtype=np.float32)
+x = jnp.ones((n,), dtype=jnp.float32)
+build_s = time.time() - t0
+path = ("dia" if A._get_dia() is not None else "csr")
+pk = A._get_dia_pack() if mode == "pallas" else None
+out = {"log2": log2, "mode": mode, "path": path,
+       "packed": pk is not None, "build_s": round(build_s, 1)}
+expect = float(np.sum([d.sum() for d in diagonals]))
+
+# Stage 1: eager launches (one pallas_call per dispatch).
+t0 = time.time()
+y = A @ x
+s1 = float(jnp.sum(y))          # forces fetch through the tunnel
+out["eager_first_s"] = round(time.time() - t0, 1)
+t0 = time.time()
+for _ in range(3):
+    y = A @ x
+float(jnp.sum(y))
+out["eager_rep_s"] = round((time.time() - t0) / 3, 3)
+out["eager_correct"] = abs(s1 - expect) < 1e-2 * max(1.0, abs(expect))
+print(json.dumps(out), flush=True)   # partial verdict survives a crash
+
+# Stage 2: the chained fori_loop composition bench.py times (the
+# pallas_call embedded in a larger jitted looped program) — this is
+# the stage bench crashed in while eager pack-validation passed.
+from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+dt_ms = loop_ms_per_iter(lambda v: A @ v, x, k_lo=2, k_hi=6)
+out["loop_ms_per_iter"] = round(dt_ms, 3)
+y2 = A @ x
+out["loop_correct"] = (abs(float(jnp.sum(y2)) - expect)
+                       < 1e-2 * max(1.0, abs(expect)))
+print(json.dumps(out), flush=True)
+"""
+
+
+def append(text: str) -> None:
+    with open(OUT, "a") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def run(log2: int, mode: str, timeout_s: int = 420) -> dict:
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE, str(log2), mode],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        wall = round(time.time() - t0, 1)
+        line = (r.stdout or "").strip().splitlines()
+        parsed = None
+        for ln in reversed(line):
+            try:
+                parsed = json.loads(ln)
+                break
+            except Exception:
+                continue
+        if r.returncode == 0 and parsed:
+            parsed["wall_s"] = wall
+            return parsed
+        return {"log2": log2, "mode": mode, "rc": r.returncode,
+                "wall_s": wall,
+                "stderr": (r.stderr or "")[-400:].strip()}
+    except subprocess.TimeoutExpired as e:
+        return {"log2": log2, "mode": mode, "rc": "timeout",
+                "wall_s": timeout_s,
+                "stderr": ((e.stderr or b"").decode("utf-8", "replace")
+                           if isinstance(e.stderr, bytes)
+                           else (e.stderr or ""))[-400:].strip()}
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S")
+    append(f"\n## Fault isolation {stamp}\n\n"
+           "One subprocess per row (bench's exact diags->SpMV path); a "
+           "crash poisons only its own row.\n\n```json\n")
+    sizes = [16, 20, 22, 24] if not quick else [16, 22]
+    for log2 in sizes:
+        for mode in ("pallas", "xla"):
+            # big sizes pay multi-minute tunnel uploads before compute
+            res = run(log2, mode, timeout_s=420 if log2 < 22 else 700)
+            append(json.dumps(res) + "\n")
+            print(json.dumps(res), flush=True)
+            bad = res.get("rc") not in (None,) or not res.get("correct", True)
+            if mode == "pallas" and bad and str(res.get("rc")) == "timeout":
+                # worker likely wedged; give it one recovery pause
+                time.sleep(60)
+    append("```\n")
+
+
+if __name__ == "__main__":
+    main()

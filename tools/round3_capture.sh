@@ -23,11 +23,23 @@ fi
 echo "$stamp: TPU alive; capturing" | tee -a evidence/round3_capture.log
 start_lines=$(wc -l < TPU_EVIDENCE.md 2>/dev/null || echo 0)
 
-# 1. The full evidence sweep, incremental appends: tunnel probe,
-#    bench.py (BENCH-contract metrics incl. spgemm/gmg/bsr), kernel
-#    shoot-out, -m tpu lane, SpGEMM, CG 2048^2.  Inner per-phase
-#    timeouts sum to ~9000s; the outer bound only guards a wedged parent.
+# 0. QUICK fault isolation first (2 sizes x 2 modes, bounded well
+#    below a window length): the 11:24 window showed the production
+#    Pallas DIA path crashes the TPU worker at the bench size; each
+#    probe runs in its own subprocess and appends its verdict
+#    immediately, so the crashing configuration is named even if the
+#    window closes right after — without consuming the window the way
+#    a full sweep would.
+timeout 1800 python tools/fault_isolate.py --quick 2>&1 | tee -a evidence/round3_capture.log
+
+# 1. The headline evidence sweep, incremental appends: tunnel probe,
+#    bench.py (canary-guarded: falls back to the XLA band path when the
+#    Pallas kernel faults the worker), kernel shoot-out, -m tpu lane,
+#    SpGEMM, CG 2048^2.
 timeout 9600 python tools/tpu_capture.py 2>&1 | tee -a evidence/round3_capture.log
+
+# 1b. Full-size fault isolation after the headline data is banked.
+timeout 4200 python tools/fault_isolate.py 2>&1 | tee -a evidence/round3_capture.log
 
 # 2. Irregular-path shoot-out (XLA ELL vs BSR across densities).
 #    Inner timeout 3000 < outer 3600 so the inner result write wins.
