@@ -86,34 +86,40 @@ def append(text: str) -> None:
         os.fsync(f.fileno())
 
 
+def _last_json(text: str):
+    for ln in reversed((text or "").strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except Exception:
+            continue
+    return None
+
+
 def run(log2: int, mode: str, timeout_s: int = 420) -> dict:
+    """One probe subprocess.  The stage-1 (eager) partial verdict is
+    KEPT on crash/timeout — the 'eager ok, loop crashed' distinction is
+    the whole point of the two-stage probe."""
     t0 = time.time()
     try:
         r = subprocess.run(
             [sys.executable, "-c", PROBE, str(log2), mode],
             capture_output=True, text=True, timeout=timeout_s,
         )
-        wall = round(time.time() - t0, 1)
-        line = (r.stdout or "").strip().splitlines()
-        parsed = None
-        for ln in reversed(line):
-            try:
-                parsed = json.loads(ln)
-                break
-            except Exception:
-                continue
-        if r.returncode == 0 and parsed:
-            parsed["wall_s"] = wall
-            return parsed
-        return {"log2": log2, "mode": mode, "rc": r.returncode,
-                "wall_s": wall,
-                "stderr": (r.stderr or "")[-400:].strip()}
+        out = _last_json(r.stdout) or {"log2": log2, "mode": mode}
+        out["wall_s"] = round(time.time() - t0, 1)
+        if r.returncode != 0:
+            out["rc"] = r.returncode
+            out["stderr"] = (r.stderr or "")[-400:].strip()
+        return out
     except subprocess.TimeoutExpired as e:
-        return {"log2": log2, "mode": mode, "rc": "timeout",
-                "wall_s": timeout_s,
-                "stderr": ((e.stderr or b"").decode("utf-8", "replace")
-                           if isinstance(e.stderr, bytes)
-                           else (e.stderr or ""))[-400:].strip()}
+        def _txt(b):
+            return (b.decode("utf-8", "replace")
+                    if isinstance(b, bytes) else (b or ""))
+        out = _last_json(_txt(e.stdout)) or {"log2": log2, "mode": mode}
+        out["rc"] = "timeout"
+        out["wall_s"] = timeout_s
+        out["stderr"] = _txt(e.stderr)[-400:].strip()
+        return out
 
 
 def main() -> None:
@@ -122,18 +128,26 @@ def main() -> None:
     append(f"\n## Fault isolation {stamp}\n\n"
            "One subprocess per row (bench's exact diags->SpMV path); a "
            "crash poisons only its own row.\n\n```json\n")
-    sizes = [16, 20, 22, 24] if not quick else [16, 22]
-    for log2 in sizes:
-        for mode in ("pallas", "xla"):
-            # big sizes pay multi-minute tunnel uploads before compute
-            res = run(log2, mode, timeout_s=420 if log2 < 22 else 700)
-            append(json.dumps(res) + "\n")
-            print(json.dumps(res), flush=True)
-            bad = res.get("rc") not in (None,) or not res.get("correct", True)
-            if mode == "pallas" and bad and str(res.get("rc")) == "timeout":
-                # worker likely wedged; give it one recovery pause
-                time.sleep(60)
-    append("```\n")
+    # Per-probe budgets must SUM below the capture script's outer
+    # timeout (quick: 1800s, full: 4200s) so the closing fence and the
+    # later capture phases always run: quick = 2*(300+540)+pauses,
+    # full = 2*(240+300+540+600)+pauses.
+    if quick:
+        plan = [(16, 300), (22, 540)]
+    else:
+        plan = [(16, 240), (20, 300), (22, 540), (24, 600)]
+    try:
+        for log2, budget in plan:
+            for mode in ("pallas", "xla"):
+                res = run(log2, mode, timeout_s=budget)
+                append(json.dumps(res) + "\n")
+                print(json.dumps(res), flush=True)
+                if mode == "pallas" and "rc" in res:
+                    # crash or timeout: the worker may be down; pause
+                    # once so the xla row isn't poisoned by recovery
+                    time.sleep(45)
+    finally:
+        append("```\n")
 
 
 if __name__ == "__main__":
