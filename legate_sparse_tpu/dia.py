@@ -26,6 +26,24 @@ from .types import coord_dtype_for, nnz_ty
 from .runtime import runtime
 
 
+def _band_slot_gather(data, offs, extent: int):
+    """Diagonal-realignment gather over scipy's column-aligned band
+    layout: ``gathered[d, p] = data[d, p + offs[d]]`` when that source
+    column is in ``[0, width)``, else 0.  Returns ``(gathered, valid,
+    src)`` — shared by ``transpose`` (p = column of A.T) and ``tocsr``
+    (p = row, so src is the CSR column index), so the clamp/mask
+    semantics live in exactly one place."""
+    num_d, width = data.shape
+    src = jnp.arange(extent)[None, :] + offs[:, None]
+    valid = (src >= 0) & (src < width)
+    gathered = jnp.where(
+        valid,
+        data[jnp.arange(num_d)[:, None], jnp.clip(src, 0, width - 1)],
+        jnp.zeros((), dtype=data.dtype),
+    )
+    return gathered, valid, src
+
+
 class dia_array(CompressedBase):
     """Sparse matrix with DIAgonal storage, backed by jax.Arrays."""
 
@@ -109,20 +127,10 @@ class dia_array(CompressedBase):
         if axes is not None:
             raise ValueError("axes parameter not supported")
         rows, cols = self.shape
-        num_d, width = self._data.shape
         max_dim = max(rows, cols)
         offs = self._offsets
         # new_data[d, j'] = data[d, j' + offset[d]] for j' = column in A.T
-        col_new = jnp.arange(max_dim)
-        src_col = col_new[None, :] + offs[:, None]
-        valid = (src_col >= 0) & (src_col < width)
-        gathered = jnp.where(
-            valid,
-            self._data[
-                jnp.arange(num_d)[:, None], jnp.clip(src_col, 0, width - 1)
-            ],
-            jnp.zeros((), dtype=self._data.dtype),
-        )
+        gathered, _, _ = _band_slot_gather(self._data, offs, max_dim)
         return dia_array(
             (gathered, -offs), shape=(cols, rows)
         )
@@ -165,23 +173,19 @@ class dia_array(CompressedBase):
         w = min(width, cols)
         cdt = coord_dtype_for(max(rows, cols) + 1)
         order = np.argsort(np.asarray(self._offsets), kind="stable")
-        offs = self._offsets.astype(cdt)[jnp.asarray(order)]
-        data = self._data[jnp.asarray(order)]
-        i = jnp.arange(rows, dtype=cdt)
-        col = i[None, :] + offs[:, None]             # (num_d, rows)
-        valid = (col >= 0) & (col < w)
+        if np.array_equal(order, np.arange(num_d)):
+            offs, data = self._offsets.astype(cdt), self._data
+        else:   # gather copies the whole band; skip when already sorted
+            offs = self._offsets.astype(cdt)[jnp.asarray(order)]
+            data = self._data[jnp.asarray(order)]
         # scipy DIA storage is column-aligned: data[d, col] holds
         # A[col - off_d, col].
-        vals = jnp.where(
-            valid,
-            data[jnp.arange(num_d)[:, None], jnp.clip(col, 0, width - 1)],
-            jnp.zeros((), dtype=data.dtype),
-        )
-        keep = valid & (vals != 0)                   # scipy drops zeros
+        vals, _, col = _band_slot_gather(data, offs, rows)
+        keep = (col >= 0) & (col < w) & (vals != 0)  # scipy drops zeros
         nnz = int(jnp.sum(keep))
         idx = jnp.nonzero(keep.T.reshape(-1), size=nnz, fill_value=0)[0]
         cdata = vals.T.reshape(-1)[idx]
-        cindices = col.T.reshape(-1)[idx]
+        cindices = col.T.reshape(-1)[idx].astype(cdt)
         # indptr counts nnz, not coordinates: nnz_ty (int64) per the
         # repo convention — an int32 cumsum would wrap past 2^31 nnz.
         counts = jnp.sum(keep, axis=0, dtype=nnz_ty)

@@ -134,6 +134,20 @@ def row_align(dia_data, offsets: Tuple[int, ...], shape: Tuple[int, int],
     return rdata, rmask
 
 
+def _use_mosaic_roll() -> bool:
+    """Roll lowering inside the kernels: ``pltpu.roll`` (default) or
+    plain ``jnp.roll`` with ``LEGATE_SPARSE_TPU_PALLAS_ROLL=xla``.
+    Both operate on VMEM-resident tiles, so the jnp variant's relayout
+    is VPU shuffle work, not HBM traffic — a fallback lowering in case
+    the Mosaic roll primitive is implicated in the on-chip worker
+    fault (fault_isolate's ``pallas-jroll`` mode probes it).
+
+    Read at kernel TRACE time and not part of the jit key: set it
+    before the first banded op of the process (the isolation harness
+    uses one subprocess per probe, so each reads it fresh)."""
+    return os.environ.get("LEGATE_SPARSE_TPU_PALLAS_ROLL", "tpu") != "xla"
+
+
 def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
     """xs with ``xs_flat[p] = w_flat[p + s]`` along the flattened last
     two dims of ``w`` (.., R, L); leading dims (axis base > 0) are
@@ -143,7 +157,7 @@ def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
     R = w.shape[axis]
     q, r = divmod(s, L)
 
-    if interpret:
+    if interpret or not _use_mosaic_roll():
         roll = lambda a, amt, ax: jnp.roll(a, amt, ax)
     else:
         from jax.experimental.pallas import tpu as pltpu
@@ -253,7 +267,7 @@ def _make_spmm_kernel(offsets: Tuple[int, ...], rows: int, cols: int,
             m_ref = None
         import jax.experimental.pallas as pl
 
-        if interpret:
+        if interpret or not _use_mosaic_roll():
             roll = lambda a, amt: jnp.roll(a, amt, 0)
         else:
             from jax.experimental.pallas import tpu as pltpu

@@ -284,3 +284,22 @@ def test_banded_spgemm_rectangular():
     assert C.nnz == SC.nnz
     np.testing.assert_allclose(np.asarray(C.todense()), SC.toarray(),
                                atol=1e-12)
+
+
+def test_transpose_wide_band_storage_matches_dense():
+    # Stored band wider than the matrix: scipy 1.17's dia transpose is
+    # internally inconsistent here (S.T.toarray() != S.toarray().T —
+    # entries shift along the diagonal), so the oracle is the DENSE
+    # transpose, which this package matches.
+    import scipy.sparse as sp
+
+    data = np.arange(1.0, 11.0).reshape(1, 10)
+    for offs, shape in [([2], (5, 9)), ([5], (5, 9)), ([2], (9, 5))]:
+        S = sp.dia_array((data, offs), shape=shape)
+        D = sparse.dia_array((data, offs), shape=shape)
+        np.testing.assert_array_equal(np.asarray(D.todense()),
+                                      S.toarray())
+        np.testing.assert_array_equal(np.asarray(D.T.todense()),
+                                      S.toarray().T)
+        np.testing.assert_array_equal(
+            np.asarray(D.tocsr().T.todense()), S.toarray().T)

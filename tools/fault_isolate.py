@@ -30,9 +30,11 @@ PROBE = r"""
 import json, os, sys, time
 import numpy as np
 log2 = int(sys.argv[1])
-mode = sys.argv[2]            # pallas | xla
+mode = sys.argv[2]            # pallas | pallas-jroll | xla
 if mode == "xla":
     os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
+elif mode == "pallas-jroll":
+    os.environ["LEGATE_SPARSE_TPU_PALLAS_ROLL"] = "xla"
 import jax
 import jax.numpy as jnp
 import legate_sparse_tpu as sparse
@@ -48,7 +50,7 @@ A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
 x = jnp.ones((n,), dtype=jnp.float32)
 build_s = time.time() - t0
 path = ("dia" if A._get_dia() is not None else "csr")
-pk = A._get_dia_pack() if mode == "pallas" else None
+pk = A._get_dia_pack() if mode.startswith("pallas") else None
 out = {"log2": log2, "mode": mode, "path": path,
        "packed": pk is not None, "build_s": round(build_s, 1)}
 expect = float(np.sum([d.sum() for d in diagonals]))
@@ -131,20 +133,26 @@ def main() -> None:
     # Per-probe budgets must SUM below the capture script's outer
     # timeout (quick: 1800s, full: 4200s) so the closing fence and the
     # later capture phases always run: quick = 2*(300+540)+pauses,
-    # full = 2*(240+300+540+600)+pauses.
+    # full = 3*(240+300)+2*(540+600)+pauses (jroll probed at small
+    # sizes where a verdict is cheap; roll-mode faults are size-
+    # independent lowering differences).
     if quick:
-        plan = [(16, 300), (22, 540)]
+        plan = [(16, 300, ("pallas", "xla")),
+                (22, 540, ("pallas", "xla"))]
     else:
-        plan = [(16, 240), (20, 300), (22, 540), (24, 600)]
+        plan = [(16, 240, ("pallas", "pallas-jroll", "xla")),
+                (20, 300, ("pallas", "pallas-jroll", "xla")),
+                (22, 540, ("pallas", "xla")),
+                (24, 600, ("pallas", "xla"))]
     try:
-        for log2, budget in plan:
-            for mode in ("pallas", "xla"):
+        for log2, budget, modes in plan:
+            for mode in modes:
                 res = run(log2, mode, timeout_s=budget)
                 append(json.dumps(res) + "\n")
                 print(json.dumps(res), flush=True)
-                if mode == "pallas" and "rc" in res:
+                if mode.startswith("pallas") and "rc" in res:
                     # crash or timeout: the worker may be down; pause
-                    # once so the xla row isn't poisoned by recovery
+                    # once so the next row isn't poisoned by recovery
                     time.sleep(45)
     finally:
         append("```\n")
