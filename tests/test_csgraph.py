@@ -84,12 +84,15 @@ def test_laplacian_self_loops():
 
 
 def test_fallbacks_take_package_arrays():
-    # scipy's csgraph Cython is int32-indexed; the boundary narrows
-    # our int64 indices (raw scipy rejects int64 outright).
-    E, A = _graph(seed=3)
+    # Distinct weights so the MST is unique — tied weights make
+    # scipy's own tree argsort-order-dependent.  (Also exercises the
+    # int64->int32 narrowing on the scipy side.)
+    E, A = _weighted(n=60, density=0.1, seed=3)
+    Es = ((E + E.T) / 2).tocsr()
     np.testing.assert_allclose(
-        sparse.csgraph.minimum_spanning_tree(A).toarray(),
-        scsg.minimum_spanning_tree(E).toarray())
+        sparse.csgraph.minimum_spanning_tree(
+            sparse.csr_array(Es)).toarray(),
+        scsg.minimum_spanning_tree(Es).toarray())
 
 
 def _weighted(n=80, density=0.06, seed=4, negative=False):
@@ -230,3 +233,60 @@ def test_shortest_path_stored_zero_edges():
                                scsg.shortest_path(B))
     np.testing.assert_allclose(
         sparse.csgraph.floyd_warshall(A), scsg.floyd_warshall(B))
+
+
+def test_minimum_spanning_tree_native():
+    # Symmetric distinct weights: MST unique, exact scipy equality.
+    rng = np.random.default_rng(12)
+    for trial in range(6):
+        n = int(rng.integers(5, 60))
+        Eu = sp.triu(sp.random(n, n, density=0.2, random_state=rng),
+                     k=1).tocoo()
+        w = rng.permutation(len(Eu.data)) + 1.0
+        S = sp.csr_array((np.concatenate([w, w]),
+                          (np.concatenate([Eu.row, Eu.col]),
+                           np.concatenate([Eu.col, Eu.row]))),
+                         shape=(n, n))
+        got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(S))
+        ref = scsg.minimum_spanning_tree(S)
+        np.testing.assert_allclose(np.asarray(got.todense()),
+                                   ref.toarray())
+    # Asymmetric stored direction is preserved; disconnected forest.
+    B = sp.csr_array(np.array([[0, 0, 0], [4.0, 0, 0], [0, 1.0, 0]]))
+    got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(B))
+    np.testing.assert_allclose(np.asarray(got.todense()),
+                               scsg.minimum_spanning_tree(B).toarray())
+    C = sp.csr_array(np.array([[0, 1.0, 0, 0]] + [[0] * 4] * 3))
+    got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(C))
+    np.testing.assert_allclose(np.asarray(got.todense()),
+                               scsg.minimum_spanning_tree(C).toarray())
+    # Equal-weight ties: tree may differ from Kruskal's, but it must be
+    # a spanning forest of the same total weight and component count.
+    T = sp.csr_array(np.array(
+        [[0, 1.0, 1.0, 0], [1.0, 0, 1.0, 0], [1.0, 1.0, 0, 1.0],
+         [0, 0, 1.0, 0]]))
+    got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(T))
+    ref = scsg.minimum_spanning_tree(T)
+    assert got.nnz == ref.nnz
+    np.testing.assert_allclose(np.asarray(got.sum()), ref.sum())
+    k_got = sparse.csgraph.connected_components(
+        got, directed=False, return_labels=False)
+    k_ref = scsg.connected_components(T, directed=False,
+                                      return_labels=False)
+    assert k_got == k_ref
+    # scipy-wart parity: float64 output always; a chosen zero-weight
+    # edge vanishes from the stored structure (scipy drops explicit
+    # zeros in its CSR construction).
+    Zd = np.array([[0, 0, 2.0], [0, 0, 3.0], [0, 0, 0]])
+    Z = sp.csr_array(Zd)
+    Z[0, 1] = 0.0   # explicit stored zero edge, cheapest 0-1 link
+    Z[1, 0] = 0.0
+    got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(Z))
+    ref = scsg.minimum_spanning_tree(Z)
+    assert got.dtype == ref.dtype == np.float64
+    assert got.nnz == ref.nnz
+    np.testing.assert_allclose(np.asarray(got.todense()), ref.toarray())
+    Zi = sp.csr_array(np.array([[0, 3, 2], [0, 0, 1], [0, 0, 0]],
+                               dtype=np.int64))
+    assert sparse.csgraph.minimum_spanning_tree(
+        sparse.csr_array(Zi)).dtype == np.float64
