@@ -327,7 +327,14 @@ def dijkstra(csgraph, directed=True, indices=None,
     heap is inherently sequential; the same distances come out of the
     min-plus relaxation sweep, which also stays correct under negative
     weights (scipy's dijkstra only warns and degrades there — we keep
-    the warning for parity but return the exact answer)."""
+    the warning for parity but return the exact answer).
+
+    Deviation from scipy: when the graph contains a *reachable negative
+    cycle* this raises :class:`NegativeCycleError` (no finite shortest
+    path exists), whereas scipy's dijkstra warns and returns
+    inaccurate finite values.  Callers that need scipy's
+    never-raise behavior should catch ``NegativeCycleError`` (also
+    raised by ``shortest_path(method='D')`` through this routine)."""
     edges = _graph_edges(csgraph, directed, unweighted)
     w_ = edges[2]
     if w_.size and bool(jnp.any(w_ < 0)):
@@ -496,7 +503,18 @@ def _boruvka(rows, cols, w, n: int):
             lab, _ = s
             lab_pad = jnp.concatenate(
                 [lab, jnp.full((1,), n, dtype=lab.dtype)])
-            new = lab_pad.at[r_i].min(lab_pad[c_i])
+            # Hook at the CLASS labels of the endpoints (not just the
+            # endpoint nodes): the class root learns the merged min
+            # directly, so the pointer-jump below flattens the whole
+            # class in one sweep and chain-like merges keep the
+            # O(log n) round bound (advisor r3).  Unselected edges
+            # carry index n -> label n -> writes land in the pad slot,
+            # which is dropped by the [:n] slice.
+            lu = lab_pad[r_i]
+            lv = lab_pad[c_i]
+            new = lab_pad.at[lu].min(lv)
+            new = new.at[lv].min(new[lu])
+            new = new.at[r_i].min(new[c_i])
             new = new.at[c_i].min(new[r_i])[:n]
             new = jnp.minimum(new, new[jnp.clip(new, 0, n - 1)])
             return new, jnp.any(new != lab)

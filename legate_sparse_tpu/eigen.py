@@ -185,7 +185,11 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
     # doubles m; n caps it).  tol=0 means machine precision (scipy).
     atol, m, tries = _escalation_params(tol, rdtype, ncv, k, rank,
                                         maxiter)
-    for _ in range(tries):
+    for try_i in range(tries):
+        if try_i:
+            m = min(rank, 2 * m)
+        # m is only ever doubled right before a run, so the post-loop
+        # convergence checks always judge the size actually run.
         V, alphas, betas = lanczos(matvec, v0, mask, m=m)
         a = np.real(np.asarray(alphas)).astype(np.float64)
         b_all = np.real(np.asarray(betas)).astype(np.float64)
@@ -208,7 +212,6 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
         scale = np.maximum(np.abs(w_k), 1.0)
         if np.all(resid <= atol * scale) or m >= rank:
             break
-        m = min(rank, 2 * m)
     w_k = w_k.astype(rdtype)
     converged = bool(np.all(resid <= atol * scale)) or m >= rank
     if converged and not return_eigenvectors:
@@ -455,7 +458,11 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
                       static_argnames=("m",))
     atol, m, tries = _escalation_params(tol, rdtype, ncv, k, n,
                                         maxiter, min_extra=2)
-    for _ in range(tries):
+    for try_i in range(tries):
+        if try_i:
+            m = min(n, 2 * m)
+        # Doubling only ever happens right before a run (see
+        # _lanczos_eigsh): post-loop checks judge the size that ran.
         V, H = arnoldi(mv, v0, m=m)
         Hm = np.asarray(H)[:m, :m]
         beta_last = float(abs(np.asarray(H)[m, m - 1]))
@@ -467,7 +474,6 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         scale = np.maximum(np.abs(w_k), 1.0)
         if np.all(resid <= atol * scale) or m >= n:
             break
-        m = min(n, 2 * m)
     converged = bool(np.all(resid <= atol * scale)) or m >= n
     if converged and not return_eigenvectors:
         return w_k          # skip forming X entirely

@@ -215,3 +215,24 @@ def test_no_convergence_raises_like_scipy():
     with pytest.raises(ArpackNoConvergence):
         linalg.eigsh(sparse.csr_array(S_sp), k=4, ncv=6, maxiter=1,
                      tol=1e-14)
+
+
+def test_no_convergence_final_try_doubling_still_raises():
+    # Advisor r3 (eigen.py:471): the escalation loop doubled m at the
+    # end of the last failed try, so the post-loop checks judged a
+    # subspace size that never ran — when cap/2 <= m_last < cap the
+    # unconverged pairs were returned silently.  ncv=24 on n=40 with
+    # maxiter=1 lands exactly in that window (m doubles to 48 >= 40
+    # after the sole failed try).
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    rng = np.random.default_rng(7)
+    n = 40
+    A_sp = sp.csr_array(rng.standard_normal((n, n)))
+    with pytest.raises(ArpackNoConvergence):
+        linalg.eigs(sparse.csr_array(A_sp), k=4, ncv=24, maxiter=1,
+                    tol=1e-30)
+    S_sp = sp.csr_array((A_sp + A_sp.T) / 2)
+    with pytest.raises(ArpackNoConvergence):
+        linalg.eigsh(sparse.csr_array(S_sp), k=4, ncv=24, maxiter=1,
+                     tol=1e-30)
