@@ -172,6 +172,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._dia_fused = None
         self._bsr = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
@@ -523,6 +524,30 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_pack = packed if packed is not None else False
         return packed
 
+    def _get_dia_fused(self):
+        """Cached padded band layout for the fused XLA SpMV
+        (``ops/dia_ops.py::dia_spmv_fused``), or None when not banded.
+        One extra band-sized buffer, built once per structure; pays for
+        itself on the first few matvecs (the fused form runs in one
+        pass where the ``at[].add`` chain runs num_diags passes)."""
+        if self._dia_fused is not None:
+            return self._dia_fused if self._dia_fused is not False else None
+        dia = self._get_dia()
+        if dia is None:
+            self._dia_fused = False
+            return None
+        dia_data, offsets, mask = dia
+        if not self._can_build_cache(self._data, self._indices,
+                                     self._indptr):
+            # Inside a trace: compute without caching.
+            return _dia_ops.pad_dia(dia_data, offsets, self.shape,
+                                    mask=mask, with_mask=mask is not None)
+        self._dia_fused = _dia_ops.pad_dia(
+            dia_data, offsets, self.shape,
+            mask=mask, with_mask=mask is not None,
+        )
+        return self._dia_fused
+
     def _get_row_ids(self):
         """Cached per-nnz row ids, or a non-cached computation when a
         cache can't be built (inside a trace / tracer structure)."""
@@ -653,6 +678,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._dia_fused = None
         self._bsr = None
 
     def sort_indices(self):
@@ -674,6 +700,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia = None
         self._dia_offsets = None
         self._dia_pack = None
+        self._dia_fused = None
         self._bsr = None
 
     def power(self, n, dtype=None):
@@ -1135,14 +1162,10 @@ class csr_array(CompressedBase, DenseSparseBase):
                 y = (dia_spmv_maybe_pallas(src._get_dia_pack(), x)
                      if pallas_dia_active() else None)
                 if y is None:
-                    dia_data, offs, mask = dia
-                    y = (
-                        _dia_ops.dia_spmv(dia_data, x, offs, self.shape)
-                        if mask is None
-                        else _dia_ops.dia_spmv_masked(
-                            dia_data, mask, x, offs, self.shape
-                        )
-                    )
+                    offs = dia[1]
+                    dpad, mpad = src._get_dia_fused()
+                    y = _dia_ops.dia_spmv_fused(dpad, mpad, x, offs,
+                                                self.shape)
             elif bsr is not None:
                 y = bsr.matvec(
                     x, interpret=jax.devices()[0].platform != "tpu"
@@ -1191,14 +1214,10 @@ class csr_array(CompressedBase, DenseSparseBase):
                     else None
                 )
                 if Y is None:
-                    dia_data, offs, mask = dia
-                    Y = (
-                        _dia_ops.dia_spmm(dia_data, X, offs, self.shape)
-                        if mask is None
-                        else _dia_ops.dia_spmm_masked(
-                            dia_data, mask, X, offs, self.shape
-                        )
-                    )
+                    offs = dia[1]
+                    dpad, mpad = src._get_dia_fused()
+                    Y = _dia_ops.dia_spmm_fused(dpad, mpad, X, offs,
+                                                self.shape)
             elif bsr is not None:
                 Y = bsr.matmat(
                     X, interpret=jax.devices()[0].platform != "tpu"
@@ -1223,6 +1242,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._ell = None
         self._dia = None
         self._dia_pack = None
+        self._dia_fused = None
         self._bsr = None
         if structure_changed:
             self._row_ids = None

@@ -42,6 +42,59 @@ def dia_spmv(data: jax.Array, x: jax.Array, offsets: Tuple[int, ...],
     return y
 
 
+@partial(jax.jit, static_argnames=("offsets", "shape", "with_mask"))
+def pad_dia(data, offsets: Tuple[int, ...], shape: Tuple[int, int],
+            mask=None, with_mask: bool = False):
+    """One-time pad of scipy-layout DIA storage for the fused SpMV
+    (``dia_spmv_fused``): left pad P = band reach below the diagonal,
+    right pad so every length-``rows`` slice at offset ``P + off``
+    stays in range.  Invalid (out-of-matrix) slots land in the zero
+    pads, which is what makes the fused single-pass form safe at the
+    edges.  Cached per structure (``csr_array._get_dia_fused``)."""
+    rows, cols = shape
+    width = data.shape[1]
+    P = max(0, -min(offsets))
+    Q = max(0, max(offsets))
+    right = max(0, rows + Q - width)
+    dpad = jnp.pad(data, ((0, 0), (P, right)))
+    if not with_mask:
+        return dpad, None
+    return dpad, jnp.pad(mask, ((0, 0), (P, right)))
+
+
+@partial(jax.jit, static_argnames=("offsets", "shape"))
+def dia_spmv_fused(dpad, mpad, x, offsets: Tuple[int, ...],
+                   shape: Tuple[int, int]) -> jax.Array:
+    """y = A @ x over the *padded* band layout from ``pad_dia``.
+
+    Unlike ``dia_spmv``'s ``y.at[i_lo:i_hi].add`` chain — whose
+    num_diags dynamic-update-slices each force a full pass over y
+    (measured: ~0.5x of stream on a multi-core CPU backend, 51 GB/s
+    on-chip) — every operand here is a same-length static slice, so
+    XLA fuses the whole sum into ONE pass over the band data
+    (measured on-chip: 84 GB/s for the pad+slice form; the Pallas
+    kernel in ``ops/pallas_dia.py`` remains the real TPU fast path).
+
+    IEEE contract: out-of-matrix slots read 0 from *both* pads
+    (0 * 0, never 0 * inf); in-range slots of an exact band are all
+    explicit entries; holey bands mask x through ``mpad`` exactly like
+    ``dia_spmv_masked``."""
+    rows, cols = shape
+    P = max(0, -min(offsets))
+    Q = max(0, max(offsets))
+    xpad = jnp.pad(x, (P, max(0, rows + Q - cols)))
+    y = jnp.zeros((rows,), dtype=jnp.result_type(dpad.dtype, x.dtype))
+    for d, off in enumerate(offsets):
+        s = P + off
+        dv = jax.lax.slice(dpad[d], (s,), (s + rows,))
+        xv = jax.lax.slice(xpad, (s,), (s + rows,))
+        if mpad is not None:
+            mv = jax.lax.slice(mpad[d], (s,), (s + rows,))
+            xv = jnp.where(mv, xv, jnp.zeros((), xv.dtype))
+        y = y + dv * xv
+    return y
+
+
 def band_cover(offsets: Tuple[int, ...], shape: Tuple[int, int],
                width: int) -> int:
     """Number of in-bounds band slots for the given diagonals — the
@@ -166,6 +219,30 @@ def dia_spmm(data: jax.Array, X: jax.Array, offsets: Tuple[int, ...],
         Y = Y.at[i_lo:i_hi, :].add(
             data[d, j_lo:j_hi, None] * X[j_lo:j_hi, :]
         )
+    return Y
+
+
+@partial(jax.jit, static_argnames=("offsets", "shape"))
+def dia_spmm_fused(dpad, mpad, X, offsets: Tuple[int, ...],
+                   shape: Tuple[int, int]) -> jax.Array:
+    """Y = A @ X over the padded band layout — the SpMM analog of
+    ``dia_spmv_fused`` (one fused pass instead of a num_diags-long
+    dynamic-update-slice chain)."""
+    rows, cols = shape
+    P = max(0, -min(offsets))
+    Q = max(0, max(offsets))
+    Xpad = jnp.pad(X, ((P, max(0, rows + Q - cols)), (0, 0)))
+    Y = jnp.zeros((rows, X.shape[1]),
+                  dtype=jnp.result_type(dpad.dtype, X.dtype))
+    k = X.shape[1]
+    for d, off in enumerate(offsets):
+        s = P + off
+        dv = jax.lax.slice(dpad[d], (s,), (s + rows,))[:, None]
+        Xv = jax.lax.slice(Xpad, (s, 0), (s + rows, k))
+        if mpad is not None:
+            mv = jax.lax.slice(mpad[d], (s,), (s + rows,))[:, None]
+            Xv = jnp.where(mv, Xv, jnp.zeros((), Xv.dtype))
+        Y = Y + dv * Xv
     return Y
 
 

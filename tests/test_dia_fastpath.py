@@ -303,3 +303,64 @@ def test_transpose_wide_band_storage_matches_dense():
                                       S.toarray().T)
         np.testing.assert_array_equal(
             np.asarray(D.tocsr().T.todense()), S.toarray().T)
+
+
+@pytest.mark.parametrize("shape", [(60, 60), (80, 50), (50, 80)])
+@pytest.mark.parametrize("masked", [False, True])
+def test_dia_spmv_fused_matches_unfused(shape, masked):
+    # The fused pad+slice formulation (one XLA pass) must agree with
+    # the at[].add reference formulation (to roundoff — XLA fusion may
+    # reassociate) on exact and holey bands, square and rectangular.
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.ops import dia_ops
+
+    rows, cols = shape
+    offsets = (-7, -2, 0, 1, 5)
+    rng = np.random.default_rng(42)
+    width = cols
+    data = np.zeros((len(offsets), width), np.float64)
+    mask = np.zeros((len(offsets), width), bool)
+    for d, off in enumerate(offsets):
+        j_lo = max(0, off)
+        j_hi = min(cols, rows + off)
+        data[d, j_lo:j_hi] = rng.normal(size=max(0, j_hi - j_lo))
+        if masked:
+            keep = rng.random(max(0, j_hi - j_lo)) < 0.7
+            data[d, j_lo:j_hi] *= keep
+            mask[d, j_lo:j_hi] = keep
+        else:
+            mask[d, j_lo:j_hi] = True
+    x = rng.normal(size=cols)
+    dj, mj, xj = jnp.asarray(data), jnp.asarray(mask), jnp.asarray(x)
+    m_arg = mj if masked else None
+    ref = (dia_ops.dia_spmv_masked(dj, mj, xj, offsets, shape) if masked
+           else dia_ops.dia_spmv(dj, xj, offsets, shape))
+    dpad, mpad = dia_ops.pad_dia(dj, offsets, shape, mask=m_arg,
+                                 with_mask=masked)
+    got = dia_ops.dia_spmv_fused(dpad, mpad, xj, offsets, shape)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-13, atol=1e-13)
+
+
+def test_dia_spmv_fused_ieee_nonfinite_x_at_hole():
+    # A non-finite x entry at a band HOLE (or out-of-matrix slot) must
+    # not leak NaN into y through the fused form's zero pads.
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.ops import dia_ops
+
+    n = 16
+    offsets = (-1, 0, 1)
+    data = np.ones((3, n))
+    mask = np.ones((3, n), bool)
+    mask[2, 5] = False          # hole at A[4, 5]
+    data[2, 5] = 0.0
+    x = np.ones(n)
+    x[5] = np.inf               # referenced by rows 4(hole),5,6
+    dpad, mpad = dia_ops.pad_dia(jnp.asarray(data), offsets, (n, n),
+                                 mask=jnp.asarray(mask), with_mask=True)
+    y = np.asarray(dia_ops.dia_spmv_fused(dpad, mpad, jnp.asarray(x),
+                                          offsets, (n, n)))
+    assert not np.isnan(y).any()
+    assert np.isinf(y[5]) and np.isinf(y[6]) and np.isfinite(y[3])
