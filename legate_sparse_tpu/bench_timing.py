@@ -28,14 +28,47 @@ from typing import Callable
 import jax
 
 
-def loop_ms_per_iter(step: Callable, x0, k_lo: int = 5, k_hi: int = 55,
-                     repeats: int = 2) -> float:
+def fixed_cost_s(x0, repeats: int = 3) -> float:
+    """Measured fixed cost of one dispatch + scalar-fetch round trip
+    (the constant both ends of the two-point measurement share).  On
+    the axon tunnel this is ~1 s; on a local backend, microseconds."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(x):
+        return jnp.ravel(x)[0] * 1.0
+
+    float(probe(x0))  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(probe(x0))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def loop_ms_per_iter(step: Callable, x0, k_lo: int = 5, k_hi: int = None,
+                     repeats: int = 2, deadline_s: float = None,
+                     k_cap: int = 4000) -> float:
     """True device ms per ``step`` application (see module docstring).
 
     ``step``: jax-traceable x -> x (magnitude-preserving so hundreds of
     chained applications neither overflow nor denormalize).
+
+    Every distinct trip count is a separate XLA compile — expensive
+    through the tunnel (tens of seconds at large shapes) — so beyond
+    the caller's first guess the trip counts are chosen from MEASURED
+    cost estimates instead of blind x4 escalation: normally at most
+    three loop compiles run (plus one trivial fixed-cost probe).
+    ``k_hi`` is the first high trial (caller's domain knowledge; None
+    picks it from the fixed-cost estimate); ``k_cap`` bounds every
+    trip count (pass a small cap to bound total work on a kernel that
+    might fault the worker); ``deadline_s`` (wall clock for this call)
+    stops escalation early.
     """
     import jax.numpy as jnp
+
+    t_start = time.perf_counter()
 
     @partial(jax.jit, static_argnames=("k",))
     def loop(x, k: int):
@@ -51,14 +84,34 @@ def loop_ms_per_iter(step: Callable, x0, k_lo: int = 5, k_hi: int = 55,
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # Escalate the trip count until the loop body dominates the fixed
-    # dispatch/fetch cost, else the delta is timing noise.
+    def left() -> float:
+        if deadline_s is None:
+            return float("inf")
+        return deadline_s - (time.perf_counter() - t_start)
+
+    fixed = fixed_cost_s(x0)
     t_lo = timed(k_lo)
+    # Delta target sized so the loop-body difference dominates
+    # fixed-cost jitter; per-iter upper bound from the low point alone.
+    per_iter_est = max(t_lo - fixed, 0.25 * t_lo) / k_lo
+    delta_target = max(4.0 * fixed, 0.4, 0.5 * t_lo)
+    if k_hi is None:
+        k_hi = k_lo + int(delta_target / per_iter_est) + 1
+    k_hi = min(k_cap, max(3 * k_lo, k_hi))
     while True:
         t_hi = timed(k_hi)
-        if t_hi >= 1.5 * t_lo or k_hi >= 4000:
+        good = t_hi >= t_lo + max(2.0 * fixed, 0.2 * t_lo)
+        if good or k_hi >= k_cap:
             break
-        k_hi *= 4
+        if left() < 3 * t_hi + 30:
+            # Not enough wall budget for another compile+run cycle:
+            # use what we have if it resolves at all, else fail loudly.
+            break
+        # Re-aim from the measured points (one jump, not x4 blind).
+        per_iter = ((t_hi - t_lo) / (k_hi - k_lo)
+                    if t_hi > t_lo else per_iter_est / 8)
+        k_next = k_lo + int(delta_target / max(per_iter, 1e-9)) + 1
+        k_hi = min(k_cap, max(k_next, 2 * k_hi))
     if t_hi <= t_lo:
         # A silent clamp here would report fantasy bandwidth in the
         # driver-contract JSON; fail loudly instead (callers guard each

@@ -72,8 +72,15 @@ print(json.dumps(out), flush=True)   # partial verdict survives a crash
 # pallas_call embedded in a larger jitted looped program) — this is
 # the stage bench crashed in while eager pack-validation passed.
 from legate_sparse_tpu.bench_timing import loop_ms_per_iter
-dt_ms = loop_ms_per_iter(lambda v: A @ v, x, k_lo=2, k_hi=6)
-out["loop_ms_per_iter"] = round(dt_ms, 3)
+try:
+    dt_ms = loop_ms_per_iter(lambda v: A @ v, x, k_lo=2, k_hi=6, k_cap=24)
+    out["loop_ms_per_iter"] = round(dt_ms, 3)
+except RuntimeError as e:
+    # Unresolvable timing under the capped trip count: the looped
+    # programs still RAN (survival is this probe's verdict); record
+    # the resolution failure without poisoning the row with an rc.
+    out["loop_ms_per_iter"] = None
+    out["loop_timing_note"] = repr(e)[:120]
 y2 = A @ x
 out["loop_correct"] = (abs(float(jnp.sum(y2)) - expect)
                        < 1e-2 * max(1.0, abs(expect)))
@@ -130,15 +137,15 @@ def main() -> None:
     append(f"\n## Fault isolation {stamp}\n\n"
            "One subprocess per row (bench's exact diags->SpMV path); a "
            "crash poisons only its own row.\n\n```json\n")
-    # Per-probe budgets must SUM below the capture script's outer
-    # timeout (quick: 1800s, full: 4200s) so the closing fence and the
-    # later capture phases always run: quick = 2*(300+540)+pauses,
-    # full = 3*(240+300)+2*(540+600)+pauses (jroll probed at small
-    # sizes where a verdict is cheap; roll-mode faults are size-
-    # independent lowering differences).
+    # Per-probe budgets (+ the recovery pause BETWEEN probes) must SUM
+    # below the capture script's outer timeout (quick: 2*390+45 < 900,
+    # full: 4200s) so the closing fence and later phases always run.  Quick mode exists to NAME the
+    # crashing configuration early in a window without consuming it:
+    # one 2^22 pallas probe, plus the jroll lowering only when the
+    # pallas probe failed (bench's canary ladder at 2^24 does the
+    # production variant selection; this is the diagnostic record).
     if quick:
-        plan = [(16, 300, ("pallas", "xla")),
-                (22, 540, ("pallas", "xla"))]
+        plan = [(22, 390, ("pallas", "pallas-jroll"))]
     else:
         plan = [(16, 240, ("pallas", "pallas-jroll", "xla")),
                 (20, 300, ("pallas", "pallas-jroll", "xla")),
@@ -146,13 +153,19 @@ def main() -> None:
                 (24, 600, ("pallas", "xla"))]
     try:
         for log2, budget, modes in plan:
+            pallas_clean = False
             for mode in modes:
+                if quick and mode == "pallas-jroll" and pallas_clean:
+                    continue   # nothing to bisect: default mode works
                 res = run(log2, mode, timeout_s=budget)
                 append(json.dumps(res) + "\n")
                 print(json.dumps(res), flush=True)
-                if mode.startswith("pallas") and "rc" in res:
+                if mode == "pallas" and "rc" not in res:
+                    pallas_clean = True
+                last = (log2, mode) == (plan[-1][0], plan[-1][2][-1])
+                if mode.startswith("pallas") and "rc" in res and not last:
                     # crash or timeout: the worker may be down; pause
-                    # once so the next row isn't poisoned by recovery
+                    # so the next row isn't poisoned by recovery
                     time.sleep(45)
     finally:
         append("```\n")

@@ -145,13 +145,14 @@ if packed is not None:
 else:
     res["pallas_dia_ms"] = None
 
-if mask is None:
-    step = lambda v: dia_ops.dia_spmv(dd, v, offsets, A.shape)
-else:
-    step = lambda v: dia_ops.dia_spmv_masked(dd, mask, v, offsets, A.shape)
+# The shipped XLA fallback is the FUSED pad+slice form (what csr.dot
+# runs when the Pallas kernel is unavailable), not the old at[].add
+# chain.
+dpad, mpad = A._get_dia_fused()
+step = lambda v: dia_ops.dia_spmv_fused(dpad, mpad, v, offsets, A.shape)
 ms = loop_ms_per_iter(step, x, k_lo=3, k_hi=13)
-res["xla_dia_ms"] = round(ms, 4)
-res["xla_dia_gbs"] = round(bytes_dia / ms / 1e6, 1)
+res["xla_dia_fused_ms"] = round(ms, 4)
+res["xla_dia_fused_gbs"] = round(bytes_dia / ms / 1e6, 1)
 
 ell = A._get_ell()
 if ell is None:
@@ -258,22 +259,29 @@ def main() -> None:
     run_phase("tunnel characterization",
               [sys.executable, "-c", TUNNEL_PROBE], 600)
 
+    # Budgets are derived from the measured tunnel characteristics
+    # (scalar fetch ~1 s, uploads 6-19 MB/s, per-trip-count compiles
+    # 20-60 s): bench = canary ladder (<= 2x480 s) + ~6 timed phases;
+    # the kernel shoot-out needs ~3 loop compiles per formulation with
+    # the adaptive trip-count selection (bench_timing r4) instead of
+    # the blind escalation that blew the r3 1500 s budget.
     run_phase("bench.py", [sys.executable, "bench.py"], 2700)
 
     run_phase("kernel timings 2^22",
-              [sys.executable, "-c", KERNEL_TIMING], 1500)
+              [sys.executable, "-c", KERNEL_TIMING], 900)
 
     run_phase("tpu smoke lane",
-              [sys.executable, "-m", "pytest", "-m", "tpu", "tests/", "-q"],
-              1200,
+              [sys.executable, "-m", "pytest", "-m", "tpu", "tests/",
+               "-q", "--durations=10"],
+              1500,
               env_extra={"LEGATE_SPARSE_TPU_TEST_PLATFORM": "tpu"},
-              tail_lines=3)
+              tail_lines=14)
 
     run_phase("SpGEMM end-to-end",
-              [sys.executable, "-c", SPGEMM_TIMING], 1500)
+              [sys.executable, "-c", SPGEMM_TIMING], 900)
 
     run_phase("CG pde 2048^2 f32",
-              [sys.executable, "-c", CG_TIMING], 1500)
+              [sys.executable, "-c", CG_TIMING], 900)
 
     print(f"recorded -> {OUT}")
 
