@@ -38,6 +38,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 __all__ = [
     "connected_components", "laplacian", "shortest_path",
     "bellman_ford", "dijkstra", "johnson", "floyd_warshall",
@@ -104,7 +106,7 @@ def _host_fallback(name):
 def _label_propagation(rows, cols, n: int):
     """Min-label propagation over an undirected edge list.  Converges
     to per-component minimum node ids in O(diameter) sweeps."""
-    labels0 = jnp.arange(n, dtype=jnp.int64)
+    labels0 = jnp.arange(n, dtype=index_dtype())
 
     def cond(state):
         _, changed = state
@@ -456,8 +458,8 @@ def _boruvka(rows, cols, w, n: int):
     TPU-shaped replacement for Kruskal's inherently sequential
     sort + union-find.  Returns the in-tree mask over stored edges."""
     E = rows.shape[0]
-    eidx = jnp.arange(E, dtype=jnp.int64)
-    comp0 = jnp.arange(n, dtype=jnp.int64)
+    eidx = jnp.arange(E, dtype=index_dtype())
+    comp0 = jnp.arange(n, dtype=index_dtype())
     in_tree0 = jnp.zeros((E,), dtype=bool)
     big_w = jnp.asarray(jnp.inf, dtype=w.dtype)
 
@@ -471,7 +473,7 @@ def _boruvka(rows, cols, w, n: int):
                   .at[cu].min(Wc).at[cv].min(Wc))
         tie_u = cross & (Wc == best_w[cu])
         tie_v = cross & (Wc == best_w[cv])
-        best_e = (jnp.full((n,), E, dtype=jnp.int64)
+        best_e = (jnp.full((n,), E, dtype=index_dtype())
                   .at[cu].min(jnp.where(tie_u, eidx, E))
                   .at[cv].min(jnp.where(tie_v, eidx, E)))
         has = best_e < E
@@ -555,8 +557,8 @@ def minimum_spanning_tree(csgraph, overwrite=False):
         return csr_array(
             (np.zeros(0, np.float64), np.zeros(0, np.int64),
              np.zeros(n + 1, np.int64)), shape=(n, n))
-    rows = A._get_row_ids().astype(jnp.int64)
-    cols = A._indices.astype(jnp.int64)
+    rows = A._get_row_ids().astype(index_dtype())
+    cols = A._indices.astype(index_dtype())
     from .runtime import runtime
 
     w = A._data.astype(runtime.default_float)

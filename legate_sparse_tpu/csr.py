@@ -33,11 +33,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 from scipy.sparse import SparseEfficiencyWarning
 
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
-from .types import coord_dtype_for, nnz_ty
+from .types import check_nnz, coord_dtype_for, nnz_dtype
 from .utils import cast_to_common_type, fill_out, require_supported_dtype
 from .ops import convert as _convert
 from .ops import dia_ops as _dia_ops
@@ -91,11 +93,12 @@ class csr_array(CompressedBase, DenseSparseBase):
             arg = arg.tocsr()
             if shape is None:
                 shape = arg.shape
+            check_nnz(int(arg.nnz))
             data = jnp.asarray(arg.data)
             indices = jnp.asarray(
                 arg.indices, dtype=coord_dtype_for(max(arg.shape))
             )
-            indptr = jnp.asarray(arg.indptr, dtype=nnz_ty)
+            indptr = jnp.asarray(arg.indptr, dtype=nnz_dtype())
             canonical = bool(arg.has_canonical_format)
             if dtype is not None:
                 data = data.astype(np.dtype(dtype))
@@ -108,7 +111,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             )
             data = jnp.zeros((0,), dtype=out_dtype)
             indices = jnp.zeros((0,), dtype=coord_dtype_for(max(shape)))
-            indptr = jnp.zeros((shape[0] + 1,), dtype=nnz_ty)
+            indptr = jnp.zeros((shape[0] + 1,), dtype=nnz_dtype())
             canonical = True
         elif isinstance(arg, tuple) and len(arg) == 2 and isinstance(arg[1], tuple):
             # COO: (data, (row, col))
@@ -127,7 +130,9 @@ class csr_array(CompressedBase, DenseSparseBase):
                 data = data.astype(np.dtype(dtype))
         elif isinstance(arg, tuple) and len(arg) == 3:
             data_in, indices_in, indptr_in = arg
-            indptr = jnp.asarray(indptr_in, dtype=nnz_ty)
+            if hasattr(indices_in, "__len__") or hasattr(indices_in, "shape"):
+                check_nnz(int(np.shape(indices_in)[0]))
+            indptr = jnp.asarray(indptr_in, dtype=nnz_dtype())
             rows = indptr.shape[0] - 1
             if shape is None:
                 cols = int(jnp.max(jnp.asarray(indices_in))) + 1 if len(indices_in) else 0
@@ -607,7 +612,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             # scipy parity: empty DIA (no stored diagonals).
             return dia_array(
                 (jnp.zeros((0, 0), dtype=self.dtype),
-                 jnp.zeros((0,), dtype=jnp.int64)),
+                 jnp.zeros((0,), dtype=index_dtype())),
                 shape=self.shape,
             )
         offsets = _dia_ops.csr_band_offsets(
@@ -617,7 +622,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             a._data, a._indices, a._get_row_ids(), offsets, cols
         )
         return dia_array(
-            (dia_data, jnp.asarray(offsets, dtype=jnp.int64)),
+            (dia_data, jnp.asarray(offsets, dtype=index_dtype())),
             shape=self.shape,
         )
 
@@ -650,7 +655,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             return jnp.diff(self._indptr)
         if axis == 0:
             return (
-                jnp.zeros((self.shape[1],), dtype=nnz_ty)
+                jnp.zeros((self.shape[1],), dtype=nnz_dtype())
                 .at[self._indices]
                 .add(1)
             )
@@ -819,13 +824,14 @@ class csr_array(CompressedBase, DenseSparseBase):
         # other side contributes its implicit zero.
         row = jnp.concatenate([ra, rb])
         col = jnp.concatenate([ca, cb])
-        key_dt = coord_dtype_for(rows * cols)
-        if np.dtype(key_dt).itemsize == 8 and not jax.config.jax_enable_x64:
+        try:
+            # Union keys are row*cols+col: the key space is rows*cols,
+            # not max(shape) — coord_dtype_for raises under no-x64.
+            key_dt = coord_dtype_for(rows * cols)
+        except OverflowError as e:
             raise OverflowError(
-                "maximum/minimum union keys need int64 but x64 is "
-                "disabled (LEGATE_SPARSE_TPU_X64=0); enable x64 for "
-                "shapes this large"
-            )
+                f"maximum/minimum union keys: {e}"
+            ) from None
         key = row.astype(key_dt) * cols + col.astype(key_dt)
         val = jnp.concatenate([va, vb])
         order = jnp.argsort(key, stable=True)
@@ -1276,12 +1282,12 @@ class csr_array(CompressedBase, DenseSparseBase):
         i0 = max(0, -k)
         row_ids = self._get_row_ids()
         on_diag = jnp.logical_and(
-            self._indices.astype(jnp.int64)
-            - row_ids.astype(jnp.int64) == k,
+            self._indices.astype(index_dtype())
+            - row_ids.astype(index_dtype()) == k,
             row_ids < i0 + length,
         )
         # Overwrite stored diagonal entries.
-        safe_rel = jnp.clip(row_ids.astype(jnp.int64) - i0, 0, length - 1)
+        safe_rel = jnp.clip(row_ids.astype(index_dtype()) - i0, 0, length - 1)
         new_data = jnp.where(on_diag, vals[safe_rel], self._data)
 
         # Rows in [i0, i0+length) missing a stored diagonal slot.

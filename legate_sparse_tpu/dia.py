@@ -22,7 +22,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .base import CompressedBase
-from .types import coord_dtype_for, nnz_ty
+from .types import check_nnz, coord_dtype_for, index_dtype, nnz_dtype
 from .runtime import runtime
 
 
@@ -56,7 +56,9 @@ class dia_array(CompressedBase):
         elif isinstance(arg, tuple) and len(arg) == 2:
             data_in, offsets_in = arg
             data = jnp.atleast_2d(jnp.asarray(data_in))
-            offsets = jnp.atleast_1d(jnp.asarray(offsets_in, dtype=np.int64))
+            offsets = jnp.atleast_1d(
+                jnp.asarray(offsets_in, dtype=index_dtype())
+            )
             if shape is None:
                 raise ValueError("dia_array from (data, offsets) needs shape")
         else:
@@ -183,14 +185,16 @@ class dia_array(CompressedBase):
         vals, _, col = _band_slot_gather(data, offs, rows)
         keep = (col >= 0) & (col < w) & (vals != 0)  # scipy drops zeros
         nnz = int(jnp.sum(keep))
+        check_nnz(nnz)
         idx = jnp.nonzero(keep.T.reshape(-1), size=nnz, fill_value=0)[0]
         cdata = vals.T.reshape(-1)[idx]
         cindices = col.T.reshape(-1)[idx].astype(cdt)
-        # indptr counts nnz, not coordinates: nnz_ty (int64) per the
-        # repo convention — an int32 cumsum would wrap past 2^31 nnz.
-        counts = jnp.sum(keep, axis=0, dtype=nnz_ty)
+        # indptr counts nnz, not coordinates: platform-width ints
+        # (int64 under x64, else int32 with the documented 2^31-1
+        # per-process nnz limit — check_nnz above fails loudly first).
+        counts = jnp.sum(keep, axis=0, dtype=nnz_dtype())
         cindptr = jnp.concatenate(
-            [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(counts)]
+            [jnp.zeros((1,), dtype=nnz_dtype()), jnp.cumsum(counts)]
         )
         return csr_array._from_parts(
             cdata, cindices, cindptr, self.shape

@@ -26,6 +26,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 __all__ = ["expm_multiply"]
 
 
@@ -192,7 +194,7 @@ def expm_multiply(A, B, start=None, stop=None, num=None, endpoint=None,
         # per-step eta factor supplies e^{dt mu} exactly.
         s = max(1, int(np.ceil(norm1 * abs(dt))))
         return _APPLY_JIT(A_mv, F, jnp.asarray(dt, rdtype), mu,
-                          jnp.asarray(s, jnp.int64), m)
+                          jnp.asarray(s, index_dtype()), m)
 
     if start is None and stop is None and num is None:
         out = advance(Bw, 1.0)

@@ -18,6 +18,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 from .dia import dia_array
 from .runtime import runtime
 
@@ -170,7 +172,7 @@ def _tri_mask(A, k: int, keep_lower: bool):
 
     A = _as_csr(A)
     row_ids = row_ids_from_indptr(A.indptr, A.nnz)
-    d = A.indices.astype(jnp.int64) - row_ids.astype(jnp.int64)
+    d = A.indices.astype(index_dtype()) - row_ids.astype(index_dtype())
     keep = (d <= k) if keep_lower else (d >= k)
     nnz_new = int(jnp.sum(keep))
     from .ops.convert import compact_mask

@@ -97,15 +97,16 @@ def mmread(source) -> csr_array:
         converted = None
     if converted is not None:
         # Normalize to the canonical dtypes every constructor applies
-        # (coord_dtype_for / nnz_ty) so the parsed matrix has the same
+        # (coord_dtype_for / nnz_dtype()) so the parsed matrix has the same
         # index dtypes whether or not the native library is present.
-        from .types import coord_dtype_for, nnz_ty
+        from .types import check_nnz, coord_dtype_for, nnz_dtype
 
         data, indices, indptr = converted
+        check_nnz(int(indptr[-1]))
         return csr_array._from_parts(
             jnp_asarray(data),
             jnp_asarray(indices.astype(coord_dtype_for(max(m, n)))),
-            jnp_asarray(indptr.astype(nnz_ty)),
+            jnp_asarray(indptr.astype(nnz_dtype())),
             (m, n), canonical=None,
         )
     return csr_array((vals, (rows, cols)), shape=(m, n))

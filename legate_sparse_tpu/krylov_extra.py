@@ -30,6 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 __all__ = ["minres", "lsqr", "lsmr", "differentiable_solve"]
 
 
@@ -126,10 +128,10 @@ def _minres_loop(A_mv, M_mv, b, x0, shift, atol, maxiter,
         dbar=jnp.zeros((), rdt), epsln=jnp.zeros((), rdt),
         phibar=beta1,
         cs=jnp.asarray(-1.0, rdt), sn=jnp.zeros((), rdt),
-        iters=jnp.asarray(0, jnp.int64),
+        iters=jnp.asarray(0, index_dtype()),
         done=jnp.asarray(beta1 == 0),
         atol=jnp.asarray(atol, rdt),
-        miter=jnp.asarray(maxiter, jnp.int64),
+        miter=jnp.asarray(maxiter, index_dtype()),
     )
     out = jax.lax.while_loop(cond, body, st0)
     return out["x"], out["iters"]
@@ -265,13 +267,13 @@ def _lsqr_loop(A_mv, At_mv, b, x0, damp, atol, btol, maxiter,
         anorm2=jnp.zeros((), rdt), psi2=jnp.zeros((), rdt),
         rnorm=beta0, arnorm=alfa0 * beta0,
         xnorm=jnp.linalg.norm(x0).astype(rdt),
-        iters=jnp.asarray(0, jnp.int64),
+        iters=jnp.asarray(0, index_dtype()),
         done=jnp.asarray(jnp.logical_or(beta0 == 0, alfa0 == 0)),
         stop1=jnp.asarray(False), stop2=jnp.asarray(False),
         damp=jnp.asarray(damp, rdt),
         atol=jnp.asarray(atol, rdt), btol=jnp.asarray(btol, rdt),
         bnorm=jnp.linalg.norm(b).astype(rdt),
-        miter=jnp.asarray(maxiter, jnp.int64),
+        miter=jnp.asarray(maxiter, index_dtype()),
     )
     out = jax.lax.while_loop(cond, body, st0)
     return out
@@ -474,7 +476,7 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
         maxrbar=jnp.zeros((), rdt),
         minrbar=jnp.asarray(np.finfo(np.float64).max, rdt),
         rhotemp=jnp.ones((), rdt),
-        iters=jnp.asarray(0, jnp.int64),
+        iters=jnp.asarray(0, index_dtype()),
         done=jnp.asarray(jnp.logical_or(beta0 == 0, alpha0 == 0)),
         stop1=jnp.asarray(False), stop2=jnp.asarray(False),
         stop3=jnp.asarray(False), stop4=jnp.asarray(False),
@@ -483,7 +485,7 @@ def _lsmr_loop(A_mv, At_mv, b, x0, damp, atol, btol, conlim, maxiter,
         damp=jnp.asarray(damp, rdt),
         atol=jnp.asarray(atol, rdt), btol=jnp.asarray(btol, rdt),
         bnorm=jnp.linalg.norm(b).astype(rdt),
-        miter=jnp.asarray(maxiter, jnp.int64),
+        miter=jnp.asarray(maxiter, index_dtype()),
     )
     return jax.lax.while_loop(cond, body, st0)
 

@@ -28,6 +28,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .types import index_dtype
+
 from .csr import csr_array
 from .utils import fill_out as _fill_out, is_sparse_matrix
 
@@ -295,10 +297,10 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
         r0,
         jnp.zeros_like(b),
         jnp.ones((), dtype=dtype),
-        jnp.asarray(0, dtype=jnp.int64),
+        jnp.asarray(0, dtype=index_dtype()),
         jnp.asarray(False),
         jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2,
-        jnp.asarray(maxiter, dtype=jnp.int64),
+        jnp.asarray(maxiter, dtype=index_dtype()),
     )
     out = jax.lax.while_loop(cond, body, state0)
     return out[0], out[4]
@@ -533,9 +535,9 @@ def _bicgstab_state0(A_mv, b, x0, atol, maxiter):
     return (
         x0, r0, r0, jnp.zeros_like(b), jnp.zeros_like(b),
         one, one, one,
-        jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False),
+        jnp.asarray(0, dtype=index_dtype()), jnp.asarray(False),
         jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2,
-        jnp.asarray(maxiter, dtype=jnp.int64),
+        jnp.asarray(maxiter, dtype=index_dtype()),
     )
 
 

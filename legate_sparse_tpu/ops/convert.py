@@ -32,7 +32,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..types import coord_dtype_for, nnz_ty
+from ..types import coord_dtype_for, index_dtype, nnz_dtype
 
 
 @partial(jax.jit, static_argnames=("nnz",))
@@ -56,7 +56,7 @@ def indptr_from_row_ids(row_ids: jax.Array, rows: int) -> jax.Array:
     """Inverse expansion: per-nnz row ids (sorted) -> indptr of length rows+1."""
     counts = jnp.bincount(row_ids, length=rows)
     return jnp.concatenate(
-        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(counts).astype(nnz_ty)]
+        [jnp.zeros((1,), dtype=nnz_dtype()), jnp.cumsum(counts).astype(nnz_dtype())]
     )
 
 
@@ -81,7 +81,7 @@ def dense_to_csr(dense: jax.Array, nnz: int):
     cdt = coord_dtype_for(max(rows, cols))
     counts = jnp.bincount(ridx, length=rows)
     indptr = jnp.concatenate(
-        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(counts).astype(nnz_ty)]
+        [jnp.zeros((1,), dtype=nnz_dtype()), jnp.cumsum(counts).astype(nnz_dtype())]
     )
     return data, cidx.astype(cdt), indptr
 
@@ -169,13 +169,11 @@ def select_rows(data, indices, indptr, rows_idx, nnz_out: int):
     by the caller — the framework's static-shape discipline).  Returns
     (data, indices, indptr) of the (k, cols) result.
     """
-    from ..types import nnz_ty
-
     starts = indptr[rows_idx]                       # (k,)
     counts = (indptr[rows_idx + 1] - starts)
     new_indptr = jnp.concatenate(
-        [jnp.zeros((1,), nnz_ty),
-         jnp.cumsum(counts).astype(nnz_ty)]
+        [jnp.zeros((1,), nnz_dtype()),
+         jnp.cumsum(counts).astype(nnz_dtype())]
     )
     k = rows_idx.shape[0]
     out_row = jnp.repeat(
@@ -185,5 +183,5 @@ def select_rows(data, indices, indptr, rows_idx, nnz_out: int):
         jnp.arange(nnz_out, dtype=starts.dtype)
         - new_indptr[out_row].astype(starts.dtype)
     )
-    src = starts[out_row].astype(jnp.int64) + pos_in_row
+    src = starts[out_row].astype(index_dtype()) + pos_in_row
     return data[src], indices[src], new_indptr

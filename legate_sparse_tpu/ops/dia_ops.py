@@ -19,6 +19,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..types import index_dtype
+
 
 @partial(jax.jit, static_argnames=("offsets", "shape"))
 def dia_spmv(data: jax.Array, x: jax.Array, offsets: Tuple[int, ...],
@@ -118,7 +120,7 @@ def csr_band_offsets(indices, row_ids, max_diags: int):
     """
     if indices.shape[0] == 0:
         return None
-    d = indices.astype(jnp.int64) - row_ids.astype(jnp.int64)
+    d = indices.astype(index_dtype()) - row_ids.astype(index_dtype())
     ds = jnp.sort(d)
     heads = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), ds[1:] != ds[:-1]]
@@ -140,8 +142,8 @@ def dia_from_csr(data, indices, row_ids, offsets: Tuple[int, ...],
     also returns the explicit-entry mask (True where a CSR nonzero
     exists) so kernels can skip band *holes* — in-bounds band slots
     with no stored entry, e.g. the zeros ``diags().tocsr()`` drops."""
-    offs = jnp.asarray(offsets, dtype=jnp.int64)
-    d = indices.astype(jnp.int64) - row_ids.astype(jnp.int64)
+    offs = jnp.asarray(offsets, dtype=index_dtype())
+    d = indices.astype(index_dtype()) - row_ids.astype(index_dtype())
     d_idx = jnp.searchsorted(offs, d)
     out = jnp.zeros((len(offsets), cols), dtype=data.dtype)
     out = out.at[d_idx, indices].set(data, mode="drop")
@@ -327,23 +329,23 @@ def band_to_csr(dia_data, offsets: Tuple[int, ...],
     """Full-band DIA -> CSR triple keeping every in-bounds band slot
     (incl. explicit zeros), ``nnz = band_cover(offsets, shape, cols)``.
     Offsets must be sorted; rows come out canonical."""
-    from ..types import coord_dtype_for, nnz_ty
+    from ..types import coord_dtype_for, nnz_dtype
 
     rows, cols = shape
-    offs = jnp.asarray(offsets, dtype=jnp.int64)
-    i = jnp.arange(rows, dtype=jnp.int64)
+    offs = jnp.asarray(offsets, dtype=index_dtype())
+    i = jnp.arange(rows, dtype=index_dtype())
     # Valid offsets per row: o in [-i, cols-1-i] (contiguous in sorted offs).
     lo = jnp.searchsorted(offs, -i, side="left")
     hi = jnp.searchsorted(offs, cols - i, side="left")
     counts = hi - lo
     indptr = jnp.concatenate(
-        [jnp.zeros((1,), dtype=nnz_ty),
-         jnp.cumsum(counts).astype(nnz_ty)]
+        [jnp.zeros((1,), dtype=nnz_dtype()),
+         jnp.cumsum(counts).astype(nnz_dtype())]
     )
     row_ids = jnp.repeat(i, counts, total_repeat_length=nnz)
     pos_in_row = (
-        jnp.arange(nnz, dtype=jnp.int64)
-        - indptr[row_ids].astype(jnp.int64)
+        jnp.arange(nnz, dtype=index_dtype())
+        - indptr[row_ids].astype(index_dtype())
     )
     d_idx = lo[row_ids] + pos_in_row
     col = row_ids + offs[d_idx]

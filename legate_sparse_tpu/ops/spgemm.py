@@ -35,7 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..types import coord_dtype_for, nnz_ty
+from ..types import coord_dtype_for, index_dtype, nnz_dtype
 from .convert import row_ids_from_indptr, indptr_from_row_ids
 
 
@@ -54,13 +54,13 @@ def _expand(a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
     # Products contributed by each A-nonzero = nnz of the B row it selects.
     b_row_nnz = jnp.diff(b_indptr)[a_indices]
     starts = jnp.concatenate(
-        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(b_row_nnz).astype(nnz_ty)]
+        [jnp.zeros((1,), dtype=nnz_dtype()), jnp.cumsum(b_row_nnz).astype(nnz_dtype())]
     )
     # For product t: owning A-nonzero e(t) and offset within its B row.
-    t = jnp.arange(num_products, dtype=nnz_ty)
-    e = jnp.searchsorted(starts[1:-1], t, side="right").astype(nnz_ty)
+    t = jnp.arange(num_products, dtype=nnz_dtype())
+    e = jnp.searchsorted(starts[1:-1], t, side="right").astype(nnz_dtype())
     within = t - starts[e]
-    b_pos = b_indptr[a_indices[e]].astype(nnz_ty) + within
+    b_pos = b_indptr[a_indices[e]].astype(nnz_dtype()) + within
     rows = a_rows[e].astype(b_indices.dtype)
     cols = b_indices[b_pos]
     vals = a_data[e] * b_data[b_pos]
@@ -87,7 +87,7 @@ def _compress_chunk(rows, cols, vals, heads, cap: int):
     """Merge duplicate runs into padded (cap,) triplet arrays (chunked
     mode's compress: ``cap`` is the shared static capacity so every
     chunk reuses one compilation; the caller slices the valid prefix)."""
-    seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int64)) - 1, 0, cap - 1)
+    seg = jnp.clip(jnp.cumsum(heads.astype(index_dtype())) - 1, 0, cap - 1)
     # Sentinel (padding) entries carry value 0, so scatter-adding every
     # slot is harmless wherever their clipped seg lands.
     out_vals = jnp.zeros((cap,), dtype=vals.dtype).at[seg].add(vals)
@@ -98,7 +98,7 @@ def _compress_chunk(rows, cols, vals, heads, cap: int):
 @partial(jax.jit, static_argnames=("nnz_c", "m"))
 def compress_coo(rows, cols, vals, heads, nnz_c: int, m: int):
     """Segment-sum duplicate (row, col) runs and compact to nnz_c triplets."""
-    seg = jnp.cumsum(heads.astype(nnz_ty)) - 1  # output slot per triplet
+    seg = jnp.cumsum(heads.astype(nnz_dtype())) - 1  # output slot per triplet
     out_vals = jnp.zeros((nnz_c,), dtype=vals.dtype).at[seg].add(vals)
     head_idx = jnp.nonzero(heads, size=nnz_c, fill_value=0)[0]
     out_rows = rows[head_idx]
@@ -154,21 +154,21 @@ def _expand_range(a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
     """
     nnz_a = a_data.shape[0]
     a_rows = row_ids_from_indptr(a_indptr, nnz_a)
-    s = jnp.arange(span, dtype=nnz_ty)
+    s = jnp.arange(span, dtype=nnz_dtype())
     valid_e = s < e_len
     idx = jnp.clip(e_lo + s, 0, nnz_a - 1)
     a_idx_c = a_indices[idx]
     b_row_nnz = jnp.where(valid_e, jnp.diff(b_indptr)[a_idx_c], 0)
     starts = jnp.concatenate(
-        [jnp.zeros((1,), dtype=nnz_ty), jnp.cumsum(b_row_nnz).astype(nnz_ty)]
+        [jnp.zeros((1,), dtype=nnz_dtype()), jnp.cumsum(b_row_nnz).astype(nnz_dtype())]
     )
     t_local = starts[-1]
-    t = jnp.arange(cap, dtype=nnz_ty)
+    t = jnp.arange(cap, dtype=nnz_dtype())
     e = jnp.clip(jnp.searchsorted(starts, t, side="right") - 1, 0, span - 1)
     valid = t < t_local
     within = t - starts[e]
     b_pos = jnp.clip(
-        b_indptr[a_idx_c[e]].astype(nnz_ty) + within, 0,
+        b_indptr[a_idx_c[e]].astype(nnz_dtype()) + within, 0,
         max(b_data.shape[0] - 1, 0),
     )
     rows = jnp.where(valid, a_rows[idx[e]], m).astype(b_indices.dtype)
@@ -210,7 +210,7 @@ def spgemm_csr_csr_csr_impl(
         return (
             jnp.zeros((0,), dtype=val_dtype),
             jnp.zeros((0,), dtype=cdt),
-            jnp.zeros((m + 1,), dtype=nnz_ty),
+            jnp.zeros((m + 1,), dtype=nnz_dtype()),
         )
 
     if chunk_products is not None and num_products > chunk_products:
@@ -239,7 +239,7 @@ def spgemm_csr_csr_csr_impl(
                 continue
             r2, c2, v2 = _compress_chunk(r, c, v, heads, cap)
             r2, c2, v2 = (
-                r2[:nnz_chunk].astype(jnp.int64), c2[:nnz_chunk],
+                r2[:nnz_chunk].astype(index_dtype()), c2[:nnz_chunk],
                 v2[:nnz_chunk],
             )
             if acc_r is None:
@@ -256,7 +256,7 @@ def spgemm_csr_csr_csr_impl(
                 )
                 acc_r = row_ids_from_indptr(
                     f_indptr, f_cols.shape[0]
-                ).astype(jnp.int64)
+                ).astype(index_dtype())
                 acc_c = f_cols
                 acc_v = f_vals
         if acc_r is None:
@@ -264,7 +264,7 @@ def spgemm_csr_csr_csr_impl(
             return (
                 jnp.zeros((0,), dtype=val_dtype),
                 jnp.zeros((0,), dtype=cdt),
-                jnp.zeros((m + 1,), dtype=nnz_ty),
+                jnp.zeros((m + 1,), dtype=nnz_dtype()),
             )
         return coalesce_coo(acc_r, acc_c, acc_v, m)
 

@@ -24,6 +24,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..types import index_dtype
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -159,8 +161,8 @@ def dist_diags(
 
     def kernel(*blocks):
         shard = jax.lax.axis_index(ROW_AXIS)
-        start = shard.astype(jnp.int64) * rps
-        r_l = jnp.arange(rps, dtype=jnp.int64)
+        start = shard.astype(index_dtype()) * rps
+        r_l = jnp.arange(rps, dtype=index_dtype())
         r = start + r_l
 
         # vals_by_diag[d, r_l] = value of diagonal d at global row r.

@@ -44,6 +44,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..csr import csr_array
@@ -603,8 +605,8 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
         dd = ddata[0]                               # (nd, rps)
         dm = rest[0][0] if has_mask else None
         shard = jax.lax.axis_index(ROW_AXIS)
-        r_g = shard.astype(jnp.int64) * rps + jnp.arange(
-            rps, dtype=jnp.int64
+        r_g = shard.astype(index_dtype()) * rps + jnp.arange(
+            rps, dtype=index_dtype()
         )
         y = jnp.zeros((rps,), dtype=dd.dtype)
         for d, o in enumerate(offsets):
@@ -1267,23 +1269,23 @@ def dist_diagonal(A: DistCSR) -> jax.Array:
         if precise:
             base = ggl.reshape(-1)
             rc = base.shape[0]
-            own = cols - rc + shard.astype(jnp.int64) * cps
+            own = cols - rc + shard.astype(index_dtype()) * cps
             return jnp.where(
                 cols < rc, base[jnp.clip(cols, 0, rc - 1)], own
             )
         if halo >= 0:
-            return cols.astype(jnp.int64) + (
-                shard.astype(jnp.int64) * rps - halo
+            return cols.astype(index_dtype()) + (
+                shard.astype(index_dtype()) * rps - halo
             )
-        return cols.astype(jnp.int64)
+        return cols.astype(index_dtype())
 
     if A.ell:
         def kernel(data, cols, counts, *rest):
             data, cols, counts = data[0], cols[0], counts[0]
             ggl = rest[0][0] if precise else None
             shard = jax.lax.axis_index(ROW_AXIS)
-            row_g = shard.astype(jnp.int64) * rps + jnp.arange(
-                rps, dtype=jnp.int64
+            row_g = shard.astype(index_dtype()) * rps + jnp.arange(
+                rps, dtype=index_dtype()
             )
             W = cols.shape[1]
             slot = jnp.arange(W, dtype=counts.dtype)
@@ -1306,8 +1308,8 @@ def dist_diagonal(A: DistCSR) -> jax.Array:
             shard = jax.lax.axis_index(ROW_AXIS)
             slot = jnp.arange(data.shape[0], dtype=jnp.int32)
             valid = slot < counts
-            target = (row_ids.astype(jnp.int64)
-                      + shard.astype(jnp.int64) * rps)
+            target = (row_ids.astype(index_dtype())
+                      + shard.astype(index_dtype()) * rps)
             g = global_cols(cols, shard, ggl)
             hit = jnp.logical_and(valid, g == target)
             return jax.ops.segment_sum(

@@ -40,6 +40,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..types import index_dtype
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -86,7 +88,7 @@ def _a_local_flat(A: _Layout, data, cols, counts, row_ids, ggl=None):
     """
     rps = A.rps
     shard = jax.lax.axis_index(ROW_AXIS)
-    start = shard.astype(jnp.int64) * rps
+    start = shard.astype(index_dtype()) * rps
 
     if A.ell:
         R_, W = cols.shape  # (rps, W)
@@ -95,20 +97,20 @@ def _a_local_flat(A: _Layout, data, cols, counts, row_ids, ggl=None):
         ).reshape(-1)
         slot = jnp.arange(W, dtype=counts.dtype)
         a_valid = (slot[None, :] < counts[:, None]).reshape(-1)
-        a_col = cols.reshape(-1).astype(jnp.int64)
+        a_col = cols.reshape(-1).astype(index_dtype())
         a_val = data.reshape(-1)
     else:
         a_row = row_ids
         nnz_max = data.shape[0]
         slot = jnp.arange(nnz_max, dtype=jnp.int32)
         a_valid = slot < counts
-        a_col = cols.astype(jnp.int64)
+        a_col = cols.astype(index_dtype())
         a_val = data
 
     if A.has_ggl:
         base = ggl.reshape(-1)
         rc = base.shape[0]
-        own = a_col - rc + shard.astype(jnp.int64) * A.cps
+        own = a_col - rc + shard.astype(index_dtype()) * A.cps
         a_col = jnp.where(
             a_col < rc, base[jnp.clip(a_col, 0, rc - 1)], own
         )
@@ -138,9 +140,9 @@ def _b_global_flat(B: _Layout, data, cols, counts, row_ids, ggl=None):
         ggl_g = jax.lax.all_gather(ggl, ROW_AXIS)  # (R, R, C)
         # Un-rebase each source block with its own inverse map; the
         # appended-local region maps back to the block's own columns.
-        per_block = cols_g.reshape(R, -1).astype(jnp.int64)
+        per_block = cols_g.reshape(R, -1).astype(index_dtype())
         cps_b = B.cps
-        s_ids = jnp.arange(R, dtype=jnp.int64)
+        s_ids = jnp.arange(R, dtype=index_dtype())
 
         def unreb(inv, c, s):
             base = inv.reshape(-1)
@@ -155,14 +157,14 @@ def _b_global_flat(B: _Layout, data, cols, counts, row_ids, ggl=None):
     if B.ell:
         W = cols.shape[-1]
         b_data_g = data_g.reshape(rows_p, W).reshape(-1)
-        b_cols_g = cols_g.reshape(rows_p, W).reshape(-1).astype(jnp.int64)
+        b_cols_g = cols_g.reshape(rows_p, W).reshape(-1).astype(index_dtype())
         b_counts = counts_g.reshape(rows_p).astype(jnp.int32)
-        b_start = jnp.arange(rows_p, dtype=jnp.int64) * W
+        b_start = jnp.arange(rows_p, dtype=index_dtype()) * W
     else:
         rid_g = jax.lax.all_gather(row_ids, ROW_AXIS)   # (R, nnz_max)
         nnz_max = data.shape[-1]
         b_data_g = data_g.reshape(-1)
-        b_cols_g = cols_g.reshape(-1).astype(jnp.int64)
+        b_cols_g = cols_g.reshape(-1).astype(index_dtype())
         # Per-row counts from the sorted local row ids: row r of block s
         # occupies [indptr_local[s, r], indptr_local[s, r+1]) clamped to
         # the block's valid prefix (padding replicates the last row id).
@@ -176,8 +178,8 @@ def _b_global_flat(B: _Layout, data, cols, counts, row_ids, ggl=None):
         b_counts = percount.reshape(rows_p)
         starts_local = jnp.cumsum(percount, axis=1) - percount  # exclusive
         b_start = (
-            starts_local.astype(jnp.int64)
-            + (jnp.arange(R, dtype=jnp.int64) * nnz_max)[:, None]
+            starts_local.astype(index_dtype())
+            + (jnp.arange(R, dtype=index_dtype()) * nnz_max)[:, None]
         ).reshape(rows_p)
 
     if B.halo >= 0:
@@ -193,7 +195,7 @@ def _unrebase_b(B: _Layout, b_cols_g, rps):
         per_block = rps * B.inner
     else:
         per_block = B.inner
-    block_of = jnp.arange(b_cols_g.shape[0], dtype=jnp.int64) // per_block
+    block_of = jnp.arange(b_cols_g.shape[0], dtype=index_dtype()) // per_block
     return b_cols_g + block_of * rps - B.halo
 
 
@@ -205,13 +207,13 @@ def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int):
     b_data_g, b_cols_g, b_start, b_counts = b_args
 
     rps = A.rps
-    counts_per_a = jnp.where(a_valid, b_counts[a_col], 0).astype(jnp.int64)
+    counts_per_a = jnp.where(a_valid, b_counts[a_col], 0).astype(index_dtype())
     starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int64), jnp.cumsum(counts_per_a)]
+        [jnp.zeros((1,), index_dtype()), jnp.cumsum(counts_per_a)]
     )
     T_local = starts[-1]
 
-    t = jnp.arange(T_cap, dtype=jnp.int64)
+    t = jnp.arange(T_cap, dtype=index_dtype())
     e = jnp.clip(
         jnp.searchsorted(starts, t, side="right") - 1, 0, a_row.shape[0] - 1
     )
@@ -297,7 +299,7 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
     ``dist_csr._dia_spmv_fn``)."""
     nd_c = len(offs_c)
     idx_c = {o: i for i, o in enumerate(offs_c)}
-    offs_c_dev = jnp.asarray(offs_c, dtype=jnp.int64)
+    offs_c_dev = jnp.asarray(offs_c, dtype=index_dtype())
 
     def kernel(a_blk, b_blk):
         a = a_blk[0]                               # (nd_a, rps)
@@ -322,8 +324,8 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
         from .dist_build import band_ell_local
 
         shard = jax.lax.axis_index(ROW_AXIS)
-        start = shard.astype(jnp.int64) * rps
-        r_l = jnp.arange(rps, dtype=jnp.int64)
+        start = shard.astype(index_dtype()) * rps
+        r_l = jnp.arange(rps, dtype=index_dtype())
         r = start + r_l
         ell_data, ell_cols, cnt = band_ell_local(
             C, offs_c_dev, n, rps, halo_c, start, r, r_l
@@ -454,14 +456,14 @@ def _esc_t_fn(mesh, la: _Layout, lb: _Layout):
         rid = _local(b_args_raw)[3]
         counts_g = jax.lax.all_gather(counts, ROW_AXIS)
         if lb.ell:
-            b_counts = counts_g.reshape(lb.rows_padded).astype(jnp.int64)
+            b_counts = counts_g.reshape(lb.rows_padded).astype(index_dtype())
         else:
             rid_g = jax.lax.all_gather(rid, ROW_AXIS)
             nnz_max = lb.inner
             slot = jnp.arange(nnz_max, dtype=jnp.int32)
             valid = slot[None, :] < counts_g[:, None]
             ids_2d = jnp.where(valid, rid_g, lb.rps)
-            one = jnp.ones_like(ids_2d, dtype=jnp.int64)
+            one = jnp.ones_like(ids_2d, dtype=index_dtype())
             percount = jax.vmap(
                 lambda ids, on: jax.ops.segment_sum(
                     on, ids, num_segments=lb.rps + 1
@@ -469,7 +471,7 @@ def _esc_t_fn(mesh, la: _Layout, lb: _Layout):
             )(ids_2d, one)[:, : lb.rps]
             b_counts = percount.reshape(lb.rows_padded)
         t_local = jnp.sum(
-            jnp.where(a_valid, b_counts[a_col], 0), dtype=jnp.int64
+            jnp.where(a_valid, b_counts[a_col], 0), dtype=index_dtype()
         )
         return t_local[None]
 

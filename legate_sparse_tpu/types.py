@@ -44,6 +44,63 @@ SUPPORTED_DATATYPES = (
 )
 
 
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def index_dtype() -> np.dtype:
+    """Platform-aware wide-index dtype: int64 when 64-bit integers
+    exist in this process, else int32.
+
+    Under the no-x64 TPU policy (``settings.py`` resolves x64 off on
+    TPU processes) a request for int64 is *silently truncated* to int32
+    by jax with a UserWarning — the r3 on-chip capture showed exactly
+    that from ``csr.py``'s indptr builds.  Routing every device-side
+    index/nnz/counter dtype request through here means a no-x64
+    process never asks for a width it cannot have (reference parity:
+    ``src/sparse/util/dispatch.h:56-77`` index-type dispatch).  The
+    documented consequence: a no-x64 process supports dims and nnz up
+    to 2^31-1 (per shard in the distributed case);
+    ``coord_dtype_for`` raises loudly past that instead of letting
+    int32 wrap."""
+    return nnz_ty if _x64_enabled() else int32
+
+
+# indptr/nnz requests read the same platform policy.
+nnz_dtype = index_dtype
+
+
+def check_nnz(nnz: int) -> None:
+    """Loud-failure guard for nnz at the host constructor boundary:
+    under no-x64, indptr is int32, so >2^31-1 nonzeros would wrap
+    negative SILENTLY (an explicit cast carries no warning).  Device-
+    computed nnz (conversions, SpGEMM) past 2^31 in a no-x64 process
+    is likewise unsupported — this guard covers the entry points where
+    external data arrives with a concrete count."""
+    if nnz > np.iinfo(np.int32).max and not _x64_enabled():
+        raise OverflowError(
+            f"nnz={nnz} needs int64 indptr, but this process has x64 "
+            f"disabled (TPU policy); enable x64 (JAX_ENABLE_X64=1 / "
+            f"LEGATE_SPARSE_TPU_X64=1) or build on a CPU process"
+        )
+
+
 def coord_dtype_for(extent: int) -> np.dtype:
-    """Pick int32 unless ``extent`` (a dimension or nnz) needs int64."""
-    return coord_ty if extent <= np.iinfo(np.int32).max else wide_coord_ty
+    """Pick int32 unless ``extent`` (a dimension or nnz) needs int64.
+
+    Raises ``OverflowError`` when the extent needs int64 but the
+    process has x64 disabled (no-x64 TPU policy): a silent int32
+    truncation would corrupt coordinates; callers must enable x64 (or
+    run the build on a CPU process) for >2^31-extent matrices."""
+    if extent <= np.iinfo(np.int32).max:
+        return coord_ty
+    if not _x64_enabled():
+        raise OverflowError(
+            f"matrix extent {extent} needs int64 coordinates, but this "
+            f"process has x64 disabled (TPU policy); enable x64 "
+            f"(JAX_ENABLE_X64=1 / LEGATE_SPARSE_TPU_X64=1) or build on "
+            f"a CPU process"
+        )
+    return wide_coord_ty
