@@ -33,13 +33,14 @@ import time
 ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
-def run_lane(name: str, env_extra: dict, args: list[str]) -> bool:
+def run_lane(name: str, env_extra: dict, args: list[str],
+             path: str = "tests/") -> bool:
     env = dict(os.environ)
     env.update(env_extra)
     t0 = time.time()
     print(f"=== lane: {name} ===", flush=True)
     r = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/", "-q", *args],
+        [sys.executable, "-m", "pytest", path, "-q", *args],
         cwd=ROOT, env=env,
     )
     dt = time.time() - t0
@@ -56,11 +57,20 @@ def main() -> int:
                     help="also run the -m slow lane (heavy shapes)")
     ap.add_argument("--tpu", action="store_true",
                     help="also run the real-chip -m tpu lane")
+    ap.add_argument("--multiproc", action="store_true",
+                    help="run ONLY the multi-process distributed lane "
+                         "(2 ranks x 4 devices via jax.distributed; "
+                         "also part of the default lanes)")
     ap.add_argument("rest", nargs="*",
                     help="extra pytest args (after --)")
     args = ap.parse_args()
 
     ok = True
+    if args.multiproc:
+        ok = run_lane("multiproc (2 ranks x 4 devices)", {},
+                      ["-m", "slow or not slow", *args.rest],
+                      path="tests/test_multiprocess.py")
+        return 0 if ok else 1
     for n in args.devices:
         ok &= run_lane(
             f"{n}-device",

@@ -1,0 +1,116 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""One rank of the multi-process distributed lane.
+
+Launched by ``tests/test_multiprocess.py`` (and ``test.py --multiproc``)
+as N separate OS processes, each owning 4 virtual CPU devices, joined
+through ``parallel.mesh.init_distributed`` — the honest analog of the
+reference's multi-rank launches (reference ``test.py:24-32`` legate
+resource shapes): the mesh spans processes, so every psum/ppermute in
+the dist kernels crosses a real process boundary through the
+distributed runtime instead of staying inside one XLA client.
+
+Usage: python multiproc_worker.py <process_id> <num_processes> <port> [N]
+Prints ``MULTIPROC-OK <pid>`` on success; any failure exits non-zero.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+port = sys.argv[3]
+N = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+
+# Environment must be fixed before jax initializes any backend.  A
+# parent test lane may already carry a device-count pin in XLA_FLAGS
+# (conftest's pin_cpu); strip it rather than appending a duplicate
+# flag whose resolution order is undocumented.
+import re
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=4"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from legate_sparse_tpu.parallel.mesh import init_distributed  # noqa: E402
+
+# The one network bootstrap (reference: GASNet/UCX/MPI selection).
+init_distributed(f"localhost:{port}", num_processes=nproc, process_id=pid)
+
+import numpy as np  # noqa: E402
+import scipy.sparse as sp  # noqa: E402
+
+import legate_sparse_tpu as sparse  # noqa: E402
+from legate_sparse_tpu.parallel.dist_csr import (  # noqa: E402
+    dist_cg, dist_spmv, shard_csr, shard_vector,
+)
+from legate_sparse_tpu.parallel.mesh import make_row_mesh  # noqa: E402
+
+assert len(jax.devices()) == 4 * nproc, (
+    f"expected {4 * nproc} global devices, got {len(jax.devices())}"
+)
+assert len(jax.local_devices()) == 4
+
+# Every rank builds the same global operator host-side (default tiny:
+# this lane proves cross-process collectives; the slow lane passes a
+# larger N so halo/padding budgets see a non-trivial shape).
+n = N * N
+main = np.full(n, 4.0)
+off1 = np.full(n - 1, -1.0)
+off1[np.arange(1, N) * N - 1] = 0.0
+offn = np.full(n - N, -1.0)
+diags_args = ([main, off1, off1, offn, offn], [0, 1, -1, N, -N])
+A = sparse.diags(*diags_args, shape=(n, n), format="csr")
+S = sp.diags(*diags_args, shape=(n, n), format="csr")
+
+mesh = make_row_mesh()          # all 8 devices, spanning both ranks
+dA = shard_csr(A, mesh=mesh)
+
+rng = np.random.default_rng(5)
+x = rng.normal(size=n)
+xs = shard_vector(x, mesh, dA.rows_padded)
+y = dist_spmv(dA, xs)
+ref = S @ x
+
+# Each rank checks ITS OWN addressable shards against the scipy
+# reference — the only data a rank can see without extra collectives.
+rows_padded = dA.rows_padded
+for shard in y.addressable_shards:
+    lo = shard.index[0].start or 0
+    got = np.asarray(shard.data).reshape(-1)
+    hi = min(lo + got.shape[0], n)
+    if lo < n:
+        np.testing.assert_allclose(
+            got[: hi - lo], ref[lo:hi], rtol=1e-10, atol=1e-12,
+            err_msg=f"rank {pid} shard rows [{lo}, {hi})",
+        )
+
+# Whole-solve path: dist CG to tolerance (psum reductions cross the
+# process boundary every iteration block).
+b = np.ones(n)
+sol, iters = dist_cg(dA, b, rtol=1e-10)
+# The true residual needs the full solution; gather it with one
+# replicated resharding (cross-process data movement is exactly what
+# this lane exists to prove).
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+sol_rep = jax.device_put(
+    sol, NamedSharding(mesh, PartitionSpec())
+)
+sol_np = np.asarray(sol_rep).reshape(-1)[:n]
+rnorm = np.linalg.norm(b - S @ sol_np)
+assert rnorm <= 1e-7 * np.linalg.norm(b), f"rank {pid}: ||r|| = {rnorm}"
+
+print(f"MULTIPROC-OK {pid} iters={int(iters)} rnorm={rnorm:.2e}",
+      flush=True)
