@@ -226,9 +226,15 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
 def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
           maxiter=None, tol=0, return_eigenvectors=True, **kwargs):
     """k eigenpairs of a symmetric/Hermitian operator (scipy
-    ``eigsh``).  Native device Lanczos for the standard problem with
-    ``which`` in {LM, LA, SA}; generalized (``M``), shift-invert
-    (``sigma``), and SM delegate to host scipy."""
+    ``eigsh``).
+
+    Capability split: the standard problem with ``which`` in
+    {LM, LA, SA} runs the NATIVE device Lanczos below; generalized
+    (``M``), shift-invert (``sigma``), and ``which='SM'`` delegate to
+    host scipy/ARPACK — shift-invert needs a sparse factorization
+    (``splu``) per solve, which is inherently sequential and stays on
+    host (same boundary as ``spsolve``).  Delegated calls convert
+    operands at the boundary and return scipy's results unchanged."""
     if M is not None or sigma is not None or which not in ("LM", "LA", "SA"):
         return _host_fallback("eigsh")(
             A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
@@ -413,11 +419,15 @@ def _select_ritz(w, k, which):
 def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
          maxiter=None, tol=0, return_eigenvectors=True, **kwargs):
     """k eigenpairs of a general (non-symmetric) operator (scipy
-    ``eigs``).  Native restarted Arnoldi for the standard problem with
-    ``which`` in {LM, LR, SR, LI, SI}; generalized (``M``),
-    shift-invert (``sigma``), and SM delegate to host scipy (which
-    serves SM via shift-invert itself).  Eigenvalues return complex,
-    like scipy."""
+    ``eigs``).
+
+    Capability split: the standard problem with ``which`` in
+    {LM, LR, SR, LI, SI} runs the NATIVE restarted Arnoldi below;
+    generalized (``M``), shift-invert (``sigma``), and SM delegate to
+    host scipy/ARPACK (which serves SM via shift-invert itself) — the
+    factorization shift-invert needs is sequential and stays on host,
+    same boundary as ``spsolve``.  Eigenvalues return complex, like
+    scipy."""
     if (M is not None or sigma is not None
             or which not in ("LM", "LR", "SR", "LI", "SI") or kwargs):
         return _host_fallback("eigs")(

@@ -148,7 +148,7 @@ def minres(A, b, x0=None, *, shift=0.0, tol=None, maxiter=None, M=None,
     (``show``/``check``) delegate to host scipy.
     """
     from .coverage import scipy_fallback
-    from .linalg import (IdentityOperator, _get_atol_rtol,
+    from .linalg import (IdentityOperator, _get_atol_rtol, _promote_rhs,
                          make_linear_operator)
 
     if callback is not None or kwargs:
@@ -175,6 +175,7 @@ def minres(A, b, x0=None, *, shift=0.0, tol=None, maxiter=None, M=None,
         b = b.reshape(-1)
     n = b.shape[0]
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     M_op = (IdentityOperator(A_op.shape, dtype=A_op.dtype)
             if M is None else make_linear_operator(M))
     bnrm = float(jnp.linalg.norm(b))
@@ -292,7 +293,7 @@ def lsqr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     delegates to host scipy.
     """
     from .coverage import scipy_fallback
-    from .linalg import make_linear_operator
+    from .linalg import _promote_rhs, make_linear_operator
 
     if calc_var or show:
         import scipy.sparse.linalg as _ssl
@@ -305,6 +306,7 @@ def lsqr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     if b.ndim == 2 and b.shape[1] == 1:
         b = b.reshape(-1)
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     m, n = A_op.shape
     if iter_lim is None:
         iter_lim = 2 * n
@@ -500,7 +502,7 @@ def lsmr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     7 iteration limit).  ``show`` delegates to host scipy.
     """
     from .coverage import scipy_fallback
-    from .linalg import make_linear_operator
+    from .linalg import _promote_rhs, make_linear_operator
 
     if show:
         import scipy.sparse.linalg as _ssl
@@ -513,6 +515,7 @@ def lsmr(A, b, damp=0.0, atol=1e-6, btol=1e-6, conlim=1e8,
     if b.ndim == 2 and b.shape[1] == 1:
         b = b.reshape(-1)
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     m, n = A_op.shape
     if maxiter is None:
         maxiter = min(m, n)   # scipy's lsmr default
@@ -566,7 +569,7 @@ def differentiable_solve(A, b, method="cg", M=None, rtol=None,
     'cg' (SPD) or 'minres' (symmetric indefinite); both imply a
     symmetric operator, which is what makes the transpose solve free.
     """
-    from .linalg import (IdentityOperator, _cg_loop,
+    from .linalg import (IdentityOperator, _cg_loop, _promote_rhs,
                          make_linear_operator)
 
     if method not in ("cg", "minres"):
@@ -578,6 +581,7 @@ def differentiable_solve(A, b, method="cg", M=None, rtol=None,
         b = b.reshape(-1)
     n = b.shape[0]
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     if A_op.shape[0] != A_op.shape[1]:
         raise ValueError("expected square matrix")
     M_op = (IdentityOperator(A_op.shape, dtype=A_op.dtype)

@@ -185,6 +185,17 @@ class IdentityOperator(LinearOperator):
         return _fill_out(x, out)
 
 
+def _promote_rhs(b, A_op):
+    """Solve in ``result_type(A, b)`` (scipy parity): a real rhs on a
+    complex operator — or f32 rhs on an f64 operator — must not build
+    mixed-dtype while_loop carries (loud TypeError) or silently cast
+    complex iterates down to real (silent wrong answers in gmres)."""
+    if A_op.dtype is None:
+        return b
+    dt = jnp.result_type(A_op.dtype, b.dtype)
+    return b.astype(dt) if b.dtype != dt else b
+
+
 def make_linear_operator(A) -> LinearOperator:
     """Promote matrices/callables to LinearOperator (reference
     ``linalg.py:417-431``).  scipy sparse operands convert to the
@@ -338,6 +349,7 @@ def cg(
         maxiter = n * 10
 
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     M_op = (
         IdentityOperator(A_op.shape, dtype=A_op.dtype)
         if M is None
@@ -451,6 +463,7 @@ def gmres(
     restart = min(int(restart), n)
 
     A_op = make_linear_operator(A)
+    b = _promote_rhs(b, A_op)
     M_op = (
         IdentityOperator(A_op.shape, dtype=A_op.dtype)
         if M is None
@@ -581,6 +594,7 @@ def bicgstab(
     b = jnp.asarray(b)
     if b.ndim == 2 and b.shape[1] == 1:
         b = b.reshape(-1)
+    b = _promote_rhs(b, A_op)
     assert b.ndim == 1
     assert len(A_op.shape) == 2 and A_op.shape[0] == A_op.shape[1]
     n = b.shape[0]

@@ -1,0 +1,185 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Systematic complex (c64/c128) coverage (VERDICT r3 #7).
+
+The reference supports complex across its native task families
+(reference ``legate_sparse/utils.py:28-33`` SUPPORTED_DATATYPES,
+``src/sparse/util/dispatch.h:26-77`` value-type dispatch).  This file
+parameterizes the core differential surface — SpMV/SpMM, SpGEMM,
+transpose/conjugate, and every native solver — over both complex
+dtypes on the CPU lane, plus the mixed real-rhs-on-complex-operator
+promotion scipy performs implicitly (which once built mixed-dtype
+while_loop carries here).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+
+CDTYPES = [np.complex64, np.complex128]
+
+
+def _tol(dtype):
+    return 1e-4 if np.dtype(dtype) == np.complex64 else 1e-10
+
+
+def _rand_complex(n, m, density, rng, dtype):
+    M = (sp.random(n, m, density=density, random_state=rng)
+         + 1j * sp.random(n, m, density=density, random_state=rng))
+    return sp.csr_array(M).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+def test_complex_spmv_spmm(dtype):
+    rng = np.random.default_rng(1)
+    S = _rand_complex(70, 50, 0.1, rng, dtype)
+    A = sparse.csr_array(S)
+    assert np.dtype(A.dtype) == np.dtype(dtype)
+    x = (rng.normal(size=50) + 1j * rng.normal(size=50)).astype(dtype)
+    np.testing.assert_allclose(np.asarray(A @ x), S @ x,
+                               rtol=_tol(dtype), atol=_tol(dtype))
+    X = (rng.normal(size=(50, 6))
+         + 1j * rng.normal(size=(50, 6))).astype(dtype)
+    np.testing.assert_allclose(np.asarray(A @ X), S @ X,
+                               rtol=_tol(dtype), atol=_tol(dtype))
+    # rmatvec drives the conjugate-transpose path solvers rely on.
+    y = (rng.normal(size=70) + 1j * rng.normal(size=70)).astype(dtype)
+    np.testing.assert_allclose(
+        np.asarray(A.T.conj() @ y), S.conj().T @ y,
+        rtol=_tol(dtype), atol=_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+def test_complex_spgemm_and_arithmetic(dtype):
+    rng = np.random.default_rng(2)
+    S1 = _rand_complex(40, 40, 0.15, rng, dtype)
+    S2 = _rand_complex(40, 40, 0.15, rng, dtype)
+    A1, A2 = sparse.csr_array(S1), sparse.csr_array(S2)
+    C = A1 @ A2
+    assert np.dtype(C.dtype) == np.dtype(dtype)
+    np.testing.assert_allclose(C.todense(), (S1 @ S2).toarray(),
+                               rtol=_tol(dtype), atol=_tol(dtype))
+    np.testing.assert_allclose((A1 + A2).todense(),
+                               (S1 + S2).toarray(),
+                               rtol=_tol(dtype), atol=_tol(dtype))
+    np.testing.assert_allclose((A1.multiply(A2)).todense(),
+                               (S1.multiply(S2)).toarray(),
+                               rtol=_tol(dtype), atol=_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+@pytest.mark.parametrize("solver", ["cg", "minres"])
+def test_complex_hermitian_solvers(dtype, solver):
+    # Hermitian positive-definite system: CG/MINRES territory.
+    rng = np.random.default_rng(3)
+    S = _rand_complex(48, 48, 0.15, rng, np.complex128)
+    H = sp.csr_array(S + S.conj().T + 12 * sp.eye(48)).astype(dtype)
+    A = sparse.csr_array(H)
+    b = (rng.normal(size=48) + 1j * rng.normal(size=48)).astype(dtype)
+    tol = 1e-5 if np.dtype(dtype) == np.complex64 else 1e-10
+    x, _ = getattr(linalg, solver)(A, b, rtol=tol)
+    resid = np.linalg.norm(H @ np.asarray(x) - b) / np.linalg.norm(b)
+    assert resid <= 50 * tol, f"{solver} {dtype}: rel resid {resid}"
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+@pytest.mark.parametrize("solver", ["gmres", "bicgstab"])
+def test_complex_nonsymmetric_solvers(dtype, solver):
+    rng = np.random.default_rng(4)
+    S = sp.csr_array(
+        _rand_complex(48, 48, 0.15, rng, np.complex128)
+        + 10 * sp.eye(48)).astype(dtype)
+    A = sparse.csr_array(S)
+    b = (rng.normal(size=48) + 1j * rng.normal(size=48)).astype(dtype)
+    tol = 1e-5 if np.dtype(dtype) == np.complex64 else 1e-10
+    x, _ = getattr(linalg, solver)(A, b, rtol=tol)
+    resid = np.linalg.norm(S @ np.asarray(x) - b) / np.linalg.norm(b)
+    assert resid <= 100 * tol, f"{solver} {dtype}: rel resid {resid}"
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+@pytest.mark.parametrize("solver", ["lsqr", "lsmr"])
+def test_complex_least_squares(dtype, solver):
+    rng = np.random.default_rng(5)
+    S = _rand_complex(60, 35, 0.2, rng, dtype)
+    A = sparse.csr_array(S)
+    b = (rng.normal(size=60) + 1j * rng.normal(size=60)).astype(dtype)
+    out = getattr(linalg, solver)(A, b, atol=1e-10, btol=1e-10)
+    x = np.asarray(out[0])
+    # Compare against scipy's solution of the same problem.
+    ref = sp.linalg.lsqr(S, b, atol=1e-10, btol=1e-10)[0]
+    np.testing.assert_allclose(
+        np.linalg.norm(S @ x - b), np.linalg.norm(S @ ref - b),
+        rtol=1e-3 if np.dtype(dtype) == np.complex64 else 1e-6,
+        atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+def test_complex_eigs(dtype):
+    rng = np.random.default_rng(6)
+    S = _rand_complex(60, 60, 0.15, rng, dtype)
+    A = sparse.csr_array(S)
+    w, V = linalg.eigs(A, k=3, which="LM")
+    resid = np.linalg.norm(S @ V - V * w[None, :], axis=0)
+    tol = 1e-3 if np.dtype(dtype) == np.complex64 else 1e-8
+    assert np.all(resid <= tol * np.abs(w).max()), resid
+
+
+def test_complex_eigsh_hermitian():
+    rng = np.random.default_rng(7)
+    S = _rand_complex(60, 60, 0.15, rng, np.complex128)
+    H = sp.csr_array(S + S.conj().T)
+    w, V = linalg.eigsh(sparse.csr_array(H), k=3, which="LA")
+    assert np.all(np.abs(w.imag) < 1e-12)  # hermitian: real spectrum
+    resid = np.linalg.norm(H @ V - V * w.real[None, :], axis=0)
+    assert np.all(resid <= 1e-7 * max(1.0, np.abs(w).max())), resid
+
+
+@pytest.mark.parametrize(
+    "solver", ["cg", "gmres", "bicgstab", "minres", "lsqr", "lsmr"])
+def test_real_rhs_on_complex_operator_promotes(solver):
+    # scipy promotes implicitly; mixed dtypes must neither crash the
+    # jitted while_loop carries nor silently cast complex to real.
+    rng = np.random.default_rng(8)
+    S = _rand_complex(40, 40, 0.2, rng, np.complex128)
+    H_s = sp.csr_array(S + S.conj().T + 10 * sp.eye(40))
+    A = sparse.csr_array(H_s)
+    b = rng.normal(size=40)          # REAL rhs
+    out = getattr(linalg, solver)(A, b)
+    x = np.asarray(out[0])
+    assert np.iscomplexobj(x)
+    resid = np.linalg.norm(H_s @ x - b) / np.linalg.norm(b)
+    assert resid <= 1e-5, f"{solver}: rel resid {resid}"
+
+
+@pytest.mark.parametrize("dtype", CDTYPES)
+def test_complex_norm_trace_diagonal(dtype):
+    rng = np.random.default_rng(9)
+    S = _rand_complex(30, 30, 0.3, rng, dtype)
+    A = sparse.csr_array(S)
+    np.testing.assert_allclose(linalg.norm(A), sp.linalg.norm(S),
+                               rtol=_tol(dtype))
+    np.testing.assert_allclose(np.asarray(A.trace()), S.trace(),
+                               rtol=_tol(dtype), atol=_tol(dtype))
+    np.testing.assert_allclose(np.asarray(A.diagonal()), S.diagonal(),
+                               rtol=_tol(dtype), atol=_tol(dtype))
+
+
+def test_differentiable_solve_real_rhs_on_complex_operator():
+    # differentiable_solve shares the cg/minres loops; the same
+    # promotion must apply (it was missed by the first fix pass).
+    from legate_sparse_tpu.krylov_extra import differentiable_solve
+
+    rng = np.random.default_rng(10)
+    S = _rand_complex(24, 24, 0.3, rng, np.complex128)
+    H_s = sp.csr_array(S + S.conj().T + 8 * sp.eye(24))
+    A = sparse.csr_array(H_s)
+    b = rng.normal(size=24)
+    for method in ("cg", "minres"):
+        x = np.asarray(differentiable_solve(A, b, method=method))
+        assert np.iscomplexobj(x)
+        resid = np.linalg.norm(H_s @ x - b) / np.linalg.norm(b)
+        assert resid <= 1e-6, f"{method}: rel resid {resid}"

@@ -1,0 +1,137 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Slow-lane distributed tests at non-trivial shapes (VERDICT r3 weak #6).
+
+The default-lane distributed tests use tiny shapes (N=64-129) — enough
+to prove wiring, not enough to engage padding budgets, the chunked
+dist-SpGEMM expansion, or a precise gather plan whose per-shard windows
+actually differ.  Each test here runs one path at a shape where those
+mechanisms do real work, differentially against scipy on the 8-device
+CPU mesh.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+import legate_sparse_tpu as sparse
+
+pytestmark = pytest.mark.slow
+
+
+def _mesh():
+    from legate_sparse_tpu.parallel.mesh import make_row_mesh
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_row_mesh(devs[:8])
+
+
+def _banded(n, W=11, seed=0):
+    rng = np.random.default_rng(seed)
+    half = W // 2
+    offs = list(range(-half, half + 1))
+    diags = [rng.normal(size=n - abs(o)) for o in offs]
+    A = sparse.diags(diags, offs, shape=(n, n), format="csr")
+    S = sp.diags(diags, offs, shape=(n, n), format="csr")
+    return A, sp.csr_array(S)
+
+
+def test_dist_spmv_halo_path_200k_rows():
+    # 25k rows per shard; the band reach (5) stays inside one neighbor
+    # shard, so this must take the fixed-width ppermute halo path.
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmv, shard_csr, shard_vector,
+    )
+
+    mesh = _mesh()
+    n = 200_000
+    A, S = _banded(n)
+    dA = shard_csr(A, mesh=mesh)
+    assert dA.halo >= 0, "expected the ppermute halo-exchange path"
+    x = np.random.default_rng(1).normal(size=n)
+    xs = shard_vector(x, mesh, dA.rows_padded)
+    y = np.asarray(dist_spmv(dA, xs))[:n]
+    np.testing.assert_allclose(y, S @ x, rtol=1e-9, atol=1e-9)
+
+
+def test_dist_spmv_precise_gather_plan_wide_windows():
+    # Long-range coupling (random far columns) defeats the halo
+    # detector; with precise=True each shard's exact all_to_all gather
+    # plan must still reproduce scipy at a shape where shard column
+    # windows genuinely differ.
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmv, shard_csr, shard_vector,
+    )
+
+    mesh = _mesh()
+    n = 40_000
+    rng = np.random.default_rng(2)
+    nnz_per_row = 8
+    rows = np.repeat(np.arange(n), nnz_per_row)
+    # Mix of local and far columns: window extents differ per shard.
+    local = (rows + rng.integers(-40, 40, size=rows.size)) % n
+    far = rng.integers(0, n, size=rows.size)
+    cols = np.where(rng.random(rows.size) < 0.8, local, far)
+    vals = rng.normal(size=rows.size)
+    S = sp.csr_array((vals, (rows, cols)), shape=(n, n))
+    S.sum_duplicates()
+    A = sparse.csr_array(S)
+    dA = shard_csr(A, mesh=mesh, precise=True)
+    assert dA.halo < 0 and dA.gather_globals is not None, (
+        "expected the precise all_to_all gather plan")
+    x = rng.normal(size=n)
+    xs = shard_vector(x, mesh, dA.rows_padded)
+    y = np.asarray(dist_spmv(dA, xs))[:n]
+    np.testing.assert_allclose(y, S @ x, rtol=1e-9, atol=1e-9)
+
+
+def test_dist_spgemm_chunked_expansion_50k():
+    # Product count large enough that the chunked ESC expansion
+    # actually iterates (cap below the total products).
+    from legate_sparse_tpu.parallel.dist_csr import shard_csr
+    from legate_sparse_tpu.parallel.dist_spgemm import dist_spgemm
+    from legate_sparse_tpu.settings import settings
+
+    mesh = _mesh()
+    n = 50_000
+    rng = np.random.default_rng(3)
+    S = sp.csr_array(sp.random(n, n, density=2e-4, random_state=rng,
+                               data_rvs=lambda k: rng.normal(size=k)))
+    # Break banded detection so the general ESC runs.
+    S[0, n - 1] = 1.0
+    S[n - 1, 0] = 1.0
+    S = sp.csr_array(S)
+    A = sparse.csr_array(S)
+    old = settings.fast_spgemm
+    try:
+        settings.fast_spgemm = False     # chunked mode
+        dA = shard_csr(A, mesh=mesh)
+        C = dist_spgemm(dA, dA).to_csr()
+    finally:
+        settings.fast_spgemm = old
+    ref = sp.csr_array(S @ S)
+    got = C.toscipy()
+    diff = (got - ref)
+    denom = max(1.0, float(abs(ref).max()))
+    assert abs(diff).max() <= 1e-9 * denom
+
+
+def test_dist_cg_poisson_256():
+    # 65k-row Poisson solve to tolerance across 8 shards.
+    from legate_sparse_tpu.parallel.dist_build import dist_poisson2d
+    from legate_sparse_tpu.parallel.dist_csr import dist_cg
+
+    mesh = _mesh()
+    N = 256
+    n = N * N
+    dA = dist_poisson2d(N, mesh=mesh)
+    b = np.ones(n)
+    sol, iters = dist_cg(dA, b, rtol=1e-8)
+    S = dA.to_csr().toscipy()
+    x = np.asarray(sol).reshape(-1)[:n]
+    rnorm = np.linalg.norm(b - S @ x)
+    assert rnorm <= 1e-5, f"||r||={rnorm} after {int(iters)} iters"
