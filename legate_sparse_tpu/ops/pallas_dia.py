@@ -148,6 +148,27 @@ def _use_mosaic_roll() -> bool:
     return os.environ.get("LEGATE_SPARSE_TPU_PALLAS_ROLL", "tpu") != "xla"
 
 
+def _distinct_inputs() -> bool:
+    """SpMV neighbor-tile inputs: pass the SAME padded x buffer three
+    times with clamped index maps (default, zero-copy), or three
+    DISTINCT tile-shifted copies with plain index maps
+    (``LEGATE_SPARSE_TPU_PALLAS_INPUTS=distinct``).
+
+    The distinct mode exists as a fault-isolation rung: the r3 on-chip
+    worker fault appears only when the kernel is embedded in a jitted
+    fori_loop (eager launches at full size pass), and the loop is
+    exactly where XLA's buffer reuse interacts with the three aliased
+    operands + min/max index maps.  Distinct copies cost one extra
+    pass over x per call (~15% of the band traffic at the bench
+    shape) and remove both structural suspects at once.
+
+    Read at kernel TRACE time, not part of the jit key — set before
+    the first banded op of the process (the isolation harness and the
+    bench canary ladder run one subprocess per variant)."""
+    return os.environ.get(
+        "LEGATE_SPARSE_TPU_PALLAS_INPUTS", "alias") == "distinct"
+
+
 def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
     """xs with ``xs_flat[p] = w_flat[p + s]`` along the flattened last
     two dims of ``w`` (.., R, L); leading dims (axis base > 0) are
@@ -232,13 +253,31 @@ def pallas_dia_spmv(rdata, rmask, x, offsets: Tuple[int, ...],
     masked = rmask is not None
     kernel = _make_kernel(offsets, rows, cols, tile, masked, interpret)
 
-    in_specs = [
-        pl.BlockSpec((Rt, L), lambda i: (jnp.maximum(i - 1, 0), 0)),
-        pl.BlockSpec((Rt, L), lambda i: (jnp.minimum(i, ntx - 1), 0)),
-        pl.BlockSpec((Rt, L), lambda i: (jnp.minimum(i + 1, ntx - 1), 0)),
-        pl.BlockSpec((nd, Rt, L), lambda i: (0, i, 0)),
-    ]
-    args = [xv, xv, xv, rdata]
+    if _distinct_inputs():
+        # Three separate tile-shifted buffers, plain index maps.  The
+        # zero edge tiles are safe: every read whose global source row
+        # is out of range is masked by `valid` inside the kernel.
+        z = jnp.zeros((Rt, L), xv.dtype)
+        xm_b = jnp.concatenate([z, xv[:-Rt]], axis=0)
+        xp_b = jnp.concatenate([xv[Rt:], z], axis=0)
+        xm_b, xc_b, xp_b = jax.lax.optimization_barrier(
+            (xm_b, xv, xp_b))
+        in_specs = [
+            pl.BlockSpec((Rt, L), lambda i: (i, 0)),
+            pl.BlockSpec((Rt, L), lambda i: (i, 0)),
+            pl.BlockSpec((Rt, L), lambda i: (i, 0)),
+            pl.BlockSpec((nd, Rt, L), lambda i: (0, i, 0)),
+        ]
+        args = [xm_b, xc_b, xp_b, rdata]
+    else:
+        in_specs = [
+            pl.BlockSpec((Rt, L), lambda i: (jnp.maximum(i - 1, 0), 0)),
+            pl.BlockSpec((Rt, L), lambda i: (jnp.minimum(i, ntx - 1), 0)),
+            pl.BlockSpec((Rt, L),
+                         lambda i: (jnp.minimum(i + 1, ntx - 1), 0)),
+            pl.BlockSpec((nd, Rt, L), lambda i: (0, i, 0)),
+        ]
+        args = [xv, xv, xv, rdata]
     if masked:
         in_specs.append(pl.BlockSpec((nd, Rt, L), lambda i: (0, i, 0)))
         args.append(rmask)
@@ -372,6 +411,11 @@ def dia_spmm_maybe_pallas(packed, X):
     """SpMM through the Pallas kernel, or None for the XLA fallback."""
     mode = _mode()
     if mode == "0" or packed is None:
+        return None
+    if _distinct_inputs():
+        # The de-aliased input mode is only implemented for the SpMV
+        # kernel; the SpMM kernel keeps the aliased three-operand
+        # structure the mode exists to rule out, so it must not run.
         return None
     k = X.shape[1]
     if k == 0 or k > SPMM_MAX_K:
@@ -543,6 +587,10 @@ def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
     """Banded SpGEMM through the Pallas kernel, or None (XLA path)."""
     mode = _mode()
     if mode == "0":
+        return None
+    if _distinct_inputs():
+        # See dia_spmm_maybe_pallas: aliased-operand structure remains
+        # here, so the distinct-inputs mode falls back to XLA.
         return None
     if np.dtype(a_data.dtype) not in (np.dtype(np.float32),
                                       np.dtype(jnp.bfloat16)):

@@ -30,11 +30,13 @@ PROBE = r"""
 import json, os, sys, time
 import numpy as np
 log2 = int(sys.argv[1])
-mode = sys.argv[2]            # pallas | pallas-jroll | xla
+mode = sys.argv[2]    # pallas | pallas-shift3 | pallas-jroll | xla
 if mode == "xla":
     os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
 elif mode == "pallas-jroll":
     os.environ["LEGATE_SPARSE_TPU_PALLAS_ROLL"] = "xla"
+elif mode == "pallas-shift3":
+    os.environ["LEGATE_SPARSE_TPU_PALLAS_INPUTS"] = "distinct"
 import jax
 import jax.numpy as jnp
 import legate_sparse_tpu as sparse
@@ -137,25 +139,28 @@ def main() -> None:
     append(f"\n## Fault isolation {stamp}\n\n"
            "One subprocess per row (bench's exact diags->SpMV path); a "
            "crash poisons only its own row.\n\n```json\n")
-    # Per-probe budgets (+ the recovery pause BETWEEN probes) must SUM
-    # below the capture script's outer timeout (quick: 2*390+45 < 900,
-    # full: 4200s) so the closing fence and later phases always run.  Quick mode exists to NAME the
-    # crashing configuration early in a window without consuming it:
-    # one 2^22 pallas probe, plus the jroll lowering only when the
+    # Per-probe budgets (+ recovery pauses BETWEEN probes) must SUM
+    # below the capture script's outer timeout (quick: 2*390+45 < 900;
+    # full: 4440 + pauses < 5400) so the closing fence and later
+    # phases always run.  Quick mode exists to NAME the crashing
+    # configuration early in a window without consuming it: one 2^22
+    # pallas probe, plus the de-aliased shift3 variant only when the
     # pallas probe failed (bench's canary ladder at 2^24 does the
     # production variant selection; this is the diagnostic record).
     if quick:
-        plan = [(22, 390, ("pallas", "pallas-jroll"))]
+        plan = [(22, 390, ("pallas", "pallas-shift3"))]
     else:
-        plan = [(16, 240, ("pallas", "pallas-jroll", "xla")),
-                (20, 300, ("pallas", "pallas-jroll", "xla")),
+        plan = [(16, 240, ("pallas", "pallas-shift3", "pallas-jroll",
+                           "xla")),
+                (20, 300, ("pallas", "pallas-shift3", "pallas-jroll",
+                           "xla")),
                 (22, 540, ("pallas", "xla")),
                 (24, 600, ("pallas", "xla"))]
     try:
         for log2, budget, modes in plan:
             pallas_clean = False
             for mode in modes:
-                if quick and mode == "pallas-jroll" and pallas_clean:
+                if quick and mode != "pallas" and pallas_clean:
                     continue   # nothing to bisect: default mode works
                 res = run(log2, mode, timeout_s=budget)
                 append(json.dumps(res) + "\n")

@@ -50,8 +50,9 @@ timeout 8400 python tools/tpu_capture.py 2>&1 | tee -a "$log"
 LEGATE_SPARSE_TPU_SHOOTOUT_TIMEOUT=1500 \
 timeout 1800 python tools/tune_irregular.py 2>&1 | tail -2 | tee -a "$log"
 
-# 4. Full-grid fault isolation after the headline data is banked.
-timeout 4200 python tools/fault_isolate.py 2>&1 | tee -a "$log"
+# 4. Full-grid fault isolation after the headline data is banked
+#    (worst case 4440s of probe budgets + recovery pauses < 5400).
+timeout 5400 python tools/fault_isolate.py 2>&1 | tee -a "$log"
 
 # 5. Scale demos (BASELINE configs 2-3).
 timeout 1800 python examples/pde.py -n 4096 -m 4096 -i 300 \
