@@ -565,6 +565,38 @@ def main() -> None:
         except Exception as e:
             sys.stderr.write(f"bench: gmg config failed: {e!r}\n")
 
+    # LAST on purpose: a bf16-specific kernel fault must not
+    # poison earlier phases.
+    # bfloat16 banded SpMV — the TPU-native extension beyond the
+    # reference's f32/f64 gate (README "dtype policy"): SpMV is
+    # bandwidth-bound, so bf16 storage halves the traffic and should
+    # land near 2x the f32 rate on chip.  Reported as its own key;
+    # the contract metric stays f32.
+    if (os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_BF16", "0") != "1"
+            and platform != "cpu"      # no native bf16 off-TPU
+            and not past_deadline(result, "bf16")):
+        try:
+            import jax.numpy as _jnp16
+
+            half = nnz_per_row // 2
+            offsets16 = list(range(-half, half + 1))
+            val16 = 1.0 / nnz_per_row
+            diagonals16 = [
+                np.full(n - abs(o), val16, dtype=np.float32)
+                for o in offsets16
+            ]
+            A16 = sparse.diags(diagonals16, offsets16, shape=(n, n),
+                               format="csr", dtype=_jnp16.bfloat16)
+            x16 = jnp.full((n,), 1.0, dtype=_jnp16.bfloat16)
+            ms16 = _time_spmv_ms(A16, x16, normalize=False, k_lo=5,
+                                 k_hi=35)
+            bytes16 = _spmv_bytes(A16, x16)
+            result["bf16_ms"] = round(ms16, 4)
+            result["bf16_gbs"] = round(bytes16 / (ms16 * 1e-3) / 1e9, 2)
+        except Exception as e:
+            sys.stderr.write(f"bench: bf16 banded failed: {e!r}\n")
+
+
     result["bench_wall_s"] = round(_time_mod.perf_counter() - t_start, 1)
     print(json.dumps(result))
 
