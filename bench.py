@@ -160,6 +160,7 @@ def _select_band_variant(log2n: int, timeout_s: int) -> tuple:
         attempts.append(f"{name}:{verdict}")
         if verdict == "ok":
             os.environ.update(env_extra)
+            _persist_variant(name, env_extra)
             return attempts, True
         sys.stderr.write(
             f"bench: band canary '{name}' verdict '{verdict}'\n"
@@ -169,9 +170,26 @@ def _select_band_variant(log2n: int, timeout_s: int) -> tuple:
         # pins CPU if the worker never comes back).
         if not _probe_accelerator():
             os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
+            _persist_variant("xla", {"LEGATE_SPARSE_TPU_PALLAS_DIA": "0"})
             return attempts, False
     os.environ["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "0"
+    _persist_variant("xla", {"LEGATE_SPARSE_TPU_PALLAS_DIA": "0"})
     return attempts, True
+
+
+def _persist_variant(name: str, env_extra: dict) -> None:
+    """Record the surviving band variant so LATER capture phases (pde,
+    SpMV sweep — separate processes in tools/round4_capture.sh) can
+    export the same env instead of re-running a possibly-faulting
+    default.  Best-effort: bench works without the evidence dir."""
+    try:
+        os.makedirs("evidence", exist_ok=True)
+        with open("evidence/band_variant.env", "w") as f:
+            f.write(f"# chosen band variant: {name}\n")
+            for k, v in env_extra.items():
+                f.write(f"export {k}={v}\n")
+    except OSError:
+        pass
 
 
 def _stream_bandwidth() -> float:

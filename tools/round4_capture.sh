@@ -46,6 +46,14 @@ timeout 900 python tools/fault_isolate.py --quick 2>&1 | tee -a "$log"
 #    kernel shoot-out, tpu test lane, SpGEMM, CG) — incremental appends.
 timeout 8400 python tools/tpu_capture.py 2>&1 | tee -a "$log"
 
+# Later phases run the band variant bench's canary ladder proved out
+# (separate processes: the selection does not propagate by itself).
+if [ -f evidence/band_variant.env ]; then
+  # shellcheck disable=SC1091
+  . evidence/band_variant.env
+  echo "using band variant env: $(cat evidence/band_variant.env | tail -n +2)" | tee -a "$log"
+fi
+
 # 3. Irregular-path shoot-out (XLA ELL vs BSR across densities).
 LEGATE_SPARSE_TPU_SHOOTOUT_TIMEOUT=1500 \
 timeout 1800 python tools/tune_irregular.py 2>&1 | tail -2 | tee -a "$log"
