@@ -68,8 +68,37 @@ TILE_MAX = 1 << 17      # beyond this the VMEM working set is too large
 _VMEM_BUDGET = 96 << 20
 
 
+def _tile_override() -> Optional[int]:
+    """Operator-forced rows-per-grid-step (``LEGATE_SPARSE_TPU_PALLAS_TILE``,
+    power of two in [2^10, TILE_MAX]).  Exists for on-chip tuning and
+    fault isolation: the tile sets the grid length (2^24 rows = 1024
+    steps at the default 2^14), and a grid-length-dependent fault looks
+    exactly like the r3 loop-composition crash.  Read at dispatch
+    time; invalid values are ignored with a warning."""
+    v = os.environ.get("LEGATE_SPARSE_TPU_PALLAS_TILE")
+    if not v:
+        return None
+    try:
+        t = int(v)
+        if t >= 1024 and t <= TILE_MAX and (t & (t - 1)) == 0:
+            return t
+    except ValueError:
+        pass
+    import sys
+
+    sys.stderr.write(
+        f"legate_sparse_tpu: ignoring invalid "
+        f"LEGATE_SPARSE_TPU_PALLAS_TILE={v!r}\n"
+    )
+    return None
+
+
 def choose_tile(max_abs_off: int) -> Optional[int]:
-    """Smallest supported tile covering the band reach, or None."""
+    """Smallest supported tile covering the band reach, or None.
+    An operator override wins when it covers the reach."""
+    forced = _tile_override()
+    if forced is not None and max_abs_off <= forced:
+        return forced
     tile = TILE_MIN
     while tile < max_abs_off and tile < TILE_MAX:
         tile *= 2

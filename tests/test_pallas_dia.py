@@ -344,3 +344,30 @@ def test_distinct_inputs_mode_matches_aliased(shape, rng, monkeypatch):
         pallas_dia.pallas_dia_spmv.clear_cache()
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(ref, A_sp @ x, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("tile", [2048, 8192])
+def test_tile_override_matches_default(tile, rng, monkeypatch):
+    # LEGATE_SPARSE_TPU_PALLAS_TILE changes the grid length (fault
+    # isolation) and VMEM working set (tuning); results must be
+    # identical to the default tile.
+    n = 1 << 13
+    offsets = (-5, -1, 0, 1, 5)
+    A, A_sp = _banded(n, offsets, rng)
+    x = rng.standard_normal(n).astype(np.float32)
+    ref = _spmv_via_pallas(A, x)
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_TILE", str(tile))
+    dia_data, offs, mask = A._get_dia()
+    packed = pallas_dia.pack_band(dia_data, offs, A.shape, mask=mask)
+    assert packed is not None and packed.tile == tile
+    got = np.asarray(pallas_dia.pallas_dia_spmv(
+        packed.rdata, packed.rmask, jnp.asarray(x), packed.offsets,
+        packed.shape, packed.tile, interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_tile_override_ignored_when_too_small(rng, monkeypatch):
+    # An override below the band reach must not break the kernel: the
+    # auto-grown tile wins.
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_TILE", "1024")
+    assert pallas_dia.choose_tile(5000) == pallas_dia.TILE_MIN

@@ -111,7 +111,7 @@ print(json.dumps(res))
 """
 
 KERNEL_TIMING = r"""
-import json
+import json, os
 import numpy as np, jax, jax.numpy as jnp
 import legate_sparse_tpu as sparse
 from legate_sparse_tpu.bench_timing import loop_ms_per_iter
@@ -161,6 +161,29 @@ ms = loop_ms_per_iter(
     lambda v: spmv_ops.ell_spmv(ell[0], ell[1], ell[2], v) * np.float32(1.0),
     x, k_lo=2, k_hi=6)
 res["xla_ell_ms"] = round(ms, 4)
+print(json.dumps(res), flush=True)   # bank before the tile sweep
+
+# Pallas tile sweep: the grid length scales inversely with the tile
+# (fault diagnosis) and the tile sets the VMEM working set (tuning).
+if packed is not None:
+    for tl in (8192, 32768, 131072):
+        os.environ["LEGATE_SPARSE_TPU_PALLAS_TILE"] = str(tl)
+        try:
+            pk = pallas_dia.pack_band(dd, offsets, A.shape, mask=mask)
+            if pk is None or pk.tile != tl:
+                res[f"pallas_tile_{tl}"] = None
+                continue
+            ms = loop_ms_per_iter(
+                lambda v, pk=pk: pallas_dia.pallas_dia_spmv(
+                    pk.rdata, pk.rmask, v, pk.offsets, pk.shape,
+                    pk.tile),
+                x, k_lo=5, k_hi=35)
+            res[f"pallas_tile_{tl}"] = round(bytes_dia / ms / 1e6, 1)
+        except Exception as e:
+            res[f"pallas_tile_{tl}"] = f"err:{e!r:.80}"
+        finally:
+            os.environ.pop("LEGATE_SPARSE_TPU_PALLAS_TILE", None)
+        print(json.dumps(res), flush=True)
 print(json.dumps(res))
 """
 
