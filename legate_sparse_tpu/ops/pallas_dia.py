@@ -112,13 +112,35 @@ def supported(offsets: Tuple[int, ...], dtype, masked: bool) -> Optional[int]:
         return None
     if not offsets:
         return None
+    nd = len(offsets)
+    itemsize = np.dtype(dtype).itemsize
+
+    def vmem_of(t: int) -> int:
+        return t * itemsize * (3 + 1) + nd * t * (itemsize + masked)
+
     tile = choose_tile(max(abs(o) for o in offsets))
     if tile is None:
         return None
-    nd = len(offsets)
-    itemsize = np.dtype(dtype).itemsize
-    vmem = tile * itemsize * (3 + 1) + nd * tile * (itemsize + masked)
-    return tile if vmem <= _VMEM_BUDGET else None
+    if vmem_of(tile) > _VMEM_BUDGET:
+        if _tile_override() == tile:
+            # A forced tile that blows the VMEM budget must degrade to
+            # the auto choice (warned), not silently disable the
+            # kernel — same contract as an invalid override value.
+            import sys
+
+            auto = TILE_MIN
+            max_off = max(abs(o) for o in offsets)
+            while auto < max_off and auto < TILE_MAX:
+                auto *= 2
+            if max_off <= auto and vmem_of(auto) <= _VMEM_BUDGET:
+                sys.stderr.write(
+                    f"legate_sparse_tpu: LEGATE_SPARSE_TPU_PALLAS_TILE="
+                    f"{tile} exceeds the VMEM budget for this band; "
+                    f"using tile {auto}\n"
+                )
+                return auto
+        return None
+    return tile
 
 
 @partial(jax.jit, static_argnames=("offsets", "shape", "tile", "with_mask"))

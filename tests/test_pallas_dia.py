@@ -371,3 +371,13 @@ def test_tile_override_ignored_when_too_small(rng, monkeypatch):
     # auto-grown tile wins.
     monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_TILE", "1024")
     assert pallas_dia.choose_tile(5000) == pallas_dia.TILE_MIN
+
+
+def test_tile_override_over_vmem_budget_degrades_to_auto(monkeypatch):
+    # A forced tile that blows the VMEM budget must degrade to the
+    # auto tile with a warning, not silently disable the kernel.
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_TILE",
+                       str(pallas_dia.TILE_MAX))
+    offsets = tuple(range(-100, 101))       # 201 diagonals
+    tile = pallas_dia.supported(offsets, np.float32, masked=True)
+    assert tile == pallas_dia.TILE_MIN

@@ -44,6 +44,10 @@ timeout 900 python tools/fault_isolate.py --quick 2>&1 | tee -a "$log"
 
 # 2. Headline sweep (bench with the variant-selection canary ladder,
 #    kernel shoot-out, tpu test lane, SpGEMM, CG) — incremental appends.
+#    Drop any stale variant selection from a previous run first: if
+#    THIS run's bench never reaches the ladder, later phases must not
+#    inherit an outdated pin.
+rm -f evidence/band_variant.env
 timeout 8400 python tools/tpu_capture.py 2>&1 | tee -a "$log"
 
 # Later phases run the band variant bench's canary ladder proved out
