@@ -72,22 +72,36 @@ def _probe_accelerator() -> bool:
     )
 
 
+# The canary probes EXACTLY the fault surface — the Pallas kernel
+# embedded in a jitted fori_loop at the bench size — with a synthetic
+# band built directly on device (row sums 1.0 keep the chain stable).
+# The r3 on-chip evidence shows the CSR->DIA build and eager launches
+# pass; skipping the full diags->CSR->pack build cuts each rung from
+# ~3-4 minutes of tunnel-bound build time to one compile + a few
+# launches, so the whole ladder fits comfortably in a window.
 _CANARY_CODE = r"""
 import sys
 import numpy as np
 import jax.numpy as jnp
-import legate_sparse_tpu as sparse
 from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+from legate_sparse_tpu.ops import pallas_dia
 n = 1 << int(sys.argv[1])
-half = 5
-offsets = list(range(-half, half + 1))
-diagonals = [np.full(n - abs(o), 1.0, dtype=np.float32) for o in offsets]
-A = sparse.diags(diagonals, offsets, shape=(n, n), format="csr",
-                 dtype=np.float32)
+W = 11
+half = W // 2
+offsets = tuple(range(-half, half + 1))
+tile = pallas_dia.supported(offsets, np.float32, masked=False)
+assert tile is not None
+val = np.float32(1.0 / W)
+rdata = jnp.full((W, n // 128, 128), val, dtype=jnp.float32)
 x = jnp.ones((n,), dtype=jnp.float32)
-float(jnp.sum(A @ x))                      # eager launch
+
+def step(v):
+    return pallas_dia.pallas_dia_spmv(rdata, None, v, offsets, (n, n),
+                                      tile)
+
+float(jnp.sum(step(x)))                    # eager launch
 try:
-    loop_ms_per_iter(lambda v: A @ v, x, k_lo=2, k_hi=6, k_cap=24)
+    loop_ms_per_iter(step, x, k_lo=2, k_hi=6, k_cap=24)
 except RuntimeError:
     # "unresolvable timing" under the capped trip count is NOT a
     # fault: both looped programs ran to completion, which is all the
