@@ -171,3 +171,47 @@ def test_solver_eigensolver_battery():
                                        normed=True).toarray(),
                  scsg.laplacian(G, normed=True).toarray(), tol=1e-10)
     assert not fails, fails
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+def test_differential_battery_complex(dtype):
+    # The same cross-op battery over complex operands (reference
+    # supports complex across its task families; utils.py:28-33).
+    rng = np.random.default_rng(7)
+    tol = 1e-4 if np.dtype(dtype) == np.complex64 else 1e-9
+    fails = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(3):
+            m, n = SHAPES[trial % 2]
+            d = float(rng.uniform(0.05, 0.3))
+
+            def rnd():
+                M = (sp.random(m, n, density=d, random_state=rng)
+                     + 1j * sp.random(m, n, density=d,
+                                      random_state=rng))
+                return sp.csr_array(M).astype(dtype)
+
+            As, Bs = rnd(), rnd()
+            A, B = lst.csr_array(As), lst.csr_array(Bs)
+            _chk(fails, trial, "add", A + B, As + Bs, tol=tol)
+            _chk(fails, trial, "sub", A - B, As - Bs, tol=tol)
+            _chk(fails, trial, "multiply", A.multiply(B),
+                 As.multiply(Bs), tol=tol)
+            _chk(fails, trial, "conjT", A.conj().T,
+                 As.conj().T.tocsr() if hasattr(As.conj().T, "tocsr")
+                 else As.conj().T, tol=tol)
+            _chk(fails, trial, "sum1", A.sum(axis=1),
+                 np.asarray(As.sum(axis=1)).ravel(), tol=tol)
+            _chk(fails, trial, "tocsc", A.tocsc(), As.tocsc(), tol=tol)
+            if m == n:
+                _chk(fails, trial, "spgemm", A @ B, As @ Bs, tol=tol)
+                _chk(fails, trial, "diag", A.diagonal(), As.diagonal(),
+                     tol=tol)
+            x = (rng.standard_normal(n)
+                 + 1j * rng.standard_normal(n)).astype(dtype)
+            _chk(fails, trial, "spmv", A @ x, As @ x, tol=tol)
+            X = (rng.standard_normal((n, 3))
+                 + 1j * rng.standard_normal((n, 3))).astype(dtype)
+            _chk(fails, trial, "spmm", A @ X, As @ X, tol=tol)
+    assert not fails, fails
