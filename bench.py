@@ -563,9 +563,6 @@ def main() -> None:
             # metric can go unresolvable when f32 GMG-CG hits an
             # exactly-zero residual before the low trip count and
             # stops despite rtol=0.
-            import jax as _jax
-            import jax.numpy as _jnp
-
             from legate_sparse_tpu.bench_timing import loop_ms_per_iter
             from legate_sparse_tpu.parallel.dist_csr import shard_vector
 
@@ -573,7 +570,7 @@ def main() -> None:
 
             def cycle_step(v):
                 y = gmg.cycle(v)
-                return y * _jax.lax.rsqrt(_jnp.mean(y * y) + 1e-20)
+                return y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-20)
 
             result["gmg_grid"] = f"{grid}x{grid}"
             try:

@@ -33,13 +33,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .types import index_dtype
-
 from scipy.sparse import SparseEfficiencyWarning
 
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
-from .types import check_nnz, coord_dtype_for, nnz_dtype
+from .types import check_nnz, coord_dtype_for, index_dtype, nnz_dtype
 from .utils import cast_to_common_type, fill_out, require_supported_dtype
 from .ops import convert as _convert
 from .ops import dia_ops as _dia_ops
@@ -542,16 +540,12 @@ class csr_array(CompressedBase, DenseSparseBase):
             self._dia_fused = False
             return None
         dia_data, offsets, mask = dia
-        if not self._can_build_cache(self._data, self._indices,
-                                     self._indptr):
-            # Inside a trace: compute without caching.
-            return _dia_ops.pad_dia(dia_data, offsets, self.shape,
-                                    mask=mask, with_mask=mask is not None)
-        self._dia_fused = _dia_ops.pad_dia(
-            dia_data, offsets, self.shape,
-            mask=mask, with_mask=mask is not None,
-        )
-        return self._dia_fused
+        fused = _dia_ops.pad_dia(dia_data, offsets, self.shape,
+                                 mask=mask, with_mask=mask is not None)
+        if self._can_build_cache(self._data, self._indices,
+                                 self._indptr):
+            self._dia_fused = fused      # else: inside a trace, no cache
+        return fused
 
     def _get_row_ids(self):
         """Cached per-nnz row ids, or a non-cached computation when a

@@ -44,6 +44,12 @@ def dia_spmv(data: jax.Array, x: jax.Array, offsets: Tuple[int, ...],
     return y
 
 
+def _band_reach(offsets: Tuple[int, ...]) -> Tuple[int, int]:
+    """(P, Q): band reach below/above the main diagonal — the pad
+    widths shared by ``pad_dia`` and the fused kernels."""
+    return max(0, -min(offsets)), max(0, max(offsets))
+
+
 @partial(jax.jit, static_argnames=("offsets", "shape", "with_mask"))
 def pad_dia(data, offsets: Tuple[int, ...], shape: Tuple[int, int],
             mask=None, with_mask: bool = False):
@@ -55,8 +61,7 @@ def pad_dia(data, offsets: Tuple[int, ...], shape: Tuple[int, int],
     edges.  Cached per structure (``csr_array._get_dia_fused``)."""
     rows, cols = shape
     width = data.shape[1]
-    P = max(0, -min(offsets))
-    Q = max(0, max(offsets))
+    P, Q = _band_reach(offsets)
     right = max(0, rows + Q - width)
     dpad = jnp.pad(data, ((0, 0), (P, right)))
     if not with_mask:
@@ -82,8 +87,7 @@ def dia_spmv_fused(dpad, mpad, x, offsets: Tuple[int, ...],
     explicit entries; holey bands mask x through ``mpad`` exactly like
     ``dia_spmv_masked``."""
     rows, cols = shape
-    P = max(0, -min(offsets))
-    Q = max(0, max(offsets))
+    P, Q = _band_reach(offsets)
     xpad = jnp.pad(x, (P, max(0, rows + Q - cols)))
     y = jnp.zeros((rows,), dtype=jnp.result_type(dpad.dtype, x.dtype))
     for d, off in enumerate(offsets):
@@ -231,8 +235,7 @@ def dia_spmm_fused(dpad, mpad, X, offsets: Tuple[int, ...],
     ``dia_spmv_fused`` (one fused pass instead of a num_diags-long
     dynamic-update-slice chain)."""
     rows, cols = shape
-    P = max(0, -min(offsets))
-    Q = max(0, max(offsets))
+    P, Q = _band_reach(offsets)
     Xpad = jnp.pad(X, ((P, max(0, rows + Q - cols)), (0, 0)))
     Y = jnp.zeros((rows, X.shape[1]),
                   dtype=jnp.result_type(dpad.dtype, X.dtype))

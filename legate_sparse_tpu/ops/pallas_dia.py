@@ -93,16 +93,21 @@ def _tile_override() -> Optional[int]:
     return None
 
 
+def _auto_tile(max_abs_off: int) -> Optional[int]:
+    """Smallest default tile covering the band reach, or None."""
+    tile = TILE_MIN
+    while tile < max_abs_off and tile < TILE_MAX:
+        tile *= 2
+    return tile if max_abs_off <= tile else None
+
+
 def choose_tile(max_abs_off: int) -> Optional[int]:
     """Smallest supported tile covering the band reach, or None.
     An operator override wins when it covers the reach."""
     forced = _tile_override()
     if forced is not None and max_abs_off <= forced:
         return forced
-    tile = TILE_MIN
-    while tile < max_abs_off and tile < TILE_MAX:
-        tile *= 2
-    return tile if max_abs_off <= tile else None
+    return _auto_tile(max_abs_off)
 
 
 def supported(offsets: Tuple[int, ...], dtype, masked: bool) -> Optional[int]:
@@ -128,11 +133,8 @@ def supported(offsets: Tuple[int, ...], dtype, masked: bool) -> Optional[int]:
             # kernel — same contract as an invalid override value.
             import sys
 
-            auto = TILE_MIN
-            max_off = max(abs(o) for o in offsets)
-            while auto < max_off and auto < TILE_MAX:
-                auto *= 2
-            if max_off <= auto and vmem_of(auto) <= _VMEM_BUDGET:
+            auto = _auto_tile(max(abs(o) for o in offsets))
+            if auto is not None and vmem_of(auto) <= _VMEM_BUDGET:
                 sys.stderr.write(
                     f"legate_sparse_tpu: LEGATE_SPARSE_TPU_PALLAS_TILE="
                     f"{tile} exceeds the VMEM budget for this band; "
