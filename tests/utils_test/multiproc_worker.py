@@ -112,5 +112,24 @@ sol_np = np.asarray(sol_rep).reshape(-1)[:n]
 rnorm = np.linalg.norm(b - S @ sol_np)
 assert rnorm <= 1e-7 * np.linalg.norm(b), f"rank {pid}: ||r|| = {rnorm}"
 
+# dist SpGEMM: a second collective family crossing processes.  The
+# product is verified through a distributed matvec against scipy on
+# THIS rank's addressable shards (a host gather of a process-spanning
+# array is not possible, by design).
+from legate_sparse_tpu.parallel.dist_spgemm import dist_spgemm  # noqa: E402
+
+dC = dist_spgemm(dA, dA)
+yC = dist_spmv(dC, xs)
+refC = (S @ S) @ x
+for shard in yC.addressable_shards:
+    lo = shard.index[0].start or 0
+    got = np.asarray(shard.data).reshape(-1)
+    hi = min(lo + got.shape[0], n)
+    if lo < n:
+        np.testing.assert_allclose(
+            got[: hi - lo], refC[lo:hi], rtol=1e-9, atol=1e-9,
+            err_msg=f"rank {pid} dist_spgemm@x rows [{lo}, {hi})",
+        )
+
 print(f"MULTIPROC-OK {pid} iters={int(iters)} rnorm={rnorm:.2e}",
       flush=True)
