@@ -270,6 +270,14 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
     matvec, m_rows, n_cols, dtype = _operator_parts(A)
     if m_rows != n_cols:
         raise ValueError("expected square matrix")
+    if (np.issubdtype(dtype, np.complexfloating)
+            or np.iscomplexobj(np.asarray(X))):
+        # jax's lobpcg_standard builds mixed real/complex while_loop
+        # carries on complex operands (upstream limitation); scipy's
+        # lobpcg handles complex Hermitian operators, so serve those
+        # through the same host boundary as the generalized forms.
+        return _host_fallback("lobpcg")(
+            A, np.asarray(X), tol=tol, maxiter=maxiter, largest=largest)
     X = jnp.asarray(np.asarray(X), dtype=dtype)
     if X.ndim != 2 or X.shape[0] != n_cols:
         raise ValueError(f"X must be (n, k) with n={n_cols}")

@@ -183,3 +183,22 @@ def test_differentiable_solve_real_rhs_on_complex_operator():
         assert np.iscomplexobj(x)
         resid = np.linalg.norm(H_s @ x - b) / np.linalg.norm(b)
         assert resid <= 1e-6, f"{method}: rel resid {resid}"
+
+
+def test_complex_svds_and_lobpcg():
+    # svds runs natively on complex (Gram-operator Lanczos); lobpcg
+    # delegates complex Hermitian operators to host scipy (jax's
+    # lobpcg_standard builds mixed-dtype carries there).
+    rng = np.random.default_rng(11)
+    S = _rand_complex(50, 30, 0.3, rng, np.complex128)
+    U, s, Vt = linalg.svds(sparse.csr_array(S), k=3)
+    ref = np.linalg.svd(S.toarray(), compute_uv=False)
+    np.testing.assert_allclose(sorted(s), sorted(ref[:3]), rtol=1e-6)
+
+    H = sp.csr_array(S @ S.conj().T + 5 * sp.eye(50))
+    X0 = (rng.normal(size=(50, 3))
+          + 1j * rng.normal(size=(50, 3)))
+    w, V = linalg.lobpcg(sparse.csr_array(H), X0, maxiter=300)
+    ref_w = np.linalg.eigvalsh(H.toarray())[-3:]
+    np.testing.assert_allclose(sorted(np.real(w)), sorted(ref_w),
+                               rtol=1e-4)
