@@ -202,3 +202,29 @@ def test_complex_svds_and_lobpcg():
     ref_w = np.linalg.eigvalsh(H.toarray())[-3:]
     np.testing.assert_allclose(sorted(np.real(w)), sorted(ref_w),
                                rtol=1e-4)
+
+
+def test_complex_expm_multiply_and_preconditioners():
+    # expm_multiply native over complex (incl. mixed real-v), and
+    # jacobi/block_jacobi-preconditioned CG on complex Hermitian.
+    import scipy.sparse.linalg as ssl
+
+    from legate_sparse_tpu.precond import block_jacobi, jacobi
+
+    rng = np.random.default_rng(12)
+    S = _rand_complex(40, 40, 0.2, rng, np.complex128)
+    A = sparse.csr_array(S)
+    v = rng.normal(size=40) + 1j * rng.normal(size=40)
+    np.testing.assert_allclose(
+        np.asarray(linalg.expm_multiply(A, v)),
+        ssl.expm_multiply(S, v), rtol=1e-8, atol=1e-8)
+    np.testing.assert_allclose(
+        np.asarray(linalg.expm_multiply(A, v.real)),
+        ssl.expm_multiply(S, v.real), rtol=1e-8, atol=1e-8)
+
+    H_s = sp.csr_array(S + S.conj().T + 10 * sp.eye(40))
+    H = sparse.csr_array(H_s)
+    b = rng.normal(size=40) + 1j * rng.normal(size=40)
+    for M in (jacobi(H), block_jacobi(H, block_size=8)):
+        x, _ = linalg.cg(H, b, M=M, rtol=1e-10)
+        assert np.linalg.norm(H_s @ np.asarray(x) - b) <= 1e-7
