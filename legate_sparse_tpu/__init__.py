@@ -30,7 +30,7 @@ from .coverage import clone_module
 from . import linalg  # noqa: F401
 from . import parallel  # noqa: F401
 
-__version__ = "25.07.0"
+__version__ = "25.07.1"
 
 # Fill every remaining scipy.sparse name as a fallback so this module is
 # namespace-complete (reference ``__init__.py:26``).
