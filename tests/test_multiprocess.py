@@ -35,7 +35,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_ranks(grid_n: int):
+def _run_ranks(grid_n: int, extra=()):
     port = _free_port()
     env = dict(os.environ)
     # The workers pin their own platform/devices; drop any test-lane
@@ -44,7 +44,7 @@ def _run_ranks(grid_n: int):
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(pid), "2", str(port),
-             str(grid_n)],
+             str(grid_n), *extra],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
@@ -85,3 +85,10 @@ def test_two_process_dist_larger_shape():
     # Non-trivial per-shard rows (4096 over 8 shards): halo windows and
     # padding budgets actually engage across the process boundary.
     _run_ranks(64)
+
+
+@pytest.mark.slow
+def test_two_process_gmg_hierarchy():
+    # Galerkin R@A@P hierarchy (chained dist_spgemm) + V-cycle
+    # preconditioned CG, all over the process-spanning mesh.
+    _run_ranks(16, extra=("gmg",))
