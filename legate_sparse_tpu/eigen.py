@@ -177,8 +177,10 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
 
     rank = int(max_rank) if max_rank is not None else n
     # matvec is a closure: static (hashable) so the scan jits around it.
-    lanczos = jax.jit(_lanczos, static_argnums=(0,),
-                      static_argnames=("m",))
+    from .linalg import maybe_jit
+
+    lanczos = maybe_jit(_lanczos, static_argnums=(0,),
+                        static_argnames=("m",))
 
     # Escalate the subspace until the Ritz residuals converge (scipy's
     # implicit restarts analog, kept host-side and simple: each retry
@@ -472,8 +474,10 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     v0 = v0 / jnp.linalg.norm(v0)
 
     rdtype = np.finfo(cdtype).dtype
-    arnoldi = jax.jit(_arnoldi, static_argnums=(0,),
-                      static_argnames=("m",))
+    from .linalg import maybe_jit
+
+    arnoldi = maybe_jit(_arnoldi, static_argnums=(0,),
+                        static_argnames=("m",))
     atol, m, tries = _escalation_params(tol, rdtype, ncv, k, n,
                                         maxiter, min_extra=2)
     for try_i in range(tries):

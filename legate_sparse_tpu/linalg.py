@@ -185,6 +185,23 @@ class IdentityOperator(LinearOperator):
         return _fill_out(x, out)
 
 
+def maybe_jit(fun, **jit_kwargs):
+    """``jax.jit(fun)`` in single-controller runs; the bare function in
+    multi-process runs.
+
+    Explicit jit embeds closure-captured arrays as trace CONSTANTS,
+    and a multi-controller run forbids constants that span processes
+    ("Closing over jax.Array that spans non-addressable devices").
+    Eagerly-executed ``lax`` control flow lifts those captures to
+    arguments instead, so dropping the wrapper keeps the heavy inner
+    scans/loops compiled while making the composition legal on a
+    process-spanning mesh.  Single-controller behavior is unchanged.
+    """
+    if jax.process_count() == 1:
+        return jax.jit(fun, **jit_kwargs)
+    return fun
+
+
 def _promote_rhs(b, A_op):
     """Solve in ``result_type(A, b)`` (scipy parity): a real rhs on a
     complex operator — or f32 rhs on an f64 operator — must not build
@@ -472,7 +489,7 @@ def gmres(
     x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
          else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
 
-    arnoldi = jax.jit(
+    arnoldi = maybe_jit(
         partial(_arnoldi_cycle, A_op.matvec, M_op.matvec, restart=restart)
     )
 
@@ -618,7 +635,7 @@ def bicgstab(
     # residual and direction state carried across steps) Python-side so
     # user code observes every iterate; r lives in the state, so the
     # convergence check costs no extra matvec.
-    body = jax.jit(_bicgstab_body(A_op.matvec, M_op.matvec,
+    body = maybe_jit(_bicgstab_body(A_op.matvec, M_op.matvec,
                                   conv_test_iters=1))
     state = _bicgstab_state0(A_op.matvec, b, x0_arr, atol, int(maxiter))
     iters = 0

@@ -88,7 +88,8 @@ def test_two_process_dist_larger_shape():
 
 
 @pytest.mark.slow
-def test_two_process_gmg_hierarchy():
+def test_two_process_solver_family():
     # Galerkin R@A@P hierarchy (chained dist_spgemm) + V-cycle
-    # preconditioned CG, all over the process-spanning mesh.
-    _run_ranks(16, extra=("gmg",))
+    # preconditioned CG + dist_gmres + dist_minres + dist_eigsh,
+    # all over the spanning mesh.
+    _run_ranks(16, extra=("ext",))

@@ -10,7 +10,8 @@ resource shapes): the mesh spans processes, so every psum/ppermute in
 the dist kernels crosses a real process boundary through the
 distributed runtime instead of staying inside one XLA client.
 
-Usage: python multiproc_worker.py <process_id> <num_processes> <port> [N] [gmg]
+Usage: python multiproc_worker.py <process_id> <num_processes> <port> [N] [ext]
+(``ext`` adds the GMG hierarchy and dist_gmres across ranks)
 Prints ``MULTIPROC-OK <pid>`` on success; any failure exits non-zero.
 """
 
@@ -24,7 +25,7 @@ pid = int(sys.argv[1])
 nproc = int(sys.argv[2])
 port = sys.argv[3]
 N = int(sys.argv[4]) if len(sys.argv) > 4 else 16
-WITH_GMG = len(sys.argv) > 5 and sys.argv[5] == "gmg"
+WITH_EXT = len(sys.argv) > 5 and sys.argv[5] in ("ext", "gmg")
 
 # Environment must be fixed before jax initializes any backend.  A
 # parent test lane may already carry a device-count pin in XLA_FLAGS
@@ -132,7 +133,7 @@ for shard in yC.addressable_shards:
             err_msg=f"rank {pid} dist_spgemm@x rows [{lo}, {hi})",
         )
 
-if WITH_GMG:
+if WITH_EXT:
     # Geometric multigrid across ranks: the Galerkin R@A@P hierarchy
     # build chains dist_spgemm products over the process-spanning
     # mesh, and each V-cycle smooth/restrict/prolong crosses ranks.
@@ -158,6 +159,39 @@ if WITH_GMG:
                    shape=(n, n), format="csr")
     rg = np.linalg.norm(bg - Sg @ xg)
     assert rg <= 1e-7 * np.linalg.norm(bg), f"rank {pid} gmg ||r||={rg}"
+
+    # Non-symmetric solver across ranks (Arnoldi inner products are
+    # psums over the spanning mesh).
+    from legate_sparse_tpu.parallel.dist_csr import dist_gmres  # noqa: E402
+
+    solr, _ = dist_gmres(dA, b, rtol=1e-10)
+    solr_rep = jax.device_put(
+        solr, NamedSharding(mesh, PartitionSpec()))
+    xr = np.asarray(solr_rep).reshape(-1)[:n]
+    rr = np.linalg.norm(b - S @ xr)
+    assert rr <= 1e-6 * np.linalg.norm(b), f"rank {pid} gmres ||r||={rr}"
+
+    # Symmetric-indefinite solver + distributed Lanczos across ranks.
+    from legate_sparse_tpu.parallel.dist_csr import (  # noqa: E402
+        dist_eigsh, dist_minres,
+    )
+
+    solm, _ = dist_minres(dA, b, rtol=1e-10)
+    solm_rep = jax.device_put(
+        solm, NamedSharding(mesh, PartitionSpec()))
+    xm = np.asarray(solm_rep).reshape(-1)[:n]
+    rm = np.linalg.norm(b - S @ xm)
+    assert rm <= 1e-6 * np.linalg.norm(b), f"rank {pid} minres ||r||={rm}"
+
+    # The top Poisson eigenvalues cluster ~0.1 apart; a larger
+    # subspace resolves them (same requirement as scipy ncv).
+    w = np.asarray(dist_eigsh(dA, k=3, which="LA", ncv=48,
+                              return_eigenvectors=False))
+    import scipy.sparse.linalg as _ssl
+    w_ref = _ssl.eigsh(S.tocsc().astype(np.float64), k=3, which="LA",
+                       return_eigenvectors=False)
+    np.testing.assert_allclose(sorted(w), sorted(w_ref), rtol=1e-8,
+                               err_msg=f"rank {pid} dist_eigsh")
 
 print(f"MULTIPROC-OK {pid} iters={int(iters)} rnorm={rnorm:.2e}",
       flush=True)
