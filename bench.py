@@ -91,8 +91,12 @@ half = W // 2
 offsets = tuple(range(-half, half + 1))
 tile = pallas_dia.supported(offsets, np.float32, masked=False)
 assert tile is not None
+# Pad rows to a tile multiple (the kernel's grid works in whole
+# tiles; row_align does the same padding on the production path) so
+# sub-tile bench sizes don't misreport a trace error as a fault.
+rows_pad = -(-n // tile) * tile
 val = np.float32(1.0 / W)
-rdata = jnp.full((W, n // 128, 128), val, dtype=jnp.float32)
+rdata = jnp.full((W, rows_pad // 128, 128), val, dtype=jnp.float32)
 x = jnp.ones((n,), dtype=jnp.float32)
 
 def step(v):

@@ -199,8 +199,7 @@ def test_differential_battery_complex(dtype):
             _chk(fails, trial, "multiply", A.multiply(B),
                  As.multiply(Bs), tol=tol)
             _chk(fails, trial, "conjT", A.conj().T,
-                 As.conj().T.tocsr() if hasattr(As.conj().T, "tocsr")
-                 else As.conj().T, tol=tol)
+                 As.conj().T.tocsr(), tol=tol)
             _chk(fails, trial, "sum1", A.sum(axis=1),
                  np.asarray(As.sum(axis=1)).ravel(), tol=tol)
             _chk(fails, trial, "tocsc", A.tocsc(), As.tocsc(), tol=tol)

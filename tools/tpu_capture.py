@@ -59,14 +59,21 @@ def append(text: str) -> None:
         os.fsync(f.fileno())
 
 
-def load_band_variant() -> dict:
+def load_band_variant(not_before: float = 0.0) -> dict:
     """Env of the band variant bench's canary ladder proved out
     (bench._persist_variant).  Later phases run that variant instead
     of a possibly-faulting default: the r3 worker stayed WEDGED after
-    a fault, so one bad phase can cost the rest of the window."""
+    a fault, so one bad phase can cost the rest of the window.
+
+    ``not_before``: ignore a file older than this timestamp — a pin
+    left by a PREVIOUS capture run must not leak into this one when
+    the bench phase died before re-selecting (round4_capture.sh also
+    removes the file up front; this guards standalone invocations)."""
     path = os.path.join(ROOT, "evidence", "band_variant.env")
     env = {}
     try:
+        if os.path.getmtime(path) < not_before:
+            return env
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -292,6 +299,7 @@ else:
 
 
 def main() -> None:
+    t_start = time.time()
     stamp = datetime.datetime.now().isoformat(timespec="seconds")
     if not probe():
         print(f"{stamp}: TPU unreachable; nothing recorded")
@@ -313,7 +321,7 @@ def main() -> None:
     # load_band_variant).  The DEFAULT formulation's own timings are
     # not lost: the full fault-isolation phase records eager and
     # looped numbers per mode at four sizes.
-    variant_env = load_band_variant()
+    variant_env = load_band_variant(not_before=t_start)
     if variant_env:
         append(f"(later phases use band variant env: {variant_env})\n")
 
