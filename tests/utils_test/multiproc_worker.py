@@ -162,10 +162,18 @@ if WITH_EXT:
     rr = np.linalg.norm(b - S @ xr)
     assert rr <= 1e-6 * np.linalg.norm(b), f"rank {pid} gmres ||r||={rr}"
 
-    # Symmetric-indefinite solver + distributed Lanczos across ranks.
+    # Symmetric-indefinite + non-symmetric-stabilized solvers and the
+    # distributed Lanczos across ranks.
     from legate_sparse_tpu.parallel.dist_csr import (  # noqa: E402
-        dist_eigsh, dist_minres,
+        dist_bicgstab, dist_eigsh, dist_minres,
     )
+
+    solb, _ = dist_bicgstab(dA, b, rtol=1e-10)
+    solb_rep = jax.device_put(
+        solb, NamedSharding(mesh, PartitionSpec()))
+    xb = np.asarray(solb_rep).reshape(-1)[:n]
+    rb = np.linalg.norm(b - S @ xb)
+    assert rb <= 1e-6 * np.linalg.norm(b), f"rank {pid} bicgstab ||r||={rb}"
 
     solm, _ = dist_minres(dA, b, rtol=1e-10)
     solm_rep = jax.device_put(
