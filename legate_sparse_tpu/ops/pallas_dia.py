@@ -202,9 +202,10 @@ def _use_mosaic_roll() -> bool:
 
 
 def _distinct_inputs() -> bool:
-    """SpMV neighbor-tile inputs: pass the SAME padded x buffer three
-    times with clamped index maps (default, zero-copy), or three
-    DISTINCT tile-shifted copies with plain index maps
+    """Band-kernel neighbor-tile inputs (SpMV, SpMM, and the banded
+    SpGEMM): pass the SAME padded buffer three times with clamped
+    index maps (default, zero-copy), or three DISTINCT tile-shifted
+    copies with plain index maps
     (``LEGATE_SPARSE_TPU_PALLAS_INPUTS=distinct``).
 
     The distinct mode exists as a fault-isolation rung: the r3 on-chip
@@ -418,13 +419,30 @@ def pallas_dia_spmm(rdata, rmask, X, offsets: Tuple[int, ...],
     masked = rm is not None
     kernel = _make_spmm_kernel(offsets, rows, cols, tile, masked,
                                interpret)
-    in_specs = [
-        pl.BlockSpec((tile, k), lambda i: (jnp.maximum(i - 1, 0), 0)),
-        pl.BlockSpec((tile, k), lambda i: (jnp.minimum(i, ntx - 1), 0)),
-        pl.BlockSpec((tile, k), lambda i: (jnp.minimum(i + 1, ntx - 1), 0)),
-        pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)),
-    ]
-    args = [Xv, Xv, Xv, rd]
+    if _distinct_inputs():
+        # De-aliased variant (see the SpMV case in pallas_dia_spmv):
+        # three separate tile-shifted X buffers, plain index maps.
+        z = jnp.zeros((tile, k), Xv.dtype)
+        Xm = jnp.concatenate([z, Xv[:-tile]], axis=0)
+        Xp = jnp.concatenate([Xv[tile:], z], axis=0)
+        Xm, Xc, Xp = jax.lax.optimization_barrier((Xm, Xv, Xp))
+        in_specs = [
+            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile, k), lambda i: (i, 0)),
+            pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)),
+        ]
+        args = [Xm, Xc, Xp, rd]
+    else:
+        in_specs = [
+            pl.BlockSpec((tile, k), lambda i: (jnp.maximum(i - 1, 0), 0)),
+            pl.BlockSpec((tile, k),
+                         lambda i: (jnp.minimum(i, ntx - 1), 0)),
+            pl.BlockSpec((tile, k),
+                         lambda i: (jnp.minimum(i + 1, ntx - 1), 0)),
+            pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)),
+        ]
+        args = [Xv, Xv, Xv, rd]
     if masked:
         in_specs.append(pl.BlockSpec((nd, tile, 1), lambda i: (0, i, 0)))
         args.append(rm)
@@ -464,11 +482,6 @@ def dia_spmm_maybe_pallas(packed, X):
     """SpMM through the Pallas kernel, or None for the XLA fallback."""
     mode = _mode()
     if mode == "0" or packed is None:
-        return None
-    if _distinct_inputs():
-        # The de-aliased input mode is only implemented for the SpMV
-        # kernel; the SpMM kernel keeps the aliased three-operand
-        # structure the mode exists to rule out, so it must not run.
         return None
     k = X.shape[1]
     if k == 0 or k > SPMM_MAX_K:
@@ -595,22 +608,38 @@ def pallas_dia_spgemm(a_data, b_data, offs_a: Tuple[int, ...],
 
     kernel = _make_spgemm_kernel(offs_a, offs_b, offs_c, shape_a,
                                  shape_b, tile, interpret)
-    C = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((ndc, pc // L, L), b_data.dtype),
-        grid=(pc // tile,),
-        in_specs=[
+    if _distinct_inputs():
+        # De-aliased variant (see pallas_dia_spmv): tile-shifted A-band
+        # copies along the blocked width axis, plain index maps.
+        z = jnp.zeros((nda, Rt, L), av.dtype)
+        am = jnp.concatenate([z, av[:, :-Rt]], axis=1)
+        ap = jnp.concatenate([av[:, Rt:], z], axis=1)
+        am, ac, ap = jax.lax.optimization_barrier((am, av, ap))
+        a_specs = [
+            pl.BlockSpec((nda, Rt, L), lambda i: (0, i, 0)),
+            pl.BlockSpec((nda, Rt, L), lambda i: (0, i, 0)),
+            pl.BlockSpec((nda, Rt, L), lambda i: (0, i, 0)),
+        ]
+        a_args = [am, ac, ap]
+    else:
+        a_specs = [
             pl.BlockSpec((nda, Rt, L),
                          lambda i: (0, jnp.maximum(i - 1, 0), 0)),
             pl.BlockSpec((nda, Rt, L),
                          lambda i: (0, jnp.minimum(i, nta - 1), 0)),
             pl.BlockSpec((nda, Rt, L),
                          lambda i: (0, jnp.minimum(i + 1, nta - 1), 0)),
-            pl.BlockSpec((ndb, Rt, L), lambda i: (0, i, 0)),
-        ],
+        ]
+        a_args = [av, av, av]
+    C = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((ndc, pc // L, L), b_data.dtype),
+        grid=(pc // tile,),
+        in_specs=[*a_specs,
+                  pl.BlockSpec((ndb, Rt, L), lambda i: (0, i, 0))],
         out_specs=pl.BlockSpec((ndc, Rt, L), lambda i: (0, i, 0)),
         interpret=interpret,
-    )(av, av, av, bv)
+    )(*a_args, bv)
     return C.reshape(ndc, -1)[:, :n]
 
 
@@ -640,10 +669,6 @@ def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
     """Banded SpGEMM through the Pallas kernel, or None (XLA path)."""
     mode = _mode()
     if mode == "0":
-        return None
-    if _distinct_inputs():
-        # See dia_spmm_maybe_pallas: aliased-operand structure remains
-        # here, so the distinct-inputs mode falls back to XLA.
         return None
     if np.dtype(a_data.dtype) not in (np.dtype(np.float32),
                                       np.dtype(jnp.bfloat16)):
