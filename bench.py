@@ -384,6 +384,18 @@ def main() -> None:
         x = jnp.full((n,), 1.0, dtype=jnp.float32)
         dt_ms = _time_spmv_ms(A, x, normalize=False, k_lo=5, k_hi=35)
         bw = _spmv_bytes(A, x) / (dt_ms * 1e-3) / 1e9
+        if stream and platform == "cpu":
+            # Shared-host CPU runs show +-25% stream variance between
+            # phases; re-measure right after the SpMV phase and use
+            # the mean as the fallback-ratio denominator (TPU HBM is
+            # stable; the contract denominator there stays the single
+            # measurement).
+            try:
+                stream2 = _stream_bandwidth()
+                result["stream2_gbs"] = round(stream2, 2)
+                stream = (stream + stream2) / 2.0
+            except Exception as e:
+                sys.stderr.write(f"bench: stream re-measure: {e!r}\n")
         result["value"] = round(bw, 2)
         result["spmv_ms"] = round(dt_ms, 4)
         result["path"] = (
