@@ -1,0 +1,108 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""bench.py band-variant survival ladder: selection logic.
+
+The ladder is the round's fault-containment machine (r3: the Pallas
+kernel faulted the TPU worker only in the looped composition); these
+tests pin its decision table with a mocked canary so the on-chip
+behavior is the only untested part.
+"""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def bench_mod(monkeypatch, tmp_path):
+    import bench
+
+    importlib.reload(bench)
+    # Keep variant persistence inside the sandbox.
+    monkeypatch.chdir(tmp_path)
+    for var in ("LEGATE_SPARSE_TPU_PALLAS_ROLL",
+                "LEGATE_SPARSE_TPU_PALLAS_INPUTS",
+                "LEGATE_SPARSE_TPU_PALLAS_DIA"):
+        monkeypatch.delenv(var, raising=False)
+    return bench
+
+
+def _mock(bench, monkeypatch, verdicts, alive=True):
+    calls = []
+
+    def fake_canary(log2n, timeout_s=480, env_extra=None):
+        name = {(): "pallas",
+                (("LEGATE_SPARSE_TPU_PALLAS_INPUTS", "distinct"),):
+                    "pallas-shift3",
+                (("LEGATE_SPARSE_TPU_PALLAS_ROLL", "xla"),):
+                    "pallas-jroll"}[
+            tuple(sorted((env_extra or {}).items()))]
+        calls.append(name)
+        return verdicts.get(name, "crash")
+
+    monkeypatch.setattr(bench, "_pallas_canary", fake_canary)
+    monkeypatch.setattr(bench, "_probe_accelerator", lambda: alive)
+    return calls
+
+
+def test_first_rung_survives(bench_mod, monkeypatch):
+    calls = _mock(bench_mod, monkeypatch, {"pallas": "ok"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas:ok"] and alive
+    assert calls == ["pallas"]
+    assert "LEGATE_SPARSE_TPU_PALLAS_DIA" not in os.environ
+    # Survivor persisted for the later capture phases.
+    env = open("evidence/band_variant.env").read()
+    assert "pallas" in env
+
+
+def test_falls_through_to_shift3(bench_mod, monkeypatch):
+    calls = _mock(bench_mod, monkeypatch,
+                  {"pallas": "crash", "pallas-shift3": "ok"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas:crash", "pallas-shift3:ok"] and alive
+    assert os.environ.get("LEGATE_SPARSE_TPU_PALLAS_INPUTS") == "distinct"
+    assert "distinct" in open("evidence/band_variant.env").read()
+
+
+def test_all_rungs_fail_lands_on_xla(bench_mod, monkeypatch):
+    _mock(bench_mod, monkeypatch, {})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert [a.split(":")[0] for a in attempts] == [
+        "pallas", "pallas-shift3", "pallas-jroll"]
+    assert alive
+    assert os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA") == "0"
+    assert "PALLAS_DIA=0" in open("evidence/band_variant.env").read()
+
+
+def test_dead_worker_stops_ladder(bench_mod, monkeypatch):
+    calls = _mock(bench_mod, monkeypatch, {"pallas": "crash"},
+                  alive=False)
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas:crash"] and not alive
+    assert calls == ["pallas"]      # no rung probed on a dead worker
+    assert os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA") == "0"
+
+
+def test_operator_roll_pin_restricts_ladder(bench_mod, monkeypatch):
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_ROLL", "xla")
+    calls = _mock(bench_mod, monkeypatch, {"pallas-jroll": "ok"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas-jroll:ok"] and alive
+    assert calls == ["pallas-jroll"]
+    # The pin itself is never overridden.
+    assert os.environ["LEGATE_SPARSE_TPU_PALLAS_ROLL"] == "xla"
+
+
+def test_operator_tpu_pin_probes_only_mosaic_rung(bench_mod, monkeypatch):
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_ROLL", "tpu")
+    calls = _mock(bench_mod, monkeypatch, {"pallas": "crash"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert calls == ["pallas"]      # no jroll rung under a tpu pin
+    assert os.environ["LEGATE_SPARSE_TPU_PALLAS_ROLL"] == "tpu"
+    assert os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA") == "0"
