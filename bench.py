@@ -111,6 +111,54 @@ except RuntimeError:
     # fault: both looped programs ran to completion, which is all the
     # canary needs to prove.
     pass
+
+# The bench's later phases also run the SpMM and banded-SpGEMM Mosaic
+# kernels under the selected variant (the variant env changes all
+# three lowerings), so each rung must prove those survive the looped
+# composition too — eager launch + a short capped fori_loop each.
+import jax
+
+class _Pk:
+    pass
+
+pk = _Pk()
+pk.rdata, pk.rmask, pk.offsets, pk.shape, pk.tile = (
+    rdata, None, offsets, (n, n), tile)
+k = 4
+mm_tile = pallas_dia._spmm_tile(pk, k)
+if mm_tile is not None:
+    X = jnp.ones((n, k), dtype=jnp.float32)
+
+    def mm_step(V):
+        return pallas_dia.pallas_dia_spmm(rdata, None, V, offsets,
+                                          (n, n), mm_tile)
+
+    float(jnp.sum(mm_step(X)))
+    float(jnp.sum(jax.lax.fori_loop(0, 8, lambda i, V: mm_step(V), X)))
+
+# Banded SpGEMM at a reduced size (its working set scales with the
+# output band): scipy-layout ones band, eager + short loop.
+ng = min(n, 1 << 22)
+offs_c = tuple(sorted({a + b for a in offsets for b in offsets}))
+gg_tile = pallas_dia._spgemm_tile(
+    offsets, W, W, len(offs_c), np.dtype(np.float32))
+if gg_tile is not None:
+    band = jnp.full((W, ng), val, dtype=jnp.float32)
+
+    def gg(b):
+        return pallas_dia.pallas_dia_spgemm(
+            b, band, offsets, offsets, offs_c, (ng, ng), (ng, ng),
+            gg_tile)
+
+    float(jnp.sum(gg(band)[0]))
+    # Carry-dependent operand so the kernel stays INSIDE the loop
+    # (the r3 fault signature is specifically kernel-in-loop).
+    float(jnp.sum(jax.lax.fori_loop(
+        0, 4,
+        lambda i, c: c * 0.5 + gg(
+            band.at[0, 0].add((c[0, 0] * 1e-30).astype(band.dtype))
+        )[0][:1],
+        jnp.zeros((1, ng), dtype=jnp.float32))))
 print("canary-ok")
 """
 

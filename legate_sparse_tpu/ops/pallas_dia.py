@@ -223,6 +223,23 @@ def _distinct_inputs() -> bool:
         "LEGATE_SPARSE_TPU_PALLAS_INPUTS", "alias") == "distinct"
 
 
+def _shifted_triple(buf, blocks: int, axis: int):
+    """(minus, center, plus): DISTINCT tile-shifted copies of ``buf``
+    along ``axis`` (shift unit = ``blocks`` rows), zero edge tiles,
+    separated by an optimization barrier so XLA cannot re-alias them —
+    the shared construction for the de-aliased input mode."""
+    shape = list(buf.shape)
+    shape[axis] = blocks
+    z = jnp.zeros(shape, buf.dtype)
+    def take(lo, hi):
+        idx = [slice(None)] * buf.ndim
+        idx[axis] = slice(lo, hi)
+        return buf[tuple(idx)]
+    minus = jnp.concatenate([z, take(None, -blocks)], axis=axis)
+    plus = jnp.concatenate([take(blocks, None), z], axis=axis)
+    return jax.lax.optimization_barrier((minus, buf, plus))
+
+
 def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
     """xs with ``xs_flat[p] = w_flat[p + s]`` along the flattened last
     two dims of ``w`` (.., R, L); leading dims (axis base > 0) are
@@ -311,11 +328,7 @@ def pallas_dia_spmv(rdata, rmask, x, offsets: Tuple[int, ...],
         # Three separate tile-shifted buffers, plain index maps.  The
         # zero edge tiles are safe: every read whose global source row
         # is out of range is masked by `valid` inside the kernel.
-        z = jnp.zeros((Rt, L), xv.dtype)
-        xm_b = jnp.concatenate([z, xv[:-Rt]], axis=0)
-        xp_b = jnp.concatenate([xv[Rt:], z], axis=0)
-        xm_b, xc_b, xp_b = jax.lax.optimization_barrier(
-            (xm_b, xv, xp_b))
+        xm_b, xc_b, xp_b = _shifted_triple(xv, Rt, axis=0)
         in_specs = [
             pl.BlockSpec((Rt, L), lambda i: (i, 0)),
             pl.BlockSpec((Rt, L), lambda i: (i, 0)),
@@ -422,10 +435,7 @@ def pallas_dia_spmm(rdata, rmask, X, offsets: Tuple[int, ...],
     if _distinct_inputs():
         # De-aliased variant (see the SpMV case in pallas_dia_spmv):
         # three separate tile-shifted X buffers, plain index maps.
-        z = jnp.zeros((tile, k), Xv.dtype)
-        Xm = jnp.concatenate([z, Xv[:-tile]], axis=0)
-        Xp = jnp.concatenate([Xv[tile:], z], axis=0)
-        Xm, Xc, Xp = jax.lax.optimization_barrier((Xm, Xv, Xp))
+        Xm, Xc, Xp = _shifted_triple(Xv, tile, axis=0)
         in_specs = [
             pl.BlockSpec((tile, k), lambda i: (i, 0)),
             pl.BlockSpec((tile, k), lambda i: (i, 0)),
@@ -611,10 +621,7 @@ def pallas_dia_spgemm(a_data, b_data, offs_a: Tuple[int, ...],
     if _distinct_inputs():
         # De-aliased variant (see pallas_dia_spmv): tile-shifted A-band
         # copies along the blocked width axis, plain index maps.
-        z = jnp.zeros((nda, Rt, L), av.dtype)
-        am = jnp.concatenate([z, av[:, :-Rt]], axis=1)
-        ap = jnp.concatenate([av[:, Rt:], z], axis=1)
-        am, ac, ap = jax.lax.optimization_barrier((am, av, ap))
+        am, ac, ap = _shifted_triple(av, Rt, axis=1)
         a_specs = [
             pl.BlockSpec((nda, Rt, L), lambda i: (0, i, 0)),
             pl.BlockSpec((nda, Rt, L), lambda i: (0, i, 0)),

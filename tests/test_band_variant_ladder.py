@@ -29,7 +29,15 @@ def bench_mod(monkeypatch, tmp_path):
                 "LEGATE_SPARSE_TPU_PALLAS_INPUTS",
                 "LEGATE_SPARSE_TPU_PALLAS_DIA"):
         monkeypatch.delenv(var, raising=False)
-    return bench
+    # _select_band_variant writes the chosen variant straight into
+    # os.environ (its job); monkeypatch does not track those writes,
+    # so snapshot and restore the whole environment — a leaked
+    # PALLAS_DIA=0 would silently disable the band path for every
+    # later test in the session.
+    snapshot = dict(os.environ)
+    yield bench
+    os.environ.clear()
+    os.environ.update(snapshot)
 
 
 def _mock(bench, monkeypatch, verdicts, alive=True):

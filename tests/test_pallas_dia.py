@@ -386,8 +386,6 @@ def test_tile_override_over_vmem_budget_degrades_to_auto(monkeypatch):
 def test_distinct_inputs_spmm_and_spgemm_match(rng, monkeypatch):
     # The de-aliased input mode now covers the SpMM and banded-SpGEMM
     # kernels too (no XLA fallback under the shift3 variant).
-    import scipy.sparse as scsp_
-
     n = 3000
     offsets = (-5, -1, 0, 1, 5)
     A, A_sp = _banded(n, offsets, rng)
