@@ -205,3 +205,27 @@ def test_hstack_non_canonical_inputs_not_mislabeled():
         H.toscipy().toarray(),
         np.array([[0, 3.0, 0, 0, 3.0, 0], [0, 0, 0, 0, 0, 0]]),
     )
+
+
+def test_modern_scipy_array_constructor_names():
+    # scipy >= 1.11 sparray-era names must return PACKAGE arrays (not
+    # fall through to host scipy types) and match scipy's values.
+    import numpy as np
+    import scipy.sparse as scsp
+
+    import legate_sparse_tpu as lst
+
+    A = lst.diags_array([1.0, 2.0, 3.0], offsets=0, shape=(3, 3))
+    assert A.__class__.__module__.startswith("legate_sparse_tpu")
+    np.testing.assert_allclose(np.asarray(A.todense()),
+                               np.diag([1.0, 2.0, 3.0]))
+    E = lst.eye_array(4, k=1)
+    assert E.__class__.__module__.startswith("legate_sparse_tpu")
+    np.testing.assert_allclose(np.asarray(E.todense()), np.eye(4, k=1))
+    R = lst.random_array((10, 8), density=0.3,
+                         rng=np.random.default_rng(0))
+    assert R.__class__.__module__.startswith("legate_sparse_tpu")
+    assert R.shape == (10, 8) and 0 < R.nnz <= 80
+    I = lst.identity(5)
+    assert I.__class__.__module__.startswith("legate_sparse_tpu")
+    np.testing.assert_allclose(np.asarray(I.todense()), np.eye(5))
