@@ -15,6 +15,8 @@ Usage:
     python test.py --devices 8 4 1  # explicit shapes
     python test.py --slow           # also the -m slow lane (8 devices)
     python test.py --tpu            # also the real-chip -m tpu lane
+    python test.py --multiproc      # ONLY the 2-rank jax.distributed
+                                    # lane (the multi-rank analog)
     python test.py -- -k spmv       # extra args forwarded to pytest
 
 Exit code: non-zero if any lane fails.  This box has one CPU core, so
