@@ -162,11 +162,42 @@ if gg_tile is not None:
 print("canary-ok")
 """
 
+# Wrapper separating Python-level bugs from device faults: a trace-time
+# exception (bad shape from a future refactor, assert, dtype mismatch)
+# prints a marker and exits 3, so the caller does NOT score it as a
+# worker fault and silently demote the bench to a slower variant.
+# Device-runtime errors (XlaRuntimeError and friends from jaxlib) keep
+# the plain-failure exit: those ARE the fault surface the canary hunts.
+_CANARY_WRAPPER = r"""
+import sys
+try:
+    exec(sys.argv[2])
+except Exception as e:
+    import traceback
+    traceback.print_exc()
+    mod = (type(e).__module__ or "").lower()
+    name = type(e).__name__
+    # Device/runtime fault classes across jax generations: jax 0.9
+    # raises jax.errors.JaxRuntimeError; older stacks raised
+    # jaxlib...XlaRuntimeError.  Any RuntimeError out of a jax-owned
+    # module is treated as the device side too — misclassifying a real
+    # worker fault as 'trace-error' would skip the recovery probe.
+    is_device = (
+        name in ("XlaRuntimeError", "JaxRuntimeError")
+        or "jaxlib" in mod or "xla" in mod
+        or (mod.startswith("jax") and isinstance(e, RuntimeError))
+    )
+    if is_device:
+        sys.exit(1)
+    print("canary-trace-error")
+    sys.exit(3)
+"""
+
 
 def _pallas_canary(log2n: int, timeout_s: int = 480,
                    env_extra: dict = None) -> str:
     """Run the exact banded Pallas path (eager + chained loop) in a
-    throwaway subprocess: "ok" | "crash" | "timeout".
+    throwaway subprocess: "ok" | "crash" | "timeout" | "trace-error".
 
     The 2026-07-31 on-chip capture showed the production kernel can
     fault the TPU worker ("TPU worker process crashed"); a fault inside
@@ -181,11 +212,19 @@ def _pallas_canary(log2n: int, timeout_s: int = 480,
         env.update(env_extra)
     try:
         r = subprocess.run(
-            [sys.executable, "-c", _CANARY_CODE, str(log2n)],
+            [sys.executable, "-c", _CANARY_WRAPPER, str(log2n),
+             _CANARY_CODE],
             capture_output=True, text=True, timeout=timeout_s, env=env,
         )
     except subprocess.TimeoutExpired:
         return "timeout"
+    if r.returncode == 3 and "canary-trace-error" in (r.stdout or ""):
+        sys.stderr.write(
+            "bench: canary raised a Python-level error (NOT a worker "
+            "fault) — fix the composition, don't demote the variant:\n"
+            + (r.stderr or "")[-2000:] + "\n"
+        )
+        return "trace-error"
     return "ok" if ("canary-ok" in (r.stdout or "")
                     and r.returncode == 0) else "crash"
 
@@ -213,13 +252,25 @@ def _select_band_variant(log2n: int, timeout_s: int) -> tuple:
         ("pallas-shift3", {"LEGATE_SPARSE_TPU_PALLAS_INPUTS": "distinct"}),
         ("pallas-jroll", {"LEGATE_SPARSE_TPU_PALLAS_ROLL": "xla"}),
     ]
-    pinned = os.environ.get("LEGATE_SPARSE_TPU_PALLAS_ROLL")
-    if pinned is not None:
+    pinned_roll = os.environ.get("LEGATE_SPARSE_TPU_PALLAS_ROLL")
+    pinned_inputs = os.environ.get("LEGATE_SPARSE_TPU_PALLAS_INPUTS")
+    if pinned_roll is not None:
         # Operator pinned the lowering: probe only that rung, never
         # override the pin ("xla" -> jroll rung, anything else -> the
-        # Mosaic-roll rung with the pin left untouched).
-        ladder = ([ladder[2]] if pinned == "xla"
-                  else [("pallas", {})])
+        # Mosaic-roll rung — labeled shift3 when the INPUTS pin means
+        # that is what the inherited env actually probes).
+        if pinned_roll == "xla":
+            ladder = [ladder[2]]
+        elif pinned_inputs == "distinct":
+            ladder = [ladder[1]]
+        else:
+            ladder = [("pallas", {})]
+    elif pinned_inputs == "distinct":
+        # The canary subprocess inherits os.environ, so rung 1 would
+        # probe the de-aliased variant while recording it as "pallas"
+        # (and rung 2 would re-probe the identical config).  Start —
+        # and label — the ladder at the shift3 rung instead.
+        ladder = ladder[1:]
     for name, env_extra in ladder:
         verdict = _pallas_canary(log2n, timeout_s=timeout_s,
                                  env_extra=env_extra)
@@ -231,6 +282,11 @@ def _select_band_variant(log2n: int, timeout_s: int) -> tuple:
         sys.stderr.write(
             f"bench: band canary '{name}' verdict '{verdict}'\n"
         )
+        if verdict == "trace-error":
+            # Python-level bug in the composition (already surfaced on
+            # stderr with its traceback): the worker is alive, so skip
+            # the recovery probe and try the next rung.
+            continue
         # A crash/timeout usually takes the worker down with it; give
         # it one recovery probe before the next rung (the probe also
         # pins CPU if the worker never comes back).

@@ -96,7 +96,7 @@ def loop_ms_per_iter(step: Callable, x0, k_lo: int = 5, k_hi: int = None,
     per_iter_est = max(t_lo - fixed, 0.25 * t_lo) / k_lo
     delta_target = max(4.0 * fixed, 0.4, 0.5 * t_lo)
     if k_hi is None:
-        k_hi = k_lo + int(delta_target / per_iter_est) + 1
+        k_hi = k_lo + int(delta_target / max(per_iter_est, 1e-9)) + 1
     k_hi = min(k_cap, max(3 * k_lo, k_hi))
     while True:
         t_hi = timed(k_hi)
@@ -112,12 +112,14 @@ def loop_ms_per_iter(step: Callable, x0, k_lo: int = 5, k_hi: int = None,
                     if t_hi > t_lo else per_iter_est / 8)
         k_next = k_lo + int(delta_target / max(per_iter, 1e-9)) + 1
         k_hi = min(k_cap, max(k_next, 2 * k_hi))
-    if t_hi <= t_lo:
-        # A silent clamp here would report fantasy bandwidth in the
-        # driver-contract JSON; fail loudly instead (callers guard each
-        # phase and record the error).
+    if not good:
+        # t_hi <= t_lo, or above it by less than the noise floor: a
+        # silent clamp (or a noise-dominated slope) would report fantasy
+        # bandwidth in the driver-contract JSON; fail loudly instead
+        # (callers guard each phase and record the error).
         raise RuntimeError(
             f"unresolvable timing: {k_hi} iters ({t_hi:.4f}s) not "
-            f"measurably slower than {k_lo} ({t_lo:.4f}s)"
+            f"measurably slower than {k_lo} ({t_lo:.4f}s; "
+            f"noise floor {max(2.0 * fixed, 0.2 * t_lo):.4f}s)"
         )
     return (t_hi - t_lo) / (k_hi - k_lo) * 1e3

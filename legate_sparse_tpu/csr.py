@@ -117,6 +117,7 @@ class csr_array(CompressedBase, DenseSparseBase):
             row = jnp.asarray(row)
             col = jnp.asarray(col)
             data_in = jnp.asarray(data_in)
+            check_nnz(int(data_in.shape[0]))
             if shape is None:
                 shape = (int(row.max()) + 1, int(col.max()) + 1)
             shape = tuple(int(s) for s in shape)

@@ -254,7 +254,12 @@ def _flat_shift(w, s: int, lane, interpret: bool, axis: int = 0):
     else:
         from jax.experimental.pallas import tpu as pltpu
 
-        roll = lambda a, amt, ax: pltpu.roll(a, amt, ax)
+        # The shift operand must be i32: a plain Python int binds as a
+        # weak i64 constant in an x64-enabled process, which
+        # tpu.dynamic_rotate rejects at Mosaic verification (caught by
+        # the off-chip TPU-export regression tests; on-chip processes
+        # run x64-off so the lowering there is unchanged).
+        roll = lambda a, amt, ax: pltpu.roll(a, np.int32(amt), ax)
 
     def rowroll(q_):
         amt = (R - q_) % R
@@ -378,7 +383,8 @@ def _make_spmm_kernel(offsets: Tuple[int, ...], rows: int, cols: int,
         else:
             from jax.experimental.pallas import tpu as pltpu
 
-            roll = lambda a, amt: pltpu.roll(a, amt, 0)
+            # i32 shift for the same reason as _flat_shift's roll.
+            roll = lambda a, amt: pltpu.roll(a, np.int32(amt), 0)
 
         base = pl.program_id(0) * tile
         w = jnp.concatenate([xm_ref[:], xc_ref[:], xp_ref[:]], axis=0)

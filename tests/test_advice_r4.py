@@ -1,0 +1,84 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Regressions for the round-4 advisor findings (ADVICE.md r4).
+
+1. ``bench_timing.loop_ms_per_iter``: sub-resolution low point
+   (t_lo == 0) must not ZeroDivisionError when ``k_hi`` is None, and a
+   noise-dominated break-out must raise instead of returning a
+   fantasy per-iter estimate.
+2. ``csr_array`` COO ``(data, (row, col))`` constructor must route
+   through ``check_nnz`` like every other host constructor boundary.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import bench_timing
+
+
+def test_loop_timing_zero_t_lo_no_zerodivision(monkeypatch):
+    # Freeze the clock: every measurement reads 0 elapsed, so
+    # per_iter_est == 0 — the exact sub-resolution case that divided
+    # by zero when k_hi=None (ADVICE r4 #1a).
+    monkeypatch.setattr(bench_timing.time, "perf_counter", lambda: 1.0)
+    import jax.numpy as jnp
+
+    x0 = jnp.ones((8,), dtype=jnp.float32)
+    try:
+        bench_timing.loop_ms_per_iter(
+            lambda v: v * 1.0, x0, k_lo=2, k_hi=None, k_cap=8,
+            deadline_s=5.0,
+        )
+    except RuntimeError:
+        pass  # "unresolvable timing" is the acceptable loud outcome
+    # ZeroDivisionError escaping is the regression.
+
+
+def test_loop_timing_noise_dominated_break_raises(monkeypatch):
+    # t_hi marginally above t_lo but below the noise floor at the
+    # k_cap break: must raise, not return the noise slope (#1b).
+    # Clock intervals grow quadratically-slowly, so the later (t_hi)
+    # measurement is strictly above the earlier (t_lo) one but far
+    # below the 2*fixed noise floor — the old code returned that noise
+    # slope as data; the new code must refuse.
+    state = {"i": 0}
+
+    def fake_clock():
+        state["i"] += 1
+        i = state["i"]
+        return i * 1e-6 + i * i * 1e-9
+
+    monkeypatch.setattr(bench_timing.time, "perf_counter", fake_clock)
+    import jax.numpy as jnp
+
+    x0 = jnp.ones((8,), dtype=jnp.float32)
+    with pytest.raises(RuntimeError, match="unresolvable"):
+        bench_timing.loop_ms_per_iter(
+            lambda v: v * 1.0, x0, k_lo=2, k_hi=4, k_cap=4,
+        )
+
+
+def test_coo_ctor_routes_through_check_nnz(monkeypatch):
+    from legate_sparse_tpu import csr as csr_mod
+
+    seen = []
+    real = csr_mod.check_nnz
+
+    def spy(nnz):
+        seen.append(int(nnz))
+        return real(nnz)
+
+    monkeypatch.setattr(csr_mod, "check_nnz", spy)
+    row = np.array([0, 1, 2, 2])
+    col = np.array([1, 0, 2, 1])
+    data = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+    A = sparse.csr_array((data, (row, col)), shape=(3, 3))
+    assert A.nnz == 4
+    assert 4 in seen, "COO constructor path skipped check_nnz"

@@ -114,3 +114,65 @@ def test_operator_tpu_pin_probes_only_mosaic_rung(bench_mod, monkeypatch):
     assert calls == ["pallas"]      # no jroll rung under a tpu pin
     assert os.environ["LEGATE_SPARSE_TPU_PALLAS_ROLL"] == "tpu"
     assert os.environ.get("LEGATE_SPARSE_TPU_PALLAS_DIA") == "0"
+
+
+def test_inputs_pin_starts_ladder_at_shift3(bench_mod, monkeypatch):
+    # With INPUTS pinned in the environment the canary subprocess would
+    # probe the de-aliased variant anyway; the ladder must start there
+    # and label it honestly (ADVICE r4).
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_INPUTS", "distinct")
+    calls = _mock(bench_mod, monkeypatch, {"pallas-shift3": "ok"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas-shift3:ok"] and alive
+    assert calls == ["pallas-shift3"]
+    assert "distinct" in open("evidence/band_variant.env").read()
+
+
+def test_roll_and_inputs_pins_label_shift3(bench_mod, monkeypatch):
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_ROLL", "tpu")
+    monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_INPUTS", "distinct")
+    calls = _mock(bench_mod, monkeypatch, {"pallas-shift3": "ok"})
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas-shift3:ok"] and alive
+    assert calls == ["pallas-shift3"]
+
+
+def test_trace_error_skips_recovery_probe(bench_mod, monkeypatch):
+    # A Python-level canary bug is not a worker fault: the ladder keeps
+    # going without the recovery probe (which would otherwise pin CPU).
+    probes = []
+
+    def fake_probe():
+        probes.append(1)
+        return True
+
+    calls = _mock(bench_mod, monkeypatch,
+                  {"pallas": "trace-error", "pallas-shift3": "ok"})
+    monkeypatch.setattr(bench_mod, "_probe_accelerator", fake_probe)
+    attempts, alive = bench_mod._select_band_variant(24, 480)
+    assert attempts == ["pallas:trace-error", "pallas-shift3:ok"]
+    assert alive and calls == ["pallas", "pallas-shift3"]
+    assert probes == []             # no recovery probe for a trace error
+
+
+def test_canary_wrapper_distinguishes_trace_error(bench_mod):
+    # End-to-end through the real subprocess wrapper: a Python-level
+    # raise inside the canary code yields "trace-error", not "crash".
+    real_code = bench_mod._CANARY_CODE
+    try:
+        bench_mod._CANARY_CODE = "import sys\nraise ValueError('boom')\n"
+        verdict = bench_mod._pallas_canary(4, timeout_s=120)
+        assert verdict == "trace-error"
+        bench_mod._CANARY_CODE = "print('canary-ok')\n"
+        assert bench_mod._pallas_canary(4, timeout_s=120) == "ok"
+        bench_mod._CANARY_CODE = "import sys\nsys.exit(1)\n"
+        assert bench_mod._pallas_canary(4, timeout_s=120) == "crash"
+        # jax 0.9's device-fault class must be scored as a crash, not a
+        # trace error (code-review r5: the classifier must match
+        # JaxRuntimeError, not just the legacy XlaRuntimeError name).
+        bench_mod._CANARY_CODE = (
+            "from jax.errors import JaxRuntimeError\n"
+            "raise JaxRuntimeError('TPU worker process crashed')\n")
+        assert bench_mod._pallas_canary(4, timeout_s=120) == "crash"
+    finally:
+        bench_mod._CANARY_CODE = real_code

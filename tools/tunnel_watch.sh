@@ -1,11 +1,11 @@
 #!/bin/bash
 # Probes the accelerator tunnel every 3 min; touches /tmp/tpu_alive when
-# up and — the part that matters — fires tools/round4_capture.sh the
+# up and — the part that matters — fires tools/round5_capture.sh the
 # first time a probe answers.  One-shot: after a capture chain records
 # on-chip data (exit 0 -> marker file), later alive probes just log.
 #
 # Lock protocol: the lock dir carries the owner watcher's PID.  A lock
-# is reclaimed only when that owner is dead AND no round4_capture.sh
+# is reclaimed only when that owner is dead AND no round*_capture.sh
 # process is still running (a killed watcher can orphan a live capture
 # chain — reclaiming under it would interleave two captures).  The EXIT
 # trap removes the lock only if this process owns it.
@@ -26,12 +26,12 @@ while true; do
     if [ ! -e "$DONE" ]; then
       owner=$(cat "$LOCK/pid" 2>/dev/null)
       if [ -d "$LOCK" ] && [ -n "$owner" ] && ! kill -0 "$owner" 2>/dev/null \
-         && ! pgrep -f "tools/round4_capture.sh" >/dev/null 2>&1; then
+         && ! pgrep -f "tools/round[0-9]_capture.sh" >/dev/null 2>&1; then
         rm -rf "$LOCK"   # dead owner, no orphaned capture: reclaim
       fi
       if mkdir "$LOCK" 2>/dev/null; then
         echo $$ > "$LOCK/pid"
-        if bash tools/round4_capture.sh >> evidence/round4_capture.log 2>&1; then
+        if bash tools/round5_capture.sh >> evidence/round5_capture.log 2>&1; then
           touch "$DONE"
         fi
         rm -rf "$LOCK"
