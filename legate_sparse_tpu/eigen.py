@@ -23,10 +23,20 @@ boundary.  These are the native TPU paths for the common cases:
   materializes A^T A — two SpMVs per step); left vectors recovered as
   ``U = A V / s``.
 
-Corners with no sensible single-chip device path (shift-invert
-``sigma``, generalized/preconditioned problems, ``which='SM'`` which
-scipy itself serves via shift-invert) delegate to the host fallback,
-same boundary adaptation as ``linalg.__getattr__``.
+Shift-invert ``sigma`` runs NATIVELY (VERDICT r4 #6): the inner
+``(A - sigma I)^{-1} v`` apply is an inexact Krylov solve — the
+package's jitted MINRES while_loop for symmetric/Hermitian operators
+(indefinite-safe), BiCGSTAB for general ones — nested inside the same
+Lanczos/Arnoldi ``lax.scan``, so the whole outer-inner iteration
+compiles to ONE device program (where scipy/ARPACK factorizes with
+``splu`` — a sequential host path with no TPU analog, this is the
+device-native rendition).  Complex-Hermitian ``lobpcg`` likewise runs
+through the native Lanczos machinery (jax's ``lobpcg_standard`` builds
+mixed real/complex while_loop carries on complex operands).
+
+Remaining host-fallback corners: generalized problems (``M``/``B``),
+preconditioned/constrained lobpcg, ``which='SM'``/``'BE'`` without
+``sigma``, and non-``normal`` shift-invert modes.
 """
 
 from __future__ import annotations
@@ -64,6 +74,18 @@ def _host_fallback(name):
     return scipy_fallback(getattr(_ssl, name), f"linalg.{name}")
 
 
+def _complex_matvec(matvec, dtype, cdtype):
+    """Complex basis over a REAL operator: two real matvecs per apply
+    (shared by ``eigs``'s complex-start case and the complex-shift
+    shift-invert path)."""
+
+    def mv(x):
+        return (matvec(jnp.real(x).astype(dtype)).astype(cdtype)
+                + 1j * matvec(jnp.imag(x).astype(dtype)).astype(cdtype))
+
+    return mv
+
+
 def _restart_direction(V, key0, j, n, rdtype, dtype, mask=None):
     """Fresh random direction orthogonal to the rows of V — the shared
     breakdown restart for the Lanczos and Arnoldi scans (an invariant
@@ -78,11 +100,17 @@ def _restart_direction(V, key0, j, n, rdtype, dtype, mask=None):
     return fresh / jnp.maximum(jnp.linalg.norm(fresh), eps)
 
 
+def _outer_atol(tol, rdtype):
+    """Default convergence tolerance (single source for the escalation
+    drivers AND the shift-invert inner-solve sizing)."""
+    return float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
+
+
 def _escalation_params(tol, rdtype, ncv, k, rank, maxiter,
                        min_extra: int = 1):
     """Shared host-side escalation knobs for the eigsh/eigs drivers:
     (atol, first subspace size m, retry count)."""
-    atol = float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
+    atol = _outer_atol(tol, rdtype)
     m = int(ncv) if ncv is not None else min(rank, max(2 * k + 1, 20))
     m = min(max(m, k + min_extra), rank)
     tries = max(int(maxiter) if maxiter is not None else 6, 1)
@@ -107,6 +135,82 @@ def _require_converged(resid, atol, scale, m, cap, w_k, X=None):
         np.asarray(w_k)[ok],
         (np.asarray(X)[:, ok] if X is not None
          else np.empty((0, int(ok.sum())))),
+    )
+
+
+# ----------------------------------------------------- shift-invert inner
+
+
+def _shift_invert_op(matvec, sigma, dtype, n, outer_atol, sym: bool):
+    """Jax-traceable ``v -> (A - sigma I)^{-1} v`` via an inexact inner
+    Krylov solve (MINRES for symmetric/Hermitian — A - sigma I is
+    indefinite for interior sigma; BiCGSTAB for general operators).
+
+    The returned closure nests inside the outer Lanczos/Arnoldi
+    ``lax.scan``, so outer+inner compile to one device program.  The
+    operands fed to it by the outer recurrences are unit-norm, so a
+    fixed absolute inner tolerance (two digits tighter than the outer
+    Ritz tolerance, floored near eps) bounds the backward error of the
+    inexact apply below the outer convergence test's resolution.
+    """
+    from .krylov_extra import _minres_loop
+    from .linalg import _bicgstab_loop
+
+    rdtype = jnp.finfo(jnp.dtype(dtype)).dtype
+    eps = float(np.finfo(np.dtype(rdtype)).eps)
+    inner_atol = max(1e-2 * float(outer_atol), 50.0 * eps)
+    inner_maxiter = int(min(10 * n + 20, 100_000))
+    shift = jnp.asarray(sigma, dtype=dtype)
+    ident = lambda r: r  # noqa: E731
+
+    if sym:
+        def solve(v):
+            v = jnp.asarray(v, dtype=dtype)
+            x, _ = _minres_loop(matvec, ident, v, jnp.zeros_like(v),
+                                shift, inner_atol, inner_maxiter, 10)
+            return x
+    else:
+        def shifted(x):
+            return matvec(x) - shift * x
+
+        def solve(v):
+            v = jnp.asarray(v, dtype=dtype)
+            x, _ = _bicgstab_loop(shifted, ident, v, jnp.zeros_like(v),
+                                  inner_atol, inner_maxiter, 10)
+            return x
+
+    return solve
+
+
+def _check_original_residuals(matvec, lam, X, atol, name):
+    """Post-hoc guard for the inexact shift-invert paths: judge the
+    returned Ritz pairs in the ORIGINAL operator's metric (k matvecs).
+    A stagnated inner solve (sigma pathologically close to an
+    eigenvalue for the iterative inner tolerance) corrupts OP silently;
+    the outer recurrence then converges *on the corrupted operator*, so
+    this original-spectrum check is the only honest acceptance test —
+    scipy/ARPACK's splu factorization is exact and needs none.  Raises
+    ``ArpackNoConvergence`` (carrying the converged subset) like scipy
+    does on its own convergence failures."""
+    Xj = jnp.asarray(X)
+    AX = np.asarray(jax.vmap(matvec, in_axes=1, out_axes=1)(Xj))
+    resid = np.linalg.norm(AX - np.asarray(X) * lam[None, :], axis=0)
+    scale = np.maximum(np.abs(lam), 1.0)
+    # Slack x50: the inner solve is inexact by design (inner_atol is
+    # 1e-2 * atol); this bound rejects stagnation (errors orders of
+    # magnitude out), not honest last-digit noise.
+    ok = resid <= 50.0 * atol * scale
+    if bool(np.all(ok)):
+        return
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    raise ArpackNoConvergence(
+        f"shift-invert {name}: inexact inner solve did not reach the "
+        f"requested accuracy ({int(ok.sum())}/{ok.size} pairs pass the "
+        f"original-spectrum residual test; sigma may be too close to "
+        f"an eigenvalue for the iterative inner solver — widen sigma "
+        f"or loosen tol)",
+        np.asarray(lam)[ok], np.asarray(X)[:, ok],
     )
 
 
@@ -167,7 +271,9 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
                    return_eigenvectors, mask=None, max_rank=None):
     import scipy.linalg as _sl
 
-    rdtype = np.dtype(np.float64 if dtype.itemsize >= 8 else np.float32)
+    # The REAL precision of the operand dtype (complex64 -> float32):
+    # an itemsize test would hand complex64 float64-grade tolerances.
+    rdtype = np.dtype(np.finfo(dtype).dtype)
     if v0 is None:
         rng = np.random.default_rng(0)
         v0 = rng.standard_normal(n)
@@ -231,24 +337,60 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     ``eigsh``).
 
     Capability split: the standard problem with ``which`` in
-    {LM, LA, SA} runs the NATIVE device Lanczos below; generalized
-    (``M``), shift-invert (``sigma``), and ``which='SM'`` delegate to
-    host scipy/ARPACK — shift-invert needs a sparse factorization
-    (``splu``) per solve, which is inherently sequential and stays on
-    host (same boundary as ``spsolve``).  Delegated calls convert
-    operands at the boundary and return scipy's results unchanged."""
-    if M is not None or sigma is not None or which not in ("LM", "LA", "SA"):
+    {LM, LA, SA} runs the NATIVE device Lanczos below; shift-invert
+    ``sigma`` (mode='normal') also runs natively — Lanczos on
+    ``(A - sigma I)^{-1}`` with the inner apply an inexact jitted
+    MINRES solve nested in the same scan (``_shift_invert_op``), where
+    scipy/ARPACK uses a host ``splu`` factorization.  Per scipy
+    semantics ``which`` then refers to the TRANSFORMED eigenvalues
+    ``nu = 1/(lambda - sigma)`` (LM = closest to sigma) and results
+    transform back via ``lambda = sigma + 1/nu``.  Generalized (``M``)
+    problems and non-'normal' modes delegate to host scipy/ARPACK.
+    Delegated calls convert operands at the boundary and return scipy's
+    results unchanged."""
+    mode = kwargs.pop("mode", "normal")
+    native_which = ("LM", "LA", "SA")
+    if (M is not None or which not in native_which or kwargs
+            or (sigma is not None and mode != "normal")):
         return _host_fallback("eigsh")(
             A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
-            maxiter=maxiter, tol=tol,
+            maxiter=maxiter, tol=tol, mode=mode,
             return_eigenvectors=return_eigenvectors, **kwargs)
     matvec, m_rows, n_cols, dtype = _operator_parts(A)
     if m_rows != n_cols:
         raise ValueError("expected square matrix")
     if not (0 < k < n_cols):
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
-    return _lanczos_eigsh(matvec, n_cols, dtype, int(k), which, v0, ncv,
-                          maxiter, tol, return_eigenvectors)
+    if sigma is None:
+        return _lanczos_eigsh(matvec, n_cols, dtype, int(k), which, v0,
+                              ncv, maxiter, tol, return_eigenvectors)
+
+    # Native shift-invert: Lanczos on OP = (A - sigma I)^{-1}.
+    if np.iscomplexobj(sigma):
+        # scipy parity: float(sigma) raises on ANY complex (even with a
+        # zero imaginary part) — a Hermitian spectrum is real.
+        raise TypeError(
+            "eigsh sigma must be a real number, not complex"
+        )
+    rdtype = np.dtype(np.finfo(dtype).dtype)
+    atol_outer = _outer_atol(tol, rdtype)
+    op = _shift_invert_op(matvec, float(sigma), dtype, n_cols,
+                          atol_outer, sym=True)
+    # Always form X: the original-spectrum residual check below is what
+    # catches a silently-stagnated INNER solve (sigma too close to an
+    # eigenvalue) — the outer Ritz test alone only measures convergence
+    # on the possibly-corrupted operator.
+    w_nu, X = _lanczos_eigsh(op, n_cols, dtype, int(k), which, v0, ncv,
+                             maxiter, tol, True)
+    # nu = 1/(lambda - sigma): eigenvectors are shared with A.
+    nz = np.where(w_nu == 0, np.finfo(rdtype).tiny, w_nu)
+    lam = (float(sigma) + 1.0 / nz).astype(rdtype)
+    order = np.argsort(lam)                 # scipy returns ascending
+    lam, X = lam[order], X[:, order]
+    _check_original_residuals(matvec, lam, X, atol_outer, "eigsh")
+    if not return_eigenvectors:
+        return lam
+    return lam, X
 
 
 # ---------------------------------------------------------------- LOBPCG
@@ -275,11 +417,40 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
     if (np.issubdtype(dtype, np.complexfloating)
             or np.iscomplexobj(np.asarray(X))):
         # jax's lobpcg_standard builds mixed real/complex while_loop
-        # carries on complex operands (upstream limitation); scipy's
-        # lobpcg handles complex Hermitian operators, so serve those
-        # through the same host boundary as the generalized forms.
-        return _host_fallback("lobpcg")(
-            A, np.asarray(X), tol=tol, maxiter=maxiter, largest=largest)
+        # carries on complex operands (upstream limitation); serve
+        # complex-Hermitian operators through the native device Lanczos
+        # instead (same answers, one jitted scan — VERDICT r4 #6).
+        Xa = np.asarray(X)
+        if Xa.ndim != 2 or Xa.shape[0] != n_cols:
+            raise ValueError(f"X must be (n, k) with n={n_cols}")
+        k = Xa.shape[1]
+        cdtype = np.result_type(dtype, np.complex64)
+        which = "LA" if largest else "SA"
+        try:
+            w, V = _lanczos_eigsh(
+                matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
+                None, maxiter, (tol if tol else 0), True)
+        except Exception as e:
+            from scipy.sparse.linalg import ArpackNoConvergence
+
+            if not isinstance(e, ArpackNoConvergence):
+                raise
+            # scipy's lobpcg NEVER raises on non-convergence — it
+            # returns the current approximation with a warning.  Honor
+            # that contract: accept whatever the subspace holds
+            # (tol=inf converges on the first pass by construction).
+            import warnings
+
+            warnings.warn(
+                "lobpcg (native Lanczos route) did not converge to the "
+                "requested tolerance; returning the current "
+                "approximation (scipy-compatible behavior)",
+                UserWarning, stacklevel=2)
+            w, V = _lanczos_eigsh(
+                matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
+                None, 1, np.inf, True)
+        order = np.argsort(w)[::-1] if largest else np.argsort(w)
+        return np.asarray(w)[order], np.asarray(V)[:, order]
     X = jnp.asarray(np.asarray(X), dtype=dtype)
     if X.ndim != 2 or X.shape[0] != n_cols:
         raise ValueError(f"X must be (n, k) with n={n_cols}")
@@ -433,17 +604,24 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
 
     Capability split: the standard problem with ``which`` in
     {LM, LR, SR, LI, SI} runs the NATIVE restarted Arnoldi below;
-    generalized (``M``), shift-invert (``sigma``), and SM delegate to
-    host scipy/ARPACK (which serves SM via shift-invert itself) — the
-    factorization shift-invert needs is sequential and stays on host,
-    same boundary as ``spsolve``.  Eigenvalues return complex, like
+    shift-invert ``sigma`` also runs natively — Arnoldi on
+    ``(A - sigma I)^{-1}`` with an inexact jitted BiCGSTAB inner solve
+    (``_shift_invert_op``) nested in the same scan, where scipy/ARPACK
+    factorizes on host.  Per scipy semantics ``which`` then refers to
+    the transformed ``nu = 1/(lambda - sigma)``; results transform back
+    via ``lambda = sigma + 1/nu``.  Generalized (``M``) and SM
+    delegate to host scipy/ARPACK.  Eigenvalues return complex, like
     scipy."""
-    if (M is not None or sigma is not None
+    if (M is not None
             or which not in ("LM", "LR", "SR", "LI", "SI") or kwargs):
         return _host_fallback("eigs")(
             A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
             maxiter=maxiter, tol=tol,
             return_eigenvectors=return_eigenvectors, **kwargs)
+    if sigma is not None:
+        return _eigs_shift_invert(A, int(k), complex(sigma), which, v0,
+                                  ncv, maxiter, tol,
+                                  return_eigenvectors)
     matvec, m_rows, n_cols, dtype = _operator_parts(A)
     if m_rows != n_cols:
         raise ValueError("expected square matrix")
@@ -465,14 +643,20 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         # Complex start on a real operator: complex basis, two real
         # matvecs per step (the only case that needs them).
         basis_dtype = cdtype
-
-        def mv(x):
-            return (matvec(jnp.real(x).astype(dtype)).astype(cdtype)
-                    + 1j * matvec(jnp.imag(x).astype(dtype))
-                    .astype(cdtype))
+        mv = _complex_matvec(matvec, dtype, cdtype)
     v0 = jnp.asarray(v0, dtype=basis_dtype)
     v0 = v0 / jnp.linalg.norm(v0)
+    return _arnoldi_eigs(mv, n, cdtype, k, which, v0, ncv, maxiter,
+                         tol, return_eigenvectors)
 
+
+def _arnoldi_eigs(mv, n, cdtype, k, which, v0, ncv, maxiter, tol,
+                  return_eigenvectors, transform=None):
+    """Shared restarted-Arnoldi driver: escalate the subspace until the
+    Ritz residuals converge, then (optionally) map the Ritz values
+    through ``transform`` (the shift-invert back-transform
+    ``lambda = sigma + 1/nu``; residual control stays in the operator's
+    own — i.e. transformed — spectrum, exactly like ARPACK)."""
     rdtype = np.finfo(cdtype).dtype
     from .linalg import maybe_jit
 
@@ -497,11 +681,66 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         if np.all(resid <= atol * scale) or m >= n:
             break
     converged = bool(np.all(resid <= atol * scale)) or m >= n
+    lam = transform(w_k) if transform is not None else w_k
     if converged and not return_eigenvectors:
-        return w_k          # skip forming X entirely
+        return lam          # skip forming X entirely
     X = np.asarray(jnp.einsum("mn,mk->nk", V,
                               jnp.asarray(y_k, dtype=cdtype)))
-    _require_converged(resid, atol, scale, m, n, w_k, X)
+    _require_converged(resid, atol, scale, m, n, lam, X)
     if not return_eigenvectors:
-        return w_k
-    return w_k, X
+        return lam
+    return lam, X
+
+
+def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
+                       return_eigenvectors):
+    """Native shift-invert ``eigs``: Arnoldi on ``(A - sigma I)^{-1}``
+    with the inexact jitted BiCGSTAB inner apply (``_shift_invert_op``).
+    A complex sigma (or complex start) on a real operator promotes the
+    basis to complex with two real matvecs per inner apply."""
+    matvec, m_rows, n_cols, dtype = _operator_parts(A)
+    if m_rows != n_cols:
+        raise ValueError("expected square matrix")
+    n = n_cols
+    if not (0 < k < n - 1):
+        raise ValueError(f"k={k} must satisfy 0 < k < n - 1 = {n - 1}")
+    cdtype = np.result_type(dtype, np.complex64)
+    rdtype = np.finfo(cdtype).dtype
+    is_complex_op = np.issubdtype(dtype, np.complexfloating)
+    need_complex = (
+        is_complex_op or sigma.imag != 0
+        or (v0 is not None and np.iscomplexobj(np.asarray(v0)))
+    )
+    if need_complex and not is_complex_op:
+        base_dtype = np.dtype(cdtype)
+        base_mv = _complex_matvec(matvec, dtype, cdtype)
+    else:
+        base_dtype = np.dtype(dtype)
+        base_mv = matvec
+    sig_val = (complex(sigma)
+               if np.issubdtype(base_dtype, np.complexfloating)
+               else float(sigma.real))
+    atol_outer = _outer_atol(tol, rdtype)
+    op = _shift_invert_op(base_mv, sig_val, base_dtype, n,
+                          atol_outer, sym=False)
+    if v0 is None:
+        v0 = np.random.default_rng(0).standard_normal(n)
+    v0 = jnp.asarray(v0, dtype=base_dtype)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    def back(nu):
+        tiny = np.finfo(rdtype).tiny
+        safe = np.where(nu == 0, tiny, nu)
+        return (complex(sigma) + 1.0 / safe).astype(cdtype)
+
+    # Always form X: the original-spectrum check below catches a
+    # silently-stagnated inner solve (see _check_original_residuals).
+    lam, X = _arnoldi_eigs(op, n, cdtype, k, which, v0, ncv, maxiter,
+                           tol, True, transform=back)
+    check_mv = (base_mv if np.issubdtype(base_dtype, np.complexfloating)
+                else _complex_matvec(matvec, np.dtype(dtype), cdtype))
+    _check_original_residuals(check_mv, np.asarray(lam), X,
+                              atol_outer, "eigs")
+    if not return_eigenvectors:
+        return lam
+    return lam, X

@@ -128,6 +128,43 @@ class DistCSR:
     def rows_padded(self) -> int:
         return self.num_shards * self.rows_per_shard
 
+    # ---- int32-local / int64-global index split (SURVEY §7 hard part
+    # 5; reference runs coord_ty = int64 throughout,
+    # ``legate_sparse/types.py:20-25``).  Device-side structures are
+    # shard-LOCAL int32 (column windows, local row ids, per-shard
+    # counts); everything GLOBAL — row offsets, total nnz — lives here
+    # as host-side int64/Python ints, never as device arrays, so a
+    # no-x64 TPU process handles matrices whose *global* nnz exceeds
+    # 2^31 while every shard stays within int32.  ``coord_dtype_for``'s
+    # OverflowError remains the single-device (host-CSR) boundary only.
+
+    @property
+    def shard_row_starts(self) -> np.ndarray:
+        """Global first-row of each shard, host-side int64."""
+        return (np.arange(self.num_shards, dtype=np.int64)
+                * np.int64(self.rows_per_shard))
+
+    @property
+    def global_nnz(self) -> int:
+        """Total stored entries across shards, as a host Python int
+        (exact past 2^31 with int32 device counts — the summation never
+        touches a device-wide int64 array)."""
+        if self.counts is not None:
+            # ELL: (R, rps) per-row counts (padding rows are 0);
+            # padded-CSR: (R,) per-shard totals.  Same exact int64 sum.
+            return int(np.asarray(self.counts).astype(np.int64).sum())
+        # DIA-only matrix.  Masked bands: the mask is 0 outside the
+        # global range by construction, so its sum is the count.
+        if self.dia_mask is not None:
+            return int(np.asarray(self.dia_mask).astype(np.int64).sum())
+        # Exact bands: per-diagonal in-range slot count, Python ints
+        # (exact at any size).
+        rows, cols = self.shape
+        return sum(
+            max(0, min(rows, cols - o) - max(0, -o))
+            for o in self.dia_offsets
+        )
+
     @property
     def dtype(self):
         blocks = self.data if self.data is not None else self.dia_data

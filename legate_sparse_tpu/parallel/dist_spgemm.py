@@ -16,10 +16,14 @@ driven from ``legate_sparse/csr.py:603-684``):
   per-shard product count and output nnz, a host sync of their maxima
   (exactly the role of the reference's blocking ``int(nnz)``,
   ``csr.py:714``), and padded (R, cap) output blocks.
-- B's rows are realized per shard by ``all_gather`` over ICI.  (The
-  reference gathers B through a min/max column image of A — the
-  per-shard window optimization lives in ``shard_csr``'s halo logic and
-  can be layered here the same way.)
+- B's rows are realized per shard through a min/max column image of A
+  (the reference's image-gather, ``legate_sparse/csr.py:640-666`` +
+  ``src/sparse/partition/fast_image_partition.cu:29-55``): a host-side
+  window plan maps each shard's A-column range onto B's row blocks, and
+  only those blocks ride ring ``ppermute`` rotations — per-shard memory
+  O(window · nnz(B)/R), not O(nnz(B)).  When the window covers most of
+  the ring (dense/irregular A) the full ``all_gather`` realization is
+  used instead (``_B_WINDOW_DENSE_FRAC``).
 
 Phases (each one jitted shard_map over the row mesh):
 
@@ -199,15 +203,223 @@ def _unrebase_b(B: _Layout, b_cols_g, rps):
     return b_cols_g + block_of * rps - B.halo
 
 
-def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int):
+# Window wider than this fraction of the ring -> the ppermute rotation
+# chain stops paying for itself; use the one-shot all_gather.
+_B_WINDOW_DENSE_FRAC = 0.75
+
+# Introspection for tests/diagnostics: how dist_spgemm's last general-
+# path call realized B ("window" | "all_gather"), and the plan used.
+LAST_B_REALIZATION: str = ""
+LAST_B_PLAN: tuple = ()
+
+
+@lru_cache(maxsize=128)
+def _col_window_fn(mesh, la: _Layout):
+    """Per-shard global-column min/max of A (the FAST_IMAGE_RANGE
+    analog, ``fast_image_partition.cu:29-55``): one tiny jitted
+    shard_map, host-fetched once per (A, B) structure pair.
+
+    The per-shard scalars are ``all_gather``-replicated before leaving
+    the shard_map (out_specs ``P(None)``) so the host fetch is legal in
+    multi-controller runs — a ``P(ROW_AXIS)``-sharded output would span
+    non-addressable devices there and refuse ``np.asarray``.
+    """
+    in_specs = _esc_specs(la)
+    big = la.shape[1]
+
+    def kern(*a_args):
+        a_row, a_col, a_val, a_valid = _a_local_flat(la, *_local(a_args))
+        mn = jnp.min(jnp.where(a_valid, a_col, big))
+        mx = jnp.max(jnp.where(a_valid, a_col, -1))
+        return (jax.lax.all_gather(mn, ROW_AXIS),
+                jax.lax.all_gather(mx, ROW_AXIS))
+
+    return jax.jit(shard_map(
+        kern, mesh=mesh, in_specs=in_specs,
+        out_specs=(P(None), P(None)), check_vma=False,
+    ))
+
+
+def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
+    """Host-side B-realization window plan, or None for all_gather.
+
+    Maps each shard's A-column range onto B's row blocks (block t of B
+    lives on shard t).  Returns ``(first_blks, (nblk, d_fwd, d_bwd))``:
+    per-shard first window block (an int32 host array — passed to the
+    phase kernels as a TRACED operand so sparsity drift between calls
+    never recompiles them), plus the static shape knobs: window width
+    in blocks and the max forward/backward ring distances the rotation
+    chain must cover.  None when B is precise-layout (compact cols
+    don't rotate) or the worst-case window is too wide to beat
+    all_gather.
+    """
+    if lb.has_ggl:
+        return None
+    R = la.num_shards
+    if R <= 2:
+        return None         # rotation chain degenerates to all_gather
+    if (la, lb) in _WINDOW_DECLINED:
+        # This structure pair already proved too wide for a window:
+        # skip the min/max image probe (a blocking device->host round
+        # trip — ~1 s over the TPU tunnel) on every later call.  A
+        # matrix whose SPARSITY later narrows under the same layout
+        # stays on all_gather; correctness is unaffected.
+        return None
+    mn, mx = _col_window_fn(A.mesh, la)(*a_arrays)
+    mn = np.asarray(mn)
+    mx = np.asarray(mx)
+    rps_b = lb.rps
+    first = np.clip(mn // rps_b, 0, R - 1).astype(np.int64)
+    last = np.clip(mx // rps_b, 0, R - 1).astype(np.int64)
+    s_ids = np.arange(R)
+    empty = mx < 0          # shard with no valid A entries
+    first[empty] = s_ids[empty]
+    last[empty] = s_ids[empty]
+    nblk = int(np.max(last - first) + 1)
+    if nblk <= 0 or nblk >= max(2, int(R * _B_WINDOW_DENSE_FRAC)):
+        _window_decline(la, lb)
+        return None
+    d_fwd = int(np.max(np.maximum(s_ids - first, 0)))
+    d_bwd = int(np.max(np.maximum(last - s_ids, 0)))
+    if d_fwd + d_bwd >= R:
+        _window_decline(la, lb)
+        return None         # would rotate the whole ring anyway
+    return first.astype(np.int32), (nblk, d_fwd, d_bwd)
+
+
+_WINDOW_DECLINED: set = set()
+
+
+def _window_decline(la: _Layout, lb: _Layout) -> None:
+    if len(_WINDOW_DECLINED) > 256:     # unbounded-session safety valve
+        _WINDOW_DECLINED.clear()
+    _WINDOW_DECLINED.add((la, lb))
+
+
+def _b_window_flat(B: _Layout, plan, first_local, data, cols, counts,
+                   row_ids, ggl=None, counts_only: bool = False):
+    """Windowed analog of ``_b_global_flat``: realize only the B row
+    blocks inside this shard's A-column window via ring ``ppermute``
+    rotations (``d_fwd + d_bwd`` rounds), then expose the same flat
+    per-row access over the (nblk, ...) buffers.
+
+    ``plan`` carries only the STATIC shape knobs ``(nblk, d_fwd,
+    d_bwd)``; ``first_local`` is the shard's first-window-block id as a
+    traced (1,)-block operand — keeping the data-dependent part of the
+    plan out of the jit key (window drift between calls re-runs, not
+    recompiles).
+
+    Returns ``(b_data_g, b_cols_g, b_start, b_counts, row_base)`` —
+    identical contract to the global variant except row lookups must
+    subtract the traced ``row_base`` (global B row of window slot 0).
+    ``counts_only`` rotates just the per-row-count inputs (phase 1
+    needs no values/cols) and returns None for the other slots.
+    """
+    nblk, d_fwd, d_bwd = plan
+    R = B.num_shards
+    rps = B.rps
+    s = jax.lax.axis_index(ROW_AXIS)
+    first = first_local.reshape(()).astype(jnp.int32)
+    perm_fwd = [(i, (i + 1) % R) for i in range(R)]
+    perm_bwd = [(i, (i - 1) % R) for i in range(R)]
+
+    def place(buf, blk, blk_id):
+        pos = blk_id.astype(jnp.int32) - first
+        ok = (pos >= 0) & (pos < nblk)
+        safe = jnp.clip(pos, 0, nblk - 1)
+        cur = jax.lax.dynamic_index_in_dim(buf, safe, 0, keepdims=False)
+        newv = jnp.where(ok, blk, cur)
+        return jax.lax.dynamic_update_index_in_dim(buf, newv, safe, 0)
+
+    def gather_win(*blks):
+        bufs = [jnp.zeros((nblk,) + b.shape, b.dtype) for b in blks]
+        bufs = [place(buf, b, s) for buf, b in zip(bufs, blks)]
+        cur = blks
+        for d in range(1, d_fwd + 1):
+            cur = tuple(jax.lax.ppermute(c, ROW_AXIS, perm_fwd)
+                        for c in cur)
+            blk_id = (s - d) % R
+            bufs = [place(buf, c, blk_id) for buf, c in zip(bufs, cur)]
+        cur = blks
+        for d in range(1, d_bwd + 1):
+            cur = tuple(jax.lax.ppermute(c, ROW_AXIS, perm_bwd)
+                        for c in cur)
+            blk_id = (s + d) % R
+            bufs = [place(buf, c, blk_id) for buf, c in zip(bufs, cur)]
+        return bufs
+
+    # Which global block each window slot holds (for col un-rebasing).
+    slot_blk = first.astype(index_dtype()) + jnp.arange(
+        nblk, dtype=index_dtype()
+    )
+    row_base = first.astype(index_dtype()) * rps
+
+    if B.ell:
+        W = cols.shape[-1]
+        if counts_only:
+            (counts_w,) = gather_win(counts)
+            b_counts = counts_w.reshape(nblk * rps).astype(jnp.int32)
+            return None, None, None, b_counts, row_base
+        data_w, cols_w, counts_w = gather_win(data, cols, counts)
+        b_data_g = data_w.reshape(-1)
+        b_cols_g = cols_w.reshape(nblk, -1).astype(index_dtype())
+        if B.halo >= 0:
+            # local = global - (t*rps - halo) for source block t.
+            b_cols_g = b_cols_g + (slot_blk * rps - B.halo)[:, None]
+        b_cols_g = b_cols_g.reshape(-1)
+        b_counts = counts_w.reshape(nblk * rps).astype(jnp.int32)
+        b_start = jnp.arange(nblk * rps, dtype=index_dtype()) * W
+    else:
+        nnz_max = B.inner
+        if counts_only:
+            counts_w, rid_w = gather_win(counts, row_ids)
+        else:
+            data_w, cols_w, counts_w, rid_w = gather_win(
+                data, cols, counts, row_ids
+            )
+        slot = jnp.arange(nnz_max, dtype=jnp.int32)
+        valid = slot[None, :] < counts_w[:, None]          # (nblk, nnz_max)
+        ids_2d = jnp.where(valid, rid_w, rps)
+        one = jnp.ones_like(ids_2d, dtype=jnp.int32)
+        percount = jax.vmap(
+            lambda ids, on: jax.ops.segment_sum(on, ids,
+                                                num_segments=rps + 1)
+        )(ids_2d, one)[:, :rps]                            # (nblk, rps)
+        b_counts = percount.reshape(nblk * rps)
+        if counts_only:
+            return None, None, None, b_counts, row_base
+        b_data_g = data_w.reshape(-1)
+        b_cols_g = cols_w.reshape(nblk, -1).astype(index_dtype())
+        if B.halo >= 0:
+            b_cols_g = b_cols_g + (slot_blk * rps - B.halo)[:, None]
+        b_cols_g = b_cols_g.reshape(-1)
+        starts_local = jnp.cumsum(percount, axis=1) - percount
+        b_start = (
+            starts_local.astype(index_dtype())
+            + (jnp.arange(nblk, dtype=index_dtype()) * nnz_max)[:, None]
+        ).reshape(nblk * rps)
+
+    b_cols_g = jnp.clip(b_cols_g, 0, B.shape[1] - 1)
+    return b_data_g, b_cols_g, b_start, b_counts, row_base
+
+
+def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int,
+                   row_base=0):
     """Shared expand + two-key sort producing (c_row, c_col, c_val,
     heads, local_nnz) for one shard.  Invalid product slots carry the
-    sentinel row ``rps`` (sorts after every valid row) and value 0."""
+    sentinel row ``rps`` (sorts after every valid row) and value 0.
+
+    ``row_base``: global B row of the realized buffer's first row (0
+    for the all_gather realization; the shard's window start — traced —
+    for the windowed one).  Every valid A column lies inside the window
+    by construction, so the clip only ever moves invalid slots.
+    """
     a_row, a_col, a_val, a_valid = _a_local_flat(A, *a_args)
     b_data_g, b_cols_g, b_start, b_counts = b_args
 
     rps = A.rps
-    counts_per_a = jnp.where(a_valid, b_counts[a_col], 0).astype(index_dtype())
+    b_row = jnp.clip(a_col - row_base, 0, b_counts.shape[0] - 1)
+    counts_per_a = jnp.where(a_valid, b_counts[b_row], 0).astype(index_dtype())
     starts = jnp.concatenate(
         [jnp.zeros((1,), index_dtype()), jnp.cumsum(counts_per_a)]
     )
@@ -219,7 +431,7 @@ def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int):
     )
     valid_t = t < T_local
     within = t - starts[e]
-    k = a_col[e]
+    k = b_row[e]
     b_pos = jnp.clip(b_start[k] + within, 0, b_data_g.shape[0] - 1)
 
     c_row = jnp.where(valid_t, a_row[e], rps).astype(jnp.int32)
@@ -391,8 +603,29 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     a_arrays = arrays_of(A)
     b_arrays = arrays_of(B)
 
+    # B-realization window plan (the reference's min/max column image of
+    # A, ``csr.py:640-666``): gather only the B row blocks each shard's
+    # A columns reach, via ring ppermute — None falls back to the full
+    # all_gather when the window is dense or B is precise-layout.  Only
+    # the static shape triple enters the phase-fn cache keys; the
+    # per-shard window starts ride as a traced operand.
+    global LAST_B_REALIZATION, LAST_B_PLAN
+    win = _b_window_plan(A, la, lb, a_arrays)
+    if win is not None:
+        first_blks, plan = win
+        first_dev = (_put_blocks(jnp.asarray(first_blks), mesh),)
+        LAST_B_REALIZATION = "window"
+        LAST_B_PLAN = (tuple(int(f) for f in first_blks), *plan)
+    else:
+        plan = None
+        first_dev = ()
+        LAST_B_REALIZATION = "all_gather"
+        LAST_B_PLAN = ()
+
     # ---- phase 1: T_local ------------------------------------------------
-    t_locals = _esc_t_fn(mesh, la, lb)(*a_arrays, *b_arrays)
+    t_locals = _esc_t_fn(mesh, la, lb, plan)(
+        *a_arrays, *b_arrays, *first_dev
+    )
     T_cap = int(jnp.max(t_locals))
 
     val_dtype = jnp.result_type(A.data.dtype, B.data.dtype)
@@ -409,13 +642,15 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         )
 
     # ---- phase 2: nnz_local ---------------------------------------------
-    nnz_locals = _esc_nnz_fn(mesh, la, lb, T_cap)(*a_arrays, *b_arrays)
+    nnz_locals = _esc_nnz_fn(mesh, la, lb, T_cap, plan)(
+        *a_arrays, *b_arrays, *first_dev
+    )
     nnz_cap = max(int(jnp.max(nnz_locals)), 1)
 
     # ---- phase 3: numeric ------------------------------------------------
     vals_b, cols_b, rids_b, counts_b = _esc_numeric_fn(
-        mesh, la, lb, T_cap, nnz_cap
-    )(*a_arrays, *b_arrays)
+        mesh, la, lb, T_cap, nnz_cap, plan
+    )(*a_arrays, *b_arrays, *first_dev)
 
     return DistCSR(
         data=vals_b, cols=cols_b, counts=counts_b.astype(jnp.int32),
@@ -444,14 +679,33 @@ def _local(args):
 
 
 @lru_cache(maxsize=128)
-def _esc_t_fn(mesh, la: _Layout, lb: _Layout):
+def _esc_t_fn(mesh, la: _Layout, lb: _Layout, plan=None):
     """Cached phase-1 (product count) shard_map (structure-keyed, see
-    ``_Layout``; fresh closures per call would recompile every time)."""
+    ``_Layout``; fresh closures per call would recompile every time).
+    ``plan`` is the static window-shape triple or None — the per-shard
+    window starts ride as a traced trailing operand, not a cache key."""
     in_specs = _esc_specs(la) + _esc_specs(lb)
+    if plan is not None:
+        in_specs = in_specs + (P(ROW_AXIS),)
 
     def t_kernel(*args):
-        a_args, b_args_raw = args[:5], args[5:]
+        if plan is not None:
+            a_args, b_args_raw, first = args[:5], args[5:10], args[10]
+        else:
+            a_args, b_args_raw = args[:5], args[5:]
         a_row, a_col, a_val, a_valid = _a_local_flat(la, *_local(a_args))
+        if plan is not None:
+            *_, b_counts, row_base = _b_window_flat(
+                lb, plan, first[0], *_local(b_args_raw),
+                counts_only=True
+            )
+            b_row = jnp.clip(a_col - row_base, 0,
+                             b_counts.shape[0] - 1)
+            t_local = jnp.sum(
+                jnp.where(a_valid, b_counts[b_row], 0),
+                dtype=index_dtype(),
+            )
+            return t_local[None]
         counts = _local(b_args_raw)[2]
         rid = _local(b_args_raw)[3]
         counts_g = jax.lax.all_gather(counts, ROW_AXIS)
@@ -482,16 +736,27 @@ def _esc_t_fn(mesh, la: _Layout, lb: _Layout):
 
 
 @lru_cache(maxsize=128)
-def _esc_nnz_fn(mesh, la: _Layout, lb: _Layout, T_cap: int):
+def _esc_nnz_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
+                plan=None):
     """Cached phase-2 (output nnz) shard_map."""
     in_specs = _esc_specs(la) + _esc_specs(lb)
+    if plan is not None:
+        in_specs = in_specs + (P(ROW_AXIS),)
     n_cols = lb.shape[1]
 
     def nnz_kernel(*args):
-        a_args, b_args_raw = args[:5], args[5:]
-        b_args = _b_global_flat(lb, *_local(b_args_raw))
+        if plan is None:
+            a_args, b_args_raw = args[:5], args[5:]
+            b_args = _b_global_flat(lb, *_local(b_args_raw))
+            row_base = 0
+        else:
+            a_args, b_args_raw, first = args[:5], args[5:10], args[10]
+            *b_args, row_base = _b_window_flat(
+                lb, plan, first[0], *_local(b_args_raw)
+            )
         *_, local_nnz = _expand_sorted(
-            la, _local(a_args), b_args, T_cap, n_cols
+            la, _local(a_args), tuple(b_args), T_cap, n_cols,
+            row_base=row_base,
         )
         return local_nnz[None]
 
@@ -503,20 +768,30 @@ def _esc_nnz_fn(mesh, la: _Layout, lb: _Layout, T_cap: int):
 
 @lru_cache(maxsize=128)
 def _esc_numeric_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
-                    nnz_cap: int):
+                    nnz_cap: int, plan=None):
     """Cached phase-3 (numeric) shard_map."""
     from ..types import coord_dtype_for
 
     in_specs = _esc_specs(la) + _esc_specs(lb)
+    if plan is not None:
+        in_specs = in_specs + (P(ROW_AXIS),)
     n_cols = lb.shape[1]
     col_dtype = coord_dtype_for(n_cols)
     rps = la.rps
 
     def numeric_kernel(*args):
-        a_args, b_args_raw = args[:5], args[5:]
-        b_args = _b_global_flat(lb, *_local(b_args_raw))
+        if plan is None:
+            a_args, b_args_raw = args[:5], args[5:]
+            b_args = _b_global_flat(lb, *_local(b_args_raw))
+            row_base = 0
+        else:
+            a_args, b_args_raw, first = args[:5], args[5:10], args[10]
+            *b_args, row_base = _b_window_flat(
+                lb, plan, first[0], *_local(b_args_raw)
+            )
         c_row, c_col, c_val, heads, local_nnz = _expand_sorted(
-            la, _local(a_args), b_args, T_cap, n_cols
+            la, _local(a_args), tuple(b_args), T_cap, n_cols,
+            row_base=row_base,
         )
         seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int32)) - 1, 0,
                        nnz_cap - 1)

@@ -204,3 +204,173 @@ def test_dist_band_spgemm_holey_falls_back():
     np.testing.assert_allclose(
         C.to_csr().todense(), SC.toarray(), rtol=1e-9, atol=1e-12
     )
+
+
+# ---- windowed B realization (VERDICT r4 #3: the reference's min/max
+# column image of A, legate_sparse/csr.py:640-666) ----------------------
+
+def _spgemm_mod():
+    # The package re-exports the dist_spgemm FUNCTION under the same
+    # name, shadowing the submodule attribute — resolve via importlib.
+    import importlib
+    return importlib.import_module(
+        "legate_sparse_tpu.parallel.dist_spgemm")
+
+
+@needs_multi
+def test_windowed_b_banded_general_path():
+    """A holey band drives the general ESC with a narrow A-column
+    window: the B realization must be the ppermute window, not the full
+    all_gather, and match scipy exactly."""
+    mod = _spgemm_mod()
+    mesh = _mesh()
+    R = int(mesh.shape["rows"])
+    if R < 3:
+        pytest.skip("window plan needs R > 2")
+    n = 128
+    d0 = np.where(np.arange(n) % 3 == 0, 0.0, 2.0)
+    A = sparse.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                     format="csr")
+    SA = sp.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                  format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    assert dAm.dia_mask is not None      # general path, not banded fast
+    C = mod.dist_spgemm(dAm, dAm)
+    assert mod.LAST_B_REALIZATION == "window"
+    first, nblk, d_fwd, d_bwd = mod.LAST_B_PLAN
+    assert nblk <= max(2, R // 2), (nblk, R)
+    assert d_fwd + d_bwd < R
+    np.testing.assert_allclose(
+        C.to_csr().todense(), (SA @ SA).toarray(), rtol=1e-9, atol=1e-12
+    )
+
+
+@needs_multi
+def test_windowed_b_rectangular_galerkin():
+    """Rectangular operands (halo=-1, global-column layout): the
+    Galerkin A @ P product still takes the windowed realization for the
+    banded A and matches scipy."""
+    mod = _spgemm_mod()
+    mesh = _mesh()
+    R = int(mesh.shape["rows"])
+    if R < 3:
+        pytest.skip("window plan needs R > 2")
+    nf, nc = 96, 48
+    A = sparse.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(nf, nf),
+                     format="csr", dtype=np.float64)
+    rows, cols, vals = [], [], []
+    for i in range(nf):
+        c = i // 2
+        if c < nc:
+            rows.append(i); cols.append(c); vals.append(1.0)
+    P_sp = sp.csr_matrix((vals, (rows, cols)), shape=(nf, nc))
+    dP = shard_csr(sparse.csr_array(P_sp), mesh=mesh)
+    # A @ P: A is square banded but P is rectangular, so the product
+    # runs the general ESC; A's narrow window must realize only a few
+    # of P's row blocks.
+    dA = shard_csr(sparse.csr_array(A.toscipy()), mesh=mesh,
+                   force_all_gather=True)
+    C = mod.dist_spgemm(dA, dP)
+    assert mod.LAST_B_REALIZATION == "window"
+    _check(C, (A.toscipy() @ P_sp).tocsr())
+
+
+@needs_multi
+def test_dense_a_column_window_falls_back_to_all_gather():
+    """A matrix whose rows span the full column range defeats the
+    window (nblk ~ R): the plan must decline and the all_gather
+    realization still produce exact results."""
+    mod = _spgemm_mod()
+    mesh = _mesh()
+    rng = np.random.RandomState(11)
+    n = 64
+    A_sp = _random_csr(rng, n, n, density=0.3)
+    B_sp = _random_csr(rng, n, n, density=0.1)
+    dA = shard_csr(sparse.csr_array(A_sp), mesh=mesh)
+    dB = shard_csr(sparse.csr_array(B_sp), mesh=mesh)
+    C = mod.dist_spgemm(dA, dB)
+    assert mod.LAST_B_REALIZATION == "all_gather"
+    _check(C, (A_sp @ B_sp).tocsr())
+
+
+@needs_multi
+def test_window_drift_does_not_recompile(monkeypatch):
+    """Per-shard window starts are a traced operand, not a jit key:
+    sparsity drift between calls (same static window shape) must reuse
+    the compiled phase programs (code-review r5 finding)."""
+    mod = _spgemm_mod()
+    mesh = _mesh()
+    R = int(mesh.shape["rows"])
+    if R < 3:
+        pytest.skip("window plan needs R > 2")
+    n = 128
+    d0 = np.where(np.arange(n) % 3 == 0, 0.0, 2.0)
+    A = sparse.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                     format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    real_plan = mod._b_window_plan
+    shift = {"v": 0}
+
+    def drifting(Aa, la, lb, arrays):
+        out = real_plan(Aa, la, lb, arrays)
+        if out is None:
+            return None
+        first, (nblk, d_fwd, d_bwd) = out
+        # Pad the static window by one block so BOTH drifted variants
+        # still cover every needed block (results stay exact, so the
+        # data-dependent T_cap/nnz_cap keys stay identical); only the
+        # per-shard starts differ between the two calls.
+        static = (nblk + 1, d_fwd + 1, d_bwd)
+        if shift["v"] == 0:
+            return np.maximum(first - 1, 0).astype(np.int32), static
+        return first.astype(np.int32), static
+
+    monkeypatch.setattr(mod, "_b_window_plan", drifting)
+    C1 = mod.dist_spgemm(dAm, dAm)
+    assert mod.LAST_B_REALIZATION == "window"
+    before = (mod._esc_t_fn.cache_info().misses,
+              mod._esc_nnz_fn.cache_info().misses,
+              mod._esc_numeric_fn.cache_info().misses)
+    shift["v"] = 1
+    C2 = mod.dist_spgemm(dAm, dAm)
+    after = (mod._esc_t_fn.cache_info().misses,
+             mod._esc_nnz_fn.cache_info().misses,
+             mod._esc_numeric_fn.cache_info().misses)
+    assert after == before, (
+        f"window drift recompiled phase fns: {before} -> {after}")
+    # Both drifted windows cover every needed block: results exact.
+    ref = (A.toscipy() @ A.toscipy()).toarray()
+    np.testing.assert_allclose(C1.to_csr().toarray(), ref, rtol=1e-12)
+    np.testing.assert_allclose(C2.to_csr().toarray(), ref, rtol=1e-12)
+
+
+@needs_multi
+@pytest.mark.slow
+def test_windowed_b_fraction_much_less_than_one_at_scale():
+    """Slow-lane scaling assertion (VERDICT r4 #3 'done' criterion):
+    for a banded A at a scale where each shard holds many rows, the
+    gathered fraction of B is ≪ 1."""
+    mod = _spgemm_mod()
+    mesh = _mesh()
+    R = int(mesh.shape["rows"])
+    if R < 4:
+        pytest.skip("fraction assertion needs R >= 4")
+    n = 1024
+    d0 = np.where(np.arange(n) % 5 == 0, 0.0, 4.0)
+    A = sparse.diags([d0, np.ones(n - 1), np.ones(n - 2)], [0, 1, 2],
+                     shape=(n, n), format="csr")
+    SA = sp.diags([d0, np.ones(n - 1), np.ones(n - 2)], [0, 1, 2],
+                  shape=(n, n), format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    assert dAm.dia_mask is not None
+    C = mod.dist_spgemm(dAm, dAm)
+    assert mod.LAST_B_REALIZATION == "window"
+    first, nblk, d_fwd, d_bwd = mod.LAST_B_PLAN
+    gathered_fraction = nblk / R
+    assert gathered_fraction <= 0.5, (nblk, R)
+    # Traffic bound: the rotation chain moves d_fwd + d_bwd blocks per
+    # shard vs R - 1 for all_gather.
+    assert (d_fwd + d_bwd) / (R - 1) <= 0.5
+    np.testing.assert_allclose(
+        C.to_csr().todense(), (SA @ SA).toarray(), rtol=1e-9, atol=1e-12
+    )
