@@ -380,11 +380,28 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     # catches a silently-stagnated INNER solve (sigma too close to an
     # eigenvalue) — the outer Ritz test alone only measures convergence
     # on the possibly-corrupted operator.
-    w_nu, X = _lanczos_eigsh(op, n_cols, dtype, int(k), which, v0, ncv,
-                             maxiter, tol, True)
+    def back_l(nu):
+        nz = np.where(nu == 0, np.finfo(rdtype).tiny, nu)
+        return (float(sigma) + 1.0 / nz).astype(rdtype)
+
+    try:
+        w_nu, X = _lanczos_eigsh(op, n_cols, dtype, int(k), which, v0,
+                                 ncv, maxiter, tol, True)
+    except Exception as e:
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        if not isinstance(e, ArpackNoConvergence):
+            raise
+        # The inner escalation raised with TRANSFORMED nu values;
+        # re-raise carrying back-transformed lambdas so a caller
+        # salvaging e.eigenvalues gets actual eigenvalues (matching
+        # the eigs shift-invert path).
+        raise ArpackNoConvergence(
+            str(e), back_l(np.asarray(e.eigenvalues)),
+            np.asarray(e.eigenvectors),
+        ) from None
     # nu = 1/(lambda - sigma): eigenvectors are shared with A.
-    nz = np.where(w_nu == 0, np.finfo(rdtype).tiny, w_nu)
-    lam = (float(sigma) + 1.0 / nz).astype(rdtype)
+    lam = back_l(w_nu)
     order = np.argsort(lam)                 # scipy returns ascending
     lam, X = lam[order], X[:, order]
     _check_original_residuals(matvec, lam, X, atol_outer, "eigsh")
@@ -425,11 +442,24 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
             raise ValueError(f"X must be (n, k) with n={n_cols}")
         k = Xa.shape[1]
         cdtype = np.result_type(dtype, np.complex64)
+        if n_cols > (1 << 15):
+            # The full-basis Lanczos route stores an (m, n) basis:
+            # fine at the sizes complex lobpcg is actually called at,
+            # but it loses LOBPCG's O(n k) memory story at large n —
+            # keep the host boundary for those.
+            return _host_fallback("lobpcg")(
+                A, Xa, tol=tol, maxiter=maxiter, largest=largest)
         which = "LA" if largest else "SA"
+        # Bound the basis at O(max(8k, 128) * n) — LOBPCG-class memory,
+        # not full-rank Lanczos — and map lobpcg's maxiter onto the
+        # (bounded) escalation retry count.
+        cap = min(n_cols, max(8 * k, 128))
+        tries = max(1, min(int(maxiter) if maxiter is not None else 6,
+                           10))
         try:
             w, V = _lanczos_eigsh(
                 matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
-                None, maxiter, (tol if tol else 0), True)
+                None, tries, (tol if tol else 0), True, max_rank=cap)
         except Exception as e:
             from scipy.sparse.linalg import ArpackNoConvergence
 
@@ -437,8 +467,9 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
                 raise
             # scipy's lobpcg NEVER raises on non-convergence — it
             # returns the current approximation with a warning.  Honor
-            # that contract: accept whatever the subspace holds
-            # (tol=inf converges on the first pass by construction).
+            # that contract with ONE pass at the full capped subspace
+            # (ncv=cap, tol=inf accepts its Ritz pairs), which matches
+            # the best subspace the escalation reached.
             import warnings
 
             warnings.warn(
@@ -448,7 +479,7 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
                 UserWarning, stacklevel=2)
             w, V = _lanczos_eigsh(
                 matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
-                None, 1, np.inf, True)
+                cap, 1, np.inf, True, max_rank=cap)
         order = np.argsort(w)[::-1] if largest else np.argsort(w)
         return np.asarray(w)[order], np.asarray(V)[:, order]
     X = jnp.asarray(np.asarray(X), dtype=dtype)

@@ -276,7 +276,12 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
     first[empty] = s_ids[empty]
     last[empty] = s_ids[empty]
     nblk = int(np.max(last - first) + 1)
-    if nblk <= 0 or nblk >= max(2, int(R * _B_WINDOW_DENSE_FRAC)):
+    # Floor of 3 so a 2-block window (any band crossing one shard
+    # boundary) is accepted on small rings: at R=3 the 0.75 fraction
+    # alone would make the window UNREACHABLE (limit 2 declines
+    # nblk=2), turning every banded product into an all_gather.
+    limit = max(3, int(R * _B_WINDOW_DENSE_FRAC))
+    if nblk <= 0 or nblk >= limit:
         _window_decline(la, lb)
         return None
     d_fwd = int(np.max(np.maximum(s_ids - first, 0)))
