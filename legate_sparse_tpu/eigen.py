@@ -34,9 +34,14 @@ device-native rendition).  Complex-Hermitian ``lobpcg`` likewise runs
 through the native Lanczos machinery (jax's ``lobpcg_standard`` builds
 mixed real/complex while_loop carries on complex operands).
 
+``which='SM'`` (eigsh and eigs) also runs natively — shift-invert at
+sigma=0 — with a probe solve that detects a singular/ill-conditioned
+operator up front and falls back to host ARPACK's direct mode (an
+inexact inverse would otherwise silently drop null-space eigenvalues).
+
 Remaining host-fallback corners: generalized problems (``M``/``B``),
-preconditioned/constrained lobpcg, ``which='SM'``/``'BE'`` without
-``sigma``, and non-``normal`` shift-invert modes.
+preconditioned/constrained lobpcg, ``which='BE'``, complex lobpcg past
+32k rows, and non-``normal`` shift-invert modes.
 """
 
 from __future__ import annotations
@@ -179,7 +184,38 @@ def _shift_invert_op(matvec, sigma, dtype, n, outer_atol, sym: bool):
                                   inner_atol, inner_maxiter, 10)
             return x
 
-    return solve
+    return solve, inner_atol
+
+
+def _probe_inverse(matvec, solve, sigma, dtype, n, inner_atol, name):
+    """One explicit (A - sigma I)x = v solve with a TRUE residual check
+    before any Lanczos/Arnoldi runs.
+
+    This is the honesty gate a Krylov inner solve owes the caller that
+    an exact splu factorization does not need: on a SINGULAR (A - sigma
+    I) the iterative solve converges to a pseudo-inverse apply whose
+    Ritz pairs are genuine eigenpairs of A — they pass every residual
+    test — while silently MISSING the null-space eigenvalue nearest
+    sigma (found empirically: eigsh(diag(0..n), which='SM') returned
+    [1, 2], not [0, 1]).  A stagnated probe residual is the observable
+    signature; raise ``ArpackNoConvergence`` so sigma callers surface
+    it and the SM route falls back to host ARPACK's direct mode."""
+    rng = np.random.default_rng(20260801)
+    v = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    v = v / jnp.linalg.norm(v)
+    x = solve(v)
+    res = float(jnp.linalg.norm(
+        matvec(x) - jnp.asarray(sigma, dtype=dtype) * x - v))
+    if res > 100.0 * inner_atol:
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        raise ArpackNoConvergence(
+            f"shift-invert {name}: inner solve of (A - sigma I)x = v "
+            f"stagnated at residual {res:.2e} (target {inner_atol:.2e})"
+            f" — (A - sigma I) is singular or too ill-conditioned for "
+            f"the iterative inner solver; move sigma or use the host "
+            f"path", np.empty(0), np.empty((n, 0)),
+        )
 
 
 def _check_original_residuals(matvec, lam, X, atol, name):
@@ -344,13 +380,19 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     scipy/ARPACK uses a host ``splu`` factorization.  Per scipy
     semantics ``which`` then refers to the TRANSFORMED eigenvalues
     ``nu = 1/(lambda - sigma)`` (LM = closest to sigma) and results
-    transform back via ``lambda = sigma + 1/nu``.  Generalized (``M``)
-    problems and non-'normal' modes delegate to host scipy/ARPACK.
-    Delegated calls convert operands at the boundary and return scipy's
-    results unchanged."""
+    transform back via ``lambda = sigma + 1/nu``.  ``which='SM'``
+    without sigma routes through the same machinery at sigma=0 (the
+    classic trick — scipy documents it as the recommended alternative
+    to its slow direct-SM mode), falling back to host ARPACK when the
+    inexact inverse cannot converge (e.g. singular A).  Generalized
+    (``M``) problems and non-'normal' modes delegate to host
+    scipy/ARPACK.  Delegated calls convert operands at the boundary
+    and return scipy's results unchanged."""
     mode = kwargs.pop("mode", "normal")
     native_which = ("LM", "LA", "SA")
-    if (M is not None or which not in native_which or kwargs
+    sm_native = which == "SM" and sigma is None and M is None and not kwargs
+    if not sm_native and (
+            M is not None or which not in native_which or kwargs
             or (sigma is not None and mode != "normal")):
         return _host_fallback("eigsh")(
             A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
@@ -361,6 +403,20 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise ValueError("expected square matrix")
     if not (0 < k < n_cols):
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
+    if sm_native:
+        # Smallest-magnitude = largest of A^{-1}: shift-invert at 0.
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        try:
+            return _eigsh_shift_invert(
+                matvec, n_cols, dtype, int(k), 0.0, "LM", v0, ncv,
+                maxiter, tol, return_eigenvectors)
+        except ArpackNoConvergence:
+            # Inexact inverse stagnated (singular / near-singular A):
+            # host ARPACK's direct-SM Lanczos handles those.
+            return _host_fallback("eigsh")(
+                A, k=k, which="SM", v0=v0, ncv=ncv, maxiter=maxiter,
+                tol=tol, return_eigenvectors=return_eigenvectors)
     if sigma is None:
         return _lanczos_eigsh(matvec, n_cols, dtype, int(k), which, v0,
                               ncv, maxiter, tol, return_eigenvectors)
@@ -372,10 +428,21 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise TypeError(
             "eigsh sigma must be a real number, not complex"
         )
+    return _eigsh_shift_invert(matvec, n_cols, dtype, int(k),
+                               float(sigma), which, v0, ncv, maxiter,
+                               tol, return_eigenvectors)
+
+
+def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
+                        ncv, maxiter, tol, return_eigenvectors):
+    """Native shift-invert eigsh body (see ``eigsh``): Lanczos on
+    ``OP = (A - sigma I)^{-1}`` with the inexact MINRES inner apply."""
     rdtype = np.dtype(np.finfo(dtype).dtype)
     atol_outer = _outer_atol(tol, rdtype)
-    op = _shift_invert_op(matvec, float(sigma), dtype, n_cols,
-                          atol_outer, sym=True)
+    op, inner_atol = _shift_invert_op(matvec, float(sigma), dtype,
+                                      n_cols, atol_outer, sym=True)
+    _probe_inverse(matvec, op, float(sigma), dtype, n_cols, inner_atol,
+                   "eigsh")
     # Always form X: the original-spectrum residual check below is what
     # catches a silently-stagnated INNER solve (sigma too close to an
     # eigenvalue) — the outer Ritz test alone only measures convergence
@@ -640,9 +707,22 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     (``_shift_invert_op``) nested in the same scan, where scipy/ARPACK
     factorizes on host.  Per scipy semantics ``which`` then refers to
     the transformed ``nu = 1/(lambda - sigma)``; results transform back
-    via ``lambda = sigma + 1/nu``.  Generalized (``M``) and SM
-    delegate to host scipy/ARPACK.  Eigenvalues return complex, like
-    scipy."""
+    via ``lambda = sigma + 1/nu``.  ``which='SM'`` without sigma routes
+    through the same shift-invert at sigma=0 (largest of A^{-1}),
+    falling back to host ARPACK if the inexact inverse stagnates.
+    Generalized (``M``) delegates to host scipy/ARPACK.  Eigenvalues
+    return complex, like scipy."""
+    if which == "SM" and sigma is None and M is None and not kwargs:
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        try:
+            return _eigs_shift_invert(A, int(k), complex(0.0), "LM",
+                                      v0, ncv, maxiter, tol,
+                                      return_eigenvectors)
+        except ArpackNoConvergence:
+            return _host_fallback("eigs")(
+                A, k=k, which="SM", v0=v0, ncv=ncv, maxiter=maxiter,
+                tol=tol, return_eigenvectors=return_eigenvectors)
     if (M is not None
             or which not in ("LM", "LR", "SR", "LI", "SI") or kwargs):
         return _host_fallback("eigs")(
@@ -752,8 +832,10 @@ def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
                if np.issubdtype(base_dtype, np.complexfloating)
                else float(sigma.real))
     atol_outer = _outer_atol(tol, rdtype)
-    op = _shift_invert_op(base_mv, sig_val, base_dtype, n,
-                          atol_outer, sym=False)
+    op, inner_atol = _shift_invert_op(base_mv, sig_val, base_dtype, n,
+                                      atol_outer, sym=False)
+    _probe_inverse(base_mv, op, sig_val, base_dtype, n, inner_atol,
+                   "eigs")
     if v0 is None:
         v0 = np.random.default_rng(0).standard_normal(n)
     v0 = jnp.asarray(v0, dtype=base_dtype)

