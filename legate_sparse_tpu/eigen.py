@@ -39,9 +39,16 @@ sigma=0 — with a probe solve that detects a singular/ill-conditioned
 operator up front and falls back to host ARPACK's direct mode (an
 inexact inverse would otherwise silently drop null-space eigenvalues).
 
-Remaining host-fallback corners: generalized problems (``M``/``B``),
-preconditioned/constrained lobpcg, ``which='BE'``, complex lobpcg past
-32k rows, and non-``normal`` shift-invert modes.
+Generalized symmetric pencils run natively too: ``eigsh(A, M=M)`` and
+``lobpcg(A, X, B=B)`` (M/B SPD) use an M-inner-product Lanczos whose
+basis recurrence, inner ``M^{-1}`` CG solves and
+M-reorthogonalization compile as one ``lax.scan`` program
+(``_lanczos_general`` — ARPACK mode 2's device rendition), guarded by
+an M-solve probe and a pencil-residual acceptance test.
+
+Remaining host-fallback corners: ``sigma`` combined with ``M``,
+``which='BE'``, preconditioned/constrained lobpcg, complex lobpcg past
+32k rows, ``svds`` smallest, and non-``normal`` shift-invert modes.
 """
 
 from __future__ import annotations
@@ -162,9 +169,8 @@ def _shift_invert_op(matvec, sigma, dtype, n, outer_atol, sym: bool):
     from .linalg import _bicgstab_loop
 
     rdtype = jnp.finfo(jnp.dtype(dtype)).dtype
-    eps = float(np.finfo(np.dtype(rdtype)).eps)
-    inner_atol = max(1e-2 * float(outer_atol), 50.0 * eps)
-    inner_maxiter = int(min(10 * n + 20, 100_000))
+    inner_atol, inner_maxiter = _inner_solver_params(outer_atol, rdtype,
+                                                     n)
     shift = jnp.asarray(sigma, dtype=dtype)
     ident = lambda r: r  # noqa: E731
 
@@ -251,6 +257,191 @@ def _check_original_residuals(matvec, lam, X, atol, name):
 
 
 # ---------------------------------------------------------------- Lanczos
+
+
+def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int):
+    """m-step M-inner-product Lanczos for the generalized symmetric
+    problem ``A x = lambda M x`` (M SPD) — ARPACK mode 2 re-designed
+    for the device: the basis recurrence, the inner ``M^{-1}`` CG
+    solves, and the full M-reorthogonalization all live in ONE
+    ``lax.scan`` (one compiled program, no per-step dispatch).
+
+    Returns (V, alphas, betas): V has M-orthonormal rows
+    (``V M V^H = I``) and T = tridiag(betas[1:], alphas, betas[1:])
+    holds the Ritz approximation of the PENCIL's spectrum.
+    """
+    n = v0.shape[0]
+    dtype = v0.dtype
+    rdtype = jnp.finfo(dtype).dtype
+    eps = jnp.finfo(rdtype).eps
+    key0 = jax.random.PRNGKey(23)
+
+    def m_reorth(V, w):
+        # w -= V^T <V, w>_M, applied twice (classical GS, Parlett).
+        for _ in range(2):
+            q = matvec_m(w)
+            w = w - V.T @ (jnp.conj(V) @ q)
+        return w
+
+    def m_normalize(w):
+        nrm = jnp.sqrt(jnp.maximum(
+            jnp.real(jnp.vdot(w, matvec_m(w))), 0)).astype(rdtype)
+        return w / jnp.where(nrm == 0, 1.0, nrm).astype(dtype), nrm
+
+    def step(carry, j):
+        V, v, beta, v_prev = carry
+        av = matvec_a(v)
+        w = solve_m(av)                       # M^{-1} A v
+        alpha = jnp.real(jnp.vdot(v, av)).astype(dtype)  # <v, Av>
+        w = w - alpha * v - beta * v_prev
+        V = V.at[j].set(v)
+        w = m_reorth(V, w)
+        w, beta_next = m_normalize(w)
+        broke = beta_next <= 100 * eps * jnp.maximum(
+            jnp.abs(jnp.real(alpha)), 1.0)
+        fresh = jax.random.normal(
+            jax.random.fold_in(key0, j), (n,), rdtype).astype(dtype)
+        fresh = m_reorth(V, fresh)
+        fresh, _ = m_normalize(fresh)
+        beta_out = jnp.where(broke, jnp.zeros((), rdtype), beta_next)
+        v_next = jnp.where(broke, fresh, w)
+        return (V, v_next, beta_out.astype(dtype), v), (
+            alpha, beta_out.astype(dtype))
+
+    V0 = jnp.zeros((m, n), dtype=dtype)
+    (V, _, _, _), (alphas, betas) = jax.lax.scan(
+        step, (V0, v0, jnp.zeros((), dtype), jnp.zeros_like(v0)),
+        jnp.arange(m))
+    return V, alphas, betas
+
+
+def _inner_solver_params(outer_atol: float, rdtype, n: int):
+    """Shared inner-Krylov sizing for every inexact-inverse path
+    (shift-invert, generalized pencil): (absolute atol for a UNIT-NORM
+    rhs, iteration cap)."""
+    eps = float(np.finfo(np.dtype(rdtype)).eps)
+    return (max(1e-2 * float(outer_atol), 50.0 * eps),
+            int(min(10 * n + 20, 100_000)))
+
+
+def _select_sym_ritz(w, y, k: int, which: str):
+    """Shared LA/SA/LM Ritz selection for the symmetric drivers
+    (ascending-eigenvalue output order, scipy convention)."""
+    if which == "LA":
+        sel = np.argsort(w)[-k:]
+    elif which == "SA":
+        sel = np.argsort(w)[:k]
+    else:  # LM
+        sel = np.argsort(np.abs(w))[-k:]
+    sel = sel[np.argsort(w[sel])]
+    return w[sel], y[:, sel]
+
+
+def _eigsh_generalized(matvec_a, matvec_m, n, dtype, k, which, v0, ncv,
+                       maxiter, tol, return_eigenvectors,
+                       max_rank=None):
+    """Native generalized ``eigsh(A, M=M)``: M-Lanczos driver with the
+    same host-side escalation/selection as ``_lanczos_eigsh``.
+    ``max_rank`` bounds the escalated basis (the lobpcg-B route passes
+    its O(max(8k,128)) memory cap)."""
+    import scipy.linalg as _sl
+
+    rdtype = np.dtype(np.finfo(dtype).dtype)
+    atol_outer = _outer_atol(tol, rdtype)
+    inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
+                                                    n)
+    from .linalg import _cg_loop, maybe_jit
+
+    ident = lambda r: r  # noqa: E731
+
+    def solve_m(b):
+        # The rhs here is A v with norm ~||A||, NOT unit-norm like the
+        # shift-invert recurrences' operands — normalize so the inner
+        # tolerance is RELATIVE (a small-norm pencil would otherwise
+        # converge to garbage digits silently; found by review with a
+        # 1e-6-scaled operator repro).
+        nrm = jnp.linalg.norm(b)
+        safe = jnp.where(nrm == 0, 1.0, nrm).astype(b.dtype)
+        x, _ = _cg_loop(matvec_m, ident, b / safe, jnp.zeros_like(b),
+                        inner_atol, inner_maxiter, 10)
+        return x * safe
+
+    # Probe: M must be solvable to the inner tolerance (SPD and
+    # nonsingular), else the whole pencil transform is untrustworthy.
+    rng = np.random.default_rng(20260801)
+    vp = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    vp = vp / jnp.linalg.norm(vp)
+    xp = solve_m(vp)
+    res = float(jnp.linalg.norm(matvec_m(xp) - vp))
+    if not np.isfinite(res) or res > 100.0 * inner_atol:
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        raise ArpackNoConvergence(
+            f"generalized eigsh: inner CG on M stagnated at residual "
+            f"{res:.2e} (target {inner_atol:.2e}) — M must be SPD and "
+            f"well-conditioned for the native route",
+            np.empty(0), np.empty((n, 0)))
+
+    if v0 is None:
+        v0 = rng.standard_normal(n)
+    v0 = jnp.asarray(v0, dtype=dtype)
+    # M-normalize the start.
+    mnrm = float(np.sqrt(max(
+        float(jnp.real(jnp.vdot(v0, matvec_m(v0)))), 1e-300)))
+    v0 = v0 / v0.dtype.type(mnrm)
+
+    lanczos = maybe_jit(_lanczos_general,
+                        static_argnums=(0, 1, 2),
+                        static_argnames=("m",))
+    rank = int(max_rank) if max_rank is not None else n
+    atol, m, tries = _escalation_params(tol, rdtype, ncv, k, rank,
+                                        maxiter)
+    for try_i in range(tries):
+        if try_i:
+            m = min(rank, 2 * m)
+        V, alphas, betas = lanczos(matvec_a, matvec_m, solve_m, v0, m=m)
+        a = np.real(np.asarray(alphas)).astype(np.float64)
+        b_all = np.real(np.asarray(betas)).astype(np.float64)
+        b = b_all[:-1]
+        beta_last = b_all[-1]
+        w, y = _sl.eigh_tridiagonal(a, b)
+        w_k, y_k = _select_sym_ritz(w, y, k, which)
+        resid = np.abs(beta_last) * np.abs(y_k[-1, :])
+        # Relative scale with a SPECTRUM-magnitude floor (not the
+        # absolute 1.0 of the standard driver): a pencil scaled by
+        # 1e-6 must get 1e-6-scaled acceptance, else inexact digits
+        # pass silently.
+        floor = max(float(np.max(np.abs(w))), np.finfo(rdtype).tiny)
+        scale = np.maximum(np.abs(w_k), floor)
+        if np.all(resid <= atol * scale) or m >= rank:
+            break
+    w_k = w_k.astype(rdtype)
+    X = np.asarray(jnp.einsum(
+        "mn,mk->nk", V, jnp.asarray(y_k, dtype=dtype)))
+    # Original-PENCIL residual guard (the inexact-inner honesty test,
+    # as in the shift-invert paths): ||A x - lambda M x|| judged
+    # RELATIVE to the pencil's own magnitude per pair.
+    AX = np.asarray(jax.vmap(matvec_a, in_axes=1, out_axes=1)(
+        jnp.asarray(X)))
+    MX = np.asarray(jax.vmap(matvec_m, in_axes=1, out_axes=1)(
+        jnp.asarray(X)))
+    res_p = np.linalg.norm(AX - MX * w_k[None, :], axis=0)
+    denom = np.maximum.reduce([
+        np.linalg.norm(AX, axis=0),
+        np.abs(w_k) * np.linalg.norm(MX, axis=0),
+        np.full(res_p.shape, np.finfo(rdtype).tiny),
+    ])
+    ok = res_p / denom <= 50.0 * atol_outer
+    if not bool(np.all(ok)):
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        raise ArpackNoConvergence(
+            f"generalized eigsh: {int(ok.sum())}/{ok.size} pairs pass "
+            f"the pencil residual test", w_k[ok], X[:, ok])
+    _require_converged(resid, atol, scale, m, rank, w_k, X)
+    if not return_eigenvectors:
+        return w_k
+    return w_k, X
 
 
 def _lanczos(matvec, v0, mask, m: int):
@@ -340,16 +531,8 @@ def _lanczos_eigsh(matvec, n, dtype, k, which, v0, ncv, maxiter, tol,
         b = b_all[:-1]            # off-diagonal of T
         beta_last = b_all[-1]     # final recurrence beta: residual term
         w, y = _sl.eigh_tridiagonal(a, b)
-        # Select k per `which` from the Ritz values.
-        if which == "LA":
-            sel = np.argsort(w)[-k:]
-        elif which == "SA":
-            sel = np.argsort(w)[:k]
-        else:  # LM
-            sel = np.argsort(np.abs(w))[-k:]
-        sel = sel[np.argsort(w[sel])]   # scipy returns ascending
-        w_k = w[sel]
-        y_k = y[:, sel]
+        # Select k per `which` from the Ritz values (ascending, scipy).
+        w_k, y_k = _select_sym_ritz(w, y, k, which)
         # Ritz residual bound: |beta_{m+1} * e_m^T y_i| — the *final*
         # recurrence beta, not T's last off-diagonal.
         resid = np.abs(beta_last) * np.abs(y_k[-1, :])
@@ -385,13 +568,18 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     classic trick — scipy documents it as the recommended alternative
     to its slow direct-SM mode), falling back to host ARPACK when the
     inexact inverse cannot converge (e.g. singular A).  Generalized
-    (``M``) problems and non-'normal' modes delegate to host
+    pencils ``A x = lambda M x`` (SPD M, no sigma) run natively too —
+    M-inner-product Lanczos with a jitted inner CG for ``M^{-1}``
+    (``_eigsh_generalized``), host fallback when the M-solve probe
+    stagnates.  sigma WITH M, and non-'normal' modes, delegate to host
     scipy/ARPACK.  Delegated calls convert operands at the boundary
     and return scipy's results unchanged."""
     mode = kwargs.pop("mode", "normal")
     native_which = ("LM", "LA", "SA")
     sm_native = which == "SM" and sigma is None and M is None and not kwargs
-    if not sm_native and (
+    gen_native = (M is not None and sigma is None and mode == "normal"
+                  and which in native_which and not kwargs)
+    if not sm_native and not gen_native and (
             M is not None or which not in native_which or kwargs
             or (sigma is not None and mode != "normal")):
         return _host_fallback("eigsh")(
@@ -403,6 +591,28 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise ValueError("expected square matrix")
     if not (0 < k < n_cols):
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
+    if gen_native:
+        # Generalized pencil A x = lambda M x (M SPD): native M-inner
+        # Lanczos with a jitted inner CG for M^{-1} (ARPACK mode 2's
+        # device rendition; scipy factorizes M on host).  A stagnating
+        # M-solve probe (non-SPD / ill-conditioned M) falls back to
+        # host ARPACK.
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        mv_m, mr, mc, mdtype = _operator_parts(M)
+        if (mr, mc) != (n_cols, n_cols):
+            raise ValueError(
+                f"M has shape {(mr, mc)}, expected {(n_cols, n_cols)}")
+        pdtype = np.promote_types(dtype, mdtype)
+        try:
+            return _eigsh_generalized(
+                matvec, mv_m, n_cols, np.dtype(pdtype), int(k), which,
+                v0, ncv, maxiter, tol, return_eigenvectors)
+        except ArpackNoConvergence:
+            return _host_fallback("eigsh")(
+                A, k=k, M=M, which=which, v0=v0, ncv=ncv,
+                maxiter=maxiter, tol=tol,
+                return_eigenvectors=return_eigenvectors)
     if sm_native:
         # Smallest-magnitude = largest of A^{-1}: shift-invert at 0.
         from scipy.sparse.linalg import ArpackNoConvergence
@@ -486,9 +696,39 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
 
     Standard problem (no B/M/Y): runs fully on device via
     ``jax.experimental.sparse.linalg.lobpcg_standard``; smallest
-    eigenvalues come from the negated operator.  Generalized /
+    eigenvalues come from the negated operator.  Generalized ``B``
+    (SPD) runs through the native M-inner Lanczos machinery
+    (``_eigsh_generalized``) at lobpcg-class sizes, falling back to
+    host scipy when B's inner CG stagnates or past 32k rows;
     preconditioned / constrained forms delegate to host scipy.
     """
+    if (B is not None and M is None and Y is None and not kwargs
+            and np.asarray(X).shape[0] <= (1 << 15)):
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        Xa = np.asarray(X)
+        mv_a, ar, ac, adt = _operator_parts(A)
+        mv_b, br, bc, bdt = _operator_parts(B)
+        if ar != ac or (br, bc) != (ar, ac):
+            raise ValueError("A and B must be square and conformal")
+        if Xa.ndim != 2 or Xa.shape[0] != ac:
+            raise ValueError(f"X must be (n, k) with n={ac}")
+        kb = Xa.shape[1]
+        cap_b = min(ac, max(8 * kb, 128))
+        tries_b = max(1, min(int(maxiter) if maxiter is not None
+                             else 6, 10))
+        try:
+            w, V = _eigsh_generalized(
+                mv_a, mv_b, ac, np.dtype(np.promote_types(adt, bdt)),
+                kb, "LA" if largest else "SA", Xa[:, 0],
+                None, tries_b, (tol if tol else 0), True,
+                max_rank=cap_b)
+            order = (np.argsort(w)[::-1] if largest
+                     else np.argsort(w))
+            return np.asarray(w)[order], np.asarray(V)[:, order]
+        except ArpackNoConvergence:
+            return _host_fallback("lobpcg")(
+                A, Xa, B=B, tol=tol, maxiter=maxiter, largest=largest)
     if B is not None or M is not None or Y is not None or kwargs:
         return _host_fallback("lobpcg")(
             A, X, B=B, M=M, Y=Y, tol=tol, maxiter=maxiter,

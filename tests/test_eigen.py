@@ -272,13 +272,122 @@ def test_eigsh_complex_sigma_raises_like_scipy():
 
 
 def test_eigsh_sigma_generalized_still_falls_back():
-    # M (generalized) keeps the host boundary — only plain shift-invert
-    # went native.
+    # sigma AND M together keep the host boundary — only the plain
+    # generalized pencil went native.
     A_sp, A = _lap1d(40)
     M_sp = sp.eye(40).tocsr() * 2.0
     w, _ = linalg.eigsh(A, k=2, sigma=1.0, M=sparse.csr_array(M_sp))
     w_ref = ssl.eigsh(A_sp, k=2, sigma=1.0, M=M_sp,
                       return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
+
+
+def _mass_matrix(n, dtype=np.float64):
+    # SPD tridiagonal mass matrix (FEM-style), strictly diagonally
+    # dominant so the inner CG converges fast.
+    return sp.diags([np.full(n - 1, 1.0), np.full(n, 4.0),
+                     np.full(n - 1, 1.0)], [-1, 0, 1],
+                    format="csr").astype(dtype) / 6.0
+
+
+@pytest.mark.parametrize("which", ["LA", "SA", "LM"])
+def test_eigsh_generalized_native_matches_scipy(monkeypatch, which):
+    _no_fallback(monkeypatch)
+    n = 80
+    A_sp, A = _lap1d(n)
+    M_sp = _mass_matrix(n)
+    w, v = linalg.eigsh(A, k=3, M=sparse.csr_array(M_sp), which=which)
+    w_ref = ssl.eigsh(A_sp, k=3, M=M_sp, which=which,
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
+    # Pencil residuals + M-orthonormality of the returned vectors.
+    resid = np.linalg.norm(
+        A_sp @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+    gram = v.T @ (M_sp @ v)
+    np.testing.assert_allclose(gram, np.eye(3), atol=1e-7)
+
+
+def test_eigsh_generalized_complex_hermitian(monkeypatch):
+    _no_fallback(monkeypatch)
+    n = 64
+    A_sp, _ = _lap1d(n)
+    H = (A_sp.astype(np.complex128)
+         + 1j * sp.diags([np.full(n - 1, 0.3)], [1])
+         - 1j * sp.diags([np.full(n - 1, 0.3)], [-1])).tocsr()
+    M_sp = _mass_matrix(n)
+    w, v = linalg.eigsh(sparse.csr_array(H), k=2,
+                        M=sparse.csr_array(M_sp), which="LA")
+    w_ref = ssl.eigsh(H, k=2, M=M_sp, which="LA",
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
+    resid = np.linalg.norm(
+        H @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+
+
+@pytest.mark.parametrize("largest", [True, False])
+def test_lobpcg_generalized_native(monkeypatch, largest):
+    _no_fallback(monkeypatch)
+    n = 72
+    A_sp, A = _lap1d(n)
+    B_sp = _mass_matrix(n)
+    X = np.random.default_rng(6).standard_normal((n, 3))
+    w, U = linalg.lobpcg(A, X, B=sparse.csr_array(B_sp),
+                         largest=largest)
+    which = "LA" if largest else "SA"
+    w_ref = ssl.eigsh(A_sp, k=3, M=B_sp, which=which,
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-6)
+    resid = np.linalg.norm(
+        A_sp @ U - (B_sp @ U) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+
+
+def test_eigsh_generalized_small_norm_pencil_precise(monkeypatch):
+    # Code-review repro: a 1e-6-scaled operator must NOT lose digits to
+    # an absolute inner tolerance (the rhs of the M-solve has norm
+    # ~||A||; the fix normalizes it so the tolerance is relative).
+    _no_fallback(monkeypatch)
+    import scipy.linalg as sl
+
+    n = 200
+    A_sp, _ = _lap1d(n)
+    A_small = (A_sp * 1e-6).tocsr()
+    M_sp = _mass_matrix(n)
+    w, _ = linalg.eigsh(sparse.csr_array(A_small), k=3,
+                        M=sparse.csr_array(M_sp), which="SA")
+    w_dense = sl.eigh(A_small.toarray(), M_sp.toarray(),
+                      eigvals_only=True)[:3]
+    np.testing.assert_allclose(np.sort(w), w_dense, rtol=1e-7)
+
+
+def test_eigsh_generalized_bad_m_falls_back(monkeypatch):
+    # A stagnating M-solve (the native route's honesty probe) must fall
+    # back to the host boundary, not return silently wrong pairs.
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    from legate_sparse_tpu import eigen as eig_mod
+
+    used = []
+    real = eig_mod._host_fallback
+
+    def spy(name):
+        used.append(name)
+        return real(name)
+
+    def boom(*a, **kw):
+        raise ArpackNoConvergence("probe tripped", np.empty(0),
+                                  np.empty((40, 0)))
+
+    monkeypatch.setattr(eig_mod, "_host_fallback", spy)
+    monkeypatch.setattr(eig_mod, "_eigsh_generalized", boom)
+    A_sp, A = _lap1d(40)
+    M_sp = _mass_matrix(40)
+    w = linalg.eigsh(A, k=2, M=sparse.csr_array(M_sp),
+                     return_eigenvectors=False)
+    assert used == ["eigsh"]
+    w_ref = ssl.eigsh(A_sp, k=2, M=M_sp, return_eigenvectors=False)
     np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
 
 
