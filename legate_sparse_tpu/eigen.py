@@ -46,9 +46,9 @@ M-reorthogonalization compile as one ``lax.scan`` program
 (``_lanczos_general`` — ARPACK mode 2's device rendition), guarded by
 an M-solve probe and a pencil-residual acceptance test.
 
-Remaining host-fallback corners: ``sigma`` combined with ``M``,
-``which='BE'``, preconditioned/constrained lobpcg, complex lobpcg past
-32k rows, ``svds`` smallest, and non-``normal`` shift-invert modes.
+Remaining host-fallback corners: preconditioned/constrained lobpcg,
+complex lobpcg past 32k rows, ``svds`` smallest, and non-``normal``
+(buckling/cayley) shift-invert modes.
 """
 
 from __future__ import annotations
@@ -206,22 +206,9 @@ def _probe_inverse(matvec, solve, sigma, dtype, n, inner_atol, name):
     [1, 2], not [0, 1]).  A stagnated probe residual is the observable
     signature; raise ``ArpackNoConvergence`` so sigma callers surface
     it and the SM route falls back to host ARPACK's direct mode."""
-    rng = np.random.default_rng(20260801)
-    v = jnp.asarray(rng.standard_normal(n), dtype=dtype)
-    v = v / jnp.linalg.norm(v)
-    x = solve(v)
-    res = float(jnp.linalg.norm(
-        matvec(x) - jnp.asarray(sigma, dtype=dtype) * x - v))
-    if res > 100.0 * inner_atol:
-        from scipy.sparse.linalg import ArpackNoConvergence
-
-        raise ArpackNoConvergence(
-            f"shift-invert {name}: inner solve of (A - sigma I)x = v "
-            f"stagnated at residual {res:.2e} (target {inner_atol:.2e})"
-            f" — (A - sigma I) is singular or too ill-conditioned for "
-            f"the iterative inner solver; move sigma or use the host "
-            f"path", np.empty(0), np.empty((n, 0)),
-        )
+    shift = jnp.asarray(sigma, dtype=dtype)
+    _probe_apply(lambda x: matvec(x) - shift * x, solve, n, dtype,
+                 inner_atol, f"shift-invert {name}")
 
 
 def _check_original_residuals(matvec, lam, X, atol, name):
@@ -259,16 +246,23 @@ def _check_original_residuals(matvec, lam, X, atol, name):
 # ---------------------------------------------------------------- Lanczos
 
 
-def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int):
+def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int,
+                     si: bool = False):
     """m-step M-inner-product Lanczos for the generalized symmetric
-    problem ``A x = lambda M x`` (M SPD) — ARPACK mode 2 re-designed
-    for the device: the basis recurrence, the inner ``M^{-1}`` CG
+    problem ``A x = lambda M x`` (M SPD) — ARPACK modes 2 and 3
+    re-designed for the device: the basis recurrence, the inner Krylov
     solves, and the full M-reorthogonalization all live in ONE
     ``lax.scan`` (one compiled program, no per-step dispatch).
 
+    ``si=False`` (mode 2): the operator is ``M^{-1} A`` and ``solve_m``
+    solves with M.  ``si=True`` (mode 3, shift-invert): the operator is
+    ``(A - sigma M)^{-1} M`` and ``solve_m`` solves with the SHIFTED
+    pencil; T then approximates ``nu = 1/(lambda - sigma)``.
+
     Returns (V, alphas, betas): V has M-orthonormal rows
     (``V M V^H = I``) and T = tridiag(betas[1:], alphas, betas[1:])
-    holds the Ritz approximation of the PENCIL's spectrum.
+    holds the Ritz approximation of the operator's spectrum in the
+    M-inner product.
     """
     n = v0.shape[0]
     dtype = v0.dtype
@@ -290,9 +284,15 @@ def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int):
 
     def step(carry, j):
         V, v, beta, v_prev = carry
-        av = matvec_a(v)
-        w = solve_m(av)                       # M^{-1} A v
-        alpha = jnp.real(jnp.vdot(v, av)).astype(dtype)  # <v, Av>
+        if si:
+            mv = matvec_m(v)
+            w = solve_m(mv)                   # (A - sigma M)^{-1} M v
+            # <v, OP v>_M = (M v)^H w (M Hermitian).
+            alpha = jnp.real(jnp.vdot(mv, w)).astype(dtype)
+        else:
+            av = matvec_a(v)
+            w = solve_m(av)                   # M^{-1} A v
+            alpha = jnp.real(jnp.vdot(v, av)).astype(dtype)  # <v, Av>
         w = w - alpha * v - beta * v_prev
         V = V.at[j].set(v)
         w = m_reorth(V, w)
@@ -325,88 +325,98 @@ def _inner_solver_params(outer_atol: float, rdtype, n: int):
 
 
 def _select_sym_ritz(w, y, k: int, which: str):
-    """Shared LA/SA/LM Ritz selection for the symmetric drivers
+    """Shared LA/SA/LM/BE Ritz selection for the symmetric drivers
     (ascending-eigenvalue output order, scipy convention)."""
     if which == "LA":
         sel = np.argsort(w)[-k:]
     elif which == "SA":
         sel = np.argsort(w)[:k]
+    elif which == "BE":
+        # scipy: k/2 from each end, the extra one from the HIGH end.
+        lo = k // 2
+        order = np.argsort(w)
+        sel = np.concatenate([order[:lo], order[lo - k:]])
     else:  # LM
         sel = np.argsort(np.abs(w))[-k:]
     sel = sel[np.argsort(w[sel])]
     return w[sel], y[:, sel]
 
 
-def _eigsh_generalized(matvec_a, matvec_m, n, dtype, k, which, v0, ncv,
-                       maxiter, tol, return_eigenvectors,
-                       max_rank=None):
-    """Native generalized ``eigsh(A, M=M)``: M-Lanczos driver with the
-    same host-side escalation/selection as ``_lanczos_eigsh``.
-    ``max_rank`` bounds the escalated basis (the lobpcg-B route passes
-    its O(max(8k,128)) memory cap)."""
-    import scipy.linalg as _sl
+def _normalized_rhs_solver(solve_unit):
+    """Wrap a unit-rhs inner solver so its absolute tolerance applies
+    RELATIVE to each right-hand side's norm.  The generalized apply's
+    rhs is A v or M v with norm ~||A||/||M|| — NOT the unit norm of the
+    standard shift-invert recurrences' operands — so an absolute inner
+    tolerance would silently lose digits on small-norm pencils (found
+    by review with a 1e-6-scaled operator repro) and never be reachable
+    on large-norm ones."""
 
-    rdtype = np.dtype(np.finfo(dtype).dtype)
-    atol_outer = _outer_atol(tol, rdtype)
-    inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
-                                                    n)
-    from .linalg import _cg_loop, maybe_jit
-
-    ident = lambda r: r  # noqa: E731
-
-    def solve_m(b):
-        # The rhs here is A v with norm ~||A||, NOT unit-norm like the
-        # shift-invert recurrences' operands — normalize so the inner
-        # tolerance is RELATIVE (a small-norm pencil would otherwise
-        # converge to garbage digits silently; found by review with a
-        # 1e-6-scaled operator repro).
+    def solve(b):
         nrm = jnp.linalg.norm(b)
         safe = jnp.where(nrm == 0, 1.0, nrm).astype(b.dtype)
-        x, _ = _cg_loop(matvec_m, ident, b / safe, jnp.zeros_like(b),
-                        inner_atol, inner_maxiter, 10)
-        return x * safe
+        return solve_unit(b / safe) * safe
 
-    # Probe: M must be solvable to the inner tolerance (SPD and
-    # nonsingular), else the whole pencil transform is untrustworthy.
+    return solve
+
+
+def _probe_apply(apply_fn, solve, n, dtype, inner_atol, what):
+    """One explicit solve of ``apply_fn(x) = v`` with a TRUE residual
+    check before any recurrence runs — the honesty gate every inexact
+    inner solve owes its caller (see ``_probe_inverse``): a stagnating
+    probe means the operator is singular or too ill-conditioned for
+    the iterative inner solver, in which case silent pseudo-inverse
+    behavior would drop eigenvalues without failing any residual test.
+    Returns the probe RNG so callers draw consistent start vectors."""
     rng = np.random.default_rng(20260801)
-    vp = jnp.asarray(rng.standard_normal(n), dtype=dtype)
-    vp = vp / jnp.linalg.norm(vp)
-    xp = solve_m(vp)
-    res = float(jnp.linalg.norm(matvec_m(xp) - vp))
+    v = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    v = v / jnp.linalg.norm(v)
+    x = solve(v)
+    res = float(jnp.linalg.norm(apply_fn(x) - v))
     if not np.isfinite(res) or res > 100.0 * inner_atol:
         from scipy.sparse.linalg import ArpackNoConvergence
 
         raise ArpackNoConvergence(
-            f"generalized eigsh: inner CG on M stagnated at residual "
-            f"{res:.2e} (target {inner_atol:.2e}) — M must be SPD and "
-            f"well-conditioned for the native route",
+            f"{what}: inner solve stagnated at residual {res:.2e} "
+            f"(target {inner_atol:.2e}) — operator singular or too "
+            f"ill-conditioned for the iterative inner solver",
             np.empty(0), np.empty((n, 0)))
+    return rng
 
+
+def _m_normalized_start(v0, matvec_m, dtype, n, rng):
+    """Start vector for the M-inner recurrences, M-normalized."""
     if v0 is None:
         v0 = rng.standard_normal(n)
     v0 = jnp.asarray(v0, dtype=dtype)
-    # M-normalize the start.
     mnrm = float(np.sqrt(max(
         float(jnp.real(jnp.vdot(v0, matvec_m(v0)))), 1e-300)))
-    v0 = v0 / v0.dtype.type(mnrm)
+    return v0 / v0.dtype.type(mnrm)
 
-    lanczos = maybe_jit(_lanczos_general,
-                        static_argnums=(0, 1, 2),
-                        static_argnames=("m",))
-    rank = int(max_rank) if max_rank is not None else n
+
+def _general_lanczos_drive(matvec_a, matvec_m, solve, si, v0, k, which,
+                           ncv, maxiter, tol, rank, rdtype, dtype):
+    """Shared escalation loop for the generalized modes 2 and 3:
+    returns ``(w_k, X, resid, atol, scale, m)`` (w_k in the operator's
+    own spectrum — pencil eigenvalues for mode 2, transformed nu for
+    mode 3)."""
+    import scipy.linalg as _sl
+
+    from .linalg import maybe_jit
+
+    lanczos = maybe_jit(_lanczos_general, static_argnums=(0, 1, 2),
+                        static_argnames=("m", "si"))
     atol, m, tries = _escalation_params(tol, rdtype, ncv, k, rank,
                                         maxiter)
     for try_i in range(tries):
         if try_i:
             m = min(rank, 2 * m)
-        V, alphas, betas = lanczos(matvec_a, matvec_m, solve_m, v0, m=m)
+        V, alphas, betas = lanczos(matvec_a, matvec_m, solve, v0, m=m,
+                                   si=si)
         a = np.real(np.asarray(alphas)).astype(np.float64)
         b_all = np.real(np.asarray(betas)).astype(np.float64)
-        b = b_all[:-1]
-        beta_last = b_all[-1]
-        w, y = _sl.eigh_tridiagonal(a, b)
+        w, y = _sl.eigh_tridiagonal(a, b_all[:-1])
         w_k, y_k = _select_sym_ritz(w, y, k, which)
-        resid = np.abs(beta_last) * np.abs(y_k[-1, :])
+        resid = np.abs(b_all[-1]) * np.abs(y_k[-1, :])
         # Relative scale with a SPECTRUM-magnitude floor (not the
         # absolute 1.0 of the standard driver): a pencil scaled by
         # 1e-6 must get 1e-6-scaled acceptance, else inexact digits
@@ -415,12 +425,51 @@ def _eigsh_generalized(matvec_a, matvec_m, n, dtype, k, which, v0, ncv,
         scale = np.maximum(np.abs(w_k), floor)
         if np.all(resid <= atol * scale) or m >= rank:
             break
-    w_k = w_k.astype(rdtype)
     X = np.asarray(jnp.einsum(
         "mn,mk->nk", V, jnp.asarray(y_k, dtype=dtype)))
-    # Original-PENCIL residual guard (the inexact-inner honesty test,
-    # as in the shift-invert paths): ||A x - lambda M x|| judged
-    # RELATIVE to the pencil's own magnitude per pair.
+    return w_k, X, resid, atol, scale, m
+
+
+def _eigsh_generalized(matvec_a, matvec_m, n, dtype, k, which, v0, ncv,
+                       maxiter, tol, return_eigenvectors,
+                       max_rank=None):
+    """Native generalized ``eigsh(A, M=M)`` (ARPACK mode 2): M-inner
+    Lanczos on ``M^{-1} A`` with an inexact jitted inner CG solve.
+    ``max_rank`` bounds the escalated basis (the lobpcg-B route passes
+    its O(max(8k,128)) memory cap)."""
+    rdtype = np.dtype(np.finfo(dtype).dtype)
+    atol_outer = _outer_atol(tol, rdtype)
+    inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
+                                                    n)
+    from .linalg import _cg_loop
+
+    ident = lambda r: r  # noqa: E731
+    solve_m = _normalized_rhs_solver(
+        lambda b: _cg_loop(matvec_m, ident, b, jnp.zeros_like(b),
+                           inner_atol, inner_maxiter, 10)[0])
+    # Probe: M must be solvable to the inner tolerance (SPD and
+    # nonsingular), else the whole pencil transform is untrustworthy.
+    rng = _probe_apply(matvec_m, solve_m, n, dtype, inner_atol,
+                       "generalized eigsh")
+    v0 = _m_normalized_start(v0, matvec_m, dtype, n, rng)
+    rank = int(max_rank) if max_rank is not None else n
+    w_k, X, resid, atol, scale, m = _general_lanczos_drive(
+        matvec_a, matvec_m, solve_m, False, v0, k, which, ncv, maxiter,
+        tol, rank, rdtype, dtype)
+    w_k = w_k.astype(rdtype)
+    _pencil_residual_guard(matvec_a, matvec_m, w_k, X, atol_outer,
+                           rdtype)
+    _require_converged(resid, atol, scale, m, rank, w_k, X)
+    if not return_eigenvectors:
+        return w_k
+    return w_k, X
+
+
+def _pencil_residual_guard(matvec_a, matvec_m, w_k, X, atol_outer,
+                           rdtype):
+    """Original-PENCIL residual guard (the inexact-inner honesty test,
+    shared by modes 2 and 3): ``||A x - lambda M x||`` judged RELATIVE
+    to the pencil's own magnitude per pair."""
     AX = np.asarray(jax.vmap(matvec_a, in_axes=1, out_axes=1)(
         jnp.asarray(X)))
     MX = np.asarray(jax.vmap(matvec_m, in_axes=1, out_axes=1)(
@@ -438,10 +487,53 @@ def _eigsh_generalized(matvec_a, matvec_m, n, dtype, k, which, v0, ncv,
         raise ArpackNoConvergence(
             f"generalized eigsh: {int(ok.sum())}/{ok.size} pairs pass "
             f"the pencil residual test", w_k[ok], X[:, ok])
-    _require_converged(resid, atol, scale, m, rank, w_k, X)
+
+
+def _eigsh_generalized_si(matvec_a, matvec_m, sigma: float, n, dtype,
+                          k, which, v0, ncv, maxiter, tol,
+                          return_eigenvectors):
+    """Native generalized shift-invert (ARPACK mode 3):
+    M-inner-product Lanczos on ``OP = (A - sigma M)^{-1} M`` with an
+    inexact jitted MINRES inner solve of the (symmetric indefinite)
+    shifted pencil.  ``which`` applies to the transformed
+    ``nu = 1/(lambda - sigma)`` (scipy semantics); results transform
+    back and return ascending."""
+    from .krylov_extra import _minres_loop
+
+    rdtype = np.dtype(np.finfo(dtype).dtype)
+    atol_outer = _outer_atol(tol, rdtype)
+    inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
+                                                    n)
+    ident = lambda r: r  # noqa: E731
+    sig = jnp.asarray(sigma, dtype=dtype)
+
+    def shifted(x):
+        return matvec_a(x) - sig * matvec_m(x)
+
+    solve_si = _normalized_rhs_solver(
+        lambda b: _minres_loop(shifted, ident, b, jnp.zeros_like(b),
+                               jnp.zeros((), b.dtype), inner_atol,
+                               inner_maxiter, 10)[0])
+    # Probe the shifted solve (sigma on an eigenvalue of the pencil /
+    # hopeless conditioning -> fall back, never silently corrupt).
+    rng = _probe_apply(shifted, solve_si, n, dtype, inner_atol,
+                       "generalized shift-invert")
+    v0 = _m_normalized_start(v0, matvec_m, dtype, n, rng)
+    w_nu, X, resid, atol, scale, m = _general_lanczos_drive(
+        matvec_a, matvec_m, solve_si, True, v0, k, which, ncv, maxiter,
+        tol, n, rdtype, dtype)
+    nz = np.where(w_nu == 0, np.finfo(rdtype).tiny, w_nu)
+    lam = (float(sigma) + 1.0 / nz).astype(rdtype)
+    # Unconverged Ritz pairs raise (scipy parity) — BEFORE reordering,
+    # while resid/scale still align with lam's columns.
+    _require_converged(resid, atol, scale, m, n, lam, X)
+    order = np.argsort(lam)
+    lam, X = lam[order], X[:, order]
+    _pencil_residual_guard(matvec_a, matvec_m, lam, X, atol_outer,
+                           rdtype)
     if not return_eigenvectors:
-        return w_k
-    return w_k, X
+        return lam
+    return lam, X
 
 
 def _lanczos(matvec, v0, mask, m: int):
@@ -575,11 +667,14 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     scipy/ARPACK.  Delegated calls convert operands at the boundary
     and return scipy's results unchanged."""
     mode = kwargs.pop("mode", "normal")
-    native_which = ("LM", "LA", "SA")
+    native_which = ("LM", "LA", "SA", "BE")
     sm_native = which == "SM" and sigma is None and M is None and not kwargs
     gen_native = (M is not None and sigma is None and mode == "normal"
                   and which in native_which and not kwargs)
-    if not sm_native and not gen_native and (
+    gen_si_native = (M is not None and sigma is not None
+                     and mode == "normal" and which in native_which
+                     and not kwargs)
+    if not sm_native and not gen_native and not gen_si_native and (
             M is not None or which not in native_which or kwargs
             or (sigma is not None and mode != "normal")):
         return _host_fallback("eigsh")(
@@ -591,26 +686,41 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise ValueError("expected square matrix")
     if not (0 < k < n_cols):
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
-    if gen_native:
+    if which == "BE" and k < 2:
+        # scipy/ARPACK parity: NEV=1 with BE is info=-13; returning a
+        # single high-end value would silently alias which='LA'.
+        from scipy.sparse.linalg import ArpackError
+
+        raise ArpackError(
+            -13, {-13: "NEV and WHICH = 'BE' are incompatible."})
+    if gen_native or gen_si_native:
         # Generalized pencil A x = lambda M x (M SPD): native M-inner
-        # Lanczos with a jitted inner CG for M^{-1} (ARPACK mode 2's
-        # device rendition; scipy factorizes M on host).  A stagnating
-        # M-solve probe (non-SPD / ill-conditioned M) falls back to
-        # host ARPACK.
+        # Lanczos — mode 2 (M^{-1} A, inner CG on M) without sigma,
+        # mode 3 ((A - sigma M)^{-1} M, inner MINRES on the shifted
+        # pencil) with it; scipy factorizes on host for both.  A
+        # stagnating inner-solve probe falls back to host ARPACK.
         from scipy.sparse.linalg import ArpackNoConvergence
 
+        if gen_si_native and np.iscomplexobj(sigma):
+            raise TypeError(
+                "eigsh sigma must be a real number, not complex")
         mv_m, mr, mc, mdtype = _operator_parts(M)
         if (mr, mc) != (n_cols, n_cols):
             raise ValueError(
                 f"M has shape {(mr, mc)}, expected {(n_cols, n_cols)}")
         pdtype = np.promote_types(dtype, mdtype)
         try:
+            if gen_si_native:
+                return _eigsh_generalized_si(
+                    matvec, mv_m, float(sigma), n_cols,
+                    np.dtype(pdtype), int(k), which, v0, ncv, maxiter,
+                    tol, return_eigenvectors)
             return _eigsh_generalized(
                 matvec, mv_m, n_cols, np.dtype(pdtype), int(k), which,
                 v0, ncv, maxiter, tol, return_eigenvectors)
         except ArpackNoConvergence:
             return _host_fallback("eigsh")(
-                A, k=k, M=M, which=which, v0=v0, ncv=ncv,
+                A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
                 maxiter=maxiter, tol=tol,
                 return_eigenvectors=return_eigenvectors)
     if sm_native:
@@ -719,7 +829,8 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
                              else 6, 10))
         try:
             w, V = _eigsh_generalized(
-                mv_a, mv_b, ac, np.dtype(np.promote_types(adt, bdt)),
+                mv_a, mv_b, ac,
+                np.dtype(np.result_type(adt, bdt, Xa.dtype)),
                 kb, "LA" if largest else "SA", Xa[:, 0],
                 None, tries_b, (tol if tol else 0), True,
                 max_rank=cap_b)

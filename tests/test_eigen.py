@@ -271,15 +271,31 @@ def test_eigsh_complex_sigma_raises_like_scipy():
         linalg.eigsh(A, k=2, sigma=1.0 + 0j)
 
 
-def test_eigsh_sigma_generalized_still_falls_back():
-    # sigma AND M together keep the host boundary — only the plain
-    # generalized pencil went native.
+def test_eigsh_sigma_generalized_native(monkeypatch):
+    # sigma AND M together: native mode-3 (M-inner Lanczos on
+    # (A - sigma M)^{-1} M with an inexact MINRES inner solve).
+    _no_fallback(monkeypatch)
     A_sp, A = _lap1d(40)
     M_sp = sp.eye(40).tocsr() * 2.0
     w, _ = linalg.eigsh(A, k=2, sigma=1.0, M=sparse.csr_array(M_sp))
     w_ref = ssl.eigsh(A_sp, k=2, sigma=1.0, M=M_sp,
                       return_eigenvectors=False)
     np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
+
+
+def test_eigsh_sigma_generalized_mass_matrix(monkeypatch):
+    _no_fallback(monkeypatch)
+    n = 80
+    A_sp, A = _lap1d(n)
+    M_sp = _mass_matrix(n)
+    sigma = 3.1                  # interior shift of the pencil
+    w, v = linalg.eigsh(A, k=3, sigma=sigma, M=sparse.csr_array(M_sp))
+    w_ref = ssl.eigsh(A_sp, k=3, sigma=sigma, M=M_sp,
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
+    resid = np.linalg.norm(
+        A_sp @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
 
 
 def _mass_matrix(n, dtype=np.float64):
@@ -342,6 +358,36 @@ def test_lobpcg_generalized_native(monkeypatch, largest):
     resid = np.linalg.norm(
         A_sp @ U - (B_sp @ U) * np.asarray(w)[None, :], axis=0)
     assert np.all(resid < 1e-5)
+
+
+@pytest.mark.parametrize("k", [2, 3])
+def test_eigsh_be_native(monkeypatch, k):
+    # which='BE' (both ends): k/2 from each end, extra from the top.
+    _no_fallback(monkeypatch)
+    A_sp, A = _lap1d(90)
+    w = linalg.eigsh(A, k=k, which="BE", return_eigenvectors=False)
+    w_ref = ssl.eigsh(A_sp, k=k, which="BE", return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
+
+
+def test_eigsh_be_k1_raises_like_scipy():
+    from scipy.sparse.linalg import ArpackError
+
+    _, A = _lap1d(30)
+    with pytest.raises(ArpackError):
+        linalg.eigsh(A, k=1, which="BE")
+
+
+def test_eigsh_be_generalized(monkeypatch):
+    _no_fallback(monkeypatch)
+    n = 72
+    A_sp, A = _lap1d(n)
+    M_sp = _mass_matrix(n)
+    w = linalg.eigsh(A, k=3, M=sparse.csr_array(M_sp), which="BE",
+                     return_eigenvectors=False)
+    w_ref = ssl.eigsh(A_sp, k=3, M=M_sp, which="BE",
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
 
 
 def test_eigsh_generalized_small_norm_pencil_precise(monkeypatch):
