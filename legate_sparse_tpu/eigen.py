@@ -193,7 +193,8 @@ def _shift_invert_op(matvec, sigma, dtype, n, outer_atol, sym: bool):
     return solve, inner_atol
 
 
-def _probe_inverse(matvec, solve, sigma, dtype, n, inner_atol, name):
+def _probe_inverse(matvec, solve, sigma, dtype, n, inner_atol, name,
+                   mask=None):
     """One explicit (A - sigma I)x = v solve with a TRUE residual check
     before any Lanczos/Arnoldi runs.
 
@@ -208,7 +209,7 @@ def _probe_inverse(matvec, solve, sigma, dtype, n, inner_atol, name):
     it and the SM route falls back to host ARPACK's direct mode."""
     shift = jnp.asarray(sigma, dtype=dtype)
     _probe_apply(lambda x: matvec(x) - shift * x, solve, n, dtype,
-                 inner_atol, f"shift-invert {name}")
+                 inner_atol, f"shift-invert {name}", mask=mask)
 
 
 def _check_original_residuals(matvec, lam, X, atol, name):
@@ -325,12 +326,17 @@ def _inner_solver_params(outer_atol: float, rdtype, n: int):
 
 
 def _select_sym_ritz(w, y, k: int, which: str):
-    """Shared LA/SA/LM/BE Ritz selection for the symmetric drivers
-    (ascending-eigenvalue output order, scipy convention)."""
+    """Shared LA/SA/LM/SM/BE Ritz selection for the symmetric drivers
+    (ascending-eigenvalue output order, scipy convention).  Under
+    shift-invert the caller passes the TRANSFORMED spectrum, so SM
+    there means smallest |nu| = farthest from sigma — exactly ARPACK's
+    semantics."""
     if which == "LA":
         sel = np.argsort(w)[-k:]
     elif which == "SA":
         sel = np.argsort(w)[:k]
+    elif which == "SM":
+        sel = np.argsort(np.abs(w))[:k]
     elif which == "BE":
         # scipy: k/2 from each end, the extra one from the HIGH end.
         lo = k // 2
@@ -359,16 +365,22 @@ def _normalized_rhs_solver(solve_unit):
     return solve
 
 
-def _probe_apply(apply_fn, solve, n, dtype, inner_atol, what):
+def _probe_apply(apply_fn, solve, n, dtype, inner_atol, what,
+                 mask=None):
     """One explicit solve of ``apply_fn(x) = v`` with a TRUE residual
     check before any recurrence runs — the honesty gate every inexact
     inner solve owes its caller (see ``_probe_inverse``): a stagnating
     probe means the operator is singular or too ill-conditioned for
     the iterative inner solver, in which case silent pseudo-inverse
     behavior would drop eigenvalues without failing any residual test.
-    Returns the probe RNG so callers draw consistent start vectors."""
+    Returns the probe RNG so callers draw consistent start vectors.
+    ``mask`` restricts the probe to the valid subspace (distributed
+    padded operators: the padding block of A - sigma*I is singular at
+    sigma=0 by construction, which must not trip the gate)."""
     rng = np.random.default_rng(20260801)
     v = jnp.asarray(rng.standard_normal(n), dtype=dtype)
+    if mask is not None:
+        v = v * mask
     v = v / jnp.linalg.norm(v)
     x = solve(v)
     res = float(jnp.linalg.norm(apply_fn(x) - v))
@@ -667,7 +679,7 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     scipy/ARPACK.  Delegated calls convert operands at the boundary
     and return scipy's results unchanged."""
     mode = kwargs.pop("mode", "normal")
-    native_which = ("LM", "LA", "SA", "BE")
+    native_which = ("LM", "LA", "SA", "BE", "SM")
     sm_native = which == "SM" and sigma is None and M is None and not kwargs
     gen_native = (M is not None and sigma is None and mode == "normal"
                   and which in native_which and not kwargs)
@@ -754,15 +766,24 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
 
 
 def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
-                        ncv, maxiter, tol, return_eigenvectors):
+                        ncv, maxiter, tol, return_eigenvectors,
+                        mask=None, max_rank=None, name="eigsh",
+                        trunc_rows=None):
     """Native shift-invert eigsh body (see ``eigsh``): Lanczos on
-    ``OP = (A - sigma I)^{-1}`` with the inexact MINRES inner apply."""
+    ``OP = (A - sigma I)^{-1}`` with the inexact MINRES inner apply.
+
+    ``mask``/``max_rank``/``trunc_rows`` serve the DISTRIBUTED caller
+    (``dist_eigsh``): the probe and Krylov space stay in the valid
+    (non-padding) subspace, the Krylov dimension caps at the true row
+    count, and every returned/raised eigenvector block is truncated to
+    the true rows."""
     rdtype = np.dtype(np.finfo(dtype).dtype)
     atol_outer = _outer_atol(tol, rdtype)
     op, inner_atol = _shift_invert_op(matvec, float(sigma), dtype,
                                       n_cols, atol_outer, sym=True)
     _probe_inverse(matvec, op, float(sigma), dtype, n_cols, inner_atol,
-                   "eigsh")
+                   name, mask=mask)
+
     # Always form X: the original-spectrum residual check below is what
     # catches a silently-stagnated INNER solve (sigma too close to an
     # eigenvalue) — the outer Ritz test alone only measures convergence
@@ -771,12 +792,17 @@ def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
         nz = np.where(nu == 0, np.finfo(rdtype).tiny, nu)
         return (float(sigma) + 1.0 / nz).astype(rdtype)
 
+    def trunc(Xa):
+        Xa = np.asarray(Xa)
+        return Xa if trunc_rows is None else Xa[:trunc_rows]
+
+    from scipy.sparse.linalg import ArpackNoConvergence
+
     try:
         w_nu, X = _lanczos_eigsh(op, n_cols, dtype, int(k), which, v0,
-                                 ncv, maxiter, tol, True)
+                                 ncv, maxiter, tol, True, mask=mask,
+                                 max_rank=max_rank)
     except Exception as e:
-        from scipy.sparse.linalg import ArpackNoConvergence
-
         if not isinstance(e, ArpackNoConvergence):
             raise
         # The inner escalation raised with TRANSFORMED nu values;
@@ -785,16 +811,23 @@ def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
         # the eigs shift-invert path).
         raise ArpackNoConvergence(
             str(e), back_l(np.asarray(e.eigenvalues)),
-            np.asarray(e.eigenvectors),
+            trunc(e.eigenvectors),
         ) from None
     # nu = 1/(lambda - sigma): eigenvectors are shared with A.
     lam = back_l(w_nu)
     order = np.argsort(lam)                 # scipy returns ascending
     lam, X = lam[order], X[:, order]
-    _check_original_residuals(matvec, lam, X, atol_outer, "eigsh")
+    try:
+        _check_original_residuals(matvec, lam, X, atol_outer, name)
+    except ArpackNoConvergence as e:
+        if trunc_rows is None:
+            raise
+        raise ArpackNoConvergence(
+            str(e), np.asarray(e.eigenvalues), trunc(e.eigenvectors),
+        ) from None
     if not return_eigenvectors:
         return lam
-    return lam, X
+    return lam, trunc(X)
 
 
 # ---------------------------------------------------------------- LOBPCG

@@ -1236,7 +1236,8 @@ def dist_minres(A: DistCSR, b, x0=None, shift=0.0, tol=None,
 
 
 def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
-               maxiter=None, tol=0, return_eigenvectors=True):
+               maxiter=None, tol=0, return_eigenvectors=True,
+               sigma=None):
     """Distributed symmetric eigensolver: the single-chip Lanczos
     (``linalg.eigsh``) over the padded sharded operator.
 
@@ -1244,19 +1245,35 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
     padding rows/columns are zero — so the Krylov space stays in the
     orthogonal complement of the padding subspace and NO spurious zero
     eigenvalues appear.  All SpMVs and reductions inside the jitted
-    Lanczos scan lower to shard_map collectives.  Returns eigenvalues
-    (and row-truncated eigenvectors).  The reference has no eigensolver
-    at any scale."""
-    from ..eigen import _lanczos_eigsh
+    Lanczos scan lower to shard_map collectives.
+
+    ``sigma`` (and ``which='SM'``, served as sigma=0) runs the same
+    native shift-invert as single-chip ``eigsh``: the inexact MINRES
+    inner solve nests inside the Lanczos scan, so every inner iteration
+    is one ppermute/psum round over the mesh — no factorization, which
+    is what makes shift-invert possible at distributed scale at all.
+    A stagnating probe (sigma at a pencil eigenvalue, singular A at
+    SM) raises ``ArpackNoConvergence`` — there is no host fallback for
+    a distributed operator.  Returns eigenvalues (and row-truncated
+    eigenvectors).  The reference has no eigensolver at any scale."""
+    from ..eigen import _eigsh_shift_invert, _lanczos_eigsh
 
     rows = A.shape[0]
     if A.shape[0] != A.shape[1]:
         raise ValueError("expected square matrix")
     if not (0 < k < rows):
         raise ValueError(f"k={k} must satisfy 0 < k < n={rows}")
-    if which not in ("LM", "LA", "SA"):
+    if which not in ("LM", "LA", "SA", "BE", "SM"):
         raise ValueError(
-            f"which={which!r}: distributed eigsh supports LM/LA/SA")
+            f"which={which!r}: distributed eigsh supports "
+            f"LM/LA/SA/BE/SM")
+    if which == "BE" and k < 2:
+        from scipy.sparse.linalg import ArpackError
+
+        raise ArpackError(
+            -13, {-13: "NEV and WHICH = 'BE' are incompatible."})
+    if which == "SM" and sigma is None:
+        sigma, which = 0.0, "LM"    # largest of A^{-1}
     if v0 is None:
         v0 = np.random.default_rng(0).standard_normal(rows)
     v0_sh = shard_vector(jnp.asarray(v0, dtype=A.dtype), A.mesh,
@@ -1265,14 +1282,28 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
     # subspace; max_rank caps the Krylov dimension at the true rows.
     mask = shard_vector(jnp.ones((rows,), dtype=A.dtype), A.mesh,
                         A.rows_padded)
-    out = _lanczos_eigsh(
-        A.matvec_fn(), A.rows_padded, np.dtype(A.dtype), int(k), which,
-        v0_sh, ncv, maxiter, tol, return_eigenvectors,
-        mask=mask, max_rank=rows)
-    if not return_eigenvectors:
-        return out
-    w, X = out
-    return w, X[:rows]
+    if sigma is None:
+        out = _lanczos_eigsh(
+            A.matvec_fn(), A.rows_padded, np.dtype(A.dtype), int(k),
+            which, v0_sh, ncv, maxiter, tol, return_eigenvectors,
+            mask=mask, max_rank=rows)
+        if not return_eigenvectors:
+            return out
+        w, X = out
+        return w, X[:rows]
+
+    # Distributed shift-invert: the shared single-chip driver with the
+    # valid-subspace mask (the padding block of A - sigma I is
+    # -sigma I, singular at sigma=0 — it must not leak into the probe
+    # or the Krylov space), the true-rows rank cap, and row truncation
+    # applied to every returned/raised eigenvector block.
+    if np.iscomplexobj(sigma):
+        raise TypeError("eigsh sigma must be a real number, not complex")
+    return _eigsh_shift_invert(
+        A.matvec_fn(), A.rows_padded, np.dtype(A.dtype), int(k),
+        float(sigma), which, v0_sh, ncv, maxiter, tol,
+        return_eigenvectors, mask=mask, max_rank=rows,
+        name="dist_eigsh", trunc_rows=rows)
 
 
 def dist_diagonal(A: DistCSR) -> jax.Array:

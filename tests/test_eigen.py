@@ -217,6 +217,19 @@ def test_eigs_sm_native_no_fallback(monkeypatch):
                                np.sort(np.real(w_ref)), rtol=1e-6)
 
 
+def test_eigsh_sm_with_explicit_sigma_native(monkeypatch):
+    # scipy semantics: under shift-invert, SM refers to the TRANSFORMED
+    # spectrum — smallest |nu| = eigenvalues FARTHEST from sigma.
+    _no_fallback(monkeypatch)
+    A_sp, A = _lap1d(80)
+    sigma = 3.3
+    w = linalg.eigsh(A, k=2, sigma=sigma, which="SM",
+                     return_eigenvectors=False)
+    w_ref = ssl.eigsh(A_sp, k=2, sigma=sigma, which="SM",
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
+
+
 def test_eigsh_sm_singular_falls_back_to_host(monkeypatch):
     # Singular A: the probe solve detects the stagnating inexact
     # inverse (a pseudo-inverse apply would silently DROP the null
