@@ -46,9 +46,12 @@ M-reorthogonalization compile as one ``lax.scan`` program
 (``_lanczos_general`` — ARPACK mode 2's device rendition), guarded by
 an M-solve probe and a pencil-residual acceptance test.
 
+``svds(which='SM')`` runs the same shift-invert-at-0 machinery on the
+Gram operator.
+
 Remaining host-fallback corners: preconditioned/constrained lobpcg,
-complex lobpcg past 32k rows, ``svds`` smallest, and non-``normal``
-(buckling/cayley) shift-invert modes.
+complex lobpcg past 32k rows, and non-``normal`` (buckling/cayley)
+shift-invert modes.
 """
 
 from __future__ import annotations
@@ -116,6 +119,25 @@ def _outer_atol(tol, rdtype):
     """Default convergence tolerance (single source for the escalation
     drivers AND the shift-invert inner-solve sizing)."""
     return float(tol) if tol else float(np.finfo(rdtype).eps ** 0.5)
+
+
+def _validate_be_k(which, k):
+    """scipy/ARPACK parity shared by eigsh and dist_eigsh: NEV=1 with
+    BE is info=-13; returning a single high-end value would silently
+    alias which='LA'."""
+    if which == "BE" and k < 2:
+        from scipy.sparse.linalg import ArpackError
+
+        raise ArpackError(
+            -13, {-13: "NEV and WHICH = 'BE' are incompatible."})
+
+
+def _require_real_sigma(sigma):
+    """scipy parity: float(sigma) raises on ANY complex (even with a
+    zero imaginary part) — a Hermitian spectrum is real."""
+    if np.iscomplexobj(sigma):
+        raise TypeError(
+            "eigsh sigma must be a real number, not complex")
 
 
 def _escalation_params(tol, rdtype, ncv, k, rank, maxiter,
@@ -698,13 +720,7 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise ValueError("expected square matrix")
     if not (0 < k < n_cols):
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
-    if which == "BE" and k < 2:
-        # scipy/ARPACK parity: NEV=1 with BE is info=-13; returning a
-        # single high-end value would silently alias which='LA'.
-        from scipy.sparse.linalg import ArpackError
-
-        raise ArpackError(
-            -13, {-13: "NEV and WHICH = 'BE' are incompatible."})
+    _validate_be_k(which, k)
     if gen_native or gen_si_native:
         # Generalized pencil A x = lambda M x (M SPD): native M-inner
         # Lanczos — mode 2 (M^{-1} A, inner CG on M) without sigma,
@@ -713,14 +729,19 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         # stagnating inner-solve probe falls back to host ARPACK.
         from scipy.sparse.linalg import ArpackNoConvergence
 
-        if gen_si_native and np.iscomplexobj(sigma):
-            raise TypeError(
-                "eigsh sigma must be a real number, not complex")
+        if gen_si_native:
+            _require_real_sigma(sigma)
         mv_m, mr, mc, mdtype = _operator_parts(M)
         if (mr, mc) != (n_cols, n_cols):
             raise ValueError(
                 f"M has shape {(mr, mc)}, expected {(n_cols, n_cols)}")
         pdtype = np.promote_types(dtype, mdtype)
+        if not gen_si_native and which == "SM":
+            # Direct smallest-magnitude on a pencil is the hardest
+            # Krylov target; serve it as generalized shift-invert at 0
+            # (largest of (A - 0*M)^{-1} M = smallest |lambda|), the
+            # same remap as the standard SM route.
+            gen_si_native, sigma, which = True, 0.0, "LM"
         try:
             if gen_si_native:
                 return _eigsh_generalized_si(
@@ -754,12 +775,7 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
                               ncv, maxiter, tol, return_eigenvectors)
 
     # Native shift-invert: Lanczos on OP = (A - sigma I)^{-1}.
-    if np.iscomplexobj(sigma):
-        # scipy parity: float(sigma) raises on ANY complex (even with a
-        # zero imaginary part) — a Hermitian spectrum is real.
-        raise TypeError(
-            "eigsh sigma must be a real number, not complex"
-        )
+    _require_real_sigma(sigma)
     return _eigsh_shift_invert(matvec, n_cols, dtype, int(k),
                                float(sigma), which, v0, ncv, maxiter,
                                tol, return_eigenvectors)
@@ -964,10 +980,13 @@ def svds(A, k=6, ncv=None, tol=0, which="LM", v0=None, maxiter=None,
 
     Native path: Lanczos on the Gram operator ``v -> A^T (A v)`` (two
     SpMVs per step, A^T A never materialized), then ``U = A V / s``.
-    ``which='SM'`` (smallest) delegates to host scipy — smallest
-    singular values of a sparse operator need shift-invert to converge.
+    ``which='SM'`` (smallest) also runs natively — shift-invert at 0 on
+    the Gram operator (largest of (A^T A)^{-1}), the same machinery as
+    ``eigsh(which='SM')`` — falling back to host scipy when the
+    inexact inverse stagnates (rank-deficient A, or kappa(A)^2 beyond
+    the iterative inner solver).
     """
-    if which != "LM" or kwargs:
+    if which not in ("LM", "SM") or kwargs:
         return _host_fallback("svds")(
             A, k=k, ncv=ncv, tol=tol, which=which, v0=v0,
             maxiter=maxiter,
@@ -1004,8 +1023,30 @@ def svds(A, k=6, ncv=None, tol=0, which="LM", v0=None, maxiter=None,
         def gram(v):
             return AT @ (op.matvec(v))
 
-    w, V = _lanczos_eigsh(gram, int(n_cols), dtype, int(k), "LA", v0, ncv,
-                          maxiter, tol, True)
+    if which == "SM":
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        if m_rows < n_cols:
+            # Wide operator: rank(A^T A) <= m_rows < n_cols, so the
+            # Gram operator is singular BY CONSTRUCTION — the probe
+            # would burn a full MINRES budget just to discover it.
+            # Skip straight to the host path.
+            return _host_fallback("svds")(
+                A, k=k, ncv=ncv, tol=tol, which="SM", v0=v0,
+                maxiter=maxiter,
+                return_singular_vectors=return_singular_vectors)
+        try:
+            w, V = _eigsh_shift_invert(
+                gram, int(n_cols), dtype, int(k), 0.0, "LM", v0, ncv,
+                maxiter, tol, True, name="svds")
+        except ArpackNoConvergence:
+            return _host_fallback("svds")(
+                A, k=k, ncv=ncv, tol=tol, which="SM", v0=v0,
+                maxiter=maxiter,
+                return_singular_vectors=return_singular_vectors)
+    else:
+        w, V = _lanczos_eigsh(gram, int(n_cols), dtype, int(k), "LA",
+                              v0, ncv, maxiter, tol, True)
     s = np.sqrt(np.clip(w, 0.0, None))            # ascending (scipy order)
     if not return_singular_vectors:
         return s

@@ -1256,7 +1256,10 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
     SM) raises ``ArpackNoConvergence`` — there is no host fallback for
     a distributed operator.  Returns eigenvalues (and row-truncated
     eigenvectors).  The reference has no eigensolver at any scale."""
-    from ..eigen import _eigsh_shift_invert, _lanczos_eigsh
+    from ..eigen import (
+        _eigsh_shift_invert, _lanczos_eigsh, _require_real_sigma,
+        _validate_be_k,
+    )
 
     rows = A.shape[0]
     if A.shape[0] != A.shape[1]:
@@ -1267,11 +1270,7 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
         raise ValueError(
             f"which={which!r}: distributed eigsh supports "
             f"LM/LA/SA/BE/SM")
-    if which == "BE" and k < 2:
-        from scipy.sparse.linalg import ArpackError
-
-        raise ArpackError(
-            -13, {-13: "NEV and WHICH = 'BE' are incompatible."})
+    _validate_be_k(which, k)
     if which == "SM" and sigma is None:
         sigma, which = 0.0, "LM"    # largest of A^{-1}
     if v0 is None:
@@ -1297,8 +1296,7 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
     # -sigma I, singular at sigma=0 — it must not leak into the probe
     # or the Krylov space), the true-rows rank cap, and row truncation
     # applied to every returned/raised eigenvector block.
-    if np.iscomplexobj(sigma):
-        raise TypeError("eigsh sigma must be a real number, not complex")
+    _require_real_sigma(sigma)
     return _eigsh_shift_invert(
         A.matvec_fn(), A.rows_padded, np.dtype(A.dtype), int(k),
         float(sigma), which, v0_sh, ncv, maxiter, tol,
