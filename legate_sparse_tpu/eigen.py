@@ -47,11 +47,12 @@ M-reorthogonalization compile as one ``lax.scan`` program
 an M-solve probe and a pencil-residual acceptance test.
 
 ``svds(which='SM')`` runs the same shift-invert-at-0 machinery on the
-Gram operator.
+Gram operator, and the ``buckling``/``cayley`` shift-invert modes
+(ARPACK 4/5) run through the same B-inner Lanczos with their own
+inner-product matrices and back-transforms.
 
-Remaining host-fallback corners: preconditioned/constrained lobpcg,
-complex lobpcg past 32k rows, and non-``normal`` (buckling/cayley)
-shift-invert modes.
+Remaining host-fallback corners: preconditioned/constrained lobpcg
+and complex lobpcg past 32k rows.
 """
 
 from __future__ import annotations
@@ -270,22 +271,25 @@ def _check_original_residuals(matvec, lam, X, atol, name):
 
 
 def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int,
-                     si: bool = False):
-    """m-step M-inner-product Lanczos for the generalized symmetric
-    problem ``A x = lambda M x`` (M SPD) — ARPACK modes 2 and 3
-    re-designed for the device: the basis recurrence, the inner Krylov
-    solves, and the full M-reorthogonalization all live in ONE
-    ``lax.scan`` (one compiled program, no per-step dispatch).
+                     si: bool = False, rhs_fn=None):
+    """m-step B-inner-product Lanczos for the generalized symmetric
+    problem — ARPACK modes 2-5 re-designed for the device: the basis
+    recurrence, the inner Krylov solves, and the full
+    B-reorthogonalization all live in ONE ``lax.scan`` (one compiled
+    program, no per-step dispatch).  ``matvec_m`` is the inner-product
+    matrix B (M for modes 2/3/5, A for buckling).
 
     ``si=False`` (mode 2): the operator is ``M^{-1} A`` and ``solve_m``
-    solves with M.  ``si=True`` (mode 3, shift-invert): the operator is
-    ``(A - sigma M)^{-1} M`` and ``solve_m`` solves with the SHIFTED
-    pencil; T then approximates ``nu = 1/(lambda - sigma)``.
+    solves with M.  ``si=True`` (shift-invert family): the operator is
+    ``(A - sigma M)^{-1} rhs(v)`` where ``rhs_fn`` defaults to the
+    inner-product matvec (modes 3/4) or is ``(A + sigma M) v`` for
+    cayley (mode 5); T then approximates the mode's transformed
+    spectrum ``nu``.
 
-    Returns (V, alphas, betas): V has M-orthonormal rows
-    (``V M V^H = I``) and T = tridiag(betas[1:], alphas, betas[1:])
+    Returns (V, alphas, betas): V has B-orthonormal rows
+    (``V B V^H = I``) and T = tridiag(betas[1:], alphas, betas[1:])
     holds the Ritz approximation of the operator's spectrum in the
-    M-inner product.
+    B-inner product.
     """
     n = v0.shape[0]
     dtype = v0.dtype
@@ -309,8 +313,9 @@ def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int,
         V, v, beta, v_prev = carry
         if si:
             mv = matvec_m(v)
-            w = solve_m(mv)                   # (A - sigma M)^{-1} M v
-            # <v, OP v>_M = (M v)^H w (M Hermitian).
+            rhs = mv if rhs_fn is None else rhs_fn(v)
+            w = solve_m(rhs)                  # (A - sigma M)^{-1} rhs
+            # <v, OP v>_B = (B v)^H w (B Hermitian).
             alpha = jnp.real(jnp.vdot(mv, w)).astype(dtype)
         else:
             av = matvec_a(v)
@@ -428,24 +433,25 @@ def _m_normalized_start(v0, matvec_m, dtype, n, rng):
 
 
 def _general_lanczos_drive(matvec_a, matvec_m, solve, si, v0, k, which,
-                           ncv, maxiter, tol, rank, rdtype, dtype):
-    """Shared escalation loop for the generalized modes 2 and 3:
-    returns ``(w_k, X, resid, atol, scale, m)`` (w_k in the operator's
-    own spectrum — pencil eigenvalues for mode 2, transformed nu for
-    mode 3)."""
+                           ncv, maxiter, tol, rank, rdtype, dtype,
+                           rhs_fn=None):
+    """Shared escalation loop for the generalized modes 2-5: returns
+    ``(w_k, X, resid, atol, scale, m)`` (w_k in the operator's own
+    spectrum — pencil eigenvalues for mode 2, the mode's transformed
+    nu otherwise)."""
     import scipy.linalg as _sl
 
     from .linalg import maybe_jit
 
     lanczos = maybe_jit(_lanczos_general, static_argnums=(0, 1, 2),
-                        static_argnames=("m", "si"))
+                        static_argnames=("m", "si", "rhs_fn"))
     atol, m, tries = _escalation_params(tol, rdtype, ncv, k, rank,
                                         maxiter)
     for try_i in range(tries):
         if try_i:
             m = min(rank, 2 * m)
         V, alphas, betas = lanczos(matvec_a, matvec_m, solve, v0, m=m,
-                                   si=si)
+                                   si=si, rhs_fn=rhs_fn)
         a = np.real(np.asarray(alphas)).astype(np.float64)
         b_all = np.real(np.asarray(betas)).astype(np.float64)
         w, y = _sl.eigh_tridiagonal(a, b_all[:-1])
@@ -525,13 +531,22 @@ def _pencil_residual_guard(matvec_a, matvec_m, w_k, X, atol_outer,
 
 def _eigsh_generalized_si(matvec_a, matvec_m, sigma: float, n, dtype,
                           k, which, v0, ncv, maxiter, tol,
-                          return_eigenvectors):
-    """Native generalized shift-invert (ARPACK mode 3):
-    M-inner-product Lanczos on ``OP = (A - sigma M)^{-1} M`` with an
-    inexact jitted MINRES inner solve of the (symmetric indefinite)
-    shifted pencil.  ``which`` applies to the transformed
-    ``nu = 1/(lambda - sigma)`` (scipy semantics); results transform
-    back and return ascending."""
+                          return_eigenvectors, mode: str = "normal"):
+    """Native generalized shift-invert (ARPACK modes 3/4/5):
+    B-inner-product Lanczos on the mode's operator with an inexact
+    jitted MINRES inner solve of the (symmetric indefinite) shifted
+    pencil ``A - sigma M``.  ``which`` applies to the transformed
+    spectrum ``nu`` (scipy semantics); results transform back and
+    return ascending.
+
+    ========  =========================  ==========  ====================
+    mode      operator                   B (inner)   back-transform
+    ========  =========================  ==========  ====================
+    normal    (A - sM)^{-1} M            M           s + 1/nu
+    buckling  (A - sM)^{-1} A            A           s*nu / (nu - 1)
+    cayley    (A - sM)^{-1} (A + sM)     M           s*(nu+1) / (nu-1)
+    ========  =========================  ==========  ====================
+    """
     from .krylov_extra import _minres_loop
 
     rdtype = np.dtype(np.finfo(dtype).dtype)
@@ -552,12 +567,37 @@ def _eigsh_generalized_si(matvec_a, matvec_m, sigma: float, n, dtype,
     # hopeless conditioning -> fall back, never silently corrupt).
     rng = _probe_apply(shifted, solve_si, n, dtype, inner_atol,
                        "generalized shift-invert")
-    v0 = _m_normalized_start(v0, matvec_m, dtype, n, rng)
+    # Per-mode inner-product matrix, rhs, and back-transform.
+    tiny = np.finfo(rdtype).tiny
+    if mode == "buckling":
+        inner_mv = matvec_a           # B = A (A must be positive)
+        rhs_fn = None
+
+        def back(nu):
+            d = np.where(np.abs(nu - 1.0) < tiny, tiny, nu - 1.0)
+            return (float(sigma) * nu / d).astype(rdtype)
+    elif mode == "cayley":
+        inner_mv = matvec_m
+
+        def rhs_fn(v):
+            return matvec_a(v) + sig * matvec_m(v)
+
+        def back(nu):
+            d = np.where(np.abs(nu - 1.0) < tiny, tiny, nu - 1.0)
+            return (float(sigma) * (nu + 1.0) / d).astype(rdtype)
+    else:
+        inner_mv = matvec_m
+        rhs_fn = None
+
+        def back(nu):
+            nz = np.where(nu == 0, tiny, nu)
+            return (float(sigma) + 1.0 / nz).astype(rdtype)
+
+    v0 = _m_normalized_start(v0, inner_mv, dtype, n, rng)
     w_nu, X, resid, atol, scale, m = _general_lanczos_drive(
-        matvec_a, matvec_m, solve_si, True, v0, k, which, ncv, maxiter,
-        tol, n, rdtype, dtype)
-    nz = np.where(w_nu == 0, np.finfo(rdtype).tiny, w_nu)
-    lam = (float(sigma) + 1.0 / nz).astype(rdtype)
+        matvec_a, inner_mv, solve_si, True, v0, k, which, ncv, maxiter,
+        tol, n, rdtype, dtype, rhs_fn=rhs_fn)
+    lam = back(w_nu)
     # Unconverged Ritz pairs raise (scipy parity) — BEFORE reordering,
     # while resid/scale still align with lam's columns.
     _require_converged(resid, atol, scale, m, n, lam, X)
@@ -694,20 +734,22 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     classic trick — scipy documents it as the recommended alternative
     to its slow direct-SM mode), falling back to host ARPACK when the
     inexact inverse cannot converge (e.g. singular A).  Generalized
-    pencils ``A x = lambda M x`` (SPD M, no sigma) run natively too —
+    pencils ``A x = lambda M x`` (SPD M) run natively too —
     M-inner-product Lanczos with a jitted inner CG for ``M^{-1}``
-    (``_eigsh_generalized``), host fallback when the M-solve probe
-    stagnates.  sigma WITH M, and non-'normal' modes, delegate to host
-    scipy/ARPACK.  Delegated calls convert operands at the boundary
-    and return scipy's results unchanged."""
+    (``_eigsh_generalized``) without sigma, and the shift-invert
+    family ``mode='normal'/'buckling'/'cayley'`` (ARPACK modes 3/4/5,
+    ``_eigsh_generalized_si``) with it — host fallback when an
+    inner-solve probe stagnates.  Remaining delegations convert
+    operands at the boundary and return scipy's results unchanged."""
     mode = kwargs.pop("mode", "normal")
     native_which = ("LM", "LA", "SA", "BE", "SM")
+    si_modes = ("normal", "buckling", "cayley")
     sm_native = which == "SM" and sigma is None and M is None and not kwargs
     gen_native = (M is not None and sigma is None and mode == "normal"
                   and which in native_which and not kwargs)
-    gen_si_native = (M is not None and sigma is not None
-                     and mode == "normal" and which in native_which
-                     and not kwargs)
+    gen_si_native = (sigma is not None and mode in si_modes
+                     and which in native_which and not kwargs
+                     and (M is not None or mode != "normal"))
     if not sm_native and not gen_native and not gen_si_native and (
             M is not None or which not in native_which or kwargs
             or (sigma is not None and mode != "normal")):
@@ -722,20 +764,31 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         raise ValueError(f"k={k} must satisfy 0 < k < n={n_cols}")
     _validate_be_k(which, k)
     if gen_native or gen_si_native:
-        # Generalized pencil A x = lambda M x (M SPD): native M-inner
-        # Lanczos — mode 2 (M^{-1} A, inner CG on M) without sigma,
-        # mode 3 ((A - sigma M)^{-1} M, inner MINRES on the shifted
-        # pencil) with it; scipy factorizes on host for both.  A
-        # stagnating inner-solve probe falls back to host ARPACK.
+        # Generalized pencil A x = lambda M x (M SPD): native B-inner
+        # Lanczos — mode 2 (M^{-1} A, inner CG on M) without sigma;
+        # modes 3/4/5 (normal/buckling/cayley shift-invert, inner
+        # MINRES on the shifted pencil) with it; scipy factorizes on
+        # host for all of them.  A stagnating inner-solve probe falls
+        # back to host ARPACK.  M=None (buckling/cayley on a standard
+        # problem) is the identity.
         from scipy.sparse.linalg import ArpackNoConvergence
 
         if gen_si_native:
             _require_real_sigma(sigma)
-        mv_m, mr, mc, mdtype = _operator_parts(M)
-        if (mr, mc) != (n_cols, n_cols):
-            raise ValueError(
-                f"M has shape {(mr, mc)}, expected {(n_cols, n_cols)}")
-        pdtype = np.promote_types(dtype, mdtype)
+            if mode != "normal" and float(sigma) == 0.0:
+                raise ValueError(
+                    f"mode={mode!r} requires a nonzero sigma "
+                    f"(the transform degenerates at 0)")
+        if M is not None:
+            mv_m, mr, mc, mdtype = _operator_parts(M)
+            if (mr, mc) != (n_cols, n_cols):
+                raise ValueError(
+                    f"M has shape {(mr, mc)}, "
+                    f"expected {(n_cols, n_cols)}")
+            pdtype = np.promote_types(dtype, mdtype)
+        else:
+            mv_m = lambda x: x  # noqa: E731
+            pdtype = dtype
         if not gen_si_native and which == "SM":
             # Direct smallest-magnitude on a pencil is the hardest
             # Krylov target; serve it as generalized shift-invert at 0
@@ -747,14 +800,14 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
                 return _eigsh_generalized_si(
                     matvec, mv_m, float(sigma), n_cols,
                     np.dtype(pdtype), int(k), which, v0, ncv, maxiter,
-                    tol, return_eigenvectors)
+                    tol, return_eigenvectors, mode=mode)
             return _eigsh_generalized(
                 matvec, mv_m, n_cols, np.dtype(pdtype), int(k), which,
                 v0, ncv, maxiter, tol, return_eigenvectors)
         except ArpackNoConvergence:
             return _host_fallback("eigsh")(
                 A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
-                maxiter=maxiter, tol=tol,
+                maxiter=maxiter, tol=tol, mode=mode,
                 return_eigenvectors=return_eigenvectors)
     if sm_native:
         # Smallest-magnitude = largest of A^{-1}: shift-invert at 0.

@@ -439,6 +439,32 @@ def test_eigsh_generalized_small_norm_pencil_precise(monkeypatch):
     np.testing.assert_allclose(np.sort(w), w_dense, rtol=1e-7)
 
 
+@pytest.mark.parametrize("mode", ["buckling", "cayley"])
+def test_eigsh_buckling_cayley_native(monkeypatch, mode):
+    # ARPACK modes 4/5: B-inner Lanczos on the mode's operator with
+    # the per-mode back-transform; scipy (host splu) referees.
+    _no_fallback(monkeypatch)
+    n = 72
+    A_sp, A = _lap1d(n)            # SPD, as buckling requires
+    M_sp = _mass_matrix(n)
+    sigma = 1.5
+    w, v = linalg.eigsh(A, k=3, M=sparse.csr_array(M_sp), sigma=sigma,
+                        mode=mode)
+    w_ref = ssl.eigsh(A_sp, k=3, M=M_sp, sigma=sigma, mode=mode,
+                      return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
+    resid = np.linalg.norm(
+        A_sp @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+
+
+def test_eigsh_buckling_zero_sigma_raises():
+    _, A = _lap1d(30)
+    M = sparse.csr_array(_mass_matrix(30))
+    with pytest.raises(ValueError, match="nonzero sigma"):
+        linalg.eigsh(A, k=2, M=M, sigma=0.0, mode="buckling")
+
+
 def test_eigsh_generalized_bad_m_falls_back(monkeypatch):
     # A stagnating M-solve (the native route's honesty probe) must fall
     # back to the host boundary, not return silently wrong pairs.
