@@ -2,9 +2,9 @@
 # SPDX-License-Identifier: Apache-2.0
 """Off-chip de-risking of the r3 on-chip Pallas worker fault (VERDICT r4 #2).
 
-Two permanent gates, one subprocess per band-variant ladder rung (the
-roll/inputs knobs are trace-time environment, exactly as the bench
-canary ladder runs them):
+Three permanent gates, one subprocess per band-variant ladder rung
+(the roll/inputs knobs are trace-time environment, exactly as the
+bench canary ladder runs them):
 
 1. **TPU lowering**: every rung's kernels (SpMV masked+unmasked, SpMM,
    banded SpGEMM) and the exact looped composition that crashed r3
@@ -17,6 +17,12 @@ canary ladder runs them):
 2. **Interpret-mode execution** of the same chained composition (same
    trip counts; tile forced to 1024 so the grid is still multi-step at
    a CPU-feasible 2^14 rows) with numeric checks against scipy.
+
+3. **Distributed TPU lowering**: the full distributed composition —
+   shard_map + ppermute halo + the per-shard Mosaic band kernel over
+   the prepacked layout, the solver-shaped fori_loop nesting, and
+   dist SpMM — must likewise export for the TPU platform (the dist
+   lanes otherwise only ever run interpret mode).
 
 The r3 fault signature: eager full-size launches PASS; the jitted
 fori_loop composition crashes the worker (see ROUND3_NOTES.md and
@@ -269,3 +275,66 @@ def test_interpret_crash_composition_every_rung(name, env_extra):
     env = dict(env_extra)
     env["LEGATE_SPARSE_TPU_PALLAS_DIA"] = "interpret"
     _run(_INTERP_CODE, env)
+
+
+# The DISTRIBUTED Mosaic route (shard_map + ppermute halo + the
+# per-shard Pallas band kernel over the prepacked layout) has never
+# executed compiled anywhere (VERDICT r4 weak #4: dist lanes run
+# interpret mode).  This gate proves the full composition at least
+# LOWERS + SERIALIZES for the TPU platform from the CPU host, for
+# every band-variant rung — so a tunnel window spends its minutes
+# measuring, not discovering Mosaic lowering bugs in the dist path.
+_DIST_EXPORT_CODE = r"""
+import os
+os.environ["LEGATE_SPARSE_TPU_PALLAS_DIST"] = "1"
+from legate_sparse_tpu._platform import pin_cpu
+pin_cpu(8)
+import numpy as np
+import jax, jax.numpy as jnp
+import jax.export as jex
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu.parallel import make_row_mesh, shard_csr
+from legate_sparse_tpu.parallel.dist_csr import dist_spmm, dist_spmv
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+n = 1 << 16
+W = 11
+half = W // 2
+offs = list(range(-half, half + 1))
+diags = [np.full(n - abs(o), 1.0 / W, np.float32) for o in offs]
+A = sparse.diags(diags, offs, shape=(n, n), format="csr",
+                 dtype=np.float32)
+mesh = make_row_mesh(jax.devices()[:8])
+dA = shard_csr(A, mesh=mesh)
+assert dA.pdia_tile, "Mosaic dist prepack must engage for this band"
+sh = NamedSharding(mesh, P("rows"))
+
+xa = jax.ShapeDtypeStruct((dA.rows_padded,), jnp.float32, sharding=sh)
+exp = jex.export(jax.jit(lambda x: dist_spmv(dA, x)),
+                 platforms=["tpu"])(xa)
+assert exp.serialize()
+
+# The looped composition (solver-shaped: the kernel inside fori_loop
+# inside shard_map-consuming jit) — the r3 fault shape, distributed.
+def loop(x):
+    out = jax.lax.fori_loop(0, 6, lambda i, v: dist_spmv(dA, v), x)
+    return jnp.ravel(out)[0]
+
+assert jex.export(jax.jit(loop), platforms=["tpu"])(xa).serialize()
+
+# Dist SpMM over the same prepack.
+Xa = jax.ShapeDtypeStruct((dA.rows_padded, 4), jnp.float32,
+                          sharding=NamedSharding(mesh, P("rows", None)))
+assert jex.export(jax.jit(lambda X: dist_spmm(dA, X)),
+                  platforms=["tpu"])(Xa).serialize()
+print("all-ok")
+"""
+
+
+@pytest.mark.parametrize("name,env_extra", RUNGS,
+                         ids=[r[0] for r in RUNGS])
+def test_dist_mosaic_tpu_export_every_rung(name, env_extra):
+    """Distributed shard_map + Pallas band SpMV/SpMM (and the looped
+    solver composition) must lower and serialize for the TPU platform
+    from this CPU host, per band-variant rung."""
+    _run(_DIST_EXPORT_CODE, env_extra)
