@@ -49,7 +49,10 @@ an M-solve probe and a pencil-residual acceptance test.
 ``svds(which='SM')`` runs the same shift-invert-at-0 machinery on the
 Gram operator, and the ``buckling``/``cayley`` shift-invert modes
 (ARPACK 4/5) run through the same B-inner Lanczos with their own
-inner-product matrices and back-transforms.
+inner-product matrices and back-transforms.  Generalized
+non-symmetric ``eigs(M=...)`` — with or without sigma — runs Arnoldi
+on ``M^{-1} A`` / ``(A - sigma M)^{-1} M`` with the same inner-solve
+and guard machinery.
 
 Remaining host-fallback corners: preconditioned/constrained lobpcg
 and complex lobpcg past 32k rows.
@@ -1162,6 +1165,10 @@ def _arnoldi(matvec, v0, m: int):
 def _select_ritz(w, k, which):
     if which == "LM":
         sel = np.argsort(np.abs(w))[-k:]
+    elif which == "SM":
+        # Under shift-invert (the only native route here): smallest
+        # |nu| = farthest from sigma, ARPACK's transformed semantics.
+        sel = np.argsort(np.abs(w))[:k]
     elif which == "LR":
         sel = np.argsort(np.real(w))[-k:]
     elif which == "SR":
@@ -1188,8 +1195,30 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
     via ``lambda = sigma + 1/nu``.  ``which='SM'`` without sigma routes
     through the same shift-invert at sigma=0 (largest of A^{-1}),
     falling back to host ARPACK if the inexact inverse stagnates.
-    Generalized (``M``) delegates to host scipy/ARPACK.  Eigenvalues
-    return complex, like scipy."""
+    Generalized pencils ``A x = lambda M x`` (positive-definite M) run
+    natively too: Arnoldi on ``M^{-1} A`` (inner CG on M) without
+    sigma, or on ``(A - sigma M)^{-1} M`` (inner BiCGSTAB) with it —
+    ``_eigs_generalized`` — with host fallback when an inner-solve
+    probe stagnates.  Eigenvalues return complex, like scipy."""
+    native_which = ("LM", "LR", "SR", "LI", "SI")
+    if M is not None and not kwargs and (
+            which in native_which
+            or which == "SM"):
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        sig = sigma
+        wch = which
+        if which == "SM" and sigma is None:
+            sig, wch = 0.0, "LM"     # smallest |lambda| of the pencil
+        try:
+            return _eigs_generalized(
+                A, M, int(k), (None if sig is None else complex(sig)),
+                wch, v0, ncv, maxiter, tol, return_eigenvectors)
+        except ArpackNoConvergence:
+            return _host_fallback("eigs")(
+                A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
+                maxiter=maxiter, tol=tol,
+                return_eigenvectors=return_eigenvectors)
     if which == "SM" and sigma is None and M is None and not kwargs:
         from scipy.sparse.linalg import ArpackNoConvergence
 
@@ -1202,12 +1231,28 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
                 A, k=k, which="SM", v0=v0, ncv=ncv, maxiter=maxiter,
                 tol=tol, return_eigenvectors=return_eigenvectors)
     if (M is not None
-            or which not in ("LM", "LR", "SR", "LI", "SI") or kwargs):
+            or which not in native_which + ("SM",) or kwargs):
         return _host_fallback("eigs")(
             A, k=k, M=M, sigma=sigma, which=which, v0=v0, ncv=ncv,
             maxiter=maxiter, tol=tol,
             return_eigenvectors=return_eigenvectors, **kwargs)
     if sigma is not None:
+        if which == "SM":
+            # Same fallback ladder as every other SM route: a
+            # stagnating inexact inverse (sigma pathologically close
+            # to an eigenvalue) serves through host ARPACK instead of
+            # raising.
+            from scipy.sparse.linalg import ArpackNoConvergence
+
+            try:
+                return _eigs_shift_invert(
+                    A, int(k), complex(sigma), which, v0, ncv, maxiter,
+                    tol, return_eigenvectors)
+            except ArpackNoConvergence:
+                return _host_fallback("eigs")(
+                    A, k=k, sigma=sigma, which=which, v0=v0, ncv=ncv,
+                    maxiter=maxiter, tol=tol,
+                    return_eigenvectors=return_eigenvectors)
         return _eigs_shift_invert(A, int(k), complex(sigma), which, v0,
                                   ncv, maxiter, tol,
                                   return_eigenvectors)
@@ -1281,6 +1326,18 @@ def _arnoldi_eigs(mv, n, cdtype, k, which, v0, ncv, maxiter, tol,
     return lam, X
 
 
+def _si_back_transform(sigma, rdtype, cdtype):
+    """Shared ``lambda = sigma + 1/nu`` back-transform for the
+    non-symmetric shift-invert drivers (zero-nu guarded by tiny)."""
+
+    def back(nu):
+        tiny = np.finfo(rdtype).tiny
+        safe = np.where(nu == 0, tiny, nu)
+        return (complex(sigma) + 1.0 / safe).astype(cdtype)
+
+    return back
+
+
 def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
                        return_eigenvectors):
     """Native shift-invert ``eigs``: Arnoldi on ``(A - sigma I)^{-1}``
@@ -1319,10 +1376,7 @@ def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
     v0 = jnp.asarray(v0, dtype=base_dtype)
     v0 = v0 / jnp.linalg.norm(v0)
 
-    def back(nu):
-        tiny = np.finfo(rdtype).tiny
-        safe = np.where(nu == 0, tiny, nu)
-        return (complex(sigma) + 1.0 / safe).astype(cdtype)
+    back = _si_back_transform(sigma, rdtype, cdtype)
 
     # Always form X: the original-spectrum check below catches a
     # silently-stagnated inner solve (see _check_original_residuals).
@@ -1332,6 +1386,98 @@ def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
                 else _complex_matvec(matvec, np.dtype(dtype), cdtype))
     _check_original_residuals(check_mv, np.asarray(lam), X,
                               atol_outer, "eigs")
+    if not return_eigenvectors:
+        return lam
+    return lam, X
+
+
+def _eigs_generalized(A, M, k, sigma, which, v0, ncv, maxiter, tol,
+                      return_eigenvectors):
+    """Native generalized (non-symmetric) ``eigs``: Arnoldi on
+    ``M^{-1} A`` (sigma None; eigenvalues of the operator ARE the
+    pencil eigenvalues — no transform) or on ``(A - sigma M)^{-1} M``
+    (shift-invert; ``which`` on the transformed nu, back-transform
+    ``lambda = sigma + 1/nu``).  Inner solves: CG on the
+    positive-definite M, BiCGSTAB on the general shifted pencil — both
+    with normalized right-hand sides so the tolerance is relative.
+    The pencil-residual guard referees the inexact inner solves."""
+    from .linalg import _bicgstab_loop, _cg_loop
+
+    matvec_a, ar, ac, adt = _operator_parts(A)
+    mv_m, mr, mc, mdt = _operator_parts(M)
+    if ar != ac:
+        raise ValueError("expected square matrix")
+    if (mr, mc) != (ar, ac):
+        raise ValueError(f"M has shape {(mr, mc)}, expected {(ar, ac)}")
+    n = ac
+    if not (0 < k < n - 1):
+        raise ValueError(f"k={k} must satisfy 0 < k < n - 1 = {n - 1}")
+    cdtype = np.result_type(adt, mdt, np.complex64)
+    rdtype = np.finfo(cdtype).dtype
+    pdt = np.promote_types(adt, mdt)
+    is_complex = np.issubdtype(pdt, np.complexfloating)
+    need_complex = (
+        is_complex or (sigma is not None and sigma.imag != 0)
+        or (v0 is not None and np.iscomplexobj(np.asarray(v0)))
+    )
+    if need_complex and not is_complex:
+        base_dtype = np.dtype(cdtype)
+        base_a = _complex_matvec(matvec_a, np.dtype(adt), cdtype)
+        base_m = _complex_matvec(mv_m, np.dtype(mdt), cdtype)
+    else:
+        base_dtype = np.dtype(pdt)
+        base_a = matvec_a
+        base_m = mv_m
+    atol_outer = _outer_atol(tol, rdtype)
+    inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
+                                                     n)
+    ident = lambda r: r  # noqa: E731
+
+    if sigma is None:
+        solve = _normalized_rhs_solver(
+            lambda b: _cg_loop(base_m, ident, b, jnp.zeros_like(b),
+                               inner_atol, inner_maxiter, 10)[0])
+        _probe_apply(base_m, solve, n, base_dtype, inner_atol,
+                     "generalized eigs")
+        transform = None
+    else:
+        sig_val = (complex(sigma) if np.issubdtype(
+            base_dtype, np.complexfloating) else float(sigma.real))
+        sig_dev = jnp.asarray(sig_val, dtype=base_dtype)
+
+        def shifted(x):
+            return base_a(x) - sig_dev * base_m(x)
+
+        solve = _normalized_rhs_solver(
+            lambda b: _bicgstab_loop(shifted, ident, b,
+                                     jnp.zeros_like(b), inner_atol,
+                                     inner_maxiter, 10)[0])
+        _probe_apply(shifted, solve, n, base_dtype, inner_atol,
+                     "generalized eigs shift-invert")
+
+        transform = _si_back_transform(sigma, rdtype, cdtype)
+
+    def op(v):
+        return solve(base_m(v)) if sigma is not None else solve(
+            base_a(v))
+
+    if v0 is None:
+        v0 = np.random.default_rng(0).standard_normal(n)
+    v0 = jnp.asarray(v0, dtype=base_dtype)
+    v0 = v0 / jnp.linalg.norm(v0)
+    lam, X = _arnoldi_eigs(op, n, cdtype, k, which, v0, ncv, maxiter,
+                           tol, True, transform=transform)
+    # scipy contract: eigenvalues return complex even when the (real)
+    # Hessenberg spectrum happens to be all-real (the transform-None
+    # branch would otherwise return a data-dependent dtype).
+    lam = np.asarray(lam).astype(cdtype)
+    # Pencil-residual referee in complex arithmetic (X is complex).
+    guard_a = (base_a if np.issubdtype(base_dtype, np.complexfloating)
+               else _complex_matvec(matvec_a, np.dtype(adt), cdtype))
+    guard_m = (base_m if np.issubdtype(base_dtype, np.complexfloating)
+               else _complex_matvec(mv_m, np.dtype(mdt), cdtype))
+    _pencil_residual_guard(guard_a, guard_m, np.asarray(lam), X,
+                           atol_outer, rdtype)
     if not return_eigenvectors:
         return lam
     return lam, X

@@ -260,6 +260,82 @@ def test_eigsh_sm_singular_falls_back_to_host(monkeypatch):
     np.testing.assert_allclose(np.sort(w), np.sort(w_ref), atol=1e-8)
 
 
+def test_eigs_generalized_native_no_fallback(monkeypatch):
+    # Non-symmetric pencil A x = lambda M x, SPD M: Arnoldi on M^{-1}A
+    # with an inner CG — no transform needed, eigenvalues are the
+    # pencil's directly.
+    _no_fallback(monkeypatch)
+    n = 60
+    rng = np.random.default_rng(3)
+    A_sp = (sp.diags([np.linspace(1.0, 9.0, n),
+                      0.3 * rng.uniform(-1, 1, n - 1),
+                      0.3 * rng.uniform(-1, 1, n - 1)], [0, 1, -1])
+            .tocsr())
+    M_sp = _mass_matrix(n)
+    w, v = linalg.eigs(sparse.csr_array(A_sp), k=3,
+                       M=sparse.csr_array(M_sp), which="LM")
+    w_ref = ssl.eigs(A_sp, k=3, M=M_sp, which="LM",
+                     return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(np.real(w)),
+                               np.sort(np.real(w_ref)), rtol=1e-6)
+    resid = np.linalg.norm(
+        A_sp @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+
+
+def test_eigs_generalized_shift_invert(monkeypatch):
+    _no_fallback(monkeypatch)
+    n = 56
+    rng = np.random.default_rng(4)
+    A_sp = (sp.diags([np.linspace(1.0, 10.0, n),
+                      0.25 * rng.uniform(-1, 1, n - 1),
+                      0.25 * rng.uniform(-1, 1, n - 1)], [0, 1, -1])
+            .tocsr())
+    M_sp = _mass_matrix(n)
+    sigma = 5.02
+    w, v = linalg.eigs(sparse.csr_array(A_sp), k=2,
+                       M=sparse.csr_array(M_sp), sigma=sigma)
+    w_ref = ssl.eigs(A_sp, k=2, M=M_sp, sigma=sigma,
+                     return_eigenvectors=False)
+    np.testing.assert_allclose(np.sort(np.real(w)),
+                               np.sort(np.real(w_ref)), rtol=1e-6,
+                               atol=1e-8)
+    resid = np.linalg.norm(
+        A_sp @ v - (M_sp @ v) * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-5)
+
+
+def test_eigs_generalized_returns_complex_dtype(monkeypatch):
+    # scipy contract: eigs eigenvalues are complex even when the
+    # Hessenberg spectrum happens to be all-real (code-review r5).
+    _no_fallback(monkeypatch)
+    n = 40
+    rng = np.random.default_rng(1)
+    A_sp = (sp.diags([np.linspace(1.0, 8.0, n),
+                      0.2 * rng.uniform(-1, 1, n - 1),
+                      0.2 * rng.uniform(-1, 1, n - 1)], [0, 1, -1])
+            .tocsr())
+    M_sp = _mass_matrix(n)
+    w = linalg.eigs(sparse.csr_array(A_sp), k=2,
+                    M=sparse.csr_array(M_sp),
+                    return_eigenvectors=False)
+    assert np.iscomplexobj(np.asarray(w))
+
+
+def test_eigs_sm_sigma_near_eigenvalue_falls_back():
+    # sigma pathologically close to an eigenvalue: the probe stagnates
+    # and SM must serve through host ARPACK instead of raising
+    # (code-review r5 repro).
+    n = 40
+    A_sp = sp.diags([np.arange(1.0, n + 1.0)], [0]).tocsr()
+    w = linalg.eigs(sparse.csr_array(A_sp), k=2, sigma=3.0 + 1e-13,
+                    which="SM", return_eigenvectors=False)
+    full = np.arange(1.0, n + 1.0)
+    w_ref = full[np.argsort(np.abs(1.0 / (full - 3.0)))[:2]]
+    np.testing.assert_allclose(np.sort(np.real(w)), np.sort(w_ref),
+                               rtol=1e-6)
+
+
 def test_lobpcg_complex_nonconvergence_returns_not_raises():
     # scipy's lobpcg contract: non-convergence returns the current
     # approximation with a warning, never raises (code-review r5).
