@@ -1316,6 +1316,10 @@ def _arnoldi_eigs(mv, n, cdtype, k, which, v0, ncv, maxiter, tol,
             break
     converged = bool(np.all(resid <= atol * scale)) or m >= n
     lam = transform(w_k) if transform is not None else w_k
+    # scipy contract: eigs eigenvalues are ALWAYS complex, even when a
+    # real Hessenberg's spectrum happens to be all-real (np.linalg.eig
+    # returns float64 then) — cast here so every caller inherits it.
+    lam = np.asarray(lam).astype(cdtype)
     if converged and not return_eigenvectors:
         return lam          # skip forming X entirely
     X = np.asarray(jnp.einsum("mn,mk->nk", V,
@@ -1324,6 +1328,30 @@ def _arnoldi_eigs(mv, n, cdtype, k, which, v0, ncv, maxiter, tol,
     if not return_eigenvectors:
         return lam
     return lam, X
+
+
+def _promote_real_operators(matvecs, dtypes, cdtype,
+                            extra_complex: bool):
+    """Shared complex-promotion ladder for the non-symmetric drivers:
+    returns ``(base_dtype, wrapped, guards)`` — the working dtype, the
+    matvecs promoted to a complex basis when anything (operand dtypes,
+    a complex sigma, a complex start) requires it, and always-complex
+    guard matvecs for the residual referees."""
+    pdt = dtypes[0] if len(dtypes) == 1 else np.promote_types(*dtypes)
+    is_complex = np.issubdtype(pdt, np.complexfloating)
+    if is_complex or not extra_complex:
+        base = np.dtype(pdt)
+        wrapped = list(matvecs)
+    else:
+        base = np.dtype(cdtype)
+        wrapped = [_complex_matvec(mv, np.dtype(d), cdtype)
+                   for mv, d in zip(matvecs, dtypes)]
+    if np.issubdtype(base, np.complexfloating):
+        guards = list(wrapped)
+    else:
+        guards = [_complex_matvec(mv, np.dtype(d), cdtype)
+                  for mv, d in zip(matvecs, dtypes)]
+    return base, wrapped, guards
 
 
 def _si_back_transform(sigma, rdtype, cdtype):
@@ -1352,17 +1380,12 @@ def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
         raise ValueError(f"k={k} must satisfy 0 < k < n - 1 = {n - 1}")
     cdtype = np.result_type(dtype, np.complex64)
     rdtype = np.finfo(cdtype).dtype
-    is_complex_op = np.issubdtype(dtype, np.complexfloating)
-    need_complex = (
-        is_complex_op or sigma.imag != 0
+    extra_complex = (
+        sigma.imag != 0
         or (v0 is not None and np.iscomplexobj(np.asarray(v0)))
     )
-    if need_complex and not is_complex_op:
-        base_dtype = np.dtype(cdtype)
-        base_mv = _complex_matvec(matvec, dtype, cdtype)
-    else:
-        base_dtype = np.dtype(dtype)
-        base_mv = matvec
+    base_dtype, (base_mv,), (check_mv,) = _promote_real_operators(
+        [matvec], [dtype], cdtype, extra_complex)
     sig_val = (complex(sigma)
                if np.issubdtype(base_dtype, np.complexfloating)
                else float(sigma.real))
@@ -1382,8 +1405,6 @@ def _eigs_shift_invert(A, k, sigma, which, v0, ncv, maxiter, tol,
     # silently-stagnated inner solve (see _check_original_residuals).
     lam, X = _arnoldi_eigs(op, n, cdtype, k, which, v0, ncv, maxiter,
                            tol, True, transform=back)
-    check_mv = (base_mv if np.issubdtype(base_dtype, np.complexfloating)
-                else _complex_matvec(matvec, np.dtype(dtype), cdtype))
     _check_original_residuals(check_mv, np.asarray(lam), X,
                               atol_outer, "eigs")
     if not return_eigenvectors:
@@ -1414,20 +1435,13 @@ def _eigs_generalized(A, M, k, sigma, which, v0, ncv, maxiter, tol,
         raise ValueError(f"k={k} must satisfy 0 < k < n - 1 = {n - 1}")
     cdtype = np.result_type(adt, mdt, np.complex64)
     rdtype = np.finfo(cdtype).dtype
-    pdt = np.promote_types(adt, mdt)
-    is_complex = np.issubdtype(pdt, np.complexfloating)
-    need_complex = (
-        is_complex or (sigma is not None and sigma.imag != 0)
+    extra_complex = (
+        (sigma is not None and sigma.imag != 0)
         or (v0 is not None and np.iscomplexobj(np.asarray(v0)))
     )
-    if need_complex and not is_complex:
-        base_dtype = np.dtype(cdtype)
-        base_a = _complex_matvec(matvec_a, np.dtype(adt), cdtype)
-        base_m = _complex_matvec(mv_m, np.dtype(mdt), cdtype)
-    else:
-        base_dtype = np.dtype(pdt)
-        base_a = matvec_a
-        base_m = mv_m
+    base_dtype, (base_a, base_m), (guard_a, guard_m) = (
+        _promote_real_operators([matvec_a, mv_m], [adt, mdt], cdtype,
+                                extra_complex))
     atol_outer = _outer_atol(tol, rdtype)
     inner_atol, inner_maxiter = _inner_solver_params(atol_outer, rdtype,
                                                      n)
@@ -1467,15 +1481,7 @@ def _eigs_generalized(A, M, k, sigma, which, v0, ncv, maxiter, tol,
     v0 = v0 / jnp.linalg.norm(v0)
     lam, X = _arnoldi_eigs(op, n, cdtype, k, which, v0, ncv, maxiter,
                            tol, True, transform=transform)
-    # scipy contract: eigenvalues return complex even when the (real)
-    # Hessenberg spectrum happens to be all-real (the transform-None
-    # branch would otherwise return a data-dependent dtype).
-    lam = np.asarray(lam).astype(cdtype)
     # Pencil-residual referee in complex arithmetic (X is complex).
-    guard_a = (base_a if np.issubdtype(base_dtype, np.complexfloating)
-               else _complex_matvec(matvec_a, np.dtype(adt), cdtype))
-    guard_m = (base_m if np.issubdtype(base_dtype, np.complexfloating)
-               else _complex_matvec(mv_m, np.dtype(mdt), cdtype))
     _pencil_residual_guard(guard_a, guard_m, np.asarray(lam), X,
                            atol_outer, rdtype)
     if not return_eigenvectors:

@@ -1,0 +1,129 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Differential fuzz battery over the native eigensolver surface.
+
+Random SPD/Hermitian operators, random mass matrices, random interior
+shifts — every draw checked against dense LAPACK ground truth (the
+referee scipy/ARPACK itself sometimes fails: SM-with-sigma, complex
+shifts on real operators).  Seeds are fixed, so failures reproduce.
+Complements the targeted tests in test_eigen.py the way
+test_differential_fuzz.py complements the op tests (SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sl
+import scipy.sparse as sp
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+
+
+def _rand_spd(n, rng, dtype=np.float64):
+    """Random SPD tridiagonal-ish operator with a spread spectrum."""
+    main = rng.uniform(2.0, 10.0, n)
+    off = rng.uniform(-0.8, 0.8, n - 1)
+    return sp.diags([off, main, off], [-1, 0, 1], format="csr").astype(
+        dtype)
+
+
+def _rand_mass(n, rng):
+    main = rng.uniform(3.0, 5.0, n)
+    off = rng.uniform(0.2, 0.9, n - 1)
+    return sp.diags([off, main, off], [-1, 0, 1], format="csr") / 6.0
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_eigsh_sigma(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 90))
+    A_sp = _rand_spd(n, rng)
+    full = sl.eigh(A_sp.toarray(), eigvals_only=True)
+    # Interior shift at a safe distance from the nearest eigenvalue.
+    mid = 0.5 * (full[n // 3] + full[n // 3 + 1])
+    w, v = linalg.eigsh(sparse.csr_array(A_sp), k=3, sigma=float(mid))
+    w_ref = full[np.argsort(np.abs(full - mid))[:3]]
+    np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-8)
+    resid = np.linalg.norm(A_sp @ v - v * np.asarray(w)[None, :], axis=0)
+    assert np.all(resid < 1e-6)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_fuzz_eigsh_generalized_modes(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 80))
+    A_sp = _rand_spd(n, rng)
+    M_sp = _rand_mass(n, rng)
+    full = sl.eigh(A_sp.toarray(), M_sp.toarray(), eigvals_only=True)
+    A = sparse.csr_array(A_sp)
+    M = sparse.csr_array(M_sp)
+    # mode 2 (no sigma), LA and SA
+    for which, ref in (("SA", full[:2]), ("LA", full[-2:])):
+        w = linalg.eigsh(A, k=2, M=M, which=which,
+                         return_eigenvectors=False)
+        np.testing.assert_allclose(np.sort(w), np.sort(ref), rtol=1e-8)
+    # mode 3 at a random interior shift
+    j = int(rng.integers(5, n - 5))
+    mid = 0.5 * (full[j] + full[j + 1])
+    w3 = linalg.eigsh(A, k=2, M=M, sigma=float(mid),
+                      return_eigenvectors=False)
+    ref3 = full[np.argsort(np.abs(full - mid))[:2]]
+    np.testing.assert_allclose(np.sort(w3), np.sort(ref3), rtol=1e-8)
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_fuzz_eigsh_hermitian_sigma(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 70))
+    A_sp = _rand_spd(n, rng)
+    off = rng.uniform(0.1, 0.5, n - 1)
+    H = (A_sp.astype(np.complex128)
+         + 1j * sp.diags([off], [1]) - 1j * sp.diags([off], [-1])
+         ).tocsr()
+    full = sl.eigh(H.toarray(), eigvals_only=True)
+    j = int(rng.integers(5, n - 5))
+    mid = 0.5 * (full[j] + full[j + 1])
+    w = linalg.eigsh(sparse.csr_array(H), k=2, sigma=float(mid),
+                     return_eigenvectors=False)
+    ref = full[np.argsort(np.abs(full - mid))[:2]]
+    np.testing.assert_allclose(np.sort(w), np.sort(ref), rtol=1e-8)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_fuzz_eigs_generalized(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 70))
+    # Diagonally dominant nonsymmetric operator.
+    A_sp = (sp.diags([np.linspace(1.0, 11.0, n),
+                      0.3 * rng.uniform(-1, 1, n - 1),
+                      0.3 * rng.uniform(-1, 1, n - 1)], [0, 1, -1])
+            .tocsr())
+    M_sp = _rand_mass(n, rng)
+    pencil = sl.eig(A_sp.toarray(), M_sp.toarray(), right=False)
+    w = linalg.eigs(sparse.csr_array(A_sp), k=3,
+                    M=sparse.csr_array(M_sp), which="LM",
+                    return_eigenvectors=False)
+    ref = pencil[np.argsort(np.abs(pencil))[-3:]]
+    np.testing.assert_allclose(
+        np.sort(np.real(w)), np.sort(np.real(ref)), rtol=1e-6)
+    sigma = float(np.real(np.median(np.real(pencil)))) + 0.013
+    w_si = linalg.eigs(sparse.csr_array(A_sp), k=2,
+                       M=sparse.csr_array(M_sp), sigma=sigma,
+                       return_eigenvectors=False)
+    ref_si = pencil[np.argsort(np.abs(pencil - sigma))[:2]]
+    np.testing.assert_allclose(
+        np.sort(np.real(w_si)), np.sort(np.real(ref_si)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [9, 10])
+def test_fuzz_svds_sm(seed):
+    rng = np.random.default_rng(seed)
+    m = int(rng.integers(40, 60))
+    n = int(rng.integers(24, m))          # tall: native SM route
+    B_dense = (rng.standard_normal((m, n))
+               + 2.5 * np.eye(m, n)).astype(np.float64)
+    s_all = np.linalg.svd(B_dense, compute_uv=False)
+    s = linalg.svds(sparse.csr_array(B_dense), k=2, which="SM",
+                    return_singular_vectors=False)
+    np.testing.assert_allclose(np.sort(s), np.sort(s_all)[:2],
+                               rtol=1e-7)
