@@ -36,6 +36,17 @@ to a CPU run with ``"platform": "cpu"`` recorded.  Each phase is
 individually guarded so a mid-bench device fault still emits a JSON
 line with whatever was measured (round-2 failure mode: a TPU worker
 crash midway lost the whole round's data).
+
+Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
+writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
+override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
+(``bench.spmv``/``bench.spgemm``/``bench.cg``/``bench.gmg``/... with
+nnz/bytes attributes) plus every op-level span and counter the package
+recorded — machine-readable per-op evidence instead of one blob (the
+v5 VERDICT ask).  ``tools/trace_summary.py`` renders the per-op
+table.  If tracing was requested but no spans were produced (silent
+no-op wiring), the process exits nonzero.  With tracing disabled the
+span API is a no-op and ``bench_wall_s`` is unaffected.
 """
 
 from __future__ import annotations
@@ -389,42 +400,13 @@ def _irregular_config(sparse, n: int, nnz_per_row: int):
 
 
 def _spmv_bytes(A, x) -> int:
-    """Byte-traffic model matching the kernel that actually runs (the
-    useful-traffic lower bound: x counted once even where a kernel
-    re-reads neighbor windows)."""
-    n = A.shape[0]
-    dia = A._get_dia()
-    if dia is not None:
-        dia_data, _offsets, mask = dia
-        mask_bytes = 0
-        if mask is not None:
-            # The Pallas kernel streams an int8 mask; the XLA fallback
-            # streams the bool (also 1 byte/slot).
-            mask_bytes = mask.size
-        return int(
-            dia_data.size * dia_data.dtype.itemsize
-            + mask_bytes
-            + x.size * x.dtype.itemsize
-            + n * dia_data.dtype.itemsize
-        )
-    ell = A._get_ell()
-    if ell is not None:
-        ell_data, ell_cols, ell_counts = ell
-        return int(
-            ell_data.size * ell_data.dtype.itemsize
-            + ell_cols.size * ell_cols.dtype.itemsize
-            + ell_counts.size * ell_counts.dtype.itemsize
-            + n * x.dtype.itemsize
-            + n * ell_data.dtype.itemsize
-        )
-    nnz = A.nnz
-    row_ids = A._get_row_ids()
-    return int(
-        nnz * (A.data.dtype.itemsize + A.indices.dtype.itemsize)
-        + row_ids.size * row_ids.dtype.itemsize
-        + n * x.dtype.itemsize
-        + n * A.data.dtype.itemsize
-    )
+    """Byte-traffic model matching the kernel that actually runs —
+    delegates to ``csr_array.spmv_traffic_bytes`` (single source of
+    truth with the obs spans) after warming the structure caches the
+    dispatch would build."""
+    if A._get_dia() is None:
+        A._get_ell()
+    return A.spmv_traffic_bytes(x)
 
 
 def _time_spmv_ms(A, x, normalize: bool, k_lo: int, k_hi: int) -> float:
@@ -481,6 +463,9 @@ def main() -> None:
     import jax.numpy as jnp
 
     import legate_sparse_tpu as sparse
+    from legate_sparse_tpu import obs
+
+    obs_requested = obs.enabled()
 
     try:
         platform = jax.devices()[0].platform
@@ -515,9 +500,13 @@ def main() -> None:
         sys.stderr.write(f"bench: stream measurement failed: {e!r}\n")
 
     try:
-        A = _banded_config(sparse, n, nnz_per_row)
-        x = jnp.full((n,), 1.0, dtype=jnp.float32)
-        dt_ms = _time_spmv_ms(A, x, normalize=False, k_lo=5, k_hi=35)
+        with obs.span("bench.spmv") as _sp:
+            A = _banded_config(sparse, n, nnz_per_row)
+            x = jnp.full((n,), 1.0, dtype=jnp.float32)
+            dt_ms = _time_spmv_ms(A, x, normalize=False, k_lo=5, k_hi=35)
+            if _sp is not None:
+                _sp.set(nnz=A.nnz, bytes=_spmv_bytes(A, x),
+                        rows=n, spmv_ms=round(dt_ms, 4))
         bw = _spmv_bytes(A, x) / (dt_ms * 1e-3) / 1e9
         if stream and platform == "cpu":
             # Shared-host CPU runs show +-25% stream variance between
@@ -601,15 +590,24 @@ def main() -> None:
                         best = min(best, _time.perf_counter() - t0)
                 return best
 
-            t1, t2 = timed(100), timed(300)
-            if t2 > t1:
-                result["cg_grid"] = f"{grid}x{grid}"
-                result["cg_ms_per_iter"] = round((t2 - t1) / 200 * 1e3, 4)
-            else:
-                sys.stderr.write(
-                    f"bench: cg timing unresolvable "
-                    f"(t100={t1:.4f}s, t300={t2:.4f}s)\n"
-                )
+            with obs.span("bench.cg") as _sp:
+                if _sp is not None:
+                    _sp.set(nnz=A_cg.nnz, rows=ng,
+                            bytes=_spmv_bytes(
+                                A_cg, jnp.ones((ng,), jnp.float32)))
+                t1, t2 = timed(100), timed(300)
+                if t2 > t1:
+                    result["cg_grid"] = f"{grid}x{grid}"
+                    result["cg_ms_per_iter"] = round(
+                        (t2 - t1) / 200 * 1e3, 4
+                    )
+                    if _sp is not None:
+                        _sp.set(ms_per_iter=result["cg_ms_per_iter"])
+                else:
+                    sys.stderr.write(
+                        f"bench: cg timing unresolvable "
+                        f"(t100={t1:.4f}s, t300={t2:.4f}s)\n"
+                    )
         except Exception as e:
             sys.stderr.write(f"bench: cg config failed: {e!r}\n")
 
@@ -674,14 +672,20 @@ def main() -> None:
             import time as _time
 
             n_gm = 1 << (20 if platform != "cpu" else 16)
-            A_gm = _banded_config(sparse, n_gm, nnz_per_row)
-            best = float("inf")
-            for rep in range(3):
-                t0 = _time.perf_counter()
-                C = A_gm @ A_gm
-                _ = float(np.asarray(C.data[0]))
-                if rep:
-                    best = min(best, _time.perf_counter() - t0)
+            with obs.span("bench.spgemm") as _sp:
+                A_gm = _banded_config(sparse, n_gm, nnz_per_row)
+                best = float("inf")
+                for rep in range(3):
+                    t0 = _time.perf_counter()
+                    C = A_gm @ A_gm
+                    _ = float(np.asarray(C.data[0]))
+                    if rep:
+                        best = min(best, _time.perf_counter() - t0)
+                if _sp is not None:
+                    itm = C.dtype.itemsize
+                    _sp.set(n=n_gm, nnz=C.nnz,
+                            bytes=(2 * A_gm.nnz + C.nnz) * itm,
+                            spgemm_ms=round(best * 1e3, 2))
             result["spgemm_n"] = n_gm
             result["spgemm_ms"] = round(best * 1e3, 2)
             # Tracked referee (VERDICT r4 weak #3): host scipy on the
@@ -734,55 +738,60 @@ def main() -> None:
                 shape=(ngm, ngm), format="csr", dtype=np.float32,
             )
             mesh1 = make_row_mesh(1)
-            dA_g = shard_csr(A_g, mesh=mesh1)
-            gmg = DistGMG(dA_g, levels=3)
-            b_g = np.ones(ngm, np.float32)
+            with obs.span("bench.gmg") as _sp:
+                dA_g = shard_csr(A_g, mesh=mesh1)
+                gmg = DistGMG(dA_g, levels=3)
+                b_g = np.ones(ngm, np.float32)
+                if _sp is not None:
+                    _sp.set(nnz=A_g.nnz, rows=ngm,
+                            bytes=_spmv_bytes(
+                                A_g, jnp.ones((ngm,), jnp.float32)))
 
-            def timed_gmg(maxiter):
-                best = float("inf")
-                for rep in range(3):
-                    t0 = _time.perf_counter()
-                    xs, _ = dist_cg(dA_g, b_g, M=gmg.cycle, rtol=0.0,
-                                    maxiter=maxiter)
-                    _ = float(np.asarray(xs[0]))
-                    if rep:
-                        best = min(best, _time.perf_counter() - t0)
-                return best
+                def timed_gmg(maxiter):
+                    best = float("inf")
+                    for rep in range(3):
+                        t0 = _time.perf_counter()
+                        xs, _ = dist_cg(dA_g, b_g, M=gmg.cycle,
+                                        rtol=0.0, maxiter=maxiter)
+                        _ = float(np.asarray(xs[0]))
+                        if rep:
+                            best = min(best, _time.perf_counter() - t0)
+                    return best
 
-            # Robust metric first: chained V-cycle applications (the
-            # preconditioner IS the GMG work; magnitude-normalized so
-            # hundreds of chained cycles stay finite).  The CG-delta
-            # metric can go unresolvable when f32 GMG-CG hits an
-            # exactly-zero residual before the low trip count and
-            # stops despite rtol=0.
-            from legate_sparse_tpu.bench_timing import loop_ms_per_iter
-            from legate_sparse_tpu.parallel.dist_csr import shard_vector
+                # Robust metric first: chained V-cycle applications (the
+                # preconditioner IS the GMG work; magnitude-normalized so
+                # hundreds of chained cycles stay finite).  The CG-delta
+                # metric can go unresolvable when f32 GMG-CG hits an
+                # exactly-zero residual before the low trip count and
+                # stops despite rtol=0.
+                from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+                from legate_sparse_tpu.parallel.dist_csr import shard_vector
 
-            bs = shard_vector(b_g, mesh1, dA_g.rows_padded)
+                bs = shard_vector(b_g, mesh1, dA_g.rows_padded)
 
-            def cycle_step(v):
-                y = gmg.cycle(v)
-                return y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-20)
+                def cycle_step(v):
+                    y = gmg.cycle(v)
+                    return y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-20)
 
-            result["gmg_grid"] = f"{grid}x{grid}"
-            try:
-                ms_cycle = loop_ms_per_iter(cycle_step, bs, k_lo=3,
-                                            k_hi=13)
-                result["gmg_cycle_ms"] = round(ms_cycle, 4)
-            except RuntimeError as e:
-                sys.stderr.write(f"bench: gmg cycle timing: {e}\n")
+                result["gmg_grid"] = f"{grid}x{grid}"
+                try:
+                    ms_cycle = loop_ms_per_iter(cycle_step, bs, k_lo=3,
+                                                k_hi=13)
+                    result["gmg_cycle_ms"] = round(ms_cycle, 4)
+                except RuntimeError as e:
+                    sys.stderr.write(f"bench: gmg cycle timing: {e}\n")
 
-            t1, t2 = timed_gmg(20), timed_gmg(60)
-            if t2 > t1:
-                result["gmg_cg_ms_per_iter"] = round(
-                    (t2 - t1) / 40 * 1e3, 4
-                )
-            else:
-                sys.stderr.write(
-                    f"bench: gmg cg timing unresolvable "
-                    f"(t20={t1:.4f}s, t60={t2:.4f}s); gmg_cycle_ms is "
-                    f"the metric of record for this run\n"
-                )
+                t1, t2 = timed_gmg(20), timed_gmg(60)
+                if t2 > t1:
+                    result["gmg_cg_ms_per_iter"] = round(
+                        (t2 - t1) / 40 * 1e3, 4
+                    )
+                else:
+                    sys.stderr.write(
+                        f"bench: gmg cg timing unresolvable "
+                        f"(t20={t1:.4f}s, t60={t2:.4f}s); gmg_cycle_ms "
+                        f"is the metric of record for this run\n"
+                    )
         except Exception as e:
             sys.stderr.write(f"bench: gmg config failed: {e!r}\n")
 
@@ -917,6 +926,43 @@ def main() -> None:
             result["bf16_error"] = repr(e)[:200]
 
     result["bench_wall_s"] = round(_time_mod.perf_counter() - t_start, 1)
+
+    if obs_requested or obs.enabled():
+        # Structured perf artifact: every span/counter recorded by the
+        # package during this run, Chrome-trace format (Perfetto /
+        # tools/trace_summary.py both read it).
+        import time as _ts
+
+        trace_path = os.environ.get("LEGATE_SPARSE_TPU_OBS_FILE")
+        if not trace_path:
+            stamp = _ts.strftime("%Y%m%dT%H%M%S", _ts.gmtime())
+            trace_path = f"BENCH_{stamp}.trace.json"
+        n_spans = sum(1 for r in obs.records() if r["type"] == "span")
+        try:
+            obs.write_chrome_trace(
+                trace_path,
+                extra_metadata={"platform": platform,
+                                "bench_result": result},
+            )
+            result["trace_file"] = trace_path
+        except OSError as e:
+            # The export must never cost the measurements (the round-2
+            # lost-data failure mode): record the error, still print.
+            sys.stderr.write(f"bench: trace export failed: {e!r}\n")
+            result["trace_error"] = repr(e)[:200]
+        result["trace_spans"] = n_spans
+        print(json.dumps(result))
+        if n_spans == 0:
+            # Tracing was requested but produced nothing: the wiring
+            # silently no-opped (e.g. a refactor dropped the spans).
+            # Fail loudly so the driver can't archive empty evidence.
+            sys.stderr.write(
+                "bench: tracing requested but 0 spans recorded "
+                f"({trace_path})\n"
+            )
+            sys.exit(1)
+        return
+
     print(json.dumps(result))
 
 

@@ -35,6 +35,16 @@ ACCEL_PROBE_CODE = (
 _saved: dict | None = None
 
 
+def _obs_event(name: str, **attrs) -> None:
+    """Structured trace event + always-on counter for probe/pinning
+    outcomes — the machine-readable replacement for grepping stderr
+    when a round's accelerator evidence goes missing."""
+    from .obs import counters, trace
+
+    counters.inc(name)
+    trace.event(name, **attrs)
+
+
 def pin_cpu(n_devices: int = 0, *, override_env: bool = True) -> None:
     """Pin jax to the host (cpu) platform with >= n_devices devices.
 
@@ -77,6 +87,7 @@ def pin_cpu(n_devices: int = 0, *, override_env: bool = True) -> None:
             "legate_sparse_tpu: pin_cpu called after backend init; "
             "clearing backends (virtual device count may be stale)\n"
         )
+        _obs_event("platform.pin_cpu_late", n_devices=n_devices)
         from jax.extend.backend import clear_backends
 
         clear_backends()
@@ -159,22 +170,32 @@ def ensure_live_backend(timeout_s: int | None = None,
                 timeout=timeout_s, capture_output=True, text=True,
             )
             if r.returncode == 0 and "ok" in r.stdout:
+                _obs_event("platform.probe_ok", attempt=attempt + 1)
                 return True
             sys.stderr.write(
                 f"legate_sparse_tpu: accelerator probe attempt "
                 f"{attempt + 1} failed (rc={r.returncode}): "
                 f"{r.stderr.strip()[-400:]}\n"
             )
+            _obs_event(
+                "platform.probe_fail", attempt=attempt + 1,
+                rc=int(r.returncode),
+                stderr_tail=r.stderr.strip()[-400:],
+            )
         except subprocess.TimeoutExpired:
             sys.stderr.write(
                 f"legate_sparse_tpu: accelerator probe attempt "
                 f"{attempt + 1} timed out after {timeout_s}s\n"
             )
+            _obs_event("platform.probe_timeout", attempt=attempt + 1,
+                       timeout_s=timeout_s)
         if attempt < retries:
             time.sleep(min(5 * (attempt + 1), 15))
     sys.stderr.write(
         "legate_sparse_tpu: accelerator unreachable; pinning cpu\n"
     )
+    _obs_event("platform.unreachable_pin_cpu", retries=retries,
+               timeout_s=timeout_s)
     pin_cpu()
     return False
 

@@ -91,9 +91,13 @@ def scipy_fallback(func, name: str):
 
     @functools.wraps(func)
     def wrapper(*args: Any, **kwargs: Any) -> Any:
+        from . import obs as _obs
+
+        _obs.inc("scipy_fallback." + name)
         args = tuple(_to_scipy(a) for a in args)
         kwargs = {k: _to_scipy(v) for k, v in kwargs.items()}
-        with jax.named_scope(scope):
+        with jax.named_scope(scope), _obs.span("scipy_fallback",
+                                               func=name):
             return _from_scipy(func(*args, **kwargs))
 
     wrapper._lst_scipy_fallback = True

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from scipy.sparse import SparseEfficiencyWarning
 
+from . import obs as _obs
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
 from .types import check_nnz, coord_dtype_for, index_dtype, nnz_dtype
@@ -1147,40 +1148,53 @@ class csr_array(CompressedBase, DenseSparseBase):
                 raise ValueError(
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
+            _obs.inc("op.spmv")
             A, x = cast_to_common_type(self, other_arr)
             src = self if A is self else None
-            dia = src._get_dia() if src is not None else None
-            bsr = (src._get_bsr() if src is not None and dia is None
-                   else None)
-            ell = (src._get_ell()
-                   if src is not None and dia is None and bsr is None
-                   else None)
-            if dia is not None:
-                from .ops.pallas_dia import (
-                    dia_spmv_maybe_pallas, pallas_dia_active,
-                )
+            with _obs.span("spmv") as sp:
+                dia = src._get_dia() if src is not None else None
+                bsr = (src._get_bsr() if src is not None and dia is None
+                       else None)
+                ell = (src._get_ell()
+                       if src is not None and dia is None and bsr is None
+                       else None)
+                if dia is not None:
+                    from .ops.pallas_dia import (
+                        dia_spmv_maybe_pallas, pallas_dia_active,
+                    )
 
-                y = (dia_spmv_maybe_pallas(src._get_dia_pack(), x)
-                     if pallas_dia_active() else None)
-                if y is None:
-                    offs = dia[1]
-                    dpad, mpad = src._get_dia_fused()
-                    y = _dia_ops.dia_spmv_fused(dpad, mpad, x, offs,
-                                                self.shape)
-            elif bsr is not None:
-                y = bsr.matvec(
-                    x, interpret=jax.devices()[0].platform != "tpu"
-                )
-            elif ell is not None:
-                y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
-            elif src is not None:
-                y = _spmv_ops.csr_spmv_rowids(
-                    A.data, A.indices, src._get_row_ids(), x, self.shape[0]
-                )
-            else:
-                y = _spmv_ops.csr_spmv(
-                    A.data, A.indices, A.indptr, x, self.shape[0]
-                )
+                    y = (dia_spmv_maybe_pallas(src._get_dia_pack(), x)
+                         if pallas_dia_active() else None)
+                    path = "dia-pallas"
+                    if y is None:
+                        offs = dia[1]
+                        dpad, mpad = src._get_dia_fused()
+                        y = _dia_ops.dia_spmv_fused(dpad, mpad, x, offs,
+                                                    self.shape)
+                        path = "dia-xla"
+                elif bsr is not None:
+                    y = bsr.matvec(
+                        x, interpret=jax.devices()[0].platform != "tpu"
+                    )
+                    path = "bsr"
+                elif ell is not None:
+                    y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
+                    path = "ell"
+                elif src is not None:
+                    y = _spmv_ops.csr_spmv_rowids(
+                        A.data, A.indices, src._get_row_ids(), x,
+                        self.shape[0]
+                    )
+                    path = "csr-rowids"
+                else:
+                    y = _spmv_ops.csr_spmv(
+                        A.data, A.indices, A.indptr, x, self.shape[0]
+                    )
+                    path = "csr"
+                if sp is not None:
+                    sp.set(path=path, rows=self.shape[0], nnz=self.nnz,
+                           bytes=A.spmv_traffic_bytes(x, path=path),
+                           flops=2 * self.nnz)
             if squeeze:
                 y = y[:, None]
             return fill_out(y, out)
@@ -1189,52 +1203,123 @@ class csr_array(CompressedBase, DenseSparseBase):
                 raise ValueError(
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
+            _obs.inc("op.spmm")
             A, X = cast_to_common_type(self, other_arr)
             src = self if A is self else None
-            dia = src._get_dia() if src is not None else None
-            from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
+            with _obs.span("spmm") as sp:
+                dia = src._get_dia() if src is not None else None
+                from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
 
-            bsr = (src._get_bsr()
-                   if src is not None and dia is None
-                   and 0 < X.shape[1] <= _BSR_MAX_K
-                   else None)
-            ell = (src._get_ell()
-                   if src is not None and dia is None and bsr is None
-                   else None)
-            if dia is not None:
-                from .ops.pallas_dia import (
-                    SPMM_MAX_K, dia_spmm_maybe_pallas, pallas_dia_active,
-                )
+                bsr = (src._get_bsr()
+                       if src is not None and dia is None
+                       and 0 < X.shape[1] <= _BSR_MAX_K
+                       else None)
+                ell = (src._get_ell()
+                       if src is not None and dia is None and bsr is None
+                       else None)
+                if dia is not None:
+                    from .ops.pallas_dia import (
+                        SPMM_MAX_K, dia_spmm_maybe_pallas,
+                        pallas_dia_active,
+                    )
 
-                # Cheap k gate first: the pack build doubles band
-                # storage and must not run for calls that can only
-                # take the XLA path anyway.
-                Y = (
-                    dia_spmm_maybe_pallas(src._get_dia_pack(), X)
-                    if 0 < X.shape[1] <= SPMM_MAX_K and pallas_dia_active()
-                    else None
-                )
-                if Y is None:
-                    offs = dia[1]
-                    dpad, mpad = src._get_dia_fused()
-                    Y = _dia_ops.dia_spmm_fused(dpad, mpad, X, offs,
-                                                self.shape)
-            elif bsr is not None:
-                Y = bsr.matmat(
-                    X, interpret=jax.devices()[0].platform != "tpu"
-                )
-            elif ell is not None:
-                Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
-            elif src is not None:
-                Y = _spmv_ops.csr_spmm_rowids(
-                    A.data, A.indices, src._get_row_ids(), X, self.shape[0]
-                )
-            else:
-                Y = _spmv_ops.csr_spmm(
-                    A.data, A.indices, A.indptr, X, self.shape[0]
-                )
+                    # Cheap k gate first: the pack build doubles band
+                    # storage and must not run for calls that can only
+                    # take the XLA path anyway.
+                    Y = (
+                        dia_spmm_maybe_pallas(src._get_dia_pack(), X)
+                        if 0 < X.shape[1] <= SPMM_MAX_K
+                        and pallas_dia_active()
+                        else None
+                    )
+                    path = "dia-pallas"
+                    if Y is None:
+                        offs = dia[1]
+                        dpad, mpad = src._get_dia_fused()
+                        Y = _dia_ops.dia_spmm_fused(dpad, mpad, X, offs,
+                                                    self.shape)
+                        path = "dia-xla"
+                elif bsr is not None:
+                    Y = bsr.matmat(
+                        X, interpret=jax.devices()[0].platform != "tpu"
+                    )
+                    path = "bsr"
+                elif ell is not None:
+                    Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
+                    path = "ell"
+                elif src is not None:
+                    Y = _spmv_ops.csr_spmm_rowids(
+                        A.data, A.indices, src._get_row_ids(), X,
+                        self.shape[0]
+                    )
+                    path = "csr-rowids"
+                else:
+                    Y = _spmv_ops.csr_spmm(
+                        A.data, A.indices, A.indptr, X, self.shape[0]
+                    )
+                    path = "csr"
+                if sp is not None:
+                    k = int(X.shape[1])
+                    sp.set(path=path, rows=self.shape[0], k=k,
+                           nnz=self.nnz, flops=2 * self.nnz * k,
+                           bytes=A.spmv_traffic_bytes(X, path=path))
             return fill_out(Y, out)
         raise ValueError(f"cannot multiply csr_array by ndim={other_arr.ndim}")
+
+    def spmv_traffic_bytes(self, x, path: str = None) -> int:
+        """Useful-traffic byte model of one ``A @ x`` through the
+        kernel named by ``path`` (the dispatch labels: dia-*, bsr,
+        ell, csr*) — or, with ``path=None``, whatever kernel the
+        structure caches say the dispatch WOULD pick (bench.py's
+        usage).  Lower bound: x counted once even where a kernel
+        re-reads neighbor windows.  Reads the already-built structure
+        caches only — call after the op (``bench.py`` and the obs
+        spans both do); an uncached matrix falls through to the CSR
+        gather model.
+        """
+        n = self.shape[0]
+        x_bytes = int(x.size) * x.dtype.itemsize
+        out_bytes = n * self.dtype.itemsize
+        if x.ndim == 2:
+            out_bytes *= int(x.shape[1])
+        # Caches use the False sentinel for "tried, not applicable".
+        dia = self._dia if self._dia is not False else None
+        if path is not None and not path.startswith("dia"):
+            dia = None
+        if path == "bsr" and self._bsr not in (None, False):
+            # Present blocks stream densified through the MXU.
+            return int(
+                self._bsr.nblocks * 128 * 128 * self.dtype.itemsize
+                + x_bytes + out_bytes
+            )
+        if dia is not None:
+            dia_data, _offsets, mask = dia
+            mask_bytes = 0
+            if mask is not None:
+                # The Pallas kernel streams an int8 mask; the XLA
+                # fallback streams the bool (also 1 byte/slot).
+                mask_bytes = mask.size
+            return int(dia_data.size * dia_data.dtype.itemsize
+                       + mask_bytes + x_bytes + out_bytes)
+        ell = self._ell if self._ell is not False else None
+        if path is not None and path != "ell":
+            ell = None
+        if ell is not None:
+            ell_data, ell_cols, ell_counts = ell
+            return int(
+                ell_data.size * ell_data.dtype.itemsize
+                + ell_cols.size * ell_cols.dtype.itemsize
+                + ell_counts.size * ell_counts.dtype.itemsize
+                + x_bytes + out_bytes
+            )
+        nnz = self.nnz
+        rid_bytes = (self._row_ids.size * self._row_ids.dtype.itemsize
+                     if self._row_ids is not None
+                     else nnz * np.dtype(np.int32).itemsize)
+        return int(
+            nnz * (self.data.dtype.itemsize + self.indices.dtype.itemsize)
+            + rid_bytes + x_bytes + out_bytes
+        )
 
     def _invalidate_caches(self, structure_changed: bool) -> None:
         """Drop stale structure caches after in-place mutation.  With
@@ -1645,52 +1730,73 @@ def spgemm_csr_csr_csr(A: csr_array, B: csr_array) -> csr_array:
 
     from .settings import settings
 
-    dia_a = A._get_dia()
-    dia_b = B._get_dia() if dia_a is not None else None
-    if (
-        dia_a is not None
-        and dia_b is not None
-        and dia_a[2] is None
-        and dia_b[2] is None
-    ):
-        offs_c = _dia_ops.band_product_offsets(dia_a[1], dia_b[1])
-        nnz_c = _dia_ops.band_cover(offs_c, (m, n), n)
+    _obs.inc("op.spgemm")
+    with _obs.span("spgemm", m=m, k=k, n=n, nnz_a=A.nnz,
+                   nnz_b=B.nnz) as sp:
+        dia_a = A._get_dia()
+        dia_b = B._get_dia() if dia_a is not None else None
         if (
-            len(offs_c) <= settings.dia_max_diags
-            and len(offs_c) * n <= settings.dia_max_expand * max(nnz_c, 1)
-            # scipy pattern parity: every in-bounds product slot must be
-            # structurally reachable, else the ESC kernel decides nnz.
-            and _dia_ops.band_product_is_full(
-                dia_a[1], dia_b[1], offs_c, A.shape, B.shape
-            )
+            dia_a is not None
+            and dia_b is not None
+            and dia_a[2] is None
+            and dia_b[2] is None
         ):
-            from .ops.pallas_dia import (
-                dia_spgemm_maybe_pallas, pallas_dia_active,
-            )
-
-            Cd = (
-                dia_spgemm_maybe_pallas(
-                    dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
-                    A.shape, B.shape,
+            offs_c = _dia_ops.band_product_offsets(dia_a[1], dia_b[1])
+            nnz_c = _dia_ops.band_cover(offs_c, (m, n), n)
+            if (
+                len(offs_c) <= settings.dia_max_diags
+                and len(offs_c) * n
+                <= settings.dia_max_expand * max(nnz_c, 1)
+                # scipy pattern parity: every in-bounds product slot must
+                # be structurally reachable, else the ESC kernel decides
+                # nnz.
+                and _dia_ops.band_product_is_full(
+                    dia_a[1], dia_b[1], offs_c, A.shape, B.shape
                 )
-                if pallas_dia_active() else None
-            )
-            if Cd is None:
-                Cd = _dia_ops.dia_spgemm(
-                    dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
-                    A.shape, B.shape,
+            ):
+                from .ops.pallas_dia import (
+                    dia_spgemm_maybe_pallas, pallas_dia_active,
                 )
-            data, indices, indptr = _dia_ops.band_to_csr(
-                Cd, offs_c, (m, n), nnz_c
-            )
-            C = csr_array._from_parts(data, indices, indptr, (m, n))
-            # The product band is exact by construction: warm C's own
-            # fast-path cache for downstream matvecs (GMG coarse ops).
-            C._dia_offsets = offs_c
-            C._dia = (Cd, offs_c, None)
-            return C
 
-    data, indices, indptr = _spgemm_ops.spgemm_csr_csr_csr_impl(
-        A.data, A.indices, A.indptr, B.data, B.indices, B.indptr, m, k, n
-    )
-    return csr_array._from_parts(data, indices, indptr, (m, n))
+                Cd = (
+                    dia_spgemm_maybe_pallas(
+                        dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
+                        A.shape, B.shape,
+                    )
+                    if pallas_dia_active() else None
+                )
+                path = "dia-pallas"
+                if Cd is None:
+                    Cd = _dia_ops.dia_spgemm(
+                        dia_a[0], dia_b[0], dia_a[1], dia_b[1], offs_c,
+                        A.shape, B.shape,
+                    )
+                    path = "dia-xla"
+                data, indices, indptr = _dia_ops.band_to_csr(
+                    Cd, offs_c, (m, n), nnz_c
+                )
+                C = csr_array._from_parts(data, indices, indptr, (m, n))
+                # The product band is exact by construction: warm C's own
+                # fast-path cache for downstream matvecs (GMG coarse ops).
+                C._dia_offsets = offs_c
+                C._dia = (Cd, offs_c, None)
+                if sp is not None:
+                    itm = C.dtype.itemsize
+                    sp.set(path=path, nnz=nnz_c,
+                           bytes=(dia_a[0].size + dia_b[0].size
+                                  + Cd.size) * itm,
+                           flops=2 * len(dia_a[1]) * len(dia_b[1]) * n)
+                return C
+
+        data, indices, indptr = _spgemm_ops.spgemm_csr_csr_csr_impl(
+            A.data, A.indices, A.indptr, B.data, B.indices, B.indptr,
+            m, k, n
+        )
+        C = csr_array._from_parts(data, indices, indptr, (m, n))
+        if sp is not None:
+            itm = C.dtype.itemsize
+            idx = C.indices.dtype.itemsize
+            sp.set(path="esc", nnz=C.nnz,
+                   chunks=_spgemm_ops._last_num_chunks,
+                   bytes=(A.nnz + B.nnz + C.nnz) * (itm + idx))
+        return C

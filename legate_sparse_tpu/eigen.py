@@ -342,7 +342,7 @@ def _lanczos_general(matvec_a, matvec_m, solve_m, v0, m: int,
     V0 = jnp.zeros((m, n), dtype=dtype)
     (V, _, _, _), (alphas, betas) = jax.lax.scan(
         step, (V0, v0, jnp.zeros((), dtype), jnp.zeros_like(v0)),
-        jnp.arange(m))
+        jnp.arange(m, dtype=jnp.int32))
     return V, alphas, betas
 
 
@@ -628,7 +628,7 @@ def _lanczos(matvec, v0, mask, m: int):
     key0 = jax.random.PRNGKey(7)
 
     def step(carry, j):
-        V, v, beta, v_prev = carry
+        V, v, beta, v_prev, alphas, betas = carry
         w = matvec(v)
         alpha = jnp.real(jnp.vdot(v, w)).astype(dtype)
         w = w - alpha * v - beta * v_prev
@@ -654,12 +654,20 @@ def _lanczos(matvec, v0, mask, m: int):
         v_next = jnp.where(
             broke, fresh,
             w / jnp.where(beta_next == 0, 1.0, beta_next))
-        return (V, v_next, beta_next, v), (alpha, beta_next)
+        # alphas/betas accumulate in the CARRY at our int32 j rather
+        # than as stacked scan outputs: with x64 on, sharding
+        # propagation shards the scan-ys stacking buffer and its s64
+        # loop-counter index trips the spmd partitioner's hlo verifier
+        # ("compare s64 vs s32") on the installed jaxlib.
+        alphas = alphas.at[j].set(alpha)
+        betas = betas.at[j].set(beta_next)
+        return (V, v_next, beta_next, v, alphas, betas), None
 
     V0 = jnp.zeros((m, n), dtype=dtype)
-    (V, _, _, _), (alphas, betas) = jax.lax.scan(
-        step, (V0, v0, jnp.zeros((), dtype), jnp.zeros_like(v0)),
-        jnp.arange(m))
+    (V, _, _, _, alphas, betas), _ = jax.lax.scan(
+        step, (V0, v0, jnp.zeros((), dtype), jnp.zeros_like(v0),
+               jnp.zeros((m,), dtype), jnp.zeros((m,), dtype)),
+        jnp.arange(m, dtype=jnp.int32))
     return V, alphas, betas
 
 
@@ -792,20 +800,27 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         else:
             mv_m = lambda x: x  # noqa: E731
             pdtype = dtype
+        # Separate locals for the SM remap (same idiom as the eigs
+        # generalized branch): the ArpackNoConvergence host fallback
+        # below must see the CALLER's sigma/which — passing the
+        # remapped sigma=0.0 makes scipy splu(A - 0*M), which raises
+        # "Factor is exactly singular" for exactly the singular-A case
+        # the fallback exists to serve (ADVICE r5 medium).
+        use_si, sig, wch = gen_si_native, sigma, which
         if not gen_si_native and which == "SM":
             # Direct smallest-magnitude on a pencil is the hardest
             # Krylov target; serve it as generalized shift-invert at 0
             # (largest of (A - 0*M)^{-1} M = smallest |lambda|), the
             # same remap as the standard SM route.
-            gen_si_native, sigma, which = True, 0.0, "LM"
+            use_si, sig, wch = True, 0.0, "LM"
         try:
-            if gen_si_native:
+            if use_si:
                 return _eigsh_generalized_si(
-                    matvec, mv_m, float(sigma), n_cols,
-                    np.dtype(pdtype), int(k), which, v0, ncv, maxiter,
+                    matvec, mv_m, float(sig), n_cols,
+                    np.dtype(pdtype), int(k), wch, v0, ncv, maxiter,
                     tol, return_eigenvectors, mode=mode)
             return _eigsh_generalized(
-                matvec, mv_m, n_cols, np.dtype(pdtype), int(k), which,
+                matvec, mv_m, n_cols, np.dtype(pdtype), int(k), wch,
                 v0, ncv, maxiter, tol, return_eigenvectors)
         except ArpackNoConvergence:
             return _host_fallback("eigsh")(
@@ -1155,7 +1170,7 @@ def _arnoldi(matvec, v0, m: int):
         return (V, v_next), col
 
     V0 = jnp.zeros((m, n), dtype=dtype)
-    (V, _), cols = jax.lax.scan(step, (V0, v0), jnp.arange(m))
+    (V, _), cols = jax.lax.scan(step, (V0, v0), jnp.arange(m, dtype=jnp.int32))
     # cols[j] is the length-(m+1) Hessenberg column j (entries beyond
     # j+1 are ~0 by orthogonality).
     H = cols.T

@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import obs as _obs
 from .types import index_dtype
 
 from .csr import csr_array
@@ -375,11 +376,26 @@ def cg(
     x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
          else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
 
+    _obs.inc("op.cg")
     if callback is None:
-        return _cg_loop(
-            A_op.matvec, M_op.matvec, b, x, atol, int(maxiter),
-            int(conv_test_iters),
-        )
+        with _obs.span("cg", n=n, maxiter=int(maxiter)) as sp:
+            xs, iters = _cg_loop(
+                A_op.matvec, M_op.matvec, b, x, atol, int(maxiter),
+                int(conv_test_iters),
+            )
+            if sp is not None:
+                # Tracing mode trades one host sync for honest span
+                # timing (the fetch is the only trusted completion
+                # signal on detached-dispatch backends) and records
+                # the true iteration count + per-iter traffic model.
+                it = int(iters)
+                sp.set(iters=it)
+                src = getattr(A_op, "A", None)
+                if isinstance(src, csr_array):
+                    sp.set(nnz=src.nnz * it,
+                           bytes=src.spmv_traffic_bytes(b) * it,
+                           flops=2 * src.nnz * it)
+        return xs, iters
 
     # Callback path: Python loop, one deferred pipeline per iteration.
     r = b - A_op.matvec(x)
@@ -387,15 +403,17 @@ def cg(
     rho = jnp.ones((), dtype=b.dtype)
     iters = 0
     while iters < maxiter:
-        z = M_op.matvec(r)
-        rho_old = rho
-        rho = jnp.vdot(r, z)
-        beta = jnp.where(iters == 0, jnp.zeros_like(rho), rho / rho_old)
-        p = z + beta * p
-        q = A_op.matvec(p)
-        alpha = rho / jnp.vdot(p, q)
-        x = x + alpha * p
-        r = r - alpha * q
+        with _obs.span("cg.iter", i=iters):
+            z = M_op.matvec(r)
+            rho_old = rho
+            rho = jnp.vdot(r, z)
+            beta = jnp.where(iters == 0, jnp.zeros_like(rho),
+                             rho / rho_old)
+            p = z + beta * p
+            q = A_op.matvec(p)
+            alpha = rho / jnp.vdot(p, q)
+            x = x + alpha * p
+            r = r - alpha * q
         iters += 1
         callback(x)
         if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
@@ -493,19 +511,22 @@ def gmres(
         partial(_arnoldi_cycle, A_op.matvec, M_op.matvec, restart=restart)
     )
 
+    _obs.inc("op.gmres")
     iters = 0
     while iters < maxiter:
-        V, H, beta = arnoldi(x, b)
-        beta_f = float(beta)
-        if beta_f < atol:
-            break
-        # Host-side small lstsq: min || beta e1 - H y ||.
-        Hh = np.asarray(H)
-        e1 = np.zeros(restart + 1, dtype=Hh.dtype)
-        e1[0] = beta_f
-        y, *_ = np.linalg.lstsq(Hh, e1, rcond=None)
-        update = jnp.asarray(y) @ V[:restart]
-        x = x + M_op.matvec(update)
+        with _obs.span("gmres.cycle", restart=restart, iters_done=iters):
+            V, H, beta = arnoldi(x, b)
+            _obs.inc("transfer.host_sync.gmres_beta")
+            beta_f = float(beta)
+            if beta_f < atol:
+                break
+            # Host-side small lstsq: min || beta e1 - H y ||.
+            Hh = np.asarray(H)
+            e1 = np.zeros(restart + 1, dtype=Hh.dtype)
+            e1[0] = beta_f
+            y, *_ = np.linalg.lstsq(Hh, e1, rcond=None)
+            update = jnp.asarray(y) @ V[:restart]
+            x = x + M_op.matvec(update)
         iters += restart
         if callback is not None:
             if callback_type == "pr_norm":
@@ -626,11 +647,16 @@ def bicgstab(
     )
     x0_arr = (jnp.zeros(n, dtype=b.dtype) if x0 is None
               else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+    _obs.inc("op.bicgstab")
     if callback is None:
-        return _bicgstab_loop(
-            A_op.matvec, M_op.matvec, b, x0_arr, atol, int(maxiter),
-            int(conv_test_iters),
-        )
+        with _obs.span("bicgstab", n=n, maxiter=int(maxiter)) as sp:
+            xs, iters = _bicgstab_loop(
+                A_op.matvec, M_op.matvec, b, x0_arr, atol, int(maxiter),
+                int(conv_test_iters),
+            )
+            if sp is not None:
+                sp.set(iters=int(iters))
+        return xs, iters
     # Callback path: step the SAME state->state iteration (shadow
     # residual and direction state carried across steps) Python-side so
     # user code observes every iterate; r lives in the state, so the

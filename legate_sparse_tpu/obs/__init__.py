@@ -1,0 +1,50 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""legate_sparse_tpu.obs: observability — op-level tracing, counters,
+and structured perf evidence.
+
+Three pieces (see each module's docstring for the design contract):
+
+- ``trace``    — near-zero-overhead spans (``with obs.span("spmv",
+                 nnz=...)``) recording wall time + first-call vs
+                 steady-state, exporting newline-JSON and
+                 Chrome-trace/Perfetto; structured instant events.
+- ``counters`` — always-on process-wide counters (op invocations, nnz
+                 processed, bytes moved, transfers, scipy-fallback
+                 hits, jit cache misses).
+- ``report``   — aggregation into a per-op table with achieved GB/s
+                 against the measured stream roofline.
+
+Enable tracing with ``LEGATE_SPARSE_TPU_OBS=1`` (read once at import,
+like the other settings) or programmatically::
+
+    from legate_sparse_tpu import obs
+    obs.enable()
+    ...             # run the workload
+    obs.write_chrome_trace("run.trace.json")
+    print(obs.report.summarize(obs.records()))
+
+Disabled (the default) the span API is a no-op returning a shared
+null context manager; counters stay live either way.
+"""
+
+from . import counters, report, trace  # noqa: F401
+from .counters import inc, snapshot  # noqa: F401
+from .trace import (  # noqa: F401
+    disable, enable, enabled, event, records, reset, span,
+    to_chrome_trace, write_chrome_trace, write_jsonl,
+)
+
+__all__ = [
+    "counters", "report", "trace",
+    "inc", "snapshot",
+    "enable", "disable", "enabled", "event", "records", "reset", "span",
+    "to_chrome_trace", "write_chrome_trace", "write_jsonl",
+]
+
+
+def reset_all() -> None:
+    """Convenience: drop buffered trace records AND zero counters
+    (test isolation / between bench phases)."""
+    trace.reset()
+    counters.reset()

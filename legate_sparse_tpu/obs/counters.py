@@ -1,0 +1,70 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Process-wide counters: op invocations, nnz processed, bytes moved,
+host<->device transfers, scipy-fallback hits, jit cache misses.
+
+Counters are ALWAYS on (unlike spans): one dict increment costs tens
+of nanoseconds, and the whole point is that a later diagnosis can ask
+"how many times did the scipy fallback fire in this run?" without
+having had tracing enabled in advance.  Naming convention::
+
+    op.<name>            python-level op dispatches (spmv, spgemm, ...)
+    trace.<name>         jax re-traces of a jitted kernel (the body of
+                         a @jax.jit function runs only on a cache
+                         miss, so an increment there counts compiles)
+    jit_miss.<name>      structure-cache misses for the lru_cache'd
+                         shard_map builders (each miss = one fresh
+                         compile of a distributed kernel)
+    transfer.<name>      host<->device movements (shard uploads, host
+                         syncs that block on device results)
+    scipy_fallback.<name>  host-scipy escape-hatch hits
+    platform.<name>      accelerator probe / pinning outcomes
+    obs.nnz_processed / obs.bytes_moved / obs.flops
+                         accumulated from span attributes (only while
+                         tracing is enabled — the attrs are computed
+                         lazily at span sites)
+
+``inc`` is intentionally tolerant of float increments (bytes/flops
+totals).  Thread safety: increments take the module lock; reads
+snapshot under it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Union
+
+Number = Union[int, float]
+
+_lock = threading.Lock()
+_counters: Dict[str, Number] = {}
+
+
+def inc(name: str, value: Number = 1) -> None:
+    """Add ``value`` to counter ``name`` (creating it at 0)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + value
+
+
+def get(name: str, default: Number = 0) -> Number:
+    """Current value of one counter."""
+    with _lock:
+        return _counters.get(name, default)
+
+
+def snapshot(prefix: Optional[str] = None) -> Dict[str, Number]:
+    """Copy of all counters, optionally filtered by name prefix."""
+    with _lock:
+        if prefix is None:
+            return dict(_counters)
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset(prefix: Optional[str] = None) -> None:
+    """Zero all counters, or only those under ``prefix``."""
+    with _lock:
+        if prefix is None:
+            _counters.clear()
+        else:
+            for k in [k for k in _counters if k.startswith(prefix)]:
+                del _counters[k]

@@ -1,0 +1,180 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Aggregation of trace records into a per-op table.
+
+Turns the raw span stream (``trace.records()`` / a trace file) into
+the evidence table the review rounds kept asking for: per op —
+call count, first-call time (compile + execute), steady-state time,
+nnz/bytes/flops totals, achieved GB/s from the steady-state time, and
+the roofline fraction against the stream bandwidth ``bench.py``
+already measures.
+
+Per-op GB/s uses STEADY-STATE time only: first calls carry the jit
+compile, and mixing them in is exactly the "compile or kernel?"
+ambiguity this subsystem exists to remove.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """Read trace records from a file in either export format
+    (newline-JSON from ``write_jsonl`` or Chrome-trace from
+    ``write_chrome_trace``).  Chrome events are mapped back to the
+    native record shape."""
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if not text:
+        return []
+    # Newline-JSON lines also start with "{": the whole-file parse
+    # only succeeds for the Chrome document (or a 1-record jsonl).
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" not in doc:
+        return [doc]        # single-record newline-JSON file
+    if isinstance(doc, dict):
+        out: List[Dict[str, Any]] = []
+        for ev in doc.get("traceEvents", []):
+            args = dict(ev.get("args") or {})
+            rec: Dict[str, Any] = {
+                "name": ev.get("name", "?"),
+                "ts_ns": float(ev.get("ts", 0.0)) * 1e3,
+                "tid": ev.get("tid", 0),
+            }
+            if ev.get("ph") == "X":
+                rec["type"] = "span"
+                rec["dur_ns"] = float(ev.get("dur", 0.0)) * 1e3
+                rec["seq"] = args.pop("seq", 0)
+                rec["first"] = bool(args.pop("first_call", rec["seq"] == 0))
+            else:
+                rec["type"] = "event"
+            if args:
+                rec["attrs"] = args
+            out.append(rec)
+        return out
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-op rollup of span records (events are counted, not timed).
+
+    Returns ``{name: {calls, events, total_ms, first_ms, steady_ms,
+    steady_calls, nnz, bytes, flops, gbs, gflops}}``; ``steady_ms`` is
+    the mean over non-first calls (None with < 2 calls), ``gbs`` the
+    achieved bandwidth bytes/steady-time (None without bytes attrs)."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        name = r.get("name", "?")
+        row = agg.setdefault(name, {
+            "calls": 0, "events": 0, "total_ms": 0.0, "first_ms": None,
+            "steady_total_ms": 0.0, "steady_calls": 0,
+            "steady_nnz": 0, "steady_bytes": 0, "steady_flops": 0,
+            "nnz": 0, "bytes": 0, "flops": 0,
+        })
+        if r.get("type") == "event":
+            row["events"] += 1
+            continue
+        dur_ms = float(r.get("dur_ns", 0)) / 1e6
+        row["calls"] += 1
+        row["total_ms"] += dur_ms
+        attrs = r.get("attrs") or {}
+        nnz = attrs.get("nnz")
+        nbytes = attrs.get("bytes")
+        flops = attrs.get("flops")
+        for key, val in (("nnz", nnz), ("bytes", nbytes), ("flops", flops)):
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                row[key] += val
+        if r.get("first", r.get("seq", 0) == 0):
+            # Several "first" spans can appear after a trace.reset();
+            # keep the largest (the real compile is the slow one).
+            if row["first_ms"] is None or dur_ms > row["first_ms"]:
+                row["first_ms"] = dur_ms
+        else:
+            row["steady_total_ms"] += dur_ms
+            row["steady_calls"] += 1
+            for key, val in (("steady_nnz", nnz), ("steady_bytes", nbytes),
+                             ("steady_flops", flops)):
+                if isinstance(val, (int, float)) and not isinstance(val,
+                                                                    bool):
+                    row[key] += val
+    for row in agg.values():
+        n = row["steady_calls"]
+        row["steady_ms"] = (row["steady_total_ms"] / n) if n else None
+        t_s = row["steady_total_ms"] / 1e3
+        row["gbs"] = (row["steady_bytes"] / t_s / 1e9
+                      if t_s > 0 and row["steady_bytes"] else None)
+        row["gflops"] = (row["steady_flops"] / t_s / 1e9
+                         if t_s > 0 and row["steady_flops"] else None)
+    return agg
+
+
+def _fmt(val: Optional[float], pattern: str = "{:.3f}") -> str:
+    if val is None:
+        return "-"
+    return pattern.format(val)
+
+
+def _fmt_count(val: Any) -> str:
+    if not val:
+        return "-"
+    v = float(val)
+    for unit in ("", "K", "M", "G", "T"):
+        if abs(v) < 1000:
+            return (f"{v:.0f}{unit}" if unit == "" or abs(v) >= 10
+                    else f"{v:.1f}{unit}")
+        v /= 1000.0
+    return f"{v:.1f}P"
+
+
+def render_table(agg: Dict[str, Dict[str, Any]],
+                 stream_gbs: Optional[float] = None) -> str:
+    """Pretty-print the aggregate as a fixed-width per-op table.
+    ``stream_gbs`` (the measured roofline from bench.py) adds a
+    ``vs_stream`` column: achieved fraction of the machine ceiling."""
+    headers = ["op", "calls", "total_ms", "first_ms", "steady_ms",
+               "nnz", "bytes", "GB/s"]
+    if stream_gbs:
+        headers.append("vs_stream")
+    rows = []
+    order = sorted(agg.items(), key=lambda kv: -kv[1]["total_ms"])
+    for name, row in order:
+        if row["calls"] == 0 and row["events"]:
+            label = f"{name} (x{row['events']} events)"
+            rows.append([label] + ["-"] * (len(headers) - 1))
+            continue
+        line = [
+            name,
+            str(row["calls"]),
+            _fmt(row["total_ms"]),
+            _fmt(row["first_ms"]),
+            _fmt(row["steady_ms"], "{:.4f}"),
+            _fmt_count(row["nnz"]),
+            _fmt_count(row["bytes"]),
+            _fmt(row["gbs"], "{:.2f}"),
+        ]
+        if stream_gbs:
+            frac = (row["gbs"] / stream_gbs) if row["gbs"] else None
+            line.append(_fmt(frac, "{:.3f}"))
+        rows.append(line)
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    def fmt_line(cells):
+        return "  ".join(
+            c.ljust(widths[i]) if i == 0 else c.rjust(widths[i])
+            for i, c in enumerate(cells)
+        ).rstrip()
+    out = [fmt_line(headers), fmt_line(["-" * w for w in widths])]
+    out.extend(fmt_line(r) for r in rows)
+    return "\n".join(out)
+
+
+def summarize(records: Iterable[Dict[str, Any]],
+              stream_gbs: Optional[float] = None) -> str:
+    """One-shot: aggregate + render."""
+    return render_table(aggregate(records), stream_gbs=stream_gbs)

@@ -61,6 +61,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
+
 L = 128                 # TPU lane width
 TILE_MIN = 1 << 14      # default rows per grid step (multiple of 1024)
 TILE_MAX = 1 << 17      # beyond this the VMEM working set is too large
@@ -527,10 +529,12 @@ def dia_spmm_maybe_pallas(packed, X):
         except ImportError:
             return None
     try:
-        y = pallas_dia_spmm(
-            packed.rdata, packed.rmask, X, packed.offsets, packed.shape,
-            tile, interpret=interpret,
-        )
+        with _obs.span("pallas.spmm", tile=tile, k=int(k),
+                       num_diags=len(packed.offsets)):
+            y = pallas_dia_spmm(
+                packed.rdata, packed.rmask, X, packed.offsets,
+                packed.shape, tile, interpret=interpret,
+            )
         _SPMM_OK.add(key)
         return y
     except Exception as e:
@@ -540,6 +544,9 @@ def dia_spmm_maybe_pallas(packed, X):
             f"legate_sparse_tpu: pallas DIA SpMM unavailable "
             f"({e!r:.200}); using XLA path\n"
         )
+        _obs.inc("op.pallas_fallback.spmm")
+        _obs.event("pallas.fallback", kernel="spmm",
+                   error=repr(e)[:200])
         _SPMM_FAILED.add(key)
         return None
 
@@ -715,9 +722,11 @@ def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
         except ImportError:
             return None
     try:
-        C = pallas_dia_spgemm(a_data, b_data, offs_a, offs_b, offs_c,
-                              shape_a, shape_b, tile,
-                              interpret=interpret)
+        with _obs.span("pallas.spgemm", tile=tile,
+                       num_diags_c=len(offs_c)):
+            C = pallas_dia_spgemm(a_data, b_data, offs_a, offs_b,
+                                  offs_c, shape_a, shape_b, tile,
+                                  interpret=interpret)
         _SPGEMM_OK.add(key)
         return C
     except Exception as e:
@@ -727,6 +736,9 @@ def dia_spgemm_maybe_pallas(a_data, b_data, offs_a, offs_b, offs_c,
             f"legate_sparse_tpu: pallas DIA SpGEMM unavailable "
             f"({e!r:.200}); using XLA path\n"
         )
+        _obs.inc("op.pallas_fallback.spgemm")
+        _obs.event("pallas.fallback", kernel="spgemm",
+                   error=repr(e)[:200])
         _SPGEMM_FAILED.add(key)
         return None
 
@@ -787,10 +799,12 @@ def dia_spmv_maybe_pallas(packed, x):
     if key in _FAILED:
         return None
     try:
-        return pallas_dia_spmv(
-            packed.rdata, packed.rmask, x, packed.offsets, packed.shape,
-            packed.tile, interpret=interpret,
-        )
+        with _obs.span("pallas.spmv", tile=packed.tile,
+                       num_diags=len(packed.offsets)):
+            return pallas_dia_spmv(
+                packed.rdata, packed.rmask, x, packed.offsets,
+                packed.shape, packed.tile, interpret=interpret,
+            )
     except Exception as e:  # lowering/compile failure -> XLA fallback
         import sys
 
@@ -798,6 +812,9 @@ def dia_spmv_maybe_pallas(packed, x):
             f"legate_sparse_tpu: pallas DIA kernel unavailable "
             f"({e!r:.200}); using XLA path\n"
         )
+        _obs.inc("op.pallas_fallback.spmv")
+        _obs.event("pallas.fallback", kernel="spmv",
+                   error=repr(e)[:200])
         _FAILED.add(key)
         return None
 

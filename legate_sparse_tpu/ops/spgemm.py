@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from ..types import coord_dtype_for, index_dtype, nnz_dtype
 from .convert import row_ids_from_indptr, indptr_from_row_ids
 
@@ -42,6 +43,7 @@ from .convert import row_ids_from_indptr, indptr_from_row_ids
 def spgemm_num_products(a_indices, a_indptr, b_indptr) -> int:
     """T = total expanded products (host-blocking size oracle)."""
     counts = jnp.diff(b_indptr)[a_indices]
+    _obs.inc("transfer.host_sync.spgemm_T")
     return int(jnp.sum(counts))
 
 
@@ -49,6 +51,7 @@ def spgemm_num_products(a_indices, a_indptr, b_indptr) -> int:
 def _expand(a_data, a_indices, a_indptr, b_data, b_indices, b_indptr,
             num_products: int, m: int):
     """Emit all (row, col, value) product triplets, ordered by A nonzero."""
+    _obs.inc("trace.spgemm_expand")
     nnz_a = a_data.shape[0]
     a_rows = row_ids_from_indptr(a_indptr, nnz_a)
     # Products contributed by each A-nonzero = nnz of the B row it selects.
@@ -115,6 +118,7 @@ def coalesce_coo(rows, cols, vals, m: int):
     """
     rows, cols, vals = sort_coo(rows, cols, vals)
     heads = run_heads(rows, cols)
+    _obs.inc("transfer.host_sync.spgemm_nnz")
     nnz_c = int(jnp.sum(heads))
     return compress_coo(rows, cols, vals, heads, nnz_c, m)
 

@@ -15,6 +15,11 @@ Kernel choice on TPU:
   loops, no dynamic shapes.
 - Structured (banded/DIA) matrices keep the gather-free shifted-add
   kernels in ``ops/dia_ops.py`` (use ``dia_array.dot``).
+
+Observability: each jitted kernel body bumps a ``trace.<kernel>``
+counter — the body only executes on a jit cache miss, so the counter
+IS the retrace/compile count for that kernel (``obs/counters.py``
+naming contract).
 """
 
 from __future__ import annotations
@@ -26,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from .convert import row_ids_from_indptr
 
 
@@ -39,6 +45,7 @@ def csr_spmv(data, indices, indptr, x, rows: int):
     the per-call ``searchsorted`` the same way Legion caches partitions
     across solver iterations (reference §3.2 partition-caching note).
     """
+    _obs.inc("trace.csr_spmv")
     nnz = data.shape[0]
     row_ids = row_ids_from_indptr(indptr, nnz)
     prod = data * x[indices]
@@ -50,6 +57,7 @@ def csr_spmv(data, indices, indptr, x, rows: int):
 @partial(jax.jit, static_argnames=("rows",))
 def csr_spmv_rowids(data, indices, row_ids, x, rows: int):
     """SpMV with precomputed per-nnz row ids (static matrix structure)."""
+    _obs.inc("trace.csr_spmv_rowids")
     prod = data * x[indices]
     return jax.ops.segment_sum(
         prod, row_ids, num_segments=rows, indices_are_sorted=True
@@ -83,6 +91,7 @@ def ell_spmv(ell_data, ell_cols, ell_counts, x):
     scatter, no searchsorted; measured ~HBM-roofline on TPU where flat
     scatter-based SpMV is orders of magnitude slower.
     """
+    _obs.inc("trace.ell_spmv")
     W = ell_data.shape[1]
     slot = jnp.arange(W, dtype=ell_counts.dtype)
     valid = slot[None, :] < ell_counts[:, None]
@@ -105,6 +114,7 @@ def ell_spmm(ell_data, ell_cols, ell_counts, X):
     trace time: one fused (rows, W, k) pass when it fits, else a
     fori_loop accumulating one W-slice at a time (transient memory
     O(rows*k) instead of O(rows*W*k))."""
+    _obs.inc("trace.ell_spmm")
     rows, W = ell_data.shape
     k = X.shape[1]
     slot = jnp.arange(W, dtype=ell_counts.dtype)
@@ -173,6 +183,7 @@ def ell_pack_device(data, indices, indptr, rows: int, W: int):
 @partial(jax.jit, static_argnames=("rows",))
 def csr_spmm_rowids(data, indices, row_ids, X, rows: int):
     """SpMM with precomputed per-nnz row ids (static matrix structure)."""
+    _obs.inc("trace.csr_spmm_rowids")
     prod = data[:, None] * X[indices, :]
     return jax.ops.segment_sum(
         prod, row_ids, num_segments=rows, indices_are_sorted=True

@@ -1,0 +1,53 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""jax version compatibility shims for the parallel layer.
+
+``shard_map`` moved twice across the jax versions this package must
+run on: ``jax.experimental.shard_map.shard_map`` (<= 0.4.x, keyword
+``check_rep``), then ``jax.shard_map`` (>= 0.6, keyword ``check_vma``).
+A bare ``from jax import shard_map`` at module import time kills
+collection of the ENTIRE test suite on older jax (the r5 seed failure
+mode), so every parallel module imports the resolved symbol from here
+instead.
+
+The wrapper normalizes on the NEW keyword spelling (``check_vma``) and
+translates for the experimental API, so call sites are written once
+against the modern surface.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+_NATIVE = getattr(_jax, "shard_map", None)
+
+if _NATIVE is not None:
+    shard_map = _NATIVE
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        """``jax.shard_map``-shaped facade over the experimental API
+        (``check_vma`` maps onto the old ``check_rep`` flag)."""
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs,
+        )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside ``shard_map``.
+
+    ``jax.lax.axis_size`` only exists on newer jax; on 0.4.x the axis
+    environment exposes the same static value through
+    ``jax.core.axis_frame`` (which returns the bare size there)."""
+    fn = getattr(_jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    import jax.core as _jc
+
+    return int(_jc.axis_frame(axis_name))
+
+
+__all__ = ["shard_map", "axis_size"]

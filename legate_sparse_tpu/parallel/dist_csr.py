@@ -45,6 +45,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -395,6 +396,23 @@ def _dia_shard_blocks(offs, dia_global, R, rps, rows, cols, dtype):
     return out
 
 
+def _device_put_sharded(arr, sharding):
+    """``jax.device_put`` onto a (possibly process-spanning) sharding.
+
+    In multi-controller runs, plain ``device_put`` of a host array
+    onto a NamedSharding that spans non-addressable devices performs a
+    cross-host equality check that the installed jax cannot run on the
+    CPU backend ("Multiprocess computations aren't implemented");
+    ``make_array_from_callback`` sidesteps it and materializes only
+    each process's addressable shards — which is also the right memory
+    behavior at scale.  Single-process behavior is unchanged."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
               force_all_gather: bool = False,
               ell_max_expand: Optional[float] = None,
@@ -410,6 +428,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     """
     from ..settings import settings
 
+    _obs.inc("op.shard_csr")
     if ell_max_expand is None:
         ell_max_expand = settings.ell_max_expand
     if precise is None:
@@ -509,7 +528,13 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     use_ell = ell_within_budget(rows_p, W, nnz, ell_max_expand)
 
     spec = NamedSharding(mesh, P(ROW_AXIS))
-    put = lambda arr: jax.device_put(jnp.asarray(arr), spec)
+
+    def put(arr):
+        a = jnp.asarray(arr)
+        _obs.inc("transfer.shard_upload")
+        _obs.inc("transfer.shard_upload_bytes",
+                 int(a.size) * a.dtype.itemsize)
+        return _device_put_sharded(a, spec)
 
     if use_ell:
         # Shared (rows, W) ELL pack, padded to R*rps rows, then reshaped
@@ -541,6 +566,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             ell_cols = np.clip(reb, 0, rps + 2 * halo - 1).astype(
                 indices.dtype
             )
+        _obs.event("shard_csr.layout", layout="ell", halo=halo,
+                   precise=bool(precise), shards=R, rows=rows, nnz=nnz,
+                   banded=dia_offs is not None)
         dist = attach_dia_prepack(DistCSR(
             data=put(ell_data), cols=put(ell_cols), counts=put(ell_counts),
             row_ids=None, shape=(rows, cols), rows_per_shard=rps,
@@ -578,6 +606,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     elif halo >= 0:
         reb = idx_b - (starts - halo)[:, None]
         idx_b = np.clip(reb, 0, rps + 2 * halo - 1).astype(indices.dtype)
+    _obs.event("shard_csr.layout", layout="padded-csr", halo=halo,
+               precise=bool(precise), shards=R, rows=rows, nnz=nnz,
+               banded=dia_offs is not None)
     return attach_dia_prepack(DistCSR(
         data=put(data_b), cols=put(idx_b),
         counts=put(local_nnz.astype(np.int32)), row_ids=put(rid_b),
@@ -599,7 +630,10 @@ def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
     pad = rows_padded - x.shape[0]
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad,), dtype=x.dtype)])
-    return jax.device_put(x, NamedSharding(mesh, P(ROW_AXIS)))
+    _obs.inc("transfer.shard_upload")
+    _obs.inc("transfer.shard_upload_bytes",
+             int(x.size) * x.dtype.itemsize)
+    return _device_put_sharded(x, NamedSharding(mesh, P(ROW_AXIS)))
 
 
 def _extend_x(x_local, halo: int, axis: int = 0):
@@ -613,7 +647,9 @@ def _extend_x(x_local, halo: int, axis: int = 0):
     """
     if halo <= 0:
         return x_local
-    axis_size = jax.lax.axis_size(ROW_AXIS)
+    from ._compat import axis_size as _axis_size
+
+    axis_size = _axis_size(ROW_AXIS)
     right_perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     left_perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
     n = x_local.shape[axis]
@@ -635,7 +671,8 @@ def _dia_spmv_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
     direct ``dist_spmv`` calls (microbenchmarks, user loops outside
     ``dist_cg``) would re-trace and recompile every time.
     """
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.dia_spmv_fn")
+    from ._compat import shard_map
 
     def dia_kernel(ddata, x_local, *rest):
         x_ext = _extend_x(x_local, halo)
@@ -693,7 +730,8 @@ def _dia_spmv_pallas_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
     outer compile — callers gate on ``supported()`` having produced the
     prepack and on result-dtype equality.
     """
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.dia_spmv_pallas_fn")
+    from ._compat import shard_map
 
     from ..ops.pallas_dia import L as _LANES
     from ..ops.pallas_dia import pallas_dia_spmv
@@ -723,7 +761,8 @@ def _block_spmv_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
                    rps: int):
     """Cached shard_map callable for the ELL / padded-CSR dist SpMV
     (see ``_dia_spmv_fn`` for why caching matters)."""
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.block_spmv_fn")
+    from ._compat import shard_map
 
     from ..ops import spmv as _spmv_ops
 
@@ -795,58 +834,72 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     """
     halo = A.halo
     precise = A.gather_idx is not None
+    _obs.inc("op.dist_spmv")
 
-    if A.dia_data is not None and halo >= 0 and not precise:
-        # Banded fast path: halo exchange + static shifted-adds, zero
-        # gathers (the per-shard analog of ``ops.dia_ops.dia_spmv``).
-        from ..ops.pallas_dia import pallas_dist_mode
+    with _obs.span("dist_spmv", shards=A.num_shards, halo=halo) as sp:
+        if A.dia_data is not None and halo >= 0 and not precise:
+            # Banded fast path: halo exchange + static shifted-adds,
+            # zero gathers (per-shard analog of ``ops.dia_ops.dia_spmv``).
+            from ..ops.pallas_dia import pallas_dist_mode
 
-        mode = pallas_dist_mode()
-        if (mode != "0" and A.pdia_tile
-                and jnp.result_type(A.dtype, x.dtype) == A.dtype):
-            # Mosaic route over the pre-blocked layout (default on
-            # TPU).  The dtype gate keeps promotion semantics (e.g.
-            # bf16 matrix * f32 x -> f32) identical to the XLA branch.
-            fn = _dia_spmv_pallas_fn(
+            mode = pallas_dist_mode()
+            if (mode != "0" and A.pdia_tile
+                    and jnp.result_type(A.dtype, x.dtype) == A.dtype):
+                # Mosaic route over the pre-blocked layout (default on
+                # TPU).  The dtype gate keeps promotion semantics (e.g.
+                # bf16 matrix * f32 x -> f32) identical to the XLA
+                # branch.
+                fn = _dia_spmv_pallas_fn(
+                    A.mesh, A.dia_offsets, halo, A.rows_per_shard,
+                    A.pdia_tile, mode == "interpret",
+                )
+                if sp is not None:
+                    sp.set(path="dia-pallas")
+                return fn(A.pdia_data, A.pdia_mask, x)
+            has_mask = A.dia_mask is not None
+            fn = _dia_spmv_fn(
                 A.mesh, A.dia_offsets, halo, A.rows_per_shard,
-                A.pdia_tile, mode == "interpret",
+                A.shape[0], has_mask,
             )
-            return fn(A.pdia_data, A.pdia_mask, x)
-        has_mask = A.dia_mask is not None
-        fn = _dia_spmv_fn(
-            A.mesh, A.dia_offsets, halo, A.rows_per_shard, A.shape[0],
-            has_mask,
-        )
-        args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
+            args = (A.dia_data, x) + ((A.dia_mask,) if has_mask else ())
+            if sp is not None:
+                sp.set(path="dia-xla")
+            return fn(*args)
+
+        A._require_blocks("dist_spmv")
+        if not A.bsr_tried and A.bsr_blocks is None:
+            # Lazy build on first SpMV (mirrors csr_array._get_bsr):
+            # other consumers (dist_spmm/dist_spgemm) never pay the
+            # densification.
+            attach_bsr_prepack(A)
+        if (A.bsr_blocks is not None
+                and jnp.result_type(A.dtype, x.dtype) == A.dtype):
+            from ..ops.pallas_dia import pallas_dist_mode
+
+            mode = pallas_dist_mode()
+            if mode != "0":
+                nbr, nbc = A.bsr_grid
+                fn = _bsr_spmv_dist_fn(
+                    A.mesh, A.rows_per_shard, nbr, nbc,
+                    mode == "interpret",
+                )
+                if sp is not None:
+                    sp.set(path="bsr")
+                return fn(A.bsr_blocks, A.bsr_brow, A.bsr_bcol, x)
+        fn = _block_spmv_fn(A.mesh, halo, precise, A.ell,
+                            A.rows_per_shard)
+        if A.ell:
+            args = (A.data, A.cols, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (x,)
+        else:
+            args = (A.data, A.cols, A.row_ids, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (x,)
+        if sp is not None:
+            sp.set(path="ell" if A.ell else "padded-csr",
+                   precise=precise)
         return fn(*args)
-
-    A._require_blocks("dist_spmv")
-    if not A.bsr_tried and A.bsr_blocks is None:
-        # Lazy build on first SpMV (mirrors csr_array._get_bsr): other
-        # consumers (dist_spmm/dist_spgemm) never pay the densification.
-        attach_bsr_prepack(A)
-    if (A.bsr_blocks is not None
-            and jnp.result_type(A.dtype, x.dtype) == A.dtype):
-        from ..ops.pallas_dia import pallas_dist_mode
-
-        mode = pallas_dist_mode()
-        if mode != "0":
-            nbr, nbc = A.bsr_grid
-            fn = _bsr_spmv_dist_fn(
-                A.mesh, A.rows_per_shard, nbr, nbc,
-                mode == "interpret",
-            )
-            return fn(A.bsr_blocks, A.bsr_brow, A.bsr_bcol, x)
-    fn = _block_spmv_fn(A.mesh, halo, precise, A.ell, A.rows_per_shard)
-    if A.ell:
-        args = (A.data, A.cols, A.counts) + (
-            (A.gather_idx,) if precise else ()
-        ) + (x,)
-    else:
-        args = (A.data, A.cols, A.row_ids, A.counts) + (
-            (A.gather_idx,) if precise else ()
-        ) + (x,)
-    return fn(*args)
 
 
 def shard_dense(X, mesh: Mesh, rows_padded: int) -> jax.Array:
@@ -866,8 +919,9 @@ def shard_dense(X, mesh: Mesh, rows_padded: int) -> jax.Array:
             X = jnp.concatenate(
                 [X, jnp.zeros((X.shape[0], pad_c), X.dtype)], axis=1
             )
-        return jax.device_put(X, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)))
-    return jax.device_put(X, NamedSharding(mesh, P(ROW_AXIS, None)))
+        return _device_put_sharded(
+            X, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)))
+    return _device_put_sharded(X, NamedSharding(mesh, P(ROW_AXIS, None)))
 
 
 @lru_cache(maxsize=128)
@@ -881,7 +935,8 @@ def _block_spmm_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
     axis up), while X's *columns* shard over the grid's "cols" axis —
     independent columns, so the column axis adds zero communication.
     """
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.block_spmm_fn")
+    from ._compat import shard_map
 
     from ..ops import spmv as _spmv_ops
 
@@ -948,7 +1003,8 @@ def _dia_spmm_dist_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
     per-shard Mosaic band kernel over the pre-blocked layout (the SpMM
     arm of ``_dia_spmv_pallas_fn``; row shifts of a 2-D X are sublane
     rolls — cheaper than the SpMV lane decomposition)."""
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.dia_spmm_dist_fn")
+    from ._compat import shard_map
 
     from ..ops.pallas_dia import L as _LANES
     from ..ops.pallas_dia import pallas_dia_spmm
@@ -1101,7 +1157,8 @@ def _bsr_spmv_dist_fn(mesh: Mesh, rps: int, nbr: int, nbc: int,
                       interpret: bool):
     """Cached shard_map callable: all_gather x, then the per-shard
     Pallas BSR kernel over the pre-packed blocks."""
-    from jax import shard_map
+    _obs.inc("jit_miss.dist_csr.bsr_spmv_dist_fn")
+    from ._compat import shard_map
 
     from ..ops.bsr import B as _B
     from ..ops.bsr import bsr_spmv_pallas
@@ -1311,7 +1368,7 @@ def dist_diagonal(A: DistCSR) -> jax.Array:
     ``src/sparse/array/csr/get_diagonal.cc``); feeds the Jacobi
     smoother in distributed GMG.
     """
-    from jax import shard_map
+    from ._compat import shard_map
 
     rps = A.rows_per_shard
 
@@ -1419,6 +1476,7 @@ def dist_cg(
     """
     from ..linalg import _cg_loop, _get_atol_rtol
 
+    _obs.inc("op.dist_cg")
     rows, b_sh, x0_sh, maxiter, cb = _shard_system(
         A, b, x0, maxiter, callback
     )
@@ -1428,10 +1486,17 @@ def dist_cg(
     atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
     M_mv = M if M is not None else (lambda r: r)
     if callback is None:
-        x, iters = _cg_loop(
-            A.matvec_fn(), M_mv, b_sh, x0_sh, atol, int(maxiter),
-            int(conv_test_iters),
-        )
+        with _obs.span("dist_cg", n=rows, shards=A.num_shards,
+                       maxiter=int(maxiter),
+                       preconditioned=M is not None) as sp:
+            x, iters = _cg_loop(
+                A.matvec_fn(), M_mv, b_sh, x0_sh, atol, int(maxiter),
+                int(conv_test_iters),
+            )
+            if sp is not None:
+                # One host sync for honest timing + the true iteration
+                # count (tracing mode only; see linalg.cg).
+                sp.set(iters=int(iters))
         return x[:rows], iters
 
     # Callback path: Python-driven loop so user code observes every

@@ -45,8 +45,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from ..types import index_dtype
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .dist_csr import DistCSR
@@ -207,8 +208,13 @@ def _unrebase_b(B: _Layout, b_cols_g, rps):
 # chain stops paying for itself; use the one-shot all_gather.
 _B_WINDOW_DENSE_FRAC = 0.75
 
-# Introspection for tests/diagnostics: how dist_spgemm's last general-
-# path call realized B ("window" | "all_gather"), and the plan used.
+# Legacy introspection globals: how dist_spgemm's last general-path
+# call realized B ("window" | "all_gather"), and the plan used.  The
+# SUPPORTED inspection mechanism is now the obs subsystem — the
+# ``dist_spgemm`` span records ``b_realization``/``b_plan`` attributes
+# and the ``dist_spgemm.realization.*`` counters accumulate the choice
+# per call (``obs/counters.py``).  These two names stay for existing
+# tests/scripts; new code should read the span attrs instead.
 LAST_B_REALIZATION: str = ""
 LAST_B_PLAN: tuple = ()
 
@@ -224,6 +230,7 @@ def _col_window_fn(mesh, la: _Layout):
     multi-controller runs — a ``P(ROW_AXIS)``-sharded output would span
     non-addressable devices there and refuse ``np.asarray``.
     """
+    _obs.inc("jit_miss.dist_spgemm.col_window_fn")
     in_specs = _esc_specs(la)
     big = la.shape[1]
 
@@ -263,8 +270,11 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
         # skip the min/max image probe (a blocking device->host round
         # trip — ~1 s over the TPU tunnel) on every later call.  A
         # matrix whose SPARSITY later narrows under the same layout
-        # stays on all_gather; correctness is unaffected.
+        # stays on all_gather (``reset_window_declines()`` un-pins);
+        # correctness is unaffected.
+        _obs.inc("dist_spgemm.window_decline_cached")
         return None
+    _obs.inc("transfer.host_sync.spgemm_window_probe")
     mn, mx = _col_window_fn(A.mesh, la)(*a_arrays)
     mn = np.asarray(mn)
     mx = np.asarray(mx)
@@ -299,6 +309,20 @@ def _window_decline(la: _Layout, lb: _Layout) -> None:
     if len(_WINDOW_DECLINED) > 256:     # unbounded-session safety valve
         _WINDOW_DECLINED.clear()
     _WINDOW_DECLINED.add((la, lb))
+    _obs.inc("dist_spgemm.window_decline")
+    _obs.event("dist_spgemm.window_decline",
+               a_shape=la.shape, b_shape=lb.shape,
+               shards=la.num_shards)
+
+
+def reset_window_declines() -> None:
+    """Clear the window-decline cache (ADVICE r5 low finding): the
+    cache is keyed on layout STRUCTURE only, so one wide-window matrix
+    would otherwise pin every later same-layout matrix to the
+    all_gather realization for the life of the process.  Call after
+    retiring a pathological matrix (or from tests) to let later
+    same-layout products re-probe the min/max column image."""
+    _WINDOW_DECLINED.clear()
 
 
 def _b_window_flat(B: _Layout, plan, first_local, data, cols, counts,
@@ -514,6 +538,7 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
     """Cached shard_map callable for the banded product (fresh closures
     would re-trace/recompile on every call — same reasoning as
     ``dist_csr._dia_spmv_fn``)."""
+    _obs.inc("jit_miss.dist_spgemm.band_spgemm_fn")
     nd_c = len(offs_c)
     idx_c = {o: i for i, o in enumerate(offs_c)}
     offs_c_dev = jnp.asarray(offs_c, dtype=index_dtype())
@@ -572,8 +597,13 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
     if A.mesh is not B.mesh and A.mesh != B.mesh:
         raise ValueError("operands must share a mesh")
-    C_band = _dist_band_spgemm(A, B)
+    _obs.inc("op.dist_spgemm")
+    with _obs.span("dist_spgemm.band_probe"):
+        C_band = _dist_band_spgemm(A, B)
     if C_band is not None:
+        _obs.inc("dist_spgemm.realization.band")
+        _obs.event("dist_spgemm.realization", choice="band",
+                   shards=A.num_shards)
         return C_band
     A._require_blocks("dist_spgemm")
     B._require_blocks("dist_spgemm")
@@ -626,11 +656,26 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         first_dev = ()
         LAST_B_REALIZATION = "all_gather"
         LAST_B_PLAN = ()
+    _obs.inc("dist_spgemm.realization." + LAST_B_REALIZATION)
+    with _obs.span("dist_spgemm", shards=R, m=m, n=n_cols,
+                   b_realization=LAST_B_REALIZATION,
+                   b_plan=LAST_B_PLAN) as sp:
+        return _dist_spgemm_phases(
+            A, B, mesh, la, lb, plan, a_arrays, b_arrays, first_dev,
+            rps, m, n_cols, col_dtype, R, sp,
+        )
 
+
+def _dist_spgemm_phases(A, B, mesh, la, lb, plan, a_arrays, b_arrays,
+                        first_dev, rps, m, n_cols, col_dtype, R, sp):
+    """The three collective ESC phases (split out so the realization
+    span covers them; ``sp`` is the live span, or None when tracing
+    is disabled)."""
     # ---- phase 1: T_local ------------------------------------------------
     t_locals = _esc_t_fn(mesh, la, lb, plan)(
         *a_arrays, *b_arrays, *first_dev
     )
+    _obs.inc("transfer.host_sync.dist_spgemm_T")
     T_cap = int(jnp.max(t_locals))
 
     val_dtype = jnp.result_type(A.data.dtype, B.data.dtype)
@@ -650,7 +695,15 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     nnz_locals = _esc_nnz_fn(mesh, la, lb, T_cap, plan)(
         *a_arrays, *b_arrays, *first_dev
     )
+    _obs.inc("transfer.host_sync.dist_spgemm_nnz")
+    # Device-side reductions only: fetching the P(ROW_AXIS)-sharded
+    # nnz_locals itself (np.asarray) is illegal in multi-controller
+    # runs — same pitfall documented at _col_window_fn.  The reduced
+    # scalars are replicated and always fetchable.
     nnz_cap = max(int(jnp.max(nnz_locals)), 1)
+    if sp is not None:
+        sp.set(T_cap=T_cap, nnz_cap=nnz_cap,
+               nnz=int(jnp.sum(nnz_locals)))
 
     # ---- phase 3: numeric ------------------------------------------------
     vals_b, cols_b, rids_b, counts_b = _esc_numeric_fn(
@@ -689,6 +742,7 @@ def _esc_t_fn(mesh, la: _Layout, lb: _Layout, plan=None):
     ``_Layout``; fresh closures per call would recompile every time).
     ``plan`` is the static window-shape triple or None — the per-shard
     window starts ride as a traced trailing operand, not a cache key."""
+    _obs.inc("jit_miss.dist_spgemm.esc_t_fn")
     in_specs = _esc_specs(la) + _esc_specs(lb)
     if plan is not None:
         in_specs = in_specs + (P(ROW_AXIS),)
@@ -744,6 +798,7 @@ def _esc_t_fn(mesh, la: _Layout, lb: _Layout, plan=None):
 def _esc_nnz_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
                 plan=None):
     """Cached phase-2 (output nnz) shard_map."""
+    _obs.inc("jit_miss.dist_spgemm.esc_nnz_fn")
     in_specs = _esc_specs(la) + _esc_specs(lb)
     if plan is not None:
         in_specs = in_specs + (P(ROW_AXIS),)
@@ -777,6 +832,7 @@ def _esc_numeric_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
     """Cached phase-3 (numeric) shard_map."""
     from ..types import coord_dtype_for
 
+    _obs.inc("jit_miss.dist_spgemm.esc_numeric_fn")
     in_specs = _esc_specs(la) + _esc_specs(lb)
     if plan is not None:
         in_specs = in_specs + (P(ROW_AXIS),)
@@ -825,4 +881,6 @@ def _esc_numeric_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
 def _put_blocks(arr, mesh):
     from jax.sharding import NamedSharding
 
-    return jax.device_put(arr, NamedSharding(mesh, P(ROW_AXIS)))
+    from .dist_csr import _device_put_sharded
+
+    return _device_put_sharded(arr, NamedSharding(mesh, P(ROW_AXIS)))

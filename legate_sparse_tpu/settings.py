@@ -38,6 +38,15 @@ Settings
     float64 (the split is TPU-specific; CUDA f64 is native, which is
     also why the reference needs no such policy).
 
+``obs`` (``LEGATE_SPARSE_TPU_OBS``)
+    Observability: op-level span tracing (``legate_sparse_tpu.obs``).
+    Off by default — the span API is a no-op context manager and the
+    hot paths pay only a module-global check.  Exposed here as a
+    property delegating to ``obs.trace`` so ``settings.obs = True``
+    and the env var are equivalent switches.
+    ``LEGATE_SPARSE_TPU_OBS_FILE`` names the default trace artifact
+    (``bench.py`` derives its ``BENCH_*.trace.json`` from it).
+
 ``check_bounds`` (``LEGATE_SPARSE_TPU_CHECK_BOUNDS``)
     Debug mode, the analog of the reference's ``--check-bounds``
     build flag (reference ``install.py:375-381`` wiring
@@ -138,6 +147,23 @@ class Settings:
         # interpret mode off-TPU) — differential-testing hook.
         self.bsr_force: bool = _env_bool("LEGATE_SPARSE_TPU_BSR_FORCE",
                                          False)
+
+    @property
+    def obs(self) -> bool:
+        """Span tracing on/off — delegates to ``obs.trace`` (single
+        source of truth; the env var was read there at import)."""
+        from .obs import trace
+
+        return trace.enabled()
+
+    @obs.setter
+    def obs(self, value: bool) -> None:
+        from .obs import trace
+
+        if value:
+            trace.enable()
+        else:
+            trace.disable()
 
 
 settings = Settings()

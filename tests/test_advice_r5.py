@@ -1,0 +1,166 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Regression tests for the ADVICE r5 findings fixed in this round.
+
+- medium (eigen.py): the generalized-eigsh SM remap must not leak its
+  internal sigma=0.0/'LM' into the ArpackNoConvergence host fallback —
+  for a singular A that made scipy splu(A - 0*M) raise "Factor is
+  exactly singular" where direct SM mode succeeds.
+- low (dist_spgemm.py): the window-decline cache is keyed on layout
+  structure only and permanently pinned later same-layout matrices to
+  all_gather; ``reset_window_declines()`` un-pins, and decline events
+  now flow through the obs counters.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.sparse as sp
+
+import jax
+
+import legate_sparse_tpu as sparse
+import legate_sparse_tpu.linalg as linalg
+from legate_sparse_tpu.obs import counters
+
+
+def _spd_mass(n, seed=5):
+    rng = np.random.RandomState(seed)
+    Q = scipy.linalg.qr(rng.standard_normal((n, n)))[0]
+    return (Q * (1.0 + rng.rand(n))) @ Q.T
+
+
+def test_eigsh_generalized_sm_singular_falls_back_to_host():
+    """Singular A = diag(0..n-1) with SPD M, which='SM': the native
+    shift-invert at sigma=0 cannot converge (A is exactly singular),
+    and the host fallback must receive the CALLER's sigma=None /
+    which='SM' — not the remapped 0.0/'LM' that makes scipy factor the
+    singular matrix and raise."""
+    n = 12
+    k = 3
+    A_d = np.diag(np.arange(n, dtype=np.float64))
+    M_d = _spd_mass(n)
+    A = sparse.csr_array(sp.csr_matrix(A_d))
+    M = sparse.csr_array(sp.csr_matrix(M_d))
+
+    w, v = linalg.eigsh(A, k=k, M=M, which="SM")
+
+    w_ref = scipy.linalg.eigh(A_d, M_d, eigvals_only=True)
+    ref_sm = np.sort(w_ref[np.argsort(np.abs(w_ref))[:k]])
+    np.testing.assert_allclose(np.sort(w), ref_sm, rtol=1e-6, atol=1e-8)
+    # Residuals in the original pencil: A v = lambda M v.
+    for i in range(k):
+        r = A_d @ v[:, i] - w[i] * (M_d @ v[:, i])
+        assert np.linalg.norm(r) < 1e-6 * max(1.0, abs(w[i]))
+
+
+def test_eigsh_generalized_sm_regular_still_native():
+    """A nonsingular pencil keeps taking the native generalized
+    shift-invert route (no behavior change for the healthy case)."""
+    n = 16
+    k = 3
+    A_d = np.diag(np.arange(1.0, n + 1.0))
+    M_d = _spd_mass(n, seed=7)
+    A = sparse.csr_array(sp.csr_matrix(A_d))
+    M = sparse.csr_array(sp.csr_matrix(M_d))
+    w, v = linalg.eigsh(A, k=k, M=M, which="SM")
+    w_ref = scipy.linalg.eigh(A_d, M_d, eigvals_only=True)
+    ref_sm = np.sort(w_ref[np.argsort(np.abs(w_ref))[:k]])
+    np.testing.assert_allclose(np.sort(w), ref_sm, rtol=1e-5, atol=1e-7)
+
+
+needs_window = pytest.mark.skipif(
+    len(jax.devices()) < 3, reason="window plan needs R > 2"
+)
+
+
+@needs_window
+def test_window_decline_reset_hook_unpins_layout():
+    """A dense-column matrix declines the window plan and caches the
+    decline; without the reset hook every later same-layout product
+    skips the probe forever.  After ``reset_window_declines()`` the
+    next call re-probes (observable through the obs counters)."""
+    import importlib
+
+    from legate_sparse_tpu.parallel import (dist_spgemm, make_row_mesh,
+                                            shard_csr)
+
+    # The package re-exports the FUNCTION under the module's name, so
+    # attribute imports hand back the callable; go through importlib.
+    mod = importlib.import_module(
+        "legate_sparse_tpu.parallel.dist_spgemm")
+
+    mesh = make_row_mesh(jax.devices())
+    rng = np.random.RandomState(3)
+    n = 64
+    A_sp = sp.random(n, n, density=0.3, random_state=rng, format="csr",
+                     dtype=np.float64)
+    A_sp.sum_duplicates()
+    dA = shard_csr(sparse.csr_array(A_sp), mesh=mesh)
+    dB = shard_csr(sparse.csr_array(A_sp), mesh=mesh)
+
+    mod.reset_window_declines()          # pristine cache for this test
+    declines0 = counters.get("dist_spgemm.window_decline")
+    _ = dist_spgemm(dA, dB)
+    assert mod.LAST_B_REALIZATION == "all_gather"
+    assert counters.get("dist_spgemm.window_decline") > declines0
+    assert len(mod._WINDOW_DECLINED) > 0
+
+    # Second product: the decline cache short-circuits the probe.
+    cached0 = counters.get("dist_spgemm.window_decline_cached")
+    probes0 = counters.get("transfer.host_sync.spgemm_window_probe")
+    _ = dist_spgemm(dA, dB)
+    assert counters.get("dist_spgemm.window_decline_cached") == cached0 + 1
+    assert counters.get("transfer.host_sync.spgemm_window_probe") == probes0
+
+    # Reset: the same layout re-probes instead of staying pinned.
+    mod.reset_window_declines()
+    assert len(mod._WINDOW_DECLINED) == 0
+    _ = dist_spgemm(dA, dB)
+    assert (counters.get("transfer.host_sync.spgemm_window_probe")
+            == probes0 + 1)
+
+
+@needs_window
+def test_dist_spgemm_span_records_realization():
+    """The obs span is the supported inspection mechanism for the
+    collective-realization choice (replacing the write-only
+    LAST_B_REALIZATION globals): its attrs must carry the decision and
+    agree with the legacy global."""
+    from legate_sparse_tpu import obs
+    from legate_sparse_tpu.obs import trace
+    import importlib
+
+    from legate_sparse_tpu.parallel import (dist_spgemm, make_row_mesh,
+                                            shard_csr)
+
+    mod = importlib.import_module(
+        "legate_sparse_tpu.parallel.dist_spgemm")
+
+    mesh = make_row_mesh(jax.devices())
+    n = 128
+    d0 = np.where(np.arange(n) % 3 == 0, 0.0, 2.0)
+    A = sparse.diags([d0, np.ones(n - 1)], [0, 1], shape=(n, n),
+                     format="csr")
+    dAm = shard_csr(A, mesh=mesh)
+    assert dAm.dia_mask is not None      # general ESC path, not banded
+
+    was = trace.enabled()
+    trace.reset()
+    trace.enable()
+    try:
+        _ = dist_spgemm(dAm, dAm)
+        spans = [r for r in obs.records()
+                 if r["name"] == "dist_spgemm"]
+        assert len(spans) == 1
+        at = spans[0]["attrs"]
+        assert at["b_realization"] == mod.LAST_B_REALIZATION
+        if at["b_realization"] == "window":
+            assert tuple(at["b_plan"]) == tuple(mod.LAST_B_PLAN)
+        assert at["T_cap"] > 0 and at["nnz_cap"] > 0
+    finally:
+        trace.reset()
+        if was:
+            trace.enable()
+        else:
+            trace.disable()

@@ -223,7 +223,7 @@ def test_modern_scipy_array_constructor_names():
     assert E.__class__.__module__.startswith("legate_sparse_tpu")
     np.testing.assert_allclose(np.asarray(E.todense()), np.eye(4, k=1))
     R = lst.random_array((10, 8), density=0.3,
-                         rng=np.random.default_rng(0))
+                         random_state=np.random.default_rng(0))
     assert R.__class__.__module__.startswith("legate_sparse_tpu")
     assert R.shape == (10, 8) and 0 < R.nnz <= 80
     I = lst.identity(5)

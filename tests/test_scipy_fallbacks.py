@@ -57,7 +57,7 @@ def test_toplevel_fallback_accepts_native_arrays(pair):
     # random_array has no native override: its scipy result must come
     # back as this package's array type (the _from_scipy path).
     assert getattr(lst.random_array, "_lst_scipy_fallback", False)
-    R = lst.random_array((8, 6), density=0.5, rng=np.random.default_rng(0))
+    R = lst.random_array((8, 6), density=0.5, random_state=np.random.default_rng(0))
     assert type(R).__module__.startswith("legate_sparse_tpu")
     assert R.shape == (8, 6)
     # kron with a scipy operand mixes both worlds through the facade.

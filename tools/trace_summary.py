@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Pretty-print a legate_sparse_tpu trace file as a per-op table.
+
+Reads either export format (Chrome-trace ``*.trace.json`` from
+``bench.py`` / ``obs.write_chrome_trace``, or newline-JSON from
+``obs.write_jsonl``) and renders the per-op aggregation: calls,
+total/first-call/steady-state time, nnz and bytes totals, achieved
+GB/s — and, given the measured stream roofline, the fraction of it
+each op reaches.
+
+Usage::
+
+    python tools/trace_summary.py BENCH_20260804T120000.trace.json
+    python tools/trace_summary.py run.trace.json --stream-gbs 819
+    python tools/trace_summary.py run.trace.json --events --counters
+
+``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
+file's bench metadata when present (bench.py embeds its result blob).
+Exit status: 2 when the file contains no span records (the same
+"silent no-op wiring" condition bench.py guards against).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from legate_sparse_tpu.obs import report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-op table from a legate_sparse_tpu trace file."
+    )
+    ap.add_argument("trace_file", help="Chrome-trace or newline-JSON file")
+    ap.add_argument("--stream-gbs", type=float, default=None,
+                    help="measured stream (triad) bandwidth for the "
+                         "vs_stream roofline column; defaults to the "
+                         "value embedded by bench.py when present")
+    ap.add_argument("--events", action="store_true",
+                    help="also list instant events (probe failures, "
+                         "layout decisions, window declines)")
+    ap.add_argument("--counters", action="store_true",
+                    help="also dump the counter snapshot embedded in a "
+                         "Chrome-trace file")
+    args = ap.parse_args(argv)
+
+    records = report.load_records(args.trace_file)
+    spans = [r for r in records if r.get("type") == "span"]
+
+    stream_gbs = args.stream_gbs
+    meta = {}
+    try:
+        with open(args.trace_file) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            meta = doc.get("otherData", {}) or {}
+            if stream_gbs is None:
+                stream_gbs = (meta.get("bench_result") or {}).get(
+                    "stream_gbs")
+    except (ValueError, OSError):
+        pass  # newline-JSON / unreadable: no embedded metadata
+
+    if not spans:
+        print(f"{args.trace_file}: no span records "
+              f"({len(records)} events total) — was tracing enabled "
+              f"(LEGATE_SPARSE_TPU_OBS=1)?", file=sys.stderr)
+        return 2
+
+    print(report.render_table(report.aggregate(records),
+                              stream_gbs=stream_gbs))
+
+    if args.events:
+        events = [r for r in records if r.get("type") == "event"]
+        if events:
+            print(f"\nevents ({len(events)}):")
+            for r in events:
+                attrs = r.get("attrs") or {}
+                detail = " ".join(f"{k}={v}" for k, v in attrs.items())
+                print(f"  {r['name']}  {detail}".rstrip())
+
+    if args.counters and meta.get("counters"):
+        print("\ncounters:")
+        for name in sorted(meta["counters"]):
+            print(f"  {name} = {meta['counters'][name]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
