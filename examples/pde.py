@@ -108,6 +108,46 @@ def execute(nx, ny, throughput, tol, max_iters, warmup_iters, timer):
         print(f"Total time: {total} ms")
 
 
+def execute_explicit(nx, ny, max_iters, warmup_iters, timer):
+    """Explicit damped-Jacobi update throughput: ``p' = p + tau (A p - b)``
+    — ONE SpMV + axpy per step, the hot loop the bench's ``pde_*`` scale
+    anchor measures.  ``tau`` is chosen inside the stability region
+    (spec(A) in [-4(a+g), 0] by Gershgorin, so tau <= 0.5/(a+g) keeps
+    ``I + tau A`` non-expansive); warmup iterations are subtracted like
+    ``--throughput`` mode."""
+    xmin, xmax = 0.0, 1.0
+    ymin, ymax = -0.5, 0.5
+    dx = (xmax - xmin) / (nx - 1)
+    dy = (ymax - ymin) / (ny - 1)
+    a, g = 1.0 / dx**2, 1.0 / dy**2
+    tau = 0.4 / (a + g)
+
+    build, solve = get_phase_procs(use_tpu)
+    with build:
+        A = d2_mat_dirichlet_2d(nx, ny, dx, dy)
+        n = A.shape[0]
+        b = np.ones((n,), dtype=harness_float())
+        p = np.zeros((n,), dtype=harness_float())
+
+    with solve:
+        warmup = warmup_iters if warmup_iters else max(1, max_iters // 10)
+        assert max_iters > warmup
+
+        def step(v):
+            return v + tau * (A.dot(v) - b)
+
+        for _ in range(warmup):
+            p = step(p)
+        timer.start()
+        for _ in range(max_iters - warmup):
+            p = step(p)
+        total = timer.stop(p)
+        print(
+            f"Explicit Mesh: {nx}x{ny}, A numrows: {n}, ms / iter:"
+            f" {total / (max_iters - warmup)}"
+        )
+
+
 def execute_distributed(nx, ny, throughput, tol, max_iters, warmup_iters,
                         timer):
     """Distributed rendition: the interior Laplacian is built
@@ -201,12 +241,24 @@ if __name__ == "__main__":
     parser.add_argument("--distributed", action="store_true",
                         help="shard-local build + collective CG over "
                              "the device mesh (tpu backend only)")
+    parser.add_argument("--explicit", action="store_true",
+                        help="measure the explicit damped-Jacobi "
+                             "update (one SpMV + axpy per step) "
+                             "instead of the CG solve")
     args, _ = parser.parse_known_args()
     _, timer, np, sparse, linalg, use_tpu = parse_common_args()
 
-    if args.throughput and args.max_iters is None:
-        print("Must provide --max-iters when using --throughput.")
+    if (args.throughput or args.explicit) and args.max_iters is None:
+        print("Must provide --max-iters when using --throughput or "
+              "--explicit.")
         sys.exit(1)
+
+    if args.explicit:
+        execute_explicit(
+            nx=args.nx, ny=args.ny, max_iters=args.max_iters,
+            warmup_iters=args.warmup_iters, timer=timer,
+        )
+        sys.exit(0)
 
     if args.distributed:
         if not use_tpu:

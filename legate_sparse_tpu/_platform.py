@@ -34,6 +34,127 @@ ACCEL_PROBE_CODE = (
 # State captured by the first pin_cpu() call, for restore_platform().
 _saved: dict | None = None
 
+# --------------------------------------------------------------------------
+# TTL-cached probe verdict, shared with the tunnel watcher
+# --------------------------------------------------------------------------
+# A down tunnel costs (retries+1) * timeout_s of subprocess probing —
+# 2 x 90 s at the defaults — and EVERY CLI entry point (bench, examples,
+# dryrun) pays it again.  The verdict barely changes minute-to-minute,
+# so it is cached in a small JSON state file shared between this module
+# and ``tools/tunnel_watch.sh`` (which re-probes every 3 min anyway and
+# keeps the cache warm): a second down-tunnel CLI run reaches compute in
+# seconds instead of re-burning the full probe budget.
+#
+# Invalidation: the cache records whether the watcher's live-tunnel
+# marker (``/tmp/tpu_alive``) existed at verdict time; a transition of
+# that marker — the tunnel coming up or going down under a running
+# watcher — makes the cached verdict stale immediately, TTL regardless.
+# ``LEGATE_SPARSE_TPU_PROBE_FORCE=1`` bypasses the cache entirely
+# (capture scripts set it so on-chip evidence never trusts a stale
+# verdict), and ``LEGATE_SPARSE_TPU_PROBE_TTL=0`` disables caching.
+#
+# Only the DEAD verdict is ever served from the cache: committing to a
+# backend on a cached "live" would reintroduce the indefinite-hang
+# failure mode the subprocess probe ladder exists to prevent (a tunnel
+# can die inside the TTL with no marker transition); a genuinely live
+# tunnel answers its real probe in seconds anyway, so caching "live"
+# buys little and risks everything.
+_ALIVE_MARKER = "/tmp/tpu_alive"
+
+
+def _probe_state_path() -> str:
+    # uid-scoped default: on a shared host another user's state file
+    # would be unwritable (sticky /tmp) AND would describe *their*
+    # tunnel — and a world-writable fixed name would let any local
+    # user plant a verdict.
+    return os.environ.get(
+        "LEGATE_SPARSE_TPU_PROBE_STATE",
+        f"/tmp/lst_probe.{os.getuid()}.json")
+
+
+def _probe_ttl_s() -> float:
+    try:
+        return float(os.environ.get("LEGATE_SPARSE_TPU_PROBE_TTL", "600"))
+    except ValueError:
+        return 600.0
+
+
+def _tunnel_marker_alive() -> bool:
+    return os.path.exists(_ALIVE_MARKER)
+
+
+def read_cached_probe() -> bool | None:
+    """The cached accelerator verdict, or None when no usable cache
+    exists (missing/corrupt/expired file, forced fresh probe, or a
+    live-tunnel-marker transition since the verdict was recorded).
+    ``ensure_live_backend`` only ever ACTS on the False ("dead")
+    verdict; True is informational (watcher dashboards, tests)."""
+    import json
+    import time
+
+    ttl = _probe_ttl_s()
+    if ttl <= 0 or os.environ.get(
+            "LEGATE_SPARSE_TPU_PROBE_FORCE", "0") == "1":
+        return None
+    try:
+        with open(_probe_state_path()) as f:
+            st = json.load(f)
+        if not isinstance(st, dict):
+            return None
+        age = time.time() - float(st["ts"])
+        if age < 0 or age > ttl:
+            return None
+        verdict = st.get("verdict")
+        if verdict not in ("live", "dead"):
+            return None
+        if bool(st.get("tunnel_marker")) != _tunnel_marker_alive():
+            return None     # tunnel transitioned: verdict is stale
+        # A verdict probed by a DIFFERENT interpreter does not speak
+        # for this one: a watcher running a cpu-only-jax python would
+        # otherwise pin every CLI (whose own python has the TPU
+        # plugin) to cpu, 180 s-refreshed, forever.
+        exe = st.get("exe")
+        if not exe or os.path.realpath(exe) != os.path.realpath(
+                sys.executable):
+            return None
+        return verdict == "live"
+    except Exception:
+        return None
+
+
+def write_probe_state(live: bool, source: str = "probe") -> None:
+    """Record a fresh probe verdict (atomic rename; best-effort — a
+    read-only /tmp must never break the probe itself)."""
+    import json
+    import tempfile
+    import time
+
+    path = _probe_state_path()
+    tmp = None
+    try:
+        payload = json.dumps({
+            "verdict": "live" if live else "dead",
+            "ts": time.time(),
+            "tunnel_marker": _tunnel_marker_alive(),
+            "source": source,
+            "pid": os.getpid(),
+            "exe": sys.executable,
+        })
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".", prefix=".lst_probe.")
+        with os.fdopen(fd, "w") as f:
+            f.write(payload + "\n")
+        os.replace(tmp, path)
+        tmp = None
+    except Exception:
+        pass
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
 
 def _obs_event(name: str, **attrs) -> None:
     """Structured trace event + always-on counter for probe/pinning
@@ -163,6 +284,20 @@ def ensure_live_backend(timeout_s: int | None = None,
         )
         if not _looks_tpu_hosted() and not gpu_hint:
             return False
+    if read_cached_probe() is False:
+        # Fresh shared DEAD verdict (this process or the tunnel
+        # watcher probed recently, and the live-tunnel marker hasn't
+        # flipped): skip the 90 s-per-attempt subprocess ladder.  A
+        # cached "live" is deliberately NOT served — see the module
+        # comment — so that path falls through to the real probe.
+        _obs_event("platform.probe_cached", verdict="dead")
+        sys.stderr.write(
+            "legate_sparse_tpu: cached probe verdict 'dead' "
+            f"({_probe_state_path()}); pinning cpu without re-probing "
+            "(LEGATE_SPARSE_TPU_PROBE_FORCE=1 forces a fresh probe)\n"
+        )
+        pin_cpu()
+        return False
     for attempt in range(retries + 1):
         try:
             r = subprocess.run(
@@ -171,6 +306,7 @@ def ensure_live_backend(timeout_s: int | None = None,
             )
             if r.returncode == 0 and "ok" in r.stdout:
                 _obs_event("platform.probe_ok", attempt=attempt + 1)
+                write_probe_state(True)
                 return True
             sys.stderr.write(
                 f"legate_sparse_tpu: accelerator probe attempt "
@@ -196,6 +332,7 @@ def ensure_live_backend(timeout_s: int | None = None,
     )
     _obs_event("platform.unreachable_pin_cpu", retries=retries,
                timeout_s=timeout_s)
+    write_probe_state(False)
     pin_cpu()
     return False
 

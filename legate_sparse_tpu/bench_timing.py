@@ -28,6 +28,26 @@ from typing import Callable
 import jax
 
 
+def triad_gbs(log2_lanes: int = 26, k_lo: int = 3,
+              k_hi: int = 18) -> float:
+    """One measured STREAM-triad bandwidth sample (GB/s): x' = a*x + y
+    over ``2**log2_lanes`` f32 lanes (the default 2^26 = 512 MB working
+    set cannot hide in VMEM/LLC).  Callers wanting a *trustworthy*
+    denominator should take several samples interleaved with their
+    workload phases and use the median — on shared CPU boxes single
+    samples vary run-to-run by 25%+ (BENCH_r05's 66 vs 29 GB/s pair),
+    and a wild denominator poisons every roofline fraction computed
+    from it."""
+    import jax.numpy as jnp
+
+    n = 1 << log2_lanes
+    x = jnp.ones((n,), dtype=jnp.float32)
+    y = jnp.full((n,), 1e-9, dtype=jnp.float32)
+    ms = loop_ms_per_iter(lambda v: 1.0000001 * v + y, x,
+                          k_lo=k_lo, k_hi=k_hi)
+    return 3 * 4 * n / (ms * 1e-3) / 1e9
+
+
 def fixed_cost_s(x0, repeats: int = 3) -> float:
     """Measured fixed cost of one dispatch + scalar-fetch round trip
     (the constant both ends of the two-point measurement share).  On

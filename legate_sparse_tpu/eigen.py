@@ -845,11 +845,23 @@ def eigsh(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
         return _lanczos_eigsh(matvec, n_cols, dtype, int(k), which, v0,
                               ncv, maxiter, tol, return_eigenvectors)
 
-    # Native shift-invert: Lanczos on OP = (A - sigma I)^{-1}.
+    # Native shift-invert: Lanczos on OP = (A - sigma I)^{-1}.  Same
+    # ArpackNoConvergence -> host ladder as the SM route above (ADVICE
+    # r5 low): a sigma near an eigenvalue stagnates the inexact inner
+    # MINRES where scipy's exact splu factorization succeeds — serve
+    # those through host ARPACK instead of raising.
     _require_real_sigma(sigma)
-    return _eigsh_shift_invert(matvec, n_cols, dtype, int(k),
-                               float(sigma), which, v0, ncv, maxiter,
-                               tol, return_eigenvectors)
+    from scipy.sparse.linalg import ArpackNoConvergence
+
+    try:
+        return _eigsh_shift_invert(matvec, n_cols, dtype, int(k),
+                                   float(sigma), which, v0, ncv,
+                                   maxiter, tol, return_eigenvectors)
+    except ArpackNoConvergence:
+        return _host_fallback("eigsh")(
+            A, k=k, sigma=sigma, which=which, v0=v0, ncv=ncv,
+            maxiter=maxiter, tol=tol,
+            return_eigenvectors=return_eigenvectors)
 
 
 def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
@@ -920,6 +932,23 @@ def _eigsh_shift_invert(matvec, n_cols, dtype, k, sigma, which, v0,
 # ---------------------------------------------------------------- LOBPCG
 
 
+def _block_seed(X, dtype):
+    """Single Lanczos start vector carrying the WHOLE guess block: a
+    fixed-seed random combination of the orthonormalized columns of X.
+
+    Lanczos is a single-vector recurrence, so it cannot consume X as a
+    block the way LOBPCG proper does; seeding with ``X[:, 0]`` alone
+    (the pre-r6 behavior) silently discarded the remaining columns — a
+    first column (near-)orthogonal to a target eigenvector that another
+    column carries would only be recovered through breakdown restarts.
+    Almost-surely-nonzero weights give the Krylov space overlap with
+    every direction the block spans."""
+    Xa = np.asarray(X)
+    q, _ = np.linalg.qr(Xa.astype(np.promote_types(Xa.dtype, dtype)))
+    w = np.random.default_rng(11).standard_normal(q.shape[1])
+    return q @ w.astype(q.dtype)
+
+
 def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
            largest=True, **kwargs):
     """Locally optimal block PCG eigensolver (scipy ``lobpcg``).
@@ -931,6 +960,14 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
     (``_eigsh_generalized``) at lobpcg-class sizes, falling back to
     host scipy when B's inner CG stagnates or past 32k rows;
     preconditioned / constrained forms delegate to host scipy.
+
+    Block-seed semantics of the Lanczos-backed routes (generalized
+    ``B`` and complex-Hermitian): the driver is a single-vector Lanczos
+    recurrence, not a block iteration, so the initial guess block
+    enters as ONE start vector — a fixed random combination of the
+    orthonormalized columns of ``X`` (``_block_seed``), which overlaps
+    every direction the block spans.  Results match scipy's; per-column
+    convergence *rates* of true block LOBPCG do not transfer.
     """
     if (B is not None and M is None and Y is None and not kwargs
             and np.asarray(X).shape[0] <= (1 << 15)):
@@ -947,11 +984,11 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
         cap_b = min(ac, max(8 * kb, 128))
         tries_b = max(1, min(int(maxiter) if maxiter is not None
                              else 6, 10))
+        pdt_b = np.dtype(np.result_type(adt, bdt, Xa.dtype))
         try:
             w, V = _eigsh_generalized(
-                mv_a, mv_b, ac,
-                np.dtype(np.result_type(adt, bdt, Xa.dtype)),
-                kb, "LA" if largest else "SA", Xa[:, 0],
+                mv_a, mv_b, ac, pdt_b,
+                kb, "LA" if largest else "SA", _block_seed(Xa, pdt_b),
                 None, tries_b, (tol if tol else 0), True,
                 max_rank=cap_b)
             order = (np.argsort(w)[::-1] if largest
@@ -994,9 +1031,10 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
         cap = min(n_cols, max(8 * k, 128))
         tries = max(1, min(int(maxiter) if maxiter is not None else 6,
                            10))
+        seed = _block_seed(Xa, np.dtype(cdtype))
         try:
             w, V = _lanczos_eigsh(
-                matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
+                matvec, n_cols, np.dtype(cdtype), k, which, seed,
                 None, tries, (tol if tol else 0), True, max_rank=cap)
         except Exception as e:
             from scipy.sparse.linalg import ArpackNoConvergence
@@ -1016,7 +1054,7 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
                 "approximation (scipy-compatible behavior)",
                 UserWarning, stacklevel=2)
             w, V = _lanczos_eigsh(
-                matvec, n_cols, np.dtype(cdtype), k, which, Xa[:, 0],
+                matvec, n_cols, np.dtype(cdtype), k, which, seed,
                 cap, 1, np.inf, True, max_rank=cap)
         order = np.argsort(w)[::-1] if largest else np.argsort(w)
         return np.asarray(w)[order], np.asarray(V)[:, order]
@@ -1268,9 +1306,21 @@ def eigs(A, k=6, M=None, sigma=None, which="LM", v0=None, ncv=None,
                     A, k=k, sigma=sigma, which=which, v0=v0, ncv=ncv,
                     maxiter=maxiter, tol=tol,
                     return_eigenvectors=return_eigenvectors)
-        return _eigs_shift_invert(A, int(k), complex(sigma), which, v0,
-                                  ncv, maxiter, tol,
-                                  return_eigenvectors)
+        # Explicit-sigma LM/LR/SR/LI/SI: same ArpackNoConvergence ->
+        # host ladder as the SM route (ADVICE r5 low) — a sigma
+        # pathologically close to an eigenvalue stagnates the inexact
+        # BiCGSTAB inverse where scipy's splu succeeds.
+        from scipy.sparse.linalg import ArpackNoConvergence
+
+        try:
+            return _eigs_shift_invert(A, int(k), complex(sigma), which,
+                                      v0, ncv, maxiter, tol,
+                                      return_eigenvectors)
+        except ArpackNoConvergence:
+            return _host_fallback("eigs")(
+                A, k=k, sigma=sigma, which=which, v0=v0, ncv=ncv,
+                maxiter=maxiter, tol=tol,
+                return_eigenvectors=return_eigenvectors)
     matvec, m_rows, n_cols, dtype = _operator_parts(A)
     if m_rows != n_cols:
         raise ValueError("expected square matrix")

@@ -44,6 +44,27 @@ def _sym_ortho(a, b):
     return c, s, r
 
 
+def _givens(a, b):
+    """Complex-capable Givens rotation (c, s) annihilating ``b``:
+
+        [ c        s      ] [a]   [r]
+        [-conj(s)  conj(c)] [b] = [0]
+
+    with r = hypot(|a|, |b|) real and |c|^2 + |s|^2 = 1.  For real
+    operands this is exactly ``_sym_ortho``'s (c, s); the complex
+    extension (c = conj(a)/r, s = conj(b)/r) is what the progressive
+    Hessenberg QR in ``linalg.gmres`` needs, where MINRES/LSQR/LSMR
+    only ever rotate real scalars."""
+    if not jnp.issubdtype(jnp.result_type(a, b), jnp.complexfloating):
+        c, s, _ = _sym_ortho(a, b)
+        return c, s
+    r = jnp.hypot(jnp.abs(a), jnp.abs(b))
+    safe = jnp.where(r == 0, jnp.ones_like(r), r).astype(a.dtype)
+    c = jnp.where(r == 0, jnp.ones_like(a), jnp.conj(a) / safe)
+    s = jnp.where(r == 0, jnp.zeros_like(b), jnp.conj(b) / safe)
+    return c, s
+
+
 def _make_normalize(dtype, rdt):
     """Shared bidiagonalization normalizer: (v/||v||, ||v||) with the
     zero-vector guarded (used by both the LSQR and LSMR loops)."""

@@ -1230,6 +1230,14 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
     them at exactly 0 and residual norms match the unpadded system.
     ``M`` may be a jittable callable on padded sharded vectors.
     Returns ``(x[:rows], iters)``.
+
+    Restart cycles inherit the single-chip sync-free design: Arnoldi +
+    progressive Givens QR of the Hessenberg + the solution update run
+    as one traced program over the sharded operands (reductions lower
+    to ``psum`` over the mesh), with ONE stacked-scalar fetch per cycle
+    as the convergence cadence (``transfer.host_sync.gmres_conv``) —
+    no per-cycle Hessenberg transfer or host ``lstsq``, which over a
+    real tunnel used to cost a full RPC round trip per restart.
     """
     from ..linalg import gmres as _gmres
 

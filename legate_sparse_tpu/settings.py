@@ -147,6 +147,23 @@ class Settings:
         # interpret mode off-TPU) — differential-testing hook.
         self.bsr_force: bool = _env_bool("LEGATE_SPARSE_TPU_BSR_FORCE",
                                          False)
+        # XLA banded-SpMV lowering: "fused" (padded single-pass form,
+        # the TPU-friendly layout), "nopad" (interior/edge split that
+        # skips the x-pad materialization — measured ~20-25% faster on
+        # the CPU lane, where every avoided copy is bandwidth), or
+        # "auto" (nopad on cpu backends, fused elsewhere).  Only the
+        # XLA path is affected; the Pallas kernel stays the TPU fast
+        # path.  A typo must fail loudly, not silently benchmark the
+        # wrong kernel.
+        self.dia_xla_variant: str = os.environ.get(
+            "LEGATE_SPARSE_TPU_DIA_XLA", "auto"
+        )
+        if self.dia_xla_variant not in ("fused", "nopad", "auto"):
+            raise ValueError(
+                f"LEGATE_SPARSE_TPU_DIA_XLA="
+                f"{self.dia_xla_variant!r}: expected one of "
+                f"'fused', 'nopad', 'auto'"
+            )
 
     @property
     def obs(self) -> bool:

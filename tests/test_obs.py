@@ -221,8 +221,8 @@ def test_spmv_span_records_path_nnz_bytes():
     spans = [r for r in obs.records() if r["name"] == "spmv"]
     assert len(spans) == 2
     at = spans[0]["attrs"]
-    assert at["path"] in ("dia-xla", "dia-pallas", "ell", "csr-rowids",
-                          "csr", "bsr")
+    assert at["path"] in ("dia-xla", "dia-xla-nopad", "dia-pallas",
+                          "ell", "csr-rowids", "csr", "bsr")
     assert at["nnz"] == A.nnz and at["bytes"] > 0
     assert spans[0]["first"] and not spans[1]["first"]
 

@@ -1,0 +1,89 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Slow-lane SpGEMM perf guard (VERDICT r5 Weak #2).
+
+The round-4 banded-SpGEMM win (``spgemm_vs_scipy`` ~1.5 in
+``BENCH_r04``/``r05``) had no regression tripwire: a refactor could
+silently demote the banded product back to the generic ESC path and
+nothing would fail.  This guard re-runs the exact bench config —
+n=65536 banded A·A, nnz/row=11 — against host scipy ON THE SAME BOX
+(the same-box referee is what makes the ratio load-independent) and
+asserts the package stays >= 1.2x scipy.
+
+Slow lane on purpose: wall-time assertions do not belong in the
+default tier-1 lane (``-m 'not slow'``); run with ``pytest -m slow``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import legate_sparse_tpu as sparse
+
+
+def _banded(n, nnz_per_row=11):
+    half = nnz_per_row // 2
+    offsets = list(range(-half, half + 1))
+    val = np.float32(1.0 / nnz_per_row)
+    diags = [np.full(n - abs(o), val, dtype=np.float32) for o in offsets]
+    return sparse.diags(diags, offsets, shape=(n, n), format="csr",
+                        dtype=np.float32)
+
+
+def _best_of(fn, reps=5):
+    best = float("inf")
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        if rep:                      # rep 0 is warmup/compile
+            best = min(best, time.perf_counter() - t0)
+    return best
+
+
+@pytest.mark.slow
+def test_spgemm_banded_beats_scipy_by_1p2x():
+    import scipy.sparse as sp
+
+    n = 65536
+    A = _banded(n)
+
+    def ours():
+        C = A @ A
+        _ = float(np.asarray(C.data[0]))     # true completion sync
+
+    A_host = sp.csr_matrix(
+        (np.asarray(A.data), np.asarray(A.indices),
+         np.asarray(A.indptr)), shape=A.shape)
+
+    def scipy_ref():
+        _ = A_host @ A_host
+
+    best = _best_of(ours)
+    best_sp = _best_of(scipy_ref)
+    ratio = best_sp / max(best, 1e-9)
+    assert ratio >= 1.2, (
+        f"banded SpGEMM regressed: {best * 1e3:.2f} ms vs scipy "
+        f"{best_sp * 1e3:.2f} ms on this box (ratio {ratio:.3f} < 1.2; "
+        f"r04/r05 recorded ~1.5) — check the dia-pallas/dia-xla "
+        f"dispatch before blaming machine noise")
+
+
+@pytest.mark.slow
+def test_spgemm_banded_result_matches_scipy():
+    """Correctness referee for the guard config: the perf path must be
+    producing the same product it is being timed on."""
+    import scipy.sparse as sp
+
+    n = 4096
+    A = _banded(n)
+    C = A @ A
+    A_host = sp.csr_matrix(
+        (np.asarray(A.data), np.asarray(A.indices),
+         np.asarray(A.indptr)), shape=A.shape)
+    C_host = (A_host @ A_host).tocsr()
+    C_host.sort_indices()
+    np.testing.assert_array_equal(np.asarray(C.indptr), C_host.indptr)
+    np.testing.assert_array_equal(np.asarray(C.indices), C_host.indices)
+    np.testing.assert_allclose(np.asarray(C.data), C_host.data,
+                               rtol=1e-5, atol=1e-6)

@@ -13,16 +13,36 @@ cd "$(dirname "$0")/.."
 mkdir -p evidence
 LOCK=/tmp/tpu_capture.lock
 DONE=/tmp/tpu_capture.done
+STATE="${LEGATE_SPARSE_TPU_PROBE_STATE:-/tmp/lst_probe.$(id -u).json}"
 cleanup() {
   if [ "$(cat "$LOCK/pid" 2>/dev/null)" = "$$" ]; then
     rm -rf "$LOCK"
   fi
 }
 trap cleanup EXIT
+# Shared probe-verdict cache (read by _platform.ensure_live_backend):
+# every watcher probe refreshes it, so CLI runs between watcher ticks
+# skip their own 90s-per-attempt subprocess ladder.  $2 records the
+# /tmp/tpu_alive marker state AT verdict time — a marker transition is
+# the reader's staleness signal.
+write_state() {
+  # "exe" scopes the verdict to THIS watcher's interpreter: readers
+  # running a different python (e.g. one that does have the TPU
+  # plugin) ignore it and probe for themselves.
+  printf '{"verdict": "%s", "ts": %s, "tunnel_marker": %s, "source": "watcher", "exe": "%s"}\n' \
+    "$1" "$(date +%s)" "$2" "$(command -v python)" > "$STATE.tmp" \
+    && mv "$STATE.tmp" "$STATE"
+}
 while true; do
-  if timeout 60 python -c "import jax, jax.numpy as jnp; ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds; assert float(jnp.ones((8, 128)).sum()) == 1024.0" 2>/dev/null; then
+  # -u so the import-ok marker survives a timeout kill: a cached
+  # "dead" verdict must only come from a probe that got PAST the jax
+  # import — a watcher running in a broken environment (no jax on
+  # PATH, bad venv) must not poison every CLI run's probe cache.
+  probe_out=$(timeout 60 python -u -c "import jax, jax.numpy as jnp; print('import-ok'); ds = jax.devices(); assert ds and ds[0].platform != 'cpu', ds; assert float(jnp.ones((8, 128)).sum()) == 1024.0" 2>/dev/null)
+  if [ $? -eq 0 ]; then
     date -u +"%Y-%m-%dT%H:%M:%SZ alive" >> /tmp/tpu_watch.log
     touch /tmp/tpu_alive
+    write_state live true
     if [ ! -e "$DONE" ]; then
       owner=$(cat "$LOCK/pid" 2>/dev/null)
       if [ -d "$LOCK" ] && [ -n "$owner" ] && ! kill -0 "$owner" 2>/dev/null \
@@ -31,7 +51,7 @@ while true; do
       fi
       if mkdir "$LOCK" 2>/dev/null; then
         echo $$ > "$LOCK/pid"
-        if bash tools/round5_capture.sh >> evidence/round5_capture.log 2>&1; then
+        if LEGATE_SPARSE_TPU_PROBE_FORCE=1 bash tools/round5_capture.sh >> evidence/round5_capture.log 2>&1; then
           touch "$DONE"
         fi
         rm -rf "$LOCK"
@@ -40,6 +60,10 @@ while true; do
   else
     date -u +"%Y-%m-%dT%H:%M:%SZ down" >> /tmp/tpu_watch.log
     rm -f /tmp/tpu_alive
+    case "$probe_out" in
+      *import-ok*) write_state dead false ;;   # real device failure/stall
+      *) ;;  # env-broken watcher: leave the cache alone, CLIs self-probe
+    esac
   fi
   sleep 180
 done
