@@ -3,7 +3,7 @@
 """legate_sparse_tpu.obs: observability — op-level tracing, counters,
 and structured perf evidence.
 
-Three pieces (see each module's docstring for the design contract):
+Six pieces (see each module's docstring for the design contract):
 
 - ``trace``    — near-zero-overhead spans (``with obs.span("spmv",
                  nnz=...)``) recording wall time + first-call vs
@@ -11,9 +11,18 @@ Three pieces (see each module's docstring for the design contract):
                  Chrome-trace/Perfetto; structured instant events.
 - ``counters`` — always-on process-wide counters (op invocations, nnz
                  processed, bytes moved, transfers, scipy-fallback
-                 hits, jit cache misses).
+                 hits, jit cache misses) with a per-thread buffered
+                 lock-free fast path (``counters.handle``) for
+                 hot-loop sites.
 - ``report``   — aggregation into a per-op table with achieved GB/s
                  against the measured stream roofline.
+- ``comm``     — the communication ledger: per-collective interconnect
+                 byte predictions from static shard shapes, recorded
+                 as ``comm.*`` counters and solver-span attrs.
+- ``memory``   — phase memory watermarks (``mem.*`` events: RSS,
+                 device stats, optional tracemalloc peaks).
+- ``regress``  — the bench-trajectory regression gate behind
+                 ``tools/bench_compare.py``.
 
 Enable tracing with ``LEGATE_SPARSE_TPU_OBS=1`` (read once at import,
 like the other settings) or programmatically::
@@ -28,7 +37,7 @@ Disabled (the default) the span API is a no-op returning a shared
 null context manager; counters stay live either way.
 """
 
-from . import counters, report, trace  # noqa: F401
+from . import comm, counters, memory, regress, report, trace  # noqa: F401
 from .counters import inc, snapshot  # noqa: F401
 from .trace import (  # noqa: F401
     disable, enable, enabled, event, records, reset, span,
@@ -36,7 +45,7 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
-    "counters", "report", "trace",
+    "comm", "counters", "memory", "regress", "report", "trace",
     "inc", "snapshot",
     "enable", "disable", "enabled", "event", "records", "reset", "span",
     "to_chrome_trace", "write_chrome_trace", "write_jsonl",

@@ -1,0 +1,209 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Communication ledger: predicted interconnect bytes for the
+distributed layer's collectives, computed from STATIC shard shapes.
+
+Distributed SpMV is communication-bound at scale (Kreutzer et al.,
+arXiv:1112.5588) and bytes-moved is the first-order sparse metric
+(SpArch, arXiv:2002.08947) — yet the collective sites in ``parallel/``
+(all_gather / ppermute halo / psum / all_to_all across dist_csr,
+dist_spgemm, dist_build, dist_gmg) used to move bytes over the mesh
+with zero accounting.  This module is the ledger: every distributed
+dispatch records, per collective kind, how many bytes its collectives
+move across the interconnect.  The numbers are derived from the same
+static shard shapes/dtypes the shard_map builders close over, so they
+are exact predictions — XLA executes exactly these collectives with
+exactly these operand shapes — not measurements subject to timer
+noise, and they cost a handful of integer multiplies per dispatch.
+
+Accounting convention
+---------------------
+Bytes are the TOTAL crossing the interconnect, summed over all mesh
+devices, counting each transferred element once at its receiver:
+
+- ``all_gather`` of an L-element local block over R shards: every
+  device receives the other R-1 blocks  ->  R*(R-1)*L*itemsize.
+- halo exchange (two ``ppermute`` rounds of an H-element boundary
+  slice): every device receives one slice per direction
+  ->  2*R*H*itemsize.
+- ``psum`` of an L-element value: ring all-reduce (reduce-scatter +
+  all-gather) moves 2*(R-1)*L elements  ->  2*(R-1)*L*itemsize.
+- ``all_to_all`` of an (R, C)-row send buffer: each device keeps its
+  own row and sends R-1  ->  R*(R-1)*C*itemsize.
+- one ``ppermute`` rotation round of an L-element block: every device
+  receives the block once  ->  R*L*itemsize.
+
+An R == 1 mesh moves nothing (every formula counts remote receivers,
+of which there are none), so a 1-device "distributed" run correctly
+ledgers zero interconnect bytes — and ``record`` drops zero-byte
+entries rather than emitting noise counters.
+
+Counters (always on, per-thread buffered — ``counters.handle`` — so a
+hot eager loop of distributed dispatches never contends on the module
+lock)::
+
+    comm.<op>.<collective>          collective ops at <op> dispatch
+    comm.<op>.<collective>_bytes    predicted interconnect bytes
+    comm.total_calls / comm.total_bytes
+
+Span attrs: the distributed spans (``dist_spmv``, ``dist_cg``,
+``dist_gmres``, ``dist_spgemm``, ``bench.dist``) carry ``comm_bytes``
+and ``comm_calls`` for the whole dispatch.
+
+Dispatch-level contract (same as every obs counter): an op traced
+INSIDE a jitted solver loop records once at trace time, not once per
+executed iteration; the solver entry points compensate by recording
+per-iteration volumes multiplied by the true iteration count (which is
+why their counters need the iteration count to be host-visible —
+tracing mode or the callback path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import counters as _counters
+
+Volumes = Dict[str, int]     # collective kind -> predicted bytes
+
+
+# ---------------------------------------------------------------- model --
+def all_gather_bytes(local_elems: int, itemsize: int, shards: int) -> int:
+    """Interconnect bytes of one tiled all_gather of an
+    ``local_elems``-element per-device block."""
+    if shards <= 1:
+        return 0
+    return shards * (shards - 1) * int(local_elems) * int(itemsize)
+
+
+def ppermute_bytes(block_elems: int, itemsize: int, shards: int,
+                   rounds: int = 1) -> int:
+    """Interconnect bytes of ``rounds`` ring-rotation ppermutes of a
+    ``block_elems``-element per-device block (every device receives
+    the block once per round)."""
+    if shards <= 1:
+        return 0
+    return int(rounds) * shards * int(block_elems) * int(itemsize)
+
+
+def halo_exchange_bytes(halo_elems: int, itemsize: int,
+                        shards: int) -> int:
+    """Interconnect bytes of one two-sided halo exchange (the
+    ``_extend_x`` pattern): one ``halo_elems`` boundary slice ppermuted
+    in each ring direction."""
+    if shards <= 1 or halo_elems <= 0:
+        return 0
+    return 2 * shards * int(halo_elems) * int(itemsize)
+
+
+def psum_bytes(elems: int, itemsize: int, shards: int) -> int:
+    """Interconnect bytes of one psum (ring all-reduce) of an
+    ``elems``-element value."""
+    if shards <= 1:
+        return 0
+    return 2 * (shards - 1) * int(elems) * int(itemsize)
+
+
+def all_to_all_bytes(row_elems: int, itemsize: int, shards: int) -> int:
+    """Interconnect bytes of one tiled all_to_all of an (R, row_elems)
+    per-device send buffer (own row stays local)."""
+    if shards <= 1:
+        return 0
+    return shards * (shards - 1) * int(row_elems) * int(itemsize)
+
+
+# --------------------------------------------------------------- ledger --
+def merge(*vols: Volumes) -> Volumes:
+    """Sum per-collective volumes across several dicts."""
+    out: Volumes = {}
+    for v in vols:
+        for k, b in v.items():
+            out[k] = out.get(k, 0) + int(b)
+    return out
+
+
+def scale(vols: Volumes, k: int) -> Volumes:
+    """Volumes for ``k`` repetitions (e.g. per-iteration x iters)."""
+    return {name: int(b) * int(k) for name, b in vols.items()}
+
+
+def total(vols: Volumes) -> int:
+    return sum(int(b) for b in vols.values())
+
+
+def record(op: str, vols: Volumes,
+           calls: Optional[Dict[str, int]] = None) -> int:
+    """Account one dispatch of ``op``: bump the ``comm.<op>.*``
+    counters per collective kind and the process totals.  ``calls``
+    optionally gives the collective-op count per kind (default 1 —
+    pass the rotation/iteration counts for chained patterns).
+    Zero-byte entries are dropped (nothing crossed the interconnect).
+    Returns the total predicted bytes."""
+    total_b = 0
+    total_c = 0
+    for kind, nbytes in vols.items():
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            continue
+        n_calls = int(calls.get(kind, 1)) if calls else 1
+        _counters.handle(f"comm.{op}.{kind}").inc(n_calls)
+        _counters.handle(f"comm.{op}.{kind}_bytes").inc(nbytes)
+        total_b += nbytes
+        total_c += n_calls
+    if total_c:
+        _counters.handle("comm.total_calls").inc(total_c)
+        _counters.handle("comm.total_bytes").inc(total_b)
+    return total_b
+
+
+# ------------------------------------------------- structure predictors --
+def spmv_volumes(*, shards: int, halo: int, precise_C: Optional[int],
+                 x_local_elems: int, itemsize: int,
+                 cols: int = 1) -> Volumes:
+    """Per-call collective volumes of one distributed SpMV/SpMM x
+    realization, mirroring the ``dist_spmv`` dispatch exactly:
+
+    - precise image plan (``precise_C`` = plan width C): one tiled
+      all_to_all of (R, C[, cols]) send rows;
+    - halo mode (``halo`` >= 0): one two-sided halo exchange of
+      ``halo``[* cols] elements (zero when halo == 0 — ``_extend_x``
+      returns early and no collective exists in the program);
+    - otherwise: one tiled all_gather of the ``x_local_elems``-element
+      local x block (``x_local_elems`` already includes ``cols`` for
+      SpMM operands).
+
+    ``cols`` is the per-device dense-operand column count for the SpMM
+    variants (halo slices and all_to_all rows widen by it).
+    """
+    if precise_C is not None:
+        return {"all_to_all": all_to_all_bytes(
+            precise_C * cols, itemsize, shards)}
+    if halo >= 0:
+        b = halo_exchange_bytes(halo * cols, itemsize, shards)
+        return {"ppermute": b} if b else {}
+    return {"all_gather": all_gather_bytes(x_local_elems, itemsize,
+                                           shards)}
+
+
+def cg_iteration_volumes(spmv_vols: Volumes, itemsize: int,
+                         shards: int) -> Volumes:
+    """One iteration of the fused CG while_loop body: the SpMV
+    realization plus THREE scalar reductions — rho = <r, z>,
+    pq = <p, q>, and rnorm2 = <r, r>.  The residual-norm vdot is
+    computed unconditionally every iteration (``conv_test_iters``
+    only gates the *decision* made from it, not the reduction), so it
+    is part of the per-iteration volume, not a periodic extra.  The
+    initial-residual SpMV (r0 = b - A x0) is the caller's +1."""
+    return merge(spmv_vols, {"psum": 3 * psum_bytes(1, itemsize, shards)})
+
+
+def gmres_cycle_volumes(spmv_vols: Volumes, restart: int, itemsize: int,
+                        shards: int) -> Volumes:
+    """One sync-free GMRES restart cycle: ``restart + 1`` SpMV
+    realizations (the initial residual plus one per Arnoldi step) and
+    the cycle's scalar reductions — ``j + 1`` MGS projections at step
+    j plus the column norm, plus the entry residual norm:
+    ``restart*(restart+1)/2 + restart + 1`` scalar psums."""
+    n_psum = restart * (restart + 1) // 2 + restart + 1
+    return merge(scale(spmv_vols, restart + 1),
+                 {"psum": n_psum * psum_bytes(1, itemsize, shards)})

@@ -132,6 +132,26 @@ def _fmt_count(val: Any) -> str:
     return f"{v:.1f}P"
 
 
+def format_table(headers: List[str], rows: List[List[str]],
+                 left_cols: int = 1) -> str:
+    """Shared fixed-width table renderer: column widths from content,
+    first ``left_cols`` columns left-aligned, the rest right-aligned,
+    a dash rule under the header.  Used by the per-op table here and
+    the regress/comm renderers — one place for the layout logic."""
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+
+    def line(cells):
+        return "  ".join(
+            c.ljust(widths[i]) if i < left_cols else c.rjust(widths[i])
+            for i, c in enumerate(cells)
+        ).rstrip()
+
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
 def render_table(agg: Dict[str, Dict[str, Any]],
                  stream_gbs: Optional[float] = None) -> str:
     """Pretty-print the aggregate as a fixed-width per-op table.
@@ -162,16 +182,7 @@ def render_table(agg: Dict[str, Dict[str, Any]],
             frac = (row["gbs"] / stream_gbs) if row["gbs"] else None
             line.append(_fmt(frac, "{:.3f}"))
         rows.append(line)
-    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
-              for i, h in enumerate(headers)]
-    def fmt_line(cells):
-        return "  ".join(
-            c.ljust(widths[i]) if i == 0 else c.rjust(widths[i])
-            for i, c in enumerate(cells)
-        ).rstrip()
-    out = [fmt_line(headers), fmt_line(["-" * w for w in widths])]
-    out.extend(fmt_line(r) for r in rows)
-    return "\n".join(out)
+    return format_table(headers, rows)
 
 
 def summarize(records: Iterable[Dict[str, Any]],

@@ -227,6 +227,9 @@ def dist_diags(
         dia_data=dia_data,
         dia_offsets=(tuple(int(o) for o in offs.tolist())
                      if halo >= 0 else None),
+        # Stored entries = every in-range band slot (explicit zeros
+        # from callable diagonals included — they occupy ELL slots).
+        nnz_hint=sum(n - abs(int(k)) for k in offs.tolist()),
     ))
 
 

@@ -119,6 +119,12 @@ class DistCSR:
     bsr_bcol: Optional[jax.Array] = None
     bsr_grid: Optional[Tuple[int, int]] = None
     bsr_tried: bool = False
+    # Host-side stored-entry count, set by the builders that know it
+    # (shard_csr, dist_diags, dist_spgemm).  -1 = unknown; consumers
+    # that need it (the sparsity-aware window-decline key) fall back to
+    # ``global_nnz`` once and memoize here — keeping the device->host
+    # counts fetch off every later call.
+    nnz_hint: int = -1
 
     @property
     def num_shards(self) -> int:
@@ -580,6 +586,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
             dia_offsets=dia_offs,
             dia_mask=(put(dia_mask_blocks)
                       if dia_mask_blocks is not None else None),
+            nnz_hint=nnz,
         ))
         return dist
 
@@ -621,6 +628,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
         dia_offsets=dia_offs,
         dia_mask=(put(dia_mask_blocks)
                   if dia_mask_blocks is not None else None),
+        nnz_hint=nnz,
     ))
 
 
@@ -822,6 +830,45 @@ def _block_spmv_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
     ))
 
 
+def spmv_comm_volumes(A: DistCSR, x_local_elems: int, itemsize: int,
+                      cols: int = 1):
+    """Per-call collective interconnect volumes of one ``dist_spmv``
+    (or ``dist_spmm`` with ``cols`` > 1) on ``A`` — the realization
+    choice (precise all_to_all / halo ppermute / tiled all_gather) read
+    from the same static fields the dispatch branches on, priced by
+    ``obs.comm``.  ``x_local_elems`` is the per-device x block size
+    (already including ``cols`` for dense operands)."""
+    from ..obs import comm as _comm
+
+    precise_C = (int(A.gather_idx.shape[-1])
+                 if A.gather_idx is not None else None)
+    return _comm.spmv_volumes(
+        shards=A.num_shards, halo=A.halo, precise_C=precise_C,
+        x_local_elems=x_local_elems, itemsize=itemsize, cols=cols,
+    )
+
+
+def cg_comm_volumes(A: DistCSR, itemsize: int, iters: int):
+    """Predicted interconnect volumes of an ``iters``-iteration
+    distributed CG on ``A``, mirroring the fused ``_cg_loop`` program
+    exactly: ``iters + 1`` SpMV realizations (the initial residual
+    plus one per iteration) and three scalar psums per iteration
+    (rho, pq, and the unconditional residual-norm vdot — see
+    ``obs.comm.cg_iteration_volumes``).  Returns ``(vols, calls)`` —
+    bytes and collective-op counts per kind (a two-sided halo exchange
+    counts as one collective phase).  Shared by the ``dist_cg`` ledger
+    and ``bench.py``'s dist phase."""
+    from ..obs import comm as _comm
+
+    R = A.num_shards
+    spmv = spmv_comm_volumes(A, A.rows_padded // R, itemsize)
+    per_iter = _comm.cg_iteration_volumes(spmv, itemsize, R)
+    vols = _comm.merge(_comm.scale(per_iter, iters), spmv)
+    calls = {k: iters + 1 for k in spmv}
+    calls["psum"] = 3 * iters
+    return vols, calls
+
+
 def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     """y = A @ x with row-block parallelism (jittable).
 
@@ -835,8 +882,21 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     halo = A.halo
     precise = A.gather_idx is not None
     _obs.inc("op.dist_spmv")
+    # Comm ledger: the realization (and so the collective volume) is a
+    # function of A's static fields alone — price it once per dispatch
+    # and account it whatever kernel branch runs below.
+    from ..obs import comm as _comm
 
-    with _obs.span("dist_spmv", shards=A.num_shards, halo=halo) as sp:
+    vols = spmv_comm_volumes(
+        A, int(x.shape[0]) // A.num_shards,
+        jnp.dtype(x.dtype).itemsize,
+    )
+    comm_bytes = _comm.record("dist_spmv", vols)
+
+    with _obs.span("dist_spmv", shards=A.num_shards, halo=halo,
+                   comm_bytes=comm_bytes,
+                   comm_calls=sum(1 for b in vols.values() if b > 0)
+                   ) as sp:
         if A.dia_data is not None and halo >= 0 and not precise:
             # Banded fast path: halo exchange + static shifted-adds,
             # zero gathers (per-shard analog of ``ops.dia_ops.dia_spmv``).
@@ -1042,13 +1102,22 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     A._require_blocks("dist_spmm")
     precise = A.gather_idx is not None
     col_sharded = COL_AXIS in A.mesh.shape
+    _obs.inc("op.dist_spmm")
+    # Comm ledger: per-device column block widens every realization
+    # slice; the column axis itself adds zero communication.
+    from ..obs import comm as _comm
+
+    k_loc = int(X.shape[1]) // (int(A.mesh.shape[COL_AXIS])
+                                if col_sharded else 1)
+    _comm.record("dist_spmm", spmv_comm_volumes(
+        A, (int(X.shape[0]) // A.num_shards) * max(k_loc, 1),
+        jnp.dtype(X.dtype).itemsize, cols=max(k_loc, 1),
+    ))
     if (A.pdia_tile and A.halo >= 0 and not precise
             and jnp.result_type(A.dtype, X.dtype) == A.dtype):
         from ..ops.pallas_dia import _VMEM_BUDGET, pallas_dist_mode
 
         mode = pallas_dist_mode()
-        k_loc = X.shape[1] // (int(A.mesh.shape[COL_AXIS])
-                               if col_sharded else 1)
         nd = A.pdia_data.shape[1]
         item = np.dtype(A.dtype).itemsize
         # Per-grid-step VMEM: 3 X views + Y at (tile, k) plus the band.
@@ -1241,16 +1310,47 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
     """
     from ..linalg import gmres as _gmres
 
+    from ..obs import comm as _comm
+
     rows, b_sh, x0_sh, maxiter, cb = _shard_system(
         A, b, x0, maxiter, callback
     )
     if callback_type == "pr_norm":
         cb = callback   # scalar iterates: nothing to truncate
-    x, info = _gmres(
-        _padded_operator(A), b_sh, x0=x0_sh, tol=tol, restart=restart,
-        maxiter=maxiter, M=_padded_precond(M, A), callback=cb,
-        atol=atol, callback_type=callback_type, rtol=rtol,
-    )
+    restart_eff = min(int(restart) if restart else 20,
+                      int(b_sh.shape[0]))
+    with _obs.span("dist_gmres", n=rows, shards=A.num_shards,
+                   restart=restart_eff) as sp:
+        x, info = _gmres(
+            _padded_operator(A), b_sh, x0=x0_sh, tol=tol,
+            restart=restart, maxiter=maxiter, M=_padded_precond(M, A),
+            callback=cb, atol=atol, callback_type=callback_type,
+            rtol=rtol,
+        )
+        # Comm ledger: the driver returns iterations as a host int, so
+        # the cycle count is free (approximated as ceil(iters/restart);
+        # a run converging at cycle start reports one cycle fewer than
+        # it dispatched).  Per-cycle volumes: restart+1 SpMV
+        # realizations + the Arnoldi/MGS scalar psums.
+        cycles = max(1, -(-int(info) // restart_eff))
+        item = jnp.dtype(b_sh.dtype).itemsize
+        spmv = spmv_comm_volumes(A, A.rows_padded // A.num_shards,
+                                 item)
+        vols = _comm.scale(
+            _comm.gmres_cycle_volumes(spmv, restart_eff, item,
+                                      A.num_shards),
+            cycles,
+        )
+        n_psum = cycles * (restart_eff * (restart_eff + 1) // 2
+                           + restart_eff + 1)
+        calls = {k: cycles * (restart_eff + 1) for k in spmv}
+        calls["psum"] = n_psum
+        comm_bytes = _comm.record("dist_gmres", vols, calls)
+        if sp is not None:
+            sp.set(iters=int(info), cycles=cycles,
+                   comm_bytes=comm_bytes,
+                   comm_calls=sum(calls[k] for k, b in vols.items()
+                                  if b > 0))
     return x[:rows], info
 
 
@@ -1493,18 +1593,33 @@ def dist_cg(
     bnrm2 = float(jnp.linalg.norm(b_sh))
     atol, _ = _get_atol_rtol(bnrm2, tol, atol, rtol)
     M_mv = M if M is not None else (lambda r: r)
+    from ..obs import comm as _comm
+    from ..obs import memory as _mem
+
+    item = jnp.dtype(b_sh.dtype).itemsize
     if callback is None:
         with _obs.span("dist_cg", n=rows, shards=A.num_shards,
                        maxiter=int(maxiter),
-                       preconditioned=M is not None) as sp:
+                       preconditioned=M is not None) as sp, \
+                _mem.watermark("dist_cg", n=rows, shards=A.num_shards):
             x, iters = _cg_loop(
                 A.matvec_fn(), M_mv, b_sh, x0_sh, atol, int(maxiter),
                 int(conv_test_iters),
             )
             if sp is not None:
                 # One host sync for honest timing + the true iteration
-                # count (tracing mode only; see linalg.cg).
-                sp.set(iters=int(iters))
+                # count (tracing mode only; see linalg.cg).  The same
+                # count drives the comm ledger: the loop body is traced
+                # once, so the per-iteration volumes are multiplied out
+                # here rather than at the (trace-time) dispatch.
+                it = int(iters)
+                vols, calls = cg_comm_volumes(A, item, it)
+                sp.set(iters=it,
+                       comm_bytes=_comm.record("dist_cg", vols,
+                                               calls),
+                       comm_calls=sum(
+                           calls[k] for k, b in vols.items()
+                           if b > 0))
         return x[:rows], iters
 
     # Callback path: Python-driven loop so user code observes every
@@ -1516,6 +1631,7 @@ def dist_cg(
     p = jnp.zeros_like(b_sh)
     rho = jnp.ones((), dtype=b_sh.dtype)
     iters = 0
+    n_norm = 0
     while iters < maxiter:
         z = M_mv(r)
         rho_old = rho
@@ -1538,8 +1654,21 @@ def dist_cg(
         r = r - alpha * q
         iters += 1
         cb(x)
-        if (iters % conv_test_iters == 0 or iters == maxiter - 1) and float(
-            jnp.linalg.norm(r)
-        ) < atol:
-            break
+        if iters % conv_test_iters == 0 or iters == maxiter - 1:
+            n_norm += 1
+            if float(jnp.linalg.norm(r)) < atol:
+                break
+    # Callback path: every eager A_mv dispatch above self-recorded its
+    # realization under comm.dist_spmv.*, so recording SpMV volumes
+    # again here would double-count the same bytes.  Only the scalar
+    # reductions this driver loop adds are ledgered under dist_cg:
+    # rho + pq every iteration, plus the residual norms the check
+    # branch actually executed (counted in the loop, not approximated
+    # — the ledger's contract is exactness).
+    n_psum = 2 * iters + n_norm
+    _comm.record(
+        "dist_cg",
+        {"psum": n_psum * _comm.psum_bytes(1, item, A.num_shards)},
+        calls={"psum": n_psum},
+    )
     return x[:rows], iters

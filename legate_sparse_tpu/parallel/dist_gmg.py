@@ -25,7 +25,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .dist_csr import DistCSR, dist_diagonal, dist_spmv, shard_csr, shard_vector
+from .. import obs as _obs
+from .dist_csr import (
+    DistCSR, dist_diagonal, dist_spmv, shard_csr, shard_vector,
+    spmv_comm_volumes,
+)
 from .dist_spgemm import dist_spgemm
 from .mesh import Mesh
 
@@ -150,6 +154,40 @@ class DistGMG:
             self.operators.append((dR, coarse, dP))
             self._append_params(coarse, omega, power_iters)
             cur = coarse
+
+        # Comm ledger: the V-cycle's interconnect budget, priced once
+        # from the hierarchy's static shard shapes.  A jittable cycle
+        # can't self-account per execution (it runs inside the CG
+        # while_loop), so the per-cycle total lives here and bench /
+        # callers attach it to their spans.
+        self.cycle_comm_volumes = self._cycle_comm_volumes()
+        self.cycle_comm_bytes = sum(self.cycle_comm_volumes.values())
+        _obs.event("dist_gmg.hierarchy", levels=levels,
+                   shards=self.A.num_shards,
+                   cycle_comm_bytes=self.cycle_comm_bytes)
+
+    def _cycle_comm_volumes(self):
+        """Per-collective interconnect bytes of ONE V-cycle: each
+        non-coarsest level runs two smoothing SpMVs on its operator
+        plus one restriction and one prolongation SpMV; the coarsest
+        level is a pointwise Jacobi step with no communication."""
+        from ..obs import comm as _comm
+
+        R = self.A.num_shards
+        item = np.dtype(self.A.dtype).itemsize
+        vols: dict = {}
+        levels = [self.A] + [op[1] for op in self.operators]
+        for lvl, (dR, coarse_A, dP) in enumerate(self.operators):
+            A_l = levels[lvl]
+            fine_local = A_l.rows_padded // R
+            coarse_local = coarse_A.rows_padded // R
+            vols = _comm.merge(
+                vols,
+                _comm.scale(spmv_comm_volumes(A_l, fine_local, item), 2),
+                spmv_comm_volumes(dR, fine_local, item),
+                spmv_comm_volumes(dP, coarse_local, item),
+            )
+        return vols
 
     def _append_params(self, A: DistCSR, omega: float, power_iters: int):
         diag = dist_diagonal(A)

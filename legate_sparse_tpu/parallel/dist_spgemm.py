@@ -247,6 +247,33 @@ def _col_window_fn(mesh, la: _Layout):
     ))
 
 
+def _density_bucket(nnz: int, rows: int) -> int:
+    """log2 bucket of nnz-per-row — the sparsity term of the
+    window-decline key (ADVICE r5 low): two matrices sharing a layout
+    but an order of magnitude apart in density get separate decline
+    entries, so one wide-window matrix no longer pins every later
+    same-layout matrix to the all_gather realization."""
+    import math
+
+    if nnz <= 0 or rows <= 0:
+        return -1
+    per_row = nnz / rows
+    return math.floor(math.log2(per_row)) if per_row >= 1 else -1
+
+
+def _decline_key(A: DistCSR, la: _Layout, lb: _Layout):
+    """Cache key for a declined window: layout structure PLUS A's
+    nnz-density bucket (the window width is a property of A's column
+    sparsity, which the layout alone does not capture).  ``nnz_hint``
+    is set by every builder; an externally constructed DistCSR pays
+    one counts fetch, memoized on the instance."""
+    nnz = A.nnz_hint
+    if nnz < 0:
+        nnz = A.global_nnz
+        A.nnz_hint = nnz
+    return (la, lb, _density_bucket(nnz, la.shape[0]))
+
+
 def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
     """Host-side B-realization window plan, or None for all_gather.
 
@@ -265,16 +292,25 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
     R = la.num_shards
     if R <= 2:
         return None         # rotation chain degenerates to all_gather
-    if (la, lb) in _WINDOW_DECLINED:
-        # This structure pair already proved too wide for a window:
-        # skip the min/max image probe (a blocking device->host round
-        # trip — ~1 s over the TPU tunnel) on every later call.  A
-        # matrix whose SPARSITY later narrows under the same layout
-        # stays on all_gather (``reset_window_declines()`` un-pins);
-        # correctness is unaffected.
+    key = _decline_key(A, la, lb)
+    if key in _WINDOW_DECLINED:
+        # This structure+density pair already proved too wide for a
+        # window: skip the min/max image probe (a blocking
+        # device->host round trip — ~1 s over the TPU tunnel) on every
+        # later call.  The key carries A's nnz-density bucket, so only
+        # comparably-dense matrices inherit the decline; a sparser
+        # same-layout matrix re-probes (``reset_window_declines()``
+        # still clears everything).  Correctness is unaffected.
         _obs.inc("dist_spgemm.window_decline_cached")
         return None
     _obs.inc("transfer.host_sync.spgemm_window_probe")
+    from ..obs import comm as _comm
+
+    # Probe cost in the ledger: two 1-element all_gathers (min/max).
+    _comm.record("dist_spgemm.window_probe", {
+        "all_gather": 2 * _comm.all_gather_bytes(
+            1, np.dtype(index_dtype()).itemsize, R),
+    }, calls={"all_gather": 2})
     mn, mx = _col_window_fn(A.mesh, la)(*a_arrays)
     mn = np.asarray(mn)
     mx = np.asarray(mx)
@@ -292,12 +328,12 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
     # nblk=2), turning every banded product into an all_gather.
     limit = max(3, int(R * _B_WINDOW_DENSE_FRAC))
     if nblk <= 0 or nblk >= limit:
-        _window_decline(la, lb)
+        _window_decline(key, la, lb)
         return None
     d_fwd = int(np.max(np.maximum(s_ids - first, 0)))
     d_bwd = int(np.max(np.maximum(last - s_ids, 0)))
     if d_fwd + d_bwd >= R:
-        _window_decline(la, lb)
+        _window_decline(key, la, lb)
         return None         # would rotate the whole ring anyway
     return first.astype(np.int32), (nblk, d_fwd, d_bwd)
 
@@ -305,23 +341,22 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
 _WINDOW_DECLINED: set = set()
 
 
-def _window_decline(la: _Layout, lb: _Layout) -> None:
+def _window_decline(key, la: _Layout, lb: _Layout) -> None:
     if len(_WINDOW_DECLINED) > 256:     # unbounded-session safety valve
         _WINDOW_DECLINED.clear()
-    _WINDOW_DECLINED.add((la, lb))
+    _WINDOW_DECLINED.add(key)
     _obs.inc("dist_spgemm.window_decline")
     _obs.event("dist_spgemm.window_decline",
                a_shape=la.shape, b_shape=lb.shape,
-               shards=la.num_shards)
+               shards=la.num_shards, density_bucket=key[2])
 
 
 def reset_window_declines() -> None:
-    """Clear the window-decline cache (ADVICE r5 low finding): the
-    cache is keyed on layout STRUCTURE only, so one wide-window matrix
-    would otherwise pin every later same-layout matrix to the
-    all_gather realization for the life of the process.  Call after
-    retiring a pathological matrix (or from tests) to let later
-    same-layout products re-probe the min/max column image."""
+    """Clear the window-decline cache.  Entries are keyed on layout
+    structure PLUS A's nnz-density bucket (``_decline_key``), so a
+    wide-window matrix only pins comparably-dense same-layout matrices
+    — but a long-lived process retiring whole matrix families can
+    still call this to force re-probing of the min/max column image."""
     _WINDOW_DECLINED.clear()
 
 
@@ -530,6 +565,7 @@ def _dist_band_spgemm(A: DistCSR, B: DistCSR):
         data=data, cols=cols_b, counts=counts, row_ids=None,
         shape=(n, n), rows_per_shard=rps, halo=halo_c, ell=True,
         mesh=A.mesh, dia_data=dia_data, dia_offsets=offs_c,
+        nnz_hint=nnz_c,
     ))
 
 
@@ -583,6 +619,61 @@ def _band_spgemm_fn(mesh, offs_a, offs_b, offs_c, n, rps, h, halo_c):
     ))
 
 
+def _b_realization_volumes(B: DistCSR, lb: _Layout, plan):
+    """Predicted interconnect volumes for realizing B across the three
+    ESC phases, for BOTH candidate realizations — the evidence behind
+    the window-vs-all_gather choice.
+
+    Returns ``(ag_vols, ag_calls, win_vols, win_calls)``: per-
+    collective byte dicts + collective-op counts, with the window pair
+    None when no accepted plan exists (declined / precise layout /
+    R <= 2).  Per-phase arrays mirror ``_esc_t_fn`` (phase 1 rotates
+    or gathers only counts/row_ids) and ``_b_global_flat`` /
+    ``_b_window_flat`` (phases 2-3 move the full operand set)."""
+    from ..obs import comm as _comm
+
+    R = lb.num_shards
+    item_d = np.dtype(B.data.dtype).itemsize
+    item_c = np.dtype(B.cols.dtype).itemsize
+    if lb.ell:
+        data_b = lb.rps * lb.inner * item_d
+        cols_b = lb.rps * lb.inner * item_c
+        cnt_b = lb.rps * 4
+        rid_b = 0
+    else:
+        data_b = lb.inner * item_d
+        cols_b = lb.inner * item_c
+        cnt_b = 4                       # (R,) per-shard totals
+        rid_b = lb.inner * 4
+    ggl_b = 0
+    if lb.has_ggl:
+        g = B.gather_globals
+        ggl_b = (int(g.shape[1]) * int(g.shape[2])
+                 * np.dtype(g.dtype).itemsize)
+
+    phase1_b = cnt_b + rid_b
+    phase1_n = 1 if lb.ell else 2
+    phase23_b = data_b + cols_b + cnt_b + rid_b + ggl_b
+    phase23_n = (3 if lb.ell else 4) + (1 if lb.has_ggl else 0)
+
+    ag_vols = {"all_gather": _comm.all_gather_bytes(
+        phase1_b + 2 * phase23_b, 1, R)}
+    ag_calls = {"all_gather": phase1_n + 2 * phase23_n}
+
+    win_vols = win_calls = None
+    if plan is not None:
+        _, d_fwd, d_bwd = plan
+        rounds = d_fwd + d_bwd
+        # Window phases never move ggl (precise layouts decline the
+        # window) and phase-1 csr rotations carry row_ids too.
+        w_phase1_b = cnt_b + rid_b
+        w_phase23_b = data_b + cols_b + cnt_b + rid_b
+        win_vols = {"ppermute": _comm.ppermute_bytes(
+            w_phase1_b + 2 * w_phase23_b, 1, R, rounds=rounds)}
+        win_calls = {"ppermute": rounds * (phase1_n + 2 * phase23_n)}
+    return ag_vols, ag_calls, win_vols, win_calls
+
+
 def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     """C = A @ B, both row-block distributed; returns a row-block C.
 
@@ -598,12 +689,23 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     if A.mesh is not B.mesh and A.mesh != B.mesh:
         raise ValueError("operands must share a mesh")
     _obs.inc("op.dist_spgemm")
+    from ..obs import comm as _comm
+
     with _obs.span("dist_spgemm.band_probe"):
         C_band = _dist_band_spgemm(A, B)
     if C_band is not None:
         _obs.inc("dist_spgemm.realization.band")
+        # Band realization moves only B's halo-extended DIA rows: one
+        # two-sided exchange of (nd_b, h) slices — no all_gather, no
+        # expansion.  That byte count IS the evidence for taking the
+        # banded path.
+        h = max(abs(int(o)) for o in A.dia_offsets)
+        nd_b = len(B.dia_offsets)
+        band_vols = {"ppermute": _comm.halo_exchange_bytes(
+            nd_b * h, np.dtype(B.dtype).itemsize, A.num_shards)}
+        band_bytes = _comm.record("dist_spgemm", band_vols)
         _obs.event("dist_spgemm.realization", choice="band",
-                   shards=A.num_shards)
+                   shards=A.num_shards, predicted_bytes=band_bytes)
         return C_band
     A._require_blocks("dist_spgemm")
     B._require_blocks("dist_spgemm")
@@ -657,9 +759,30 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         LAST_B_REALIZATION = "all_gather"
         LAST_B_PLAN = ()
     _obs.inc("dist_spgemm.realization." + LAST_B_REALIZATION)
+    # Evidence for the realization choice: predicted interconnect
+    # bytes of BOTH candidates from the static shard shapes, the
+    # chosen one entering the comm ledger.  (The window prediction
+    # exists only when a plan was accepted — a declined probe never
+    # computed ring distances.)
+    ag_vols, ag_calls, win_vols, win_calls = _b_realization_volumes(
+        B, lb, plan)
+    if win is not None:
+        comm_bytes = _comm.record("dist_spgemm", win_vols, win_calls)
+        comm_calls = sum(win_calls.values())
+    else:
+        comm_bytes = _comm.record("dist_spgemm", ag_vols, ag_calls)
+        comm_calls = sum(ag_calls.values())
+    _obs.event(
+        "dist_spgemm.realization", choice=LAST_B_REALIZATION,
+        shards=R, predicted_bytes=comm_bytes,
+        predicted_all_gather_bytes=_comm.total(ag_vols),
+        predicted_window_bytes=(_comm.total(win_vols)
+                                if win_vols is not None else None),
+    )
     with _obs.span("dist_spgemm", shards=R, m=m, n=n_cols,
                    b_realization=LAST_B_REALIZATION,
-                   b_plan=LAST_B_PLAN) as sp:
+                   b_plan=LAST_B_PLAN, comm_bytes=comm_bytes,
+                   comm_calls=comm_calls) as sp:
         return _dist_spgemm_phases(
             A, B, mesh, la, lb, plan, a_arrays, b_arrays, first_dev,
             rps, m, n_cols, col_dtype, R, sp,
@@ -688,7 +811,7 @@ def _dist_spgemm_phases(A, B, mesh, la, lb, plan, a_arrays, b_arrays,
                 jnp.full((R, 1), max(rps - 1, 0), jnp.int32), mesh
             ),
             shape=(m, n_cols), rows_per_shard=rps, halo=-1, ell=False,
-            mesh=mesh,
+            mesh=mesh, nnz_hint=0,
         )
 
     # ---- phase 2: nnz_local ---------------------------------------------
@@ -701,19 +824,38 @@ def _dist_spgemm_phases(A, B, mesh, la, lb, plan, a_arrays, b_arrays,
     # runs — same pitfall documented at _col_window_fn.  The reduced
     # scalars are replicated and always fetchable.
     nnz_cap = max(int(jnp.max(nnz_locals)), 1)
+    # The exact output nnz costs one more blocking scalar fetch —
+    # tracing mode only (the default path must not grow a host sync;
+    # over the TPU tunnel each one is ~1 s).  Without it the result's
+    # nnz_hint stays -1 and the decline key's lazy ``global_nnz``
+    # fallback pays once, memoized on the instance.
+    nnz_total = int(jnp.sum(nnz_locals)) if _obs.enabled() else -1
     if sp is not None:
-        sp.set(T_cap=T_cap, nnz_cap=nnz_cap,
-               nnz=int(jnp.sum(nnz_locals)))
+        sp.set(T_cap=T_cap, nnz_cap=nnz_cap, nnz=nnz_total)
 
     # ---- phase 3: numeric ------------------------------------------------
-    vals_b, cols_b, rids_b, counts_b = _esc_numeric_fn(
-        mesh, la, lb, T_cap, nnz_cap, plan
-    )(*a_arrays, *b_arrays, *first_dev)
+    # Output-nnz blowup becomes a recorded number, not an OOM: the
+    # watermark event carries the predicted padded allocation next to
+    # the realized RSS delta.
+    from ..obs import memory as _mem
+
+    item_d = np.dtype(jnp.result_type(A.data.dtype,
+                                      B.data.dtype)).itemsize
+    out_mb = R * nnz_cap * (item_d + np.dtype(col_dtype).itemsize
+                            + 4) / 2**20
+    expand_mb = R * T_cap * (item_d + 2 * np.dtype(
+        index_dtype()).itemsize) / 2**20
+    with _mem.watermark("dist_spgemm", T_cap=T_cap, nnz_cap=nnz_cap,
+                        nnz=nnz_total, out_mb=round(out_mb, 2),
+                        expand_mb=round(expand_mb, 2)):
+        vals_b, cols_b, rids_b, counts_b = _esc_numeric_fn(
+            mesh, la, lb, T_cap, nnz_cap, plan
+        )(*a_arrays, *b_arrays, *first_dev)
 
     return DistCSR(
         data=vals_b, cols=cols_b, counts=counts_b.astype(jnp.int32),
         row_ids=rids_b, shape=(m, n_cols), rows_per_shard=rps,
-        halo=-1, ell=False, mesh=mesh,
+        halo=-1, ell=False, mesh=mesh, nnz_hint=nnz_total,
     )
 
 

@@ -1,0 +1,215 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Bench-trajectory regression gate (obs/regress.py +
+tools/bench_compare.py): noise bands from the recorded stream spread,
+nonzero exit on synthetic regressions, a clean pass on the real
+archived round pair, and the trajectory table."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from legate_sparse_tpu.obs import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare",
+        os.path.join(REPO, "tools", "bench_compare.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _base(**over):
+    d = {
+        "metric": "csr_spmv_bandwidth",
+        "platform": "cpu",
+        "schema_version": 7,
+        "stream_samples": [50.0, 52.0, 51.0],
+        "stream_gbs": 51.0,
+        "spmv_ms": 2.0,
+        "cg_ms_per_iter": 0.1,
+        "pde_roofline_ratio": 0.8,
+        "dist_spmv_comm_bytes": 320,
+        "bench_wall_s": 100.0,
+    }
+    d.update(over)
+    return d
+
+
+# ---------------------------------------------------------------- loads --
+def test_load_bench_shapes(tmp_path):
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(_base()))
+    assert regress.load_bench(str(raw))["spmv_ms"] == 2.0
+
+    wrapper = tmp_path / "wrap.json"
+    wrapper.write_text(json.dumps({"n": 6, "rc": 0,
+                                   "parsed": _base(spmv_ms=3.0)}))
+    assert regress.load_bench(str(wrapper))["spmv_ms"] == 3.0
+
+    log = tmp_path / "log.txt"
+    log.write_text("noise line\n" + json.dumps(_base(spmv_ms=4.0))
+                   + "\n")
+    assert regress.load_bench(str(log))["spmv_ms"] == 4.0
+
+    empty = tmp_path / "empty.json"
+    empty.write_text("no json here")
+    with pytest.raises(ValueError):
+        regress.load_bench(str(empty))
+
+
+# ---------------------------------------------------------- noise bands --
+def test_stream_spread_and_band():
+    tight = _base()
+    assert regress.stream_spread(tight) == pytest.approx(2 / 51)
+    legacy = {"stream_gbs": 66.34, "stream2_gbs": 28.91}
+    # Pre-r6 artifacts: the two-sample pair, even-median averaged.
+    assert regress.stream_spread(legacy) == pytest.approx(
+        (66.34 - 28.91) / ((66.34 + 28.91) / 2))
+    assert regress.stream_spread({"spmv_ms": 1}) is None
+    # Band is the worst spread of the pair, floored.
+    assert regress.noise_band(tight, tight, floor=0.25) == 0.25
+    wild = _base(stream_samples=[30.0, 60.0, 45.0])
+    assert regress.noise_band(tight, wild, floor=0.1) == pytest.approx(
+        30 / 45)
+
+
+# -------------------------------------------------------------- compare --
+def test_in_band_wobble_passes_and_out_of_band_fails():
+    old = _base()
+    ok = regress.compare(old, _base(spmv_ms=2.5))     # 1.25x < 1.75x
+    assert not regress.regressions(ok)
+    bad = regress.compare(old, _base(spmv_ms=20.0))   # 10x
+    (r,) = regress.regressions(bad)
+    assert r["field"] == "spmv_ms" and r["status"] == "regressed"
+
+
+def test_roofline_ratio_direction_is_inverted():
+    old = _base()
+    bad = regress.compare(old, _base(pde_roofline_ratio=0.2))  # 4x worse
+    assert any(f["field"] == "pde_roofline_ratio"
+               and f["status"] == "regressed"
+               for f in bad)
+    ok = regress.compare(old, _base(pde_roofline_ratio=0.95))
+    assert not regress.regressions(ok)
+
+
+def test_comm_bytes_are_gated_strictly():
+    old = _base()
+    # +50% comm bytes is a code change, not machine noise: fails even
+    # though the timing band would forgive it.
+    bad = regress.compare(old, _base(dist_spmv_comm_bytes=480))
+    (r,) = regress.regressions(bad)
+    assert r["field"] == "dist_spmv_comm_bytes"
+    # Fewer bytes is an improvement.
+    ok = regress.compare(old, _base(dist_spmv_comm_bytes=160))
+    assert not regress.regressions(ok)
+    assert any(f["status"] == "improved" for f in ok)
+
+
+def test_comm_gate_skipped_across_platform_or_mesh_transitions():
+    """A CPU-fallback round vs a live multi-chip round runs a
+    different collective program: comm fields must be reported
+    incomparable, not regressed, in either direction."""
+    old = _base(dist_shards=1, dist_spmv_comm_bytes=0)
+    new = _base(platform="tpu", dist_shards=8,
+                dist_spmv_comm_bytes=81920)
+    findings = regress.compare(old, new)
+    assert not regress.regressions(findings)
+    (f,) = [x for x in findings if x["field"] == "dist_spmv_comm_bytes"]
+    assert f["status"] == "incomparable"
+    # Same mesh+platform: the strict gate applies again.
+    same = regress.compare(_base(dist_shards=8),
+                           _base(dist_shards=8,
+                                 dist_spmv_comm_bytes=480))
+    assert regress.regressions(same)
+
+
+def test_missing_gated_field_breaks_superset_contract():
+    old = _base()
+    new = _base()
+    del new["cg_ms_per_iter"]
+    bad = regress.compare(old, new)
+    (r,) = regress.regressions(bad)
+    assert r["field"] == "cg_ms_per_iter" and r["status"] == "missing"
+    ok = regress.compare(old, new, allow_missing=True)
+    assert not regress.regressions(ok)
+
+
+def test_fields_filter_restricts_the_gate():
+    old = _base()
+    new = _base(spmv_ms=50.0)               # would regress unfiltered
+    findings = regress.compare(old, new,
+                               fields=["*_comm_bytes",
+                                       "schema_version"])
+    assert not regress.regressions(findings)
+    names = {f["field"] for f in findings}
+    assert "spmv_ms" not in names
+    assert "schema_version" in names        # exact-match gated
+    bad = regress.compare(old, _base(schema_version=8),
+                          fields=["schema_version"])
+    assert regress.regressions(bad)
+
+
+# ------------------------------------------------------- real artifacts --
+def test_real_archived_pair_passes_with_noise_band():
+    old = regress.load_bench(os.path.join(REPO, "BENCH_r04.json"))
+    new = regress.load_bench(os.path.join(REPO, "BENCH_r05.json"))
+    findings = regress.compare(old, new)
+    assert not regress.regressions(findings), regress.render_findings(
+        findings)
+
+
+def test_real_artifact_synthetically_regressed_fails():
+    old = regress.load_bench(os.path.join(REPO, "BENCH_r05.json"))
+    new = dict(old)
+    new["spmv_ms"] = old["spmv_ms"] * 10
+    assert regress.regressions(regress.compare(old, new))
+
+
+# ----------------------------------------------------------------- tool --
+def test_cli_pair_and_exit_codes(tmp_path, capsys):
+    mod = _tool()
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_base()))
+    b.write_text(json.dumps(_base(spmv_ms=2.1)))
+    assert mod.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out and "spmv_ms" in out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_base(spmv_ms=40.0)))
+    assert mod.main([str(a), str(bad)]) == 1
+    assert mod.main([str(a), str(tmp_path / "nope.json")]) == 2
+    assert mod.main([]) == 2
+
+
+def test_cli_trajectory_renders_and_gates(tmp_path, capsys):
+    mod = _tool()
+    for i, ms in enumerate([4.0, 3.0, 2.5], start=1):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps(_base(spmv_ms=ms)))
+    assert mod.main(["--trajectory", "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "r01" in out and "r03" in out and "spmv_ms" in out
+    # Newest round regresses -> trajectory gate fails.
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps(_base(spmv_ms=30.0)))
+    assert mod.main(["--trajectory", "--dir", str(tmp_path)]) == 1
+
+
+def test_repo_trajectory_gate_is_clean(capsys):
+    """The committed BENCH_r0*.json trajectory must gate clean — this
+    is the standing CI guard the tentpole exists for."""
+    mod = _tool()
+    rc = mod.main(["--trajectory", "--dir", REPO])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err

@@ -1,0 +1,120 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Tier-1 bench smoke lane: ``bench.py --smoke`` under
+``LEGATE_SPARSE_TPU_OBS=1`` must produce a non-empty trace artifact
+with nonzero ``comm.*`` counters from the dist phase, a schema-
+versioned JSON line whose deterministic fields match the committed
+golden through ``tools/bench_compare.py``, and the gate must fire on a
+synthetically regressed copy.  This is the CI teeth of the obs v2
+tentpole: the wiring can no longer silently no-op between capture
+rounds."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
+
+# Deterministic fields only: timings vary per machine, but the static
+# comm predictions, the mesh width and the schema do not.
+GOLDEN_FIELDS = "*_comm_bytes,dist_shards,schema_version"
+
+
+def _tool(name):
+    """Import a tools/ CLI in-process (a subprocess would re-import
+    the whole package — seconds of suite wall time for nothing)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory):
+    """One shared ``bench.py --smoke`` subprocess for every assertion
+    below (the run costs ~10 s; the checks are free)."""
+    tmp = tmp_path_factory.mktemp("bench_smoke")
+    trace_path = tmp / "smoke.trace.json"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LEGATE_SPARSE_TPU_OBS": "1",
+        "LEGATE_SPARSE_TPU_OBS_FILE": str(trace_path),
+    })
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 0, (r.stdout or "") + (r.stderr or "")[-2000:]
+    line = next(ln for ln in reversed(r.stdout.strip().splitlines())
+                if ln.startswith("{"))
+    return json.loads(line), trace_path, tmp
+
+
+def test_smoke_emits_versioned_result_with_dist_comm(smoke_run):
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 7
+    assert result["smoke"] is True
+    assert result["platform"] == "cpu"
+    assert result["dist_shards"] == 8
+    assert result["dist_spmv_comm_bytes"] > 0
+    assert result["dist_cg_comm_bytes"] > result["dist_spmv_comm_bytes"]
+    assert result["comm_total_bytes"] > 0
+    assert result["mem_peak_rss_mb"] > 0
+    assert result["trace_spans"] > 0
+
+
+def test_smoke_trace_artifact_has_comm_counters_and_mem_events(
+        smoke_run):
+    result, trace_path, _ = smoke_run
+    assert os.path.exists(trace_path)
+    assert os.path.getsize(trace_path) > 0
+    doc = json.loads(trace_path.read_text())
+    assert doc["traceEvents"], "empty trace artifact"
+    ctrs = doc["otherData"]["counters"]
+    comm = {k: v for k, v in ctrs.items() if k.startswith("comm.")}
+    assert comm, "no comm.* counters in the trace"
+    assert any(k.startswith("comm.dist_") and k.endswith("_bytes")
+               and v > 0 for k, v in comm.items()), comm
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "bench.dist" in names
+    assert any(n.startswith("mem.") for n in names), sorted(names)
+
+
+def test_smoke_matches_committed_golden(smoke_run, capsys):
+    result, _, tmp = smoke_run
+    assert os.path.exists(GOLDEN), "golden smoke artifact not committed"
+    new = tmp / "smoke.json"
+    new.write_text(json.dumps(result))
+    rc = _tool("bench_compare").main(
+        [GOLDEN, str(new), "--fields", GOLDEN_FIELDS])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_gate_fires_on_synthetic_comm_regression(smoke_run, capsys):
+    result, _, tmp = smoke_run
+    bad = dict(result)
+    bad["dist_spmv_comm_bytes"] = result["dist_spmv_comm_bytes"] * 2
+    bad_path = tmp / "regressed.json"
+    bad_path.write_text(json.dumps(bad))
+    rc = _tool("bench_compare").main(
+        [GOLDEN, str(bad_path), "--fields", GOLDEN_FIELDS])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "dist_spmv_comm_bytes" in out.out + out.err
+
+
+def test_trace_summary_comm_table_renders(smoke_run, capsys):
+    _, trace_path, _ = smoke_run
+    rc = _tool("trace_summary").main([str(trace_path), "--comm"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "comm ledger:" in out
+    assert "dist_spmv" in out and "ppermute" in out

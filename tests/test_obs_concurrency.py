@@ -1,0 +1,312 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Obs under threads: counter monotonicity, tear-free snapshots, the
+per-thread buffered handles (lock-free hot path), and span nesting
+integrity while threaded distributed ops run on the virtual mesh."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import obs
+from legate_sparse_tpu.obs import counters, trace
+from legate_sparse_tpu.parallel import make_row_mesh, shard_csr
+from legate_sparse_tpu.parallel.dist_csr import dist_spmv, shard_vector
+
+R = len(jax.devices())
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    yield
+    obs.reset_all()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+# ------------------------------------------------------------- counters --
+def test_counters_monotonic_and_untorn_under_threads():
+    """Writers bump x then y each round; every snapshot a concurrent
+    reader takes must satisfy x >= y (no torn pair) and both values
+    must be nondecreasing across successive snapshots."""
+    N, M = 4, 2000
+    counters.reset("cc.")
+    start = threading.Barrier(N + 1)
+    done = threading.Event()
+
+    def writer():
+        start.wait()
+        for _ in range(M):
+            counters.inc("cc.x")
+            counters.inc("cc.y")
+
+    threads = [threading.Thread(target=writer) for _ in range(N)]
+    for t in threads:
+        t.start()
+
+    seen = []
+
+    def reader():
+        # Bounded, briefly-yielding sampler: an unbounded hot spin on
+        # the module lock starves the writers into a convoy (and eats
+        # memory) without testing anything extra.
+        start.wait()
+        while not done.is_set() and len(seen) < 2000:
+            snap = counters.snapshot("cc.")
+            seen.append((snap.get("cc.x", 0), snap.get("cc.y", 0)))
+            time.sleep(0)       # yield the GIL deterministically
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.join()
+    done.set()
+    rt.join()
+
+    assert counters.get("cc.x") == counters.get("cc.y") == N * M
+    prev = (0, 0)
+    for x, y in seen:
+        assert x >= y, "torn snapshot: y visible without its x"
+        assert x >= prev[0] and y >= prev[1], "counter went backwards"
+        prev = (x, y)
+
+
+def test_buffered_handles_concurrent_exact_sum():
+    """One lock-free handle per thread, all feeding one counter: the
+    merged total must be exact — no lost increments."""
+    N, M = 8, 5000
+    counters.reset("cc.")
+    start = threading.Barrier(N)
+
+    def worker():
+        h = counters.handle("cc.buffered")
+        start.wait()
+        for _ in range(M):
+            h.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counters.get("cc.buffered") == N * M
+    assert counters.snapshot("cc.")["cc.buffered"] == N * M
+
+
+def test_buffered_handle_reset_rebases_not_mutates():
+    counters.reset("cc.")
+    h = counters.handle("cc.rebase")
+    h.inc(5)
+    assert counters.get("cc.rebase") == 5
+    counters.reset("cc.")
+    assert counters.get("cc.rebase") == 0
+    h.inc(2)
+    assert counters.get("cc.rebase") == 2
+    # Prefix reset only touches matching handles.
+    h2 = counters.handle("dd.other")
+    h2.inc(3)
+    counters.reset("cc.")
+    assert counters.get("cc.rebase") == 0
+    assert counters.get("dd.other") == 3
+    counters.reset("dd.")
+
+
+def test_handle_and_inc_merge_into_one_counter():
+    counters.reset("cc.")
+    counters.inc("cc.mixed", 10)
+    counters.handle("cc.mixed").inc(5)
+    assert counters.get("cc.mixed") == 15
+    snap = counters.snapshot()
+    assert snap["cc.mixed"] == 15
+
+
+def test_handle_returns_same_object_per_thread_per_name():
+    h1 = counters.handle("cc.same")
+    h2 = counters.handle("cc.same")
+    assert h1 is h2
+    got = {}
+
+    def other():
+        got["h"] = counters.handle("cc.same")
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert got["h"] is not h1           # per-thread ownership
+    counters.reset("cc.")
+
+
+def test_dead_thread_handles_fold_and_compact():
+    """Handles owned by finished threads must fold their pending
+    amounts into the base counters and leave the registry at the next
+    compaction sweep — a thread-pool-per-request service must not leak
+    one Handle per (thread, name) forever."""
+    counters.reset("cc.")
+
+    def short_lived():
+        counters.handle("cc.dead").inc(3)
+
+    threads = [threading.Thread(target=short_lived) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counters.get("cc.dead") == 15      # pending still visible
+    with counters._lock:
+        before = sum(1 for h in counters._handles
+                     if h.name == "cc.dead")
+        counters._compact_locked()
+        after = sum(1 for h in counters._handles
+                    if h.name == "cc.dead")
+    assert before == 5 and after == 0
+    # Folded into the base dict: totals survive the compaction.
+    assert counters.get("cc.dead") == 15
+    assert counters.snapshot("cc.")["cc.dead"] == 15
+    counters.reset("cc.")
+
+
+# ---------------------------------------------------------------- spans --
+def test_span_nesting_integrity_across_threads():
+    """The depth stack is thread-local: concurrent nesting in N
+    threads must record exact depths with no cross-thread leakage."""
+    trace.enable()
+    N, M = 4, 200
+    start = threading.Barrier(N)
+
+    def worker(i):
+        start.wait()
+        for _ in range(M):
+            with obs.span(f"thr{i}.outer"):
+                with obs.span(f"thr{i}.inner"):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = obs.records()
+    for i in range(N):
+        outer = [r for r in recs if r["name"] == f"thr{i}.outer"]
+        inner = [r for r in recs if r["name"] == f"thr{i}.inner"]
+        assert len(outer) == len(inner) == M
+        assert all(r["depth"] == 0 for r in outer)
+        assert all(r["depth"] == 1 for r in inner)
+        # seq is globally consistent per name: 0..M-1 exactly once.
+        assert sorted(r["seq"] for r in outer) == list(range(M))
+        assert sum(1 for r in outer if r["first"]) == 1
+
+
+# ---------------------------------------------------- threaded dist ops --
+@pytest.mark.skipif(R < 2, reason="needs a multi-device mesh")
+def test_threaded_dist_spmv_ledger_consistent():
+    """dist_spmv dispatched from several threads while span/counter
+    hammer threads and a snapshotting observer run concurrently: the
+    op and comm counters must account every call exactly, spans must
+    all be recorded with intact nesting, and no snapshot may observe
+    bytes ahead of the matching call count.
+
+    NOTE the ``launch`` lock: concurrent launches of COLLECTIVE
+    programs (ppermute/all_gather) on a multi-device mesh deadlock in
+    the XLA CPU backend — device programs from different launches
+    interleave and the collective rendezvous never completes (a
+    backend property, reproducible with a bare jitted shard_map
+    ppermute from two threads; real meshes order launches through a
+    single dispatch path).  The obs layer itself has no such
+    constraint, which is exactly what the unserialized hammer threads
+    exercise alongside."""
+    trace.enable()
+    mesh = make_row_mesh()
+    n = 32 * R
+    A = sparse.diags(
+        [np.ones(n - 1), np.full(n, 4.0), np.ones(n - 1)], [-1, 0, 1],
+        shape=(n, n), format="csr", dtype=np.float32,
+    )
+    dA = shard_csr(A, mesh=mesh)
+    x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+    _ = np.asarray(dist_spmv(dA, x))    # compile before the storm
+    trace.reset()                       # drop the warm-up span
+    counters.reset("comm.")
+    counters.reset("op.dist_spmv")
+
+    N, M, H = 4, 8, 2
+    launch = threading.Lock()
+    start = threading.Barrier(N + H + 1)
+    done = threading.Event()
+    errors = []
+
+    def worker():
+        start.wait()
+        try:
+            for _ in range(M):
+                with launch:
+                    y = dist_spmv(dA, x)
+                np.asarray(y)       # drain before the next launch
+        except Exception as e:   # pragma: no cover - diagnostic
+            errors.append(e)
+
+    def hammer(i):
+        start.wait()
+        try:
+            for k in range(200):
+                with obs.span(f"hammer{i}.outer"):
+                    with obs.span(f"hammer{i}.inner"):
+                        counters.handle("cc.hammer").inc()
+        except Exception as e:   # pragma: no cover - diagnostic
+            errors.append(e)
+
+    observations = []
+
+    def observer():
+        start.wait()
+        while not done.is_set() and len(observations) < 2000:
+            snap = counters.snapshot("comm.dist_spmv.")
+            observations.append((snap.get("comm.dist_spmv.ppermute", 0),
+                                 snap.get("comm.dist_spmv.ppermute_bytes",
+                                          0)))
+            time.sleep(0)
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    threads += [threading.Thread(target=hammer, args=(i,))
+                for i in range(H)]
+    obs_t = threading.Thread(target=observer)
+    for t in threads:
+        t.start()
+    obs_t.start()
+    for t in threads:
+        t.join()
+    done.set()
+    obs_t.join()
+
+    assert not errors, errors
+    per_call = 2 * R * dA.halo * 4
+    assert counters.get("op.dist_spmv") == N * M
+    assert counters.get("comm.dist_spmv.ppermute") == N * M
+    assert (counters.get("comm.dist_spmv.ppermute_bytes")
+            == N * M * per_call)
+    assert counters.get("cc.hammer") == H * 200
+    recs = obs.records()
+    spans = [r for r in recs if r["name"] == "dist_spmv"]
+    assert len(spans) == N * M
+    for i in range(H):
+        inner = [r for r in recs if r["name"] == f"hammer{i}.inner"]
+        assert len(inner) == 200
+        assert all(r["depth"] == 1 for r in inner)
+    # Records bump the call handle before the bytes handle, so a
+    # snapshot may catch at most one in-flight record per worker:
+    # bytes never EXCEED calls * per_call and lag by at most N.
+    for calls, nbytes in observations:
+        assert nbytes <= calls * per_call, "bytes ahead of calls"
+        assert nbytes >= (calls - N) * per_call, "torn comm snapshot"
+    counters.reset("cc.")

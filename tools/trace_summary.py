@@ -15,6 +15,7 @@ Usage::
     python tools/trace_summary.py BENCH_20260804T120000.trace.json
     python tools/trace_summary.py run.trace.json --stream-gbs 819
     python tools/trace_summary.py run.trace.json --events --counters
+    python tools/trace_summary.py run.trace.json --comm
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
 file's bench metadata when present (bench.py embeds its result blob).
@@ -35,6 +36,38 @@ sys.path.insert(0, os.path.dirname(_HERE))
 from legate_sparse_tpu.obs import report  # noqa: E402
 
 
+def render_comm_table(counters: dict) -> str:
+    """Per-op x collective table from the ``comm.*`` ledger counters
+    embedded in a Chrome-trace artifact: collective-op count and
+    predicted interconnect bytes (obs/comm.py accounting convention:
+    total across the mesh, counted once at each receiver)."""
+    rows = {}
+    for name, val in counters.items():
+        if not name.startswith("comm.") or name.startswith("comm.total"):
+            continue
+        body = name[len("comm."):]
+        is_bytes = body.endswith("_bytes")
+        if is_bytes:
+            body = body[: -len("_bytes")]
+        op, _, coll = body.rpartition(".")
+        row = rows.setdefault((op, coll), {"calls": 0, "bytes": 0})
+        row["bytes" if is_bytes else "calls"] += val
+    if not rows:
+        return "no comm.* counters recorded (no distributed ops ran?)"
+    headers = ["op", "collective", "calls", "bytes", "MB"]
+    lines = []
+    for (op, coll), row in sorted(rows.items(),
+                                  key=lambda kv: -kv[1]["bytes"]):
+        lines.append([op, coll, str(int(row["calls"])),
+                      str(int(row["bytes"])),
+                      f"{row['bytes'] / 2**20:.3f}"])
+    total_b = sum(r["bytes"] for r in rows.values())
+    total_c = sum(r["calls"] for r in rows.values())
+    lines.append(["TOTAL", "", str(int(total_c)), str(int(total_b)),
+                  f"{total_b / 2**20:.3f}"])
+    return report.format_table(headers, lines, left_cols=2)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Per-op table from a legate_sparse_tpu trace file."
@@ -50,6 +83,10 @@ def main(argv=None) -> int:
     ap.add_argument("--counters", action="store_true",
                     help="also dump the counter snapshot embedded in a "
                          "Chrome-trace file")
+    ap.add_argument("--comm", action="store_true",
+                    help="also render the comm.* ledger (per-op x "
+                         "collective calls + predicted interconnect "
+                         "bytes)")
     args = ap.parse_args(argv)
 
     records = report.load_records(args.trace_file)
@@ -90,6 +127,10 @@ def main(argv=None) -> int:
         print("\ncounters:")
         for name in sorted(meta["counters"]):
             print(f"  {name} = {meta['counters'][name]}")
+
+    if args.comm:
+        print("\ncomm ledger:")
+        print(render_comm_table(meta.get("counters") or {}))
     return 0
 
 
