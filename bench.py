@@ -56,6 +56,13 @@ is the hermetic CI lane: an 8-virtual-device CPU mesh, no probe or
 canary, tiny sizes — the whole schema in seconds, exercised by
 ``tests/test_bench_smoke.py`` against ``evidence/BENCH_golden_smoke.json``.
 
+Engine phase (schema_version 8, ``docs/ENGINE.md``): cold (plan
+compile) vs warm-cache (same shape bucket, different n — the
+zero-retrace hit path) vs micro-batched dispatch, recorded as
+``engine_cold_ms``/``engine_warm_ms``/``engine_batched_ms_per_req``
+plus the deterministic ``engine_plan_hits``/``engine_plan_misses``
+that the smoke golden pins.
+
 Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
 writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
 override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
@@ -404,6 +411,29 @@ def _banded_config(sparse, n: int, nnz_per_row: int, dtype=np.float32):
                         dtype=dtype)
 
 
+def _engine_config(sparse, n: int, nnz_per_row: int):
+    """Random-column CSR with a DETERMINISTIC nnz and one heavy row:
+    random columns defeat band detection and the heavy row blows the
+    ELL (and BSR) budgets, so the matrix is engine-eligible on every
+    platform — on TPU the engine declines ELL-packable matrices (the
+    roofline gather path wins there), and a uniform-row config would
+    silently skip the whole phase.  nnz = nnz_per_row * (n + 63)
+    exactly, so the shape buckets — and the golden-gated plan
+    hit/miss counts — are the same on every machine."""
+    rng = np.random.default_rng(7)
+    counts = np.full(n, nnz_per_row, dtype=np.int64)
+    counts[0] = min(64 * nnz_per_row, n)   # ELL-budget breaker
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    nnz = int(indptr[-1])
+    indices = rng.integers(0, n, size=nnz).astype(np.int32)
+    row_ids = np.repeat(np.arange(n), counts)
+    order = np.lexsort((indices, row_ids))
+    indices = indices[order]
+    data = rng.standard_normal(nnz).astype(np.float32)
+    return sparse.csr_array((data, indices, indptr), shape=(n, n))
+
+
 def _irregular_config(sparse, n: int, nnz_per_row: int):
     """Random-sparsity CSR with skewed row lengths: defeats band/ELL
     detection (one heavy row) so the gather/segment-sum path runs."""
@@ -528,8 +558,10 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # Bench JSON schema version: bumped whenever the key set or a key's
 # meaning changes (BASELINE.md documents the history; the superset
 # contract still holds within a version).  7 = comm/mem ledger fields
-# + dist phase + schema_version itself.
-SCHEMA_VERSION = 7
+# + dist phase + schema_version itself.  8 = execution-engine phase
+# (engine_cold_ms / engine_warm_ms / engine_batched_ms_per_req +
+# golden-gated engine_plan_hits / engine_plan_misses).
+SCHEMA_VERSION = 8
 
 
 def main() -> None:
@@ -1050,6 +1082,83 @@ def main() -> None:
                 obs.counters.get("comm.total_bytes"))
         except Exception as e:
             sys.stderr.write(f"bench: dist phase failed: {e!r}\n")
+
+    # Execution-engine phase (docs/ENGINE.md): cold (plan compile) vs
+    # warm-cache (same bucket, DIFFERENT n — the zero-retrace hit
+    # path) vs micro-batched dispatch, on a fixed-nnz random matrix.
+    # Runs in --smoke too: the plan hit/miss deltas are deterministic
+    # given the call sequence below, so the smoke golden pins them and
+    # the *_ms fields join the bench_compare trajectory gate.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_ENGINE",
+                           "0") != "1")
+            and not past_deadline(result, "engine")):
+        try:
+            import time as _time
+
+            from legate_sparse_tpu.engine import Engine, RequestExecutor
+
+            n_cold = (1 << 12 if smoke else 1 << 16) - 37
+            n_warm = (1 << 12 if smoke else 1 << 16) - 101
+            with obs.span("bench.engine") as _sp, \
+                    obs.memory.watermark("bench.engine"):
+                A_cold = _engine_config(sparse, n_cold, nnz_per_row)
+                A_warm = _engine_config(sparse, n_warm, nnz_per_row)
+                x_cold = jnp.ones((n_cold,), jnp.float32)
+                x_warm = jnp.ones((n_warm,), jnp.float32)
+                # Fresh engine: the cold number really is a plan build
+                # even when the routing flag was on earlier.
+                eng = Engine()
+                hm0 = (obs.counters.get("engine.plan.hits"),
+                       obs.counters.get("engine.plan.misses"))
+                t0 = _time.perf_counter()
+                y = eng.matvec(A_cold, x_cold)
+                if y is None:
+                    # A silent decline must be a recorded phase error,
+                    # not a TypeError swallowed as one.
+                    raise RuntimeError(
+                        "engine declined the bench matrix "
+                        "(eligibility drifted?)")
+                _ = float(np.asarray(y[0]))
+                cold_ms = (_time.perf_counter() - t0) * 1e3
+                # One untimed hit absorbs A_warm's pack build + the
+                # tail-pad op compile; the timed calls are the pure
+                # cached-executable path.
+                _ = float(np.asarray(eng.matvec(A_warm, x_warm)[0]))
+                warm_ms = float("inf")
+                for _rep in range(5):
+                    t0 = _time.perf_counter()
+                    y = eng.matvec(A_warm, x_warm)
+                    _ = float(np.asarray(y[0]))
+                    warm_ms = min(warm_ms,
+                                  (_time.perf_counter() - t0) * 1e3)
+                # Batched: 8 same-matrix requests -> ONE stacked SpMM
+                # dispatch (deterministic: timeout 0 = flush-only).
+                ex = RequestExecutor(eng, max_batch=8, queue_depth=64,
+                                     timeout_ms=0)
+                reqs = 8
+                t0 = _time.perf_counter()
+                futs = [ex.submit(A_warm, x_warm) for _r in range(reqs)]
+                _ = [float(np.asarray(f.result()[0])) for f in futs]
+                batched_ms = (_time.perf_counter() - t0) * 1e3 / reqs
+                ex.shutdown()
+                result["engine_cold_ms"] = round(cold_ms, 4)
+                result["engine_warm_ms"] = round(warm_ms, 4)
+                result["engine_warm_speedup"] = round(
+                    cold_ms / max(warm_ms, 1e-9), 2)
+                result["engine_batched_ms_per_req"] = round(batched_ms,
+                                                            4)
+                result["engine_batch_requests"] = reqs
+                result["engine_plan_hits"] = int(
+                    obs.counters.get("engine.plan.hits") - hm0[0])
+                result["engine_plan_misses"] = int(
+                    obs.counters.get("engine.plan.misses") - hm0[1])
+                if _sp is not None:
+                    _sp.set(nnz=A_cold.nnz + A_warm.nnz,
+                            cold_ms=result["engine_cold_ms"],
+                            warm_ms=result["engine_warm_ms"])
+        except Exception as e:
+            sys.stderr.write(f"bench: engine phase failed: {e!r}\n")
 
     # Non-toy scale anchors (VERDICT r4 weak #6): one 1e6-row CG and
     # one 4096^2 pde datapoint, recorded REGARDLESS of tunnel state so

@@ -29,6 +29,7 @@ from .module import (  # explicit re-exports for linters
 from .coverage import clone_module
 from . import linalg  # noqa: F401
 from . import parallel  # noqa: F401
+from . import engine  # noqa: F401
 
 __version__ = "25.07.1"
 

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from scipy.sparse import SparseEfficiencyWarning
 
 from . import obs as _obs
+from .engine import route_matmat as _engine_route_matmat
+from .engine import route_matvec as _engine_route_matvec
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
 from .types import check_nnz, coord_dtype_for, index_dtype, nnz_dtype
@@ -198,6 +200,9 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_pack = None
         self._dia_fused = None
         self._bsr = None
+        # Engine bucket pack: (key terms, padded operands) — built by
+        # legate_sparse_tpu.engine on first routed dispatch.
+        self._engine_pack = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
             f"indptr length {self._indptr.shape[0]} != rows+1 "
@@ -700,6 +705,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_pack = None
         self._dia_fused = None
         self._bsr = None
+        self._engine_pack = None
 
     def sort_indices(self):
         """Sort column indices within each row in place (stable; no
@@ -722,6 +728,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_pack = None
         self._dia_fused = None
         self._bsr = None
+        self._engine_pack = None
 
     def power(self, n, dtype=None):
         """Element-wise power (scipy semantics: duplicates are summed
@@ -1171,6 +1178,23 @@ class csr_array(CompressedBase, DenseSparseBase):
             A, x = cast_to_common_type(self, other_arr)
             src = self if A is self else None
             with _obs.span("spmv") as sp:
+                if src is not None:
+                    # Engine route (settings.engine): bucketed plan
+                    # dispatch with zero retraces under n/nnz drift.
+                    # Declines (off, tracer context, structure fast
+                    # path) fall through to the normal chain.
+                    y = _engine_route_matvec(src, x)
+                    if y is not None:
+                        if sp is not None:
+                            # Traffic model: the engine kernel is the
+                            # CSR gather path over padded operands.
+                            sp.set(path="engine", rows=self.shape[0],
+                                   nnz=self.nnz, flops=2 * self.nnz,
+                                   bytes=A.spmv_traffic_bytes(
+                                       x, path="csr"))
+                        if squeeze:
+                            y = y[:, None]
+                        return fill_out(y, out)
                 dia = src._get_dia() if src is not None else None
                 bsr = (src._get_bsr() if src is not None and dia is None
                        else None)
@@ -1231,6 +1255,17 @@ class csr_array(CompressedBase, DenseSparseBase):
             A, X = cast_to_common_type(self, other_arr)
             src = self if A is self else None
             with _obs.span("spmm") as sp:
+                if src is not None:
+                    Y = _engine_route_matmat(src, X)
+                    if Y is not None:
+                        if sp is not None:
+                            k = int(X.shape[1])
+                            sp.set(path="engine", rows=self.shape[0],
+                                   k=k, nnz=self.nnz,
+                                   flops=2 * self.nnz * k,
+                                   bytes=A.spmv_traffic_bytes(
+                                       X, path="csr"))
+                        return fill_out(Y, out)
                 dia = src._get_dia() if src is not None else None
                 from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
 
@@ -1354,6 +1389,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_pack = None
         self._dia_fused = None
         self._bsr = None
+        self._engine_pack = None
         if structure_changed:
             self._row_ids = None
             self._ell_width = None

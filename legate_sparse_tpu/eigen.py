@@ -968,6 +968,23 @@ def lobpcg(A, X, B=None, M=None, Y=None, tol=None, maxiter=20,
     orthonormalized columns of ``X`` (``_block_seed``), which overlaps
     every direction the block spans.  Results match scipy's; per-column
     convergence *rates* of true block LOBPCG do not transfer.
+
+    ``maxiter`` semantics: scipy counts *block iterations* — each one
+    is one Rayleigh-Ritz step on the (X, R, P) subspace, and
+    ``maxiter=20`` means at most 20 such steps.  The Lanczos-backed
+    routes here have no block iteration to count; ``maxiter`` instead
+    bounds the **escalation retry count** — how many times the driver
+    may widen its Krylov subspace (growing ``ncv`` toward the
+    ``max(8k, 128)`` basis cap) and restart after a non-converged
+    attempt, clamped to [1, 10].  Consequences: (a) ``maxiter=1`` is
+    one full Lanczos solve at the initial subspace width, not one
+    Rayleigh-Ritz step — usually *more* work than scipy's first
+    iteration; (b) raising ``maxiter`` past 10 buys nothing on these
+    routes; (c) iteration-matched comparisons against scipy's
+    ``lobpcg`` are not meaningful — compare residual tolerances
+    instead.  The ``jax.experimental`` ``lobpcg_standard`` route (real
+    standard problems) keeps scipy-style semantics: ``maxiter`` is the
+    block-iteration count ``m`` passed straight through.
     """
     if (B is not None and M is None and Y is None and not kwargs
             and np.asarray(X).shape[0] <= (1 << 15)):

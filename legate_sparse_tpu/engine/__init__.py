@@ -1,0 +1,45 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""legate_sparse_tpu.engine: shape-bucketed plan cache + micro-batching
+request executor.
+
+The serving layer between user traffic and the kernels (see
+``docs/ENGINE.md``).  Three pieces:
+
+- **plan cache** (``plan_cache``): AOT-compiled executables keyed on
+  (op, dtype, shape *bucket*, mesh fingerprint, settings epoch), with
+  an explicit ``warmup(plans)`` API and optional persistent backing
+  via JAX's compilation cache — nearby ``n``/``nnz`` hit one compiled
+  program with zero retraces.
+- **shape bucketing** (``buckets``): power-of-two (or user-ladder)
+  padding with masked tails, bit-for-bit identical to the unpadded
+  kernels.
+- **request executor** (``executor``): thread-safe ``submit`` that
+  micro-batches same-plan SpMV requests into one stacked SpMM
+  dispatch, with queue-depth/timeout/backpressure knobs in
+  ``settings``.
+
+Enable with ``LEGATE_SPARSE_TPU_ENGINE=1`` (or ``settings.engine =
+True``): eligible ``csr_array.dot`` and ``linalg.cg`` hot paths then
+route through the engine automatically.  All engine activity lands in
+the obs counters/spans (``engine.*``); ``tools/trace_summary.py
+--plans`` renders the per-plan table.
+"""
+
+from .buckets import bucket, k_bucket, next_pow2  # noqa: F401
+from .core import (  # noqa: F401
+    Engine, engine_enabled, get_engine, reset_engine, route_matmat,
+    route_matvec, warmup,
+)
+from .executor import RequestExecutor  # noqa: F401
+from .plan_cache import (  # noqa: F401
+    Plan, PlanCache, PlanKey, maybe_enable_persistent_cache,
+)
+
+__all__ = [
+    "bucket", "k_bucket", "next_pow2",
+    "Engine", "engine_enabled", "get_engine", "reset_engine",
+    "route_matvec", "route_matmat", "warmup",
+    "RequestExecutor",
+    "Plan", "PlanCache", "PlanKey", "maybe_enable_persistent_cache",
+]

@@ -1,0 +1,59 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Shape bucketing policy: the quantizer behind the plan cache.
+
+Every entry point retraced whenever ``n``/``nnz`` drifted, and the obs
+first-call split showed compiles dominating first-touch latency.  The
+fix is the JITSPMM lesson (PAPERS.md): runtime specialization pays only
+when the specialized artifact is REUSED — so specialize on a shape
+*bucket*, not the exact shape.  Operands are padded up to the bucket
+with masked tails (``ops.spmv.csr_spmv_rowids_masked`` /
+``csr_spmm_rowids_masked`` drop padded products exactly), which keeps
+results bit-for-bit identical to the unpadded kernels while nearby
+sizes share one compiled executable.
+
+Policy: the smallest rung of ``settings.engine_bucket_ladder`` that
+holds the value, or — with an empty ladder (the default) or a value
+above the top rung — the next power of two.  Either way the bucket is
+floored at ``settings.engine_min_bucket`` so tiny matrices don't mint
+one plan per size.  Padding waste is bounded: < 2x under the
+power-of-two policy, operator-chosen under a ladder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def next_pow2(value: int) -> int:
+    """Smallest power of two >= ``value`` (>= 1)."""
+    return 1 << max(int(value) - 1, 0).bit_length()
+
+
+def bucket(value: int, ladder: Optional[Tuple[int, ...]] = None,
+           minimum: Optional[int] = None) -> int:
+    """Bucketed size for ``value`` under the active policy.
+
+    ``ladder``/``minimum`` default to the live settings; pass
+    explicitly for policy-independent uses (tests, warmup specs).
+    """
+    if ladder is None or minimum is None:
+        from ..settings import settings
+
+        if ladder is None:
+            ladder = settings.engine_bucket_ladder
+        if minimum is None:
+            minimum = settings.engine_min_bucket
+    value = max(int(value), 1)
+    floor = max(int(minimum), 1)
+    for rung in ladder:
+        if rung >= value:
+            return max(rung, floor)
+    return max(next_pow2(value), floor)
+
+
+def k_bucket(k: int) -> int:
+    """Bucket for the dense-operand column count of an SpMM plan (the
+    executor's stacked-batch width): plain next power of two, floor 1 —
+    batch widths are small, a ladder buys nothing."""
+    return next_pow2(max(int(k), 1))

@@ -1,0 +1,438 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Execution engine: bucketed plans + request-level dispatch.
+
+``Engine`` ties the pieces together:
+
+- :mod:`.buckets` quantizes ``(rows, cols, nnz, k)`` to policy buckets;
+- :mod:`.plan_cache` holds one AOT-compiled executable per bucket key;
+- per-matrix *packs* (operands padded to the bucket, cached on the
+  ``csr_array`` like the ELL/DIA structure caches) supply the
+  executable's inputs without per-call padding of the matrix;
+- :mod:`.executor` micro-batches same-plan SpMV requests into one
+  stacked SpMM dispatch.
+
+The engine routes only what it can serve *better*: matrices whose
+dispatch would take the gather/segment-sum (CSR/ELL) paths.  Banded
+(DIA) and block (BSR) matrices keep their structure-specialized
+kernels — those are shape-specialized for a reason, and bucketing them
+is a different project.  Routing requires a concrete (non-tracer)
+context and a single-controller process; everything else falls back to
+the normal dispatch, so ``settings.engine = True`` is always safe.
+
+Correctness contract: a bucketed dispatch is bit-for-bit identical to
+the unpadded ``csr_spmv_rowids``/``csr_spmm_rowids`` kernels — padded
+products are masked to exact zeros and padded row ids fall outside
+``[0, rows_b)`` so ``segment_sum`` drops them (tests/test_engine.py
+fuzzes this on f32/f64/c64 including bucket-boundary tails).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .. import obs as _obs
+from ..settings import settings as _settings_ref
+from . import buckets as _buckets
+from .plan_cache import BUILDERS, Plan, PlanCache, PlanKey, \
+    maybe_enable_persistent_cache
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+class _Pack:
+    """Bucket-padded operands of one matrix (device arrays)."""
+
+    __slots__ = ("data", "indices", "row_ids", "valid", "rows", "cols",
+                 "nnz")
+
+    def __init__(self, data, indices, row_ids, valid, rows, cols, nnz):
+        self.data = data
+        self.indices = indices
+        self.row_ids = row_ids
+        self.valid = valid
+        self.rows = rows
+        self.cols = cols
+        self.nnz = nnz
+
+
+def _pad_tail(arr, total: int, fill):
+    """Pad a 1-D array up to ``total`` with ``fill`` (device concat)."""
+    import jax.numpy as jnp
+
+    pad = total - arr.shape[0]
+    if pad <= 0:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.full((pad,), fill, dtype=arr.dtype)])
+
+
+class Engine:
+    """Shape-bucketed plan cache + request executor (one per process
+    via :func:`get_engine`; independent instances are fine in tests)."""
+
+    def __init__(self, plan_capacity: Optional[int] = None):
+        from ..settings import settings
+
+        self._settings = settings
+        self._cache = PlanCache(
+            plan_capacity if plan_capacity is not None
+            else settings.engine_plan_cache_size)
+        self._executor = None
+        self._exec_lock = threading.Lock()
+        maybe_enable_persistent_cache()
+
+    # ---------------- keys / eligibility ----------------
+
+    def _key(self, op: str, rows: int, cols: int, nnz: int,
+             dtype, k: int = 1, mesh_fp: str = "") -> PlanKey:
+        return PlanKey(
+            op=op,
+            dtype=np.dtype(dtype).name,
+            rows_b=_buckets.bucket(rows),
+            cols_b=_buckets.bucket(cols),
+            nnz_b=_buckets.bucket(nnz),
+            k_b=_buckets.k_bucket(k),
+            mesh_fp=mesh_fp,
+            epoch=self._settings.epoch,
+        )
+
+    def _eligible(self, A, x_dtype=None) -> bool:
+        """Can (and should) this matrix route through bucketed plans?
+        Declines are silent-by-design: the caller falls back to the
+        normal dispatch."""
+        import jax
+
+        from ..csr import csr_array
+
+        if not isinstance(A, csr_array):
+            return False
+        if jax.process_count() != 1:
+            return False        # AOT executables + closure constants
+        if not csr_array._can_build_cache(A.data, A.indices, A.indptr):
+            return False        # ambient trace: pack/pad would leak
+        if x_dtype is not None and np.result_type(
+                A.dtype, x_dtype) != A.dtype:
+            return False        # promotion would rebuild packs per call
+        rows_b = _buckets.bucket(A.shape[0])
+        cols_b = _buckets.bucket(A.shape[1])
+        # All three BUCKETED: the kernel's iota/row ids run over the
+        # padded nnz_b, which can cross int32 even when raw nnz fits.
+        if (rows_b > _INT32_MAX or cols_b > _INT32_MAX
+                or _buckets.bucket(A.nnz) > _INT32_MAX):
+            return False        # int32 row-id/pad sentinel domain
+        # Structure-specialized fast paths win over shape stability.
+        if A._get_dia() is not None or A._get_bsr() is not None:
+            return False
+        if (jax.devices()[0].platform == "tpu"
+                and A._get_ell() is not None):
+            # On TPU the rectangular ELL gather runs at HBM roofline
+            # while scatter/segment-sum kernels do not (csr.py kernel
+            # notes): shape stability must not cost the roofline
+            # there.  On CPU the two are the same class, so bucketed
+            # plans take ELL-packable matrices too.
+            return False
+        return True
+
+    # ---------------- plans ----------------
+
+    def plan_for(self, op: str, rows: int, cols: int, nnz: int, dtype,
+                 k: int = 1, mesh_fp: str = "") -> Plan:
+        """Fetch (or build) the plan for a bucketed shape — the
+        ``warmup`` workhorse, also usable directly."""
+        key = self._key(op, rows, cols, nnz, dtype, k=k, mesh_fp=mesh_fp)
+        builder = BUILDERS.get(op)
+        if builder is None:
+            raise ValueError(f"unknown plan op {op!r}; known: "
+                             f"{sorted(BUILDERS)}")
+        plan, _hit = self._cache.get_or_build(key, builder)
+        return plan
+
+    def warmup(self, plans: Iterable[Dict[str, Any]]) -> List[str]:
+        """Pre-compile plans before traffic arrives.
+
+        Each spec is a dict: ``{"op": "spmv"|"spmm", "dtype": ...,
+        "rows": n, "cols": m, "nnz": z, "k": 1}`` (``cols`` defaults to
+        ``rows``, ``k`` to 1).  Shapes are bucketed exactly like live
+        dispatch, so a warmed spec guarantees a plan hit for every
+        workload landing in the same buckets.  Compiles against
+        ``ShapeDtypeStruct``s — no operand materialization.  Returns
+        the built plan ids."""
+        built = []
+        for spec in plans:
+            rows = int(spec["rows"])
+            plan = self.plan_for(
+                spec.get("op", "spmv"),
+                rows,
+                int(spec.get("cols", rows)),
+                int(spec["nnz"]),
+                spec.get("dtype", np.float32),
+                k=int(spec.get("k", 1)),
+            )
+            built.append(plan.key.plan_id)
+        return built
+
+    # ---------------- per-matrix packs ----------------
+
+    def _pack_for(self, A, key: PlanKey) -> _Pack:
+        import jax.numpy as jnp
+
+        from ..csr import csr_array
+        from ..types import coord_dtype_for
+
+        terms = (key.rows_b, key.cols_b, key.nnz_b, key.dtype)
+        cached = getattr(A, "_engine_pack", None)
+        if cached is not None and cached[0] == terms:
+            return cached[1]
+        nnz = A.nnz
+        cdt = coord_dtype_for(max(key.cols_b, 1))
+        data = _pad_tail(A.data, key.nnz_b, 0)
+        indices = _pad_tail(A.indices.astype(cdt), key.nnz_b, 0)
+        # Padded row ids land OUT of [0, rows_b): segment_sum drops
+        # them (no +0.0 ever touches a real row — the bit-for-bit
+        # invariant; adding 0.0 would flip a -0.0 sum).
+        row_ids = _pad_tail(
+            A._get_row_ids().astype(jnp.int32), key.nnz_b, key.rows_b)
+        valid = jnp.asarray(nnz, dtype=jnp.int32)
+        pack = _Pack(data, indices, row_ids, valid,
+                     A.shape[0], A.shape[1], nnz)
+        if csr_array._can_build_cache(A.data, A.indices, A.indptr):
+            A._engine_pack = (terms, pack)
+        return pack
+
+    # ---------------- dispatch ----------------
+
+    def matvec(self, A, x, _checked: bool = False):
+        """``A @ x`` through the bucketed SpMV plan; returns None when
+        the matrix/context is ineligible (caller falls back)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        if x.ndim != 1 or x.shape[0] != A.shape[1]:
+            raise ValueError(
+                f"engine.matvec: operand shape {x.shape} does not "
+                f"match matrix {A.shape}"
+            )
+        if not _checked and not self._eligible(A, x.dtype):
+            return None
+        key = self._key("spmv", A.shape[0], A.shape[1], A.nnz, A.dtype)
+        plan, _hit = self._cache.get_or_build(key, BUILDERS["spmv"])
+        pack = self._pack_for(A, key)
+        x_p = _pad_tail(x.astype(A.dtype), key.cols_b, 0)
+        y_p = plan(pack.data, pack.indices, pack.row_ids, pack.valid,
+                   x_p)
+        return y_p[: A.shape[0]]
+
+    def matmat(self, A, X, _checked: bool = False):
+        """``A @ X`` (dense ``(cols, k)`` operand) through the bucketed
+        SpMM plan; None when ineligible.  ``k`` is bucketed too, with
+        zero columns padded in and sliced back off."""
+        import jax.numpy as jnp
+
+        X = jnp.asarray(X)
+        if X.ndim != 2 or X.shape[0] != A.shape[1]:
+            raise ValueError(
+                f"engine.matmat: operand shape {X.shape} does not "
+                f"match matrix {A.shape}"
+            )
+        if not _checked and not self._eligible(A, X.dtype):
+            return None
+        k = int(X.shape[1])
+        if k == 0:
+            return None
+        key = self._key("spmm", A.shape[0], A.shape[1], A.nnz, A.dtype,
+                        k=k)
+        plan, _hit = self._cache.get_or_build(key, BUILDERS["spmm"])
+        pack = self._pack_for(A, key)
+        X_p = X.astype(A.dtype)
+        pad_r = key.cols_b - X_p.shape[0]
+        if pad_r:
+            X_p = jnp.concatenate(
+                [X_p, jnp.zeros((pad_r, k), dtype=X_p.dtype)])
+        pad_k = key.k_b - k
+        if pad_k:
+            X_p = jnp.concatenate(
+                [X_p, jnp.zeros((X_p.shape[0], pad_k), dtype=X_p.dtype)],
+                axis=1)
+        Y_p = plan(pack.data, pack.indices, pack.row_ids, pack.valid,
+                   X_p)
+        return Y_p[: A.shape[0], :k]
+
+    def traceable_matvec(self, A) -> Optional[Callable]:
+        """A jax-traceable ``x -> A @ x`` closure over the bucketed
+        plan — for solver loops (``linalg.cg`` et al.), where the AOT
+        executable cannot appear inside the trace.  Built eagerly
+        (plan compiled, pack padded NOW, in a concrete context);
+        returns None when ineligible.
+
+        The closure's output is ``[: n]``-sliced before any reduction
+        a solver performs, so solver iterates — and the converged
+        result — are bit-for-bit the unpadded kernel's."""
+        if not self._eligible(A):
+            return None
+        import jax.numpy as jnp
+
+        key = self._key("spmv", A.shape[0], A.shape[1], A.nnz, A.dtype)
+        plan, _hit = self._cache.get_or_build(key, BUILDERS["spmv"])
+        pack = self._pack_for(A, key)
+        n = A.shape[0]
+        cols_b = key.cols_b
+        dtype = A.dtype
+        traced = plan.traced
+
+        def mv(x):
+            x_p = _pad_tail(jnp.asarray(x).astype(dtype), cols_b, 0)
+            return traced(pack.data, pack.indices, pack.row_ids,
+                          pack.valid, x_p)[:n]
+
+        # Freshness token for callers that hold the closure across
+        # possible matrix mutation (linalg's solver route): the pack
+        # this closure captured.  A mutation clears A._engine_pack, so
+        # `A._engine_pack is not None and A._engine_pack[1] is
+        # mv.pack` iff the closure still reads the live operands.
+        mv.pack = pack
+        return mv
+
+    def record_dist_plan(self, A, op: str = "dist_spmv") -> bool:
+        """Ledger one distributed dispatch against its plan identity.
+
+        The executables of distributed plans live in ``dist_csr``'s
+        ``lru_cache``'d shard_map builders (keyed on the same layout
+        terms); what the engine adds is the identity — mesh
+        fingerprint + layout + dtype + epoch — and the hit/miss
+        evidence that a second same-layout matrix on the same mesh
+        reuses the compiled program instead of re-tracing.
+        ``dist_spmv`` itself calls this when routing is enabled, so
+        every production dispatch (solvers, bench) feeds the ledger.
+        Returns True on a plan hit."""
+        from ..parallel.dist_csr import dist_plan_fingerprint
+
+        key = PlanKey(
+            op=op,
+            dtype=np.dtype(A.dtype).name,
+            rows_b=A.rows_padded,
+            cols_b=A.shape[1],
+            nnz_b=0,
+            k_b=1,
+            mesh_fp=dist_plan_fingerprint(A),
+            epoch=self._settings.epoch,
+        )
+        plan, hit = self._cache.get_or_build(
+            key, lambda k: Plan(k, meta={"kind": op}))
+        plan.execs += 1
+        _obs.inc(f"engine.plan.{key.plan_id}.execs")
+        return hit
+
+    def dist_matvec(self, A, x):
+        """Distributed SpMV with its plan-ledger entry recorded (the
+        direct-call convenience; with routing enabled ``dist_spmv``
+        itself records into the process engine, so this only records
+        here when that path won't)."""
+        from ..parallel.dist_csr import dist_spmv
+
+        if not _settings_ref.engine:
+            self.record_dist_plan(A)
+        return dist_spmv(A, x)
+
+    # ---------------- executor ----------------
+
+    @property
+    def executor(self):
+        """Lazily constructed request executor (settings knobs)."""
+        if self._executor is None:
+            with self._exec_lock:
+                if self._executor is None:
+                    from .executor import RequestExecutor
+
+                    self._executor = RequestExecutor(self)
+        return self._executor
+
+    def submit(self, A, x):
+        """Async SpMV: enqueue for micro-batching, get a Future."""
+        return self.executor.submit(A, x)
+
+    # ---------------- introspection ----------------
+
+    def stats(self) -> Dict[str, Any]:
+        snap = _obs.counters.snapshot("engine.")
+        return {
+            "plans": self._cache.stats(),
+            "counters": snap,
+        }
+
+    def clear(self) -> None:
+        """Drop every cached plan (tests; live traffic just misses)."""
+        self._cache.clear()
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown()
+            self._executor = None
+
+
+# ---------------------------------------------------------------- singleton
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    """The process-wide engine (created on first use)."""
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                _engine = Engine()
+    return _engine
+
+
+def reset_engine() -> None:
+    """Tear down the singleton (tests / fork hygiene)."""
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.shutdown()
+        _engine = None
+
+
+def engine_enabled() -> bool:
+    """Fast routing check for the dispatch hot path: one attribute
+    read on the settings singleton (imported at module scope — the
+    obs overhead contract applies to this dispatch site too)."""
+    return _settings_ref.engine
+
+
+def route_matvec(A, x):
+    """Dispatch-site helper: engine result or None (fall through).
+
+    Routing must never make ``A @ x`` fail where the normal dispatch
+    would succeed ("settings.engine = True is always safe"): a plan
+    build/dispatch error — XLA compile failure on the padded shapes, a
+    misconfigured persist dir — is recorded and falls back."""
+    if not engine_enabled():
+        return None
+    try:
+        return get_engine().matvec(A, x)
+    except Exception as e:
+        _obs.inc("engine.route.error")
+        _obs.event("engine.route.error", op="spmv", error=repr(e)[:200])
+        return None
+
+
+def route_matmat(A, X):
+    if not engine_enabled():
+        return None
+    try:
+        return get_engine().matmat(A, X)
+    except Exception as e:
+        _obs.inc("engine.route.error")
+        _obs.event("engine.route.error", op="spmm", error=repr(e)[:200])
+        return None
+
+
+def warmup(plans: Iterable[Dict[str, Any]]) -> List[str]:
+    """Module-level convenience: ``get_engine().warmup(plans)``."""
+    return get_engine().warmup(plans)

@@ -1,0 +1,283 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Micro-batching request executor: the admission layer above plans.
+
+Serving-shaped traffic (the GPGPU-cluster SpMV paper's framing) is
+many small same-matrix matvec requests arriving concurrently.  One
+SpMV moves the whole matrix for one vector; k stacked requests move it
+once for k vectors — so the executor coalesces same-plan SpMV
+submissions into ONE stacked SpMM dispatch (``csr_spmm_rowids_masked``
+computes each column exactly as the SpMV kernel would: batching is
+bit-for-bit invisible to callers).
+
+Contract
+--------
+- ``submit(A, x) -> concurrent.futures.Future`` — thread-safe; callers
+  must not mutate ``A`` while requests are in flight.
+- A batch dispatches when it reaches ``settings.engine_max_batch``
+  requests (in the submitting thread), when its oldest request ages
+  past ``settings.engine_batch_timeout_ms`` (background worker), or on
+  an explicit ``flush()``.  ``timeout_ms <= 0`` disables the worker —
+  fully deterministic dispatch for tests/benchmarks (max-batch and
+  ``flush`` only).
+- Backpressure: at ``settings.engine_queue_depth`` pending requests, a
+  ``submit`` converts into an inline dispatch of the largest group
+  (bounded queue without a deadlockable wait).
+- Ineligible submissions (matrix on a structure fast path, tracer
+  context) dispatch inline through the normal ``A.dot`` — the Future
+  contract holds either way.
+
+Device-launch discipline: every batch dispatch happens in exactly one
+thread at a time per executor (submitting thread or the worker), which
+matches the XLA CPU backend's dislike of concurrent collective
+launches (tests/test_obs_concurrency.py).
+
+Counters: ``engine.exec.submitted`` / ``.batches`` /
+``.batched_requests`` / ``.inline`` / ``.backpressure`` /
+``.queue_ns``; each dispatch records an ``engine.batch`` span with the
+plan id and batch width.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs as _obs
+
+
+class _Request:
+    __slots__ = ("A", "x", "future", "t_ns")
+
+    def __init__(self, A, x):
+        self.A = A
+        self.x = x
+        self.future: Future = Future()
+        self.t_ns = time.perf_counter_ns()
+
+
+class RequestExecutor:
+    def __init__(self, engine, max_batch: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 timeout_ms: Optional[float] = None):
+        from ..settings import settings
+
+        self._engine = engine
+        self.max_batch = max(int(
+            max_batch if max_batch is not None
+            else settings.engine_max_batch), 1)
+        self.queue_depth = max(int(
+            queue_depth if queue_depth is not None
+            else settings.engine_queue_depth), 1)
+        self.timeout_ms = float(
+            timeout_ms if timeout_ms is not None
+            else settings.engine_batch_timeout_ms)
+        self._cv = threading.Condition()
+        # Group token -> ordered requests.  Token is the matrix
+        # identity: one group = one stacked dispatch against one pack.
+        self._groups: Dict[int, List[_Request]] = {}
+        self._anchors: Dict[int, object] = {}   # token -> A (strong ref)
+        self._pending = 0
+        self._worker: Optional[threading.Thread] = None
+        self._shutdown = False
+        # Serializes _dispatch bodies: a max-batch dispatch in a
+        # submitting thread must not overlap the worker's timeout
+        # dispatch — concurrent device launches are the pattern that
+        # deadlocks the XLA CPU backend for collectives
+        # (tests/test_obs_concurrency.py), and collective-backed plans
+        # will eventually route through here.
+        self._dispatch_lock = threading.Lock()
+
+    # ---------------- public API ----------------
+
+    def submit(self, A, x) -> Future:
+        """Enqueue one SpMV request; resolve via the returned Future."""
+        _obs.inc("engine.exec.submitted")
+        import jax.numpy as jnp
+
+        # Normalize NOW: an array-less operand (list) would otherwise
+        # skip the dtype-promotion gate and batch-dependent casting
+        # could change its result dtype.  Also reject a wrong-shape
+        # request HERE: batched with others, its dispatch error would
+        # fail every future in the group.
+        x = jnp.asarray(x)
+        if x.shape != (A.shape[1],):
+            raise ValueError(
+                f"engine submit: operand shape {x.shape} does not "
+                f"match matrix {A.shape}"
+            )
+        if not self._engine._eligible(A, x.dtype):
+            # Serve through the normal dispatch, same Future contract.
+            _obs.inc("engine.exec.inline")
+            req = _Request(A, x)
+            self._resolve_inline(req)
+            return req.future
+        req = _Request(A, x)
+        to_dispatch: List[Tuple[object, List[_Request]]] = []
+        with self._cv:
+            if self._shutdown:
+                # Checked under the lock: a submit racing shutdown()
+                # must either land before the final flush or raise —
+                # never enqueue into a drained queue (orphaned future).
+                raise RuntimeError("executor is shut down")
+            if self._pending >= self.queue_depth:
+                # Bounded queue without a deadlockable wait: the
+                # submitter pays for the largest group inline.
+                _obs.inc("engine.exec.backpressure")
+                item = self._pop_largest_locked()
+                if item is not None:
+                    to_dispatch.append(item)
+            token = id(A)
+            group = self._groups.setdefault(token, [])
+            self._anchors[token] = A
+            group.append(req)
+            self._pending += 1
+            if len(group) >= self.max_batch:
+                self._groups.pop(token)
+                self._anchors.pop(token)
+                self._pending -= len(group)
+                to_dispatch.append((A, group))
+            elif self.timeout_ms > 0:
+                self._ensure_worker_locked()
+                self._cv.notify_all()
+        for item in to_dispatch:
+            self._dispatch(*item)
+        return req.future
+
+    def flush(self) -> None:
+        """Dispatch every pending group now, in the calling thread
+        (the deterministic drain used by tests and bench)."""
+        while True:
+            with self._cv:
+                item = self._pop_oldest_locked()
+            if item is None:
+                return
+            self._dispatch(*item)
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+            worker = self._worker
+        if worker is not None and wait:
+            worker.join(timeout=5)
+        self.flush()
+
+    def pending(self) -> int:
+        with self._cv:
+            return self._pending
+
+    # ---------------- internals ----------------
+
+    def _pop_largest_locked(self):
+        if not self._groups:
+            return None
+        token = max(self._groups, key=lambda t: len(self._groups[t]))
+        group = self._groups.pop(token)
+        A = self._anchors.pop(token)
+        self._pending -= len(group)
+        return A, group
+
+    def _pop_oldest_locked(self):
+        if not self._groups:
+            return None
+        token = min(self._groups,
+                    key=lambda t: self._groups[t][0].t_ns)
+        group = self._groups.pop(token)
+        A = self._anchors.pop(token)
+        self._pending -= len(group)
+        return A, group
+
+    def _pop_expired_locked(self, now_ns: int):
+        limit = self.timeout_ms * 1e6
+        ready = []
+        for token in [t for t, g in self._groups.items()
+                      if now_ns - g[0].t_ns >= limit]:
+            group = self._groups.pop(token)
+            ready.append((self._anchors.pop(token), group))
+            self._pending -= len(group)
+        return ready
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop,
+                name="legate-sparse-engine-executor", daemon=True)
+            self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._shutdown and not self._groups:
+                    self._cv.wait()
+                if self._shutdown:
+                    return
+                now = time.perf_counter_ns()
+                oldest = min(g[0].t_ns for g in self._groups.values())
+                wait_s = (oldest + self.timeout_ms * 1e6 - now) / 1e9
+                if wait_s > 0:
+                    self._cv.wait(wait_s)
+                    continue        # re-evaluate after sleep/notify
+                ready = self._pop_expired_locked(now)
+            for A, group in ready:
+                self._dispatch(A, group)
+
+    def _resolve_inline(self, req: _Request) -> None:
+        try:
+            req.future.set_result(req.A.dot(req.x))
+        except BaseException as e:   # noqa: BLE001 - future contract
+            req.future.set_exception(e)
+
+    def _dispatch(self, A, group: List[_Request]) -> None:
+        """One stacked dispatch for ``group`` (all against ``A``);
+        bodies serialize on ``_dispatch_lock`` (one dispatching thread
+        at a time per executor)."""
+        with self._dispatch_lock:
+            self._dispatch_locked(A, group)
+
+    def _dispatch_locked(self, A, group: List[_Request]) -> None:
+        import jax.numpy as jnp
+
+        k = len(group)
+        t_disp = time.perf_counter_ns()
+        queue_ns = sum(t_disp - r.t_ns for r in group)
+        _obs.inc("engine.exec.batches")
+        _obs.inc("engine.exec.batched_requests", k)
+        _obs.inc("engine.exec.queue_ns", queue_ns)
+        try:
+            with _obs.span("engine.batch", reqs=k, rows=A.shape[0],
+                           nnz=A.nnz) as sp:
+                # Eligibility was checked at submit (_checked=True):
+                # re-checking would rebuild structure caches per batch
+                # for nothing; mutation-in-flight is out of contract.
+                if k == 1:
+                    y = self._engine.matvec(A, group[0].x,
+                                            _checked=True)
+                    group[0].future.set_result(y)
+                    if sp is not None:
+                        sp.set(path="spmv")
+                    return
+                X = jnp.stack(
+                    [jnp.asarray(r.x).astype(A.dtype) for r in group],
+                    axis=1)
+                Y = self._engine.matmat(A, X, _checked=True)
+                if sp is not None:
+                    sp.set(path="spmm", k=k)
+                for i, r in enumerate(group):
+                    r.future.set_result(Y[:, i])
+        except Exception:
+            # Engine-side failure (e.g. a cached plan-build error):
+            # the 'engine on is always safe' contract holds for the
+            # executor too — serve each request through the normal
+            # dispatch; _resolve_inline delivers ITS error if even
+            # that fails.
+            _obs.inc("engine.exec.dispatch_fallback")
+            for r in group:
+                if not r.future.done():
+                    self._resolve_inline(r)
+        except BaseException as e:   # noqa: BLE001 - deliver, don't die
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(e)

@@ -145,15 +145,61 @@ class _CustomLinearOperator(LinearOperator):
 
 class _SparseMatrixLinearOperator(LinearOperator):
     """Wraps a csr_array; caches the conjugate transpose for rmatvec
-    (reference ``linalg.py:375-390``)."""
+    (reference ``linalg.py:375-390``).
+
+    Engine routing (``settings.engine``): construction — always a
+    concrete context — eagerly builds the engine's bucketed traceable
+    matvec for eligible matrices, so solver loops (cg/gmres/...) run
+    their in-trace matvecs through the same cached plan the eager
+    ``A @ x`` dispatch uses.  The closure slices back to ``n`` before
+    returning, so solver reductions — and results — are bit-for-bit
+    the unpadded kernel's (``docs/ENGINE.md``)."""
 
     def __init__(self, A: csr_array):
         self.A = A
         self.AT = None
+        self._engine_mv = None
+        from .settings import settings as _settings
+
+        if _settings.engine:
+            from . import obs as _obs
+            from .engine import get_engine
+
+            # Same "engine on is always safe" contract as
+            # route_matvec: a plan-build failure (including the
+            # cached-failure fast path) must not make a solve raise
+            # where the normal dispatch would succeed.
+            try:
+                self._engine_mv = get_engine().traceable_matvec(A)
+            except Exception as e:
+                _obs.inc("engine.route.error")
+                _obs.event("engine.route.error", op="solver_matvec",
+                           error=repr(e)[:200])
         super().__init__(A.dtype, A.shape)
 
     def _matvec(self, x, out=None):
+        if (self._engine_mv is not None
+                and isinstance(x, jax.core.Tracer)
+                and np.result_type(self.A.dtype, x.dtype)
+                == np.dtype(self.A.dtype)
+                and self._engine_fresh()):
+            # Inside a solver trace the AOT route declines; the
+            # traceable closure keeps the loop on the bucketed kernel.
+            # The dtype gate mirrors engine eligibility: a PROMOTED
+            # iterate (f64 rhs over an f32 matrix, complex over real —
+            # what _promote_rhs arranges) must not be downcast by the
+            # closure's astype; those solves keep the normal dispatch.
+            return _fill_out(self._engine_mv(x), out)
         return self.A.dot(x, out=out)
+
+    def _engine_fresh(self) -> bool:
+        """The construction-time closure captured padded COPIES of the
+        operands; an in-place mutation of ``A`` since then (which
+        clears ``A._engine_pack``) would make it a silent solve of the
+        OLD matrix — fall back to the live dispatch instead."""
+        cached = getattr(self.A, "_engine_pack", None)
+        return (cached is not None
+                and cached[1] is getattr(self._engine_mv, "pack", None))
 
     def _rmatvec(self, x, out=None):
         if self.AT is None:

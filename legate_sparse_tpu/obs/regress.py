@@ -256,7 +256,8 @@ TRAJECTORY_FIELDS = [
     "platform", "stream_gbs", "value", "spmv_ms",
     "cpu_roofline_ratio", "cg_ms_per_iter", "spgemm_ms",
     "gmg_cycle_ms", "pde_ms_per_iter", "pde_roofline_ratio",
-    "dist_spmv_comm_bytes", "comm_total_bytes", "bench_wall_s",
+    "dist_spmv_comm_bytes", "comm_total_bytes",
+    "engine_warm_ms", "engine_batched_ms_per_req", "bench_wall_s",
 ]
 
 

@@ -189,3 +189,61 @@ def summarize(records: Iterable[Dict[str, Any]],
               stream_gbs: Optional[float] = None) -> str:
     """One-shot: aggregate + render."""
     return render_table(aggregate(records), stream_gbs=stream_gbs)
+
+
+def render_plans_table(counters: Dict[str, Any]) -> str:
+    """Per-plan table from the ``engine.plan.*`` counters (embedded in
+    a Chrome-trace artifact or taken live from ``counters.snapshot``):
+    one row per compiled plan — builds (XLA compiles), cache hits,
+    executions — plus the aggregate hit/miss/eviction line and the
+    executor's batching totals.  ``tools/trace_summary.py --plans``."""
+    per_plan: Dict[str, Dict[str, float]] = {}
+    for name, val in counters.items():
+        if not name.startswith("engine.plan."):
+            continue
+        body = name[len("engine.plan."):]
+        if body in ("hits", "misses", "evictions", "build_ms"):
+            continue                      # aggregate counters
+        pid, _, kind = body.rpartition(".")
+        if kind not in ("hits", "builds", "execs") or not pid:
+            continue
+        per_plan.setdefault(
+            pid, {"hits": 0, "builds": 0, "execs": 0})[kind] += val
+    lines = []
+    if per_plan:
+        rows = [
+            [pid, str(int(r["builds"])), str(int(r["hits"])),
+             str(int(r["execs"]))]
+            for pid, r in sorted(per_plan.items(),
+                                 key=lambda kv: -kv[1]["execs"])
+        ]
+        lines.append(format_table(["plan", "builds", "hits", "execs"],
+                                  rows))
+    else:
+        lines.append("no engine.plan.* counters recorded "
+                     "(engine never dispatched?)")
+    hits = counters.get("engine.plan.hits", 0)
+    misses = counters.get("engine.plan.misses", 0)
+    if hits or misses:
+        total = hits + misses
+        lines.append(
+            f"plan cache: {int(hits)} hits / {int(misses)} misses "
+            f"({hits / total:.1%} hit rate), "
+            f"{counters.get('engine.plan.build_ms', 0):.0f} ms "
+            f"compiling, "
+            f"{int(counters.get('engine.plan.evictions', 0))} evictions"
+        )
+    subs = counters.get("engine.exec.submitted", 0)
+    if subs:
+        batches = counters.get("engine.exec.batches", 0)
+        breqs = counters.get("engine.exec.batched_requests", 0)
+        qns = counters.get("engine.exec.queue_ns", 0)
+        lines.append(
+            f"executor: {int(subs)} submitted, {int(batches)} batches "
+            f"({breqs / max(batches, 1):.1f} reqs/batch), "
+            f"queue latency {qns / max(breqs, 1) / 1e3:.0f} us/req, "
+            f"{int(counters.get('engine.exec.inline', 0))} inline, "
+            f"{int(counters.get('engine.exec.backpressure', 0))} "
+            f"backpressure"
+        )
+    return "\n".join(lines)

@@ -69,11 +69,31 @@ def csr_spmv_rowids_masked(data, indices, row_ids, valid_nnz, x, rows: int):
     """SpMV over a zero-padded nonzero suffix: slots >= ``valid_nnz``
     contribute an exact 0 (masked product, not 0*x — preserves IEEE
     semantics against non-finite x, same invariant as ``ell_spmv``)."""
+    _obs.inc("trace.csr_spmv_rowids_masked")
     nnz = data.shape[0]
     slot = jnp.arange(nnz, dtype=jnp.int32)
     prod = jnp.where(
         slot < valid_nnz, data * x[indices],
         jnp.zeros((1,), dtype=data.dtype),
+    )
+    return jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def csr_spmm_rowids_masked(data, indices, row_ids, valid_nnz, X, rows: int):
+    """SpMM over a zero-padded nonzero suffix (the engine's bucketed
+    batch kernel): slots >= ``valid_nnz`` contribute an exact 0 via a
+    masked product — identical IEEE semantics to
+    ``csr_spmv_rowids_masked`` column by column, so a stacked dispatch
+    of k requests is bit-for-bit the k individual dispatches."""
+    _obs.inc("trace.csr_spmm_rowids_masked")
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = jnp.where(
+        (slot < valid_nnz)[:, None], data[:, None] * X[indices, :],
+        jnp.zeros((1, 1), dtype=data.dtype),
     )
     return jax.ops.segment_sum(
         prod, row_ids, num_segments=rows, indices_are_sorted=True

@@ -28,6 +28,8 @@ from .dist_csr import (  # noqa: F401
     dist_bicgstab,
     dist_minres,
     dist_eigsh,
+    dist_plan_fingerprint,
+    mesh_fingerprint,
 )
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..engine import engine_enabled as _engine_enabled
+from ..engine import get_engine as _get_engine
 from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -644,6 +646,39 @@ def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
     return _device_put_sharded(x, NamedSharding(mesh, P(ROW_AXIS)))
 
 
+def mesh_fingerprint(mesh: Mesh) -> str:
+    """Stable identity of the physical device set behind a mesh:
+    axis names/shape plus every device's (platform, id).
+
+    The engine's plan-cache key term for distributed plans
+    (``docs/ENGINE.md``): a compiled collective program is only
+    reusable on the exact device topology it was lowered for, and two
+    meshes over the same devices in the same order ARE the same
+    topology even when the ``Mesh`` objects differ."""
+    import hashlib
+
+    devs = tuple(
+        (getattr(d, "platform", "?"), int(getattr(d, "id", -1)))
+        for d in mesh.devices.flat
+    )
+    desc = repr((tuple(mesh.axis_names), tuple(mesh.devices.shape),
+                 devs))
+    return hashlib.sha1(desc.encode()).hexdigest()[:16]
+
+
+def dist_plan_fingerprint(A: DistCSR) -> str:
+    """Mesh fingerprint + the layout terms the ``lru_cache``'d
+    shard_map builders key on (halo, ELL vs padded-CSR, precise
+    gather, rows-per-shard, banded prepack): two DistCSRs with equal
+    fingerprints reuse one compiled distributed program, and the
+    engine's ``dist_spmv`` plan entries record exactly that reuse."""
+    precise = A.gather_idx is not None
+    return (f"{mesh_fingerprint(A.mesh)}:h{A.halo}:e{int(A.ell)}"
+            f":p{int(precise)}:r{A.rows_per_shard}"
+            f":d{int(A.dia_data is not None)}"
+            f":t{A.pdia_tile}")
+
+
 def _extend_x(x_local, halo: int, axis: int = 0):
     """Halo exchange: ppermute boundary slices to/from ring neighbors
     along ``axis`` of the local block.
@@ -882,6 +917,13 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     halo = A.halo
     precise = A.gather_idx is not None
     _obs.inc("op.dist_spmv")
+    # Engine plan ledger (docs/ENGINE.md): with routing enabled, every
+    # production dist dispatch records against its plan identity (mesh
+    # fingerprint + layout + dtype + epoch) — the reuse evidence for
+    # the lru_cache'd shard_map programs below.  Disabled (default),
+    # this is one flag read.
+    if _engine_enabled():
+        _get_engine().record_dist_plan(A)
     # Comm ledger: the realization (and so the collective volume) is a
     # function of A's static fields alone — price it once per dispatch
     # and account it whatever kernel branch runs below.

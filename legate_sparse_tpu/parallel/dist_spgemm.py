@@ -37,6 +37,7 @@ Returns a padded-CSR ``DistCSR`` whose cols are global indices
 
 from __future__ import annotations
 
+import threading
 from functools import lru_cache, partial
 from typing import NamedTuple, Tuple
 
@@ -293,7 +294,9 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
     if R <= 2:
         return None         # rotation chain degenerates to all_gather
     key = _decline_key(A, la, lb)
-    if key in _WINDOW_DECLINED:
+    with _STATE_LOCK:
+        declined = key in _WINDOW_DECLINED
+    if declined:
         # This structure+density pair already proved too wide for a
         # window: skip the min/max image probe (a blocking
         # device->host round trip — ~1 s over the TPU tunnel) on every
@@ -339,16 +342,37 @@ def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
 
 
 _WINDOW_DECLINED: set = set()
+# Guards the module-level mutable state above (_WINDOW_DECLINED and
+# the LAST_B_* introspection globals): the engine's request executor
+# makes concurrent dist_spgemm callers a supported configuration, and
+# an unguarded size-check-then-clear/add on the set (or a torn
+# REALIZATION/PLAN pair) is a real race there.  Device launches still
+# serialize (tests/test_obs_concurrency.py: concurrent collective
+# launches deadlock the XLA CPU backend); this lock only covers the
+# host-side bookkeeping.
+_STATE_LOCK = threading.Lock()
 
 
 def _window_decline(key, la: _Layout, lb: _Layout) -> None:
-    if len(_WINDOW_DECLINED) > 256:     # unbounded-session safety valve
-        _WINDOW_DECLINED.clear()
-    _WINDOW_DECLINED.add(key)
+    with _STATE_LOCK:
+        if len(_WINDOW_DECLINED) > 256:  # unbounded-session safety valve
+            _WINDOW_DECLINED.clear()
+        _WINDOW_DECLINED.add(key)
     _obs.inc("dist_spgemm.window_decline")
     _obs.event("dist_spgemm.window_decline",
                a_shape=la.shape, b_shape=lb.shape,
                shards=la.num_shards, density_bucket=key[2])
+
+
+def last_b_realization() -> tuple:
+    """Consistent snapshot of the legacy introspection pair
+    ``(LAST_B_REALIZATION, LAST_B_PLAN)`` — both read under the state
+    lock, so a concurrent ``dist_spgemm`` can never tear the pair
+    (realization from one call, plan from another).  The SUPPORTED
+    mechanism remains the obs span attrs; this accessor exists for the
+    scripts that still read the globals."""
+    with _STATE_LOCK:
+        return LAST_B_REALIZATION, LAST_B_PLAN
 
 
 def reset_window_declines() -> None:
@@ -357,7 +381,8 @@ def reset_window_declines() -> None:
     wide-window matrix only pins comparably-dense same-layout matrices
     — but a long-lived process retiring whole matrix families can
     still call this to force re-probing of the min/max column image."""
-    _WINDOW_DECLINED.clear()
+    with _STATE_LOCK:
+        _WINDOW_DECLINED.clear()
 
 
 def _b_window_flat(B: _Layout, plan, first_local, data, cols, counts,
@@ -751,14 +776,21 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     if win is not None:
         first_blks, plan = win
         first_dev = (_put_blocks(jnp.asarray(first_blks), mesh),)
-        LAST_B_REALIZATION = "window"
-        LAST_B_PLAN = (tuple(int(f) for f in first_blks), *plan)
+        realization = "window"
+        b_plan = (tuple(int(f) for f in first_blks), *plan)
     else:
         plan = None
         first_dev = ()
-        LAST_B_REALIZATION = "all_gather"
-        LAST_B_PLAN = ()
-    _obs.inc("dist_spgemm.realization." + LAST_B_REALIZATION)
+        realization = "all_gather"
+        b_plan = ()
+    with _STATE_LOCK:
+        # Written as a pair under the lock.  Concurrent readers who
+        # need the pair to be mutually consistent must read through
+        # ``last_b_realization()`` (which takes the same lock); bare
+        # reads of either global alone stay safe (single attribute).
+        LAST_B_REALIZATION = realization
+        LAST_B_PLAN = b_plan
+    _obs.inc("dist_spgemm.realization." + realization)
     # Evidence for the realization choice: predicted interconnect
     # bytes of BOTH candidates from the static shard shapes, the
     # chosen one entering the comm ledger.  (The window prediction
@@ -773,15 +805,15 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
         comm_bytes = _comm.record("dist_spgemm", ag_vols, ag_calls)
         comm_calls = sum(ag_calls.values())
     _obs.event(
-        "dist_spgemm.realization", choice=LAST_B_REALIZATION,
+        "dist_spgemm.realization", choice=realization,
         shards=R, predicted_bytes=comm_bytes,
         predicted_all_gather_bytes=_comm.total(ag_vols),
         predicted_window_bytes=(_comm.total(win_vols)
                                 if win_vols is not None else None),
     )
     with _obs.span("dist_spgemm", shards=R, m=m, n=n_cols,
-                   b_realization=LAST_B_REALIZATION,
-                   b_plan=LAST_B_PLAN, comm_bytes=comm_bytes,
+                   b_realization=realization,
+                   b_plan=b_plan, comm_bytes=comm_bytes,
                    comm_calls=comm_calls) as sp:
         return _dist_spgemm_phases(
             A, B, mesh, la, lb, plan, a_arrays, b_arrays, first_dev,

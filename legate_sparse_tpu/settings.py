@@ -54,9 +54,65 @@ Settings
     within [0, cols), indptr monotone and consistent) at array
     construction, and turns on ``jax_debug_nans`` so the first NaN
     produced by any kernel raises with a traceback.
+
+``engine`` (``LEGATE_SPARSE_TPU_ENGINE``)
+    Execution engine (``legate_sparse_tpu.engine``): shape-bucketed
+    plan cache + micro-batching request executor.  Off by default —
+    with it on, eligible matvec/solve hot paths run through cached
+    executables whose shapes are padded to policy buckets, so nearby
+    ``n``/``nnz`` hit one compiled program instead of retracing.
+    Knobs (all env-overridable, see ``docs/ENGINE.md``):
+
+    - ``engine_bucket_ladder`` (``LEGATE_SPARSE_TPU_ENGINE_BUCKETS``):
+      comma-separated ascending sizes; empty = power-of-two buckets.
+    - ``engine_min_bucket`` (``..._ENGINE_MIN_BUCKET``): floor bucket,
+      bounds tiny-matrix plan proliferation.
+    - ``engine_plan_cache_size`` (``..._ENGINE_PLANS``): LRU capacity.
+    - ``engine_max_batch`` / ``engine_queue_depth`` /
+      ``engine_batch_timeout_ms`` (``..._ENGINE_BATCH`` / ``..._QUEUE``
+      / ``..._BATCH_TIMEOUT_MS``): executor micro-batching limits and
+      backpressure bound.
+    - ``engine_persist_dir`` (``..._ENGINE_PERSIST``): when set, plans
+      additionally back onto JAX's persistent compilation cache there
+      (process-global: it captures every XLA compile, not only engine
+      plans — scope caveat in ``docs/ENGINE.md``).
+
+Settings epoch
+--------------
+``settings.epoch`` is a monotone counter bumped by every post-import
+VALUE CHANGE of a lowering-relevant setting.  Compiled-plan caches
+(``engine.plan_cache``) key on it, so flipping a setting that could
+change lowering (kernel budgets, variants) naturally invalidates
+cached executables instead of serving stale programs.  ``obs`` and
+``engine`` are exempt (they gate tracing/routing, never lowering), so
+turning observability on to watch a warmed server does not void the
+``warmup()`` guarantee.
 """
 
 import os
+
+
+def _parse_ladder(spec: str) -> tuple:
+    """Parse a user bucket ladder ("1024,4096,65536") into an ascending
+    int tuple; empty spec = () = power-of-two policy.  A malformed
+    ladder must fail loudly at import, not silently bucket wrong."""
+    spec = spec.strip()
+    if not spec:
+        return ()
+    try:
+        rungs = tuple(sorted({int(tok) for tok in spec.split(",")
+                              if tok.strip()}))
+    except ValueError:
+        raise ValueError(
+            f"LEGATE_SPARSE_TPU_ENGINE_BUCKETS={spec!r}: expected "
+            f"comma-separated integers"
+        ) from None
+    if rungs and rungs[0] <= 0:
+        raise ValueError(
+            f"LEGATE_SPARSE_TPU_ENGINE_BUCKETS={spec!r}: rungs must "
+            f"be positive"
+        )
+    return rungs
 
 
 def _env_bool(name: str, default: bool) -> bool:
@@ -164,6 +220,62 @@ class Settings:
                 f"{self.dia_xla_variant!r}: expected one of "
                 f"'fused', 'nopad', 'auto'"
             )
+        # ---- execution engine (legate_sparse_tpu.engine) ----
+        self.engine: bool = _env_bool("LEGATE_SPARSE_TPU_ENGINE", False)
+        self.engine_bucket_ladder: tuple = _parse_ladder(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_BUCKETS", "")
+        )
+        self.engine_min_bucket: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_MIN_BUCKET", "64")
+        )
+        self.engine_plan_cache_size: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_PLANS", "128")
+        )
+        self.engine_max_batch: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_BATCH", "8")
+        )
+        self.engine_queue_depth: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_QUEUE", "64")
+        )
+        self.engine_batch_timeout_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_ENGINE_BATCH_TIMEOUT_MS",
+                           "2.0")
+        )
+        self.engine_persist_dir: str = os.environ.get(
+            "LEGATE_SPARSE_TPU_ENGINE_PERSIST", ""
+        )
+        # Settings epoch: compiled-plan cache keys include it, so any
+        # later settings mutation (see __setattr__) invalidates plans.
+        self._epoch: int = 0
+        self._init_done: bool = True
+
+    # Settings that cannot change what a plan lowers to: mutating them
+    # must NOT void warmup() guarantees (flipping ``obs`` on to watch
+    # steady state would otherwise trigger the very compile storm one
+    # is trying to measure; ``engine`` only gates routing; the
+    # executor/cache knobs shape queueing and capacity, never the
+    # compiled program — the bucket policy knobs are NOT exempt, they
+    # legitimately change plan keys).
+    _EPOCH_EXEMPT = frozenset({
+        "obs", "engine", "engine_max_batch", "engine_queue_depth",
+        "engine_batch_timeout_ms", "engine_plan_cache_size",
+        "engine_persist_dir", "_epoch", "_init_done",
+    })
+
+    def __setattr__(self, name: str, value) -> None:
+        # A post-init VALUE CHANGE of a lowering-relevant setting
+        # bumps the epoch (a changed budget/variant can change what a
+        # plan would lower to); no-op rewrites and exempt flags don't.
+        d = self.__dict__
+        if (d.get("_init_done") and name not in self._EPOCH_EXEMPT
+                and (name not in d or d[name] != value)):
+            d["_epoch"] = d.get("_epoch", 0) + 1
+        super().__setattr__(name, value)
+
+    @property
+    def epoch(self) -> int:
+        """Monotone settings-mutation counter (plan-cache key term)."""
+        return self._epoch
 
     @property
     def obs(self) -> bool:

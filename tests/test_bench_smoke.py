@@ -21,8 +21,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 
 # Deterministic fields only: timings vary per machine, but the static
-# comm predictions, the mesh width and the schema do not.
-GOLDEN_FIELDS = "*_comm_bytes,dist_shards,schema_version"
+# comm predictions, the mesh width, the schema — and the engine
+# phase's plan-cache hit/miss counts (a fixed call sequence against a
+# fresh engine) — do not.
+GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
+                 "engine_plan_hits,engine_plan_misses,"
+                 "engine_batch_requests")
 
 
 def _tool(name):
@@ -118,3 +122,38 @@ def test_trace_summary_comm_table_renders(smoke_run, capsys):
     assert rc == 0, out
     assert "comm ledger:" in out
     assert "dist_spmv" in out and "ppermute" in out
+
+
+def test_smoke_engine_phase_numbers(smoke_run):
+    """ISSUE 4 acceptance: cold/warm/batched engine numbers recorded,
+    warm >= 2x faster than cold on the CPU lane (cold carries the plan
+    compile; warm is the cached-executable hit path), and the
+    deterministic plan-cache ledger for the fixed phase sequence:
+    1 spmv miss (cold) + 1 spmm miss (stacked batch), 6 hits (1 pack
+    warm + 5 timed)."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 8
+    assert result["engine_cold_ms"] > 0
+    assert result["engine_warm_ms"] > 0
+    assert result["engine_cold_ms"] >= 2 * result["engine_warm_ms"], (
+        result["engine_cold_ms"], result["engine_warm_ms"])
+    assert result["engine_batched_ms_per_req"] > 0
+    assert result["engine_batch_requests"] == 8
+    assert result["engine_plan_misses"] == 2
+    assert result["engine_plan_hits"] == 6
+
+
+def test_smoke_trace_has_engine_plans(smoke_run, capsys):
+    """The trace artifact carries the engine.plan.* counters and
+    ``trace_summary --plans`` renders the per-plan table from them."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("engine.plan.misses", 0) >= 2
+    assert any(k.startswith("engine.plan.spmv/") for k in ctrs), [
+        k for k in ctrs if k.startswith("engine.")]
+    rc = _tool("trace_summary").main([str(trace_path), "--plans"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "engine plans:" in out
+    assert "plan cache:" in out and "spmv/float32" in out

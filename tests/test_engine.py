@@ -1,0 +1,661 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Execution engine (docs/ENGINE.md): bucketing, plan cache,
+executor, routing.
+
+The two load-bearing contracts:
+
+- **bit-for-bit**: a bucketed (padded, masked-tail) dispatch must
+  equal the unpadded ``csr_spmv_rowids``/``csr_spmm_rowids`` kernels
+  exactly — fuzzed here on f32/f64/c64 including bucket boundaries
+  and non-finite operands (the ISSUE 4 differential-fuzz satellite);
+- **zero retraces on a plan hit**: a second same-bucket different-``n``
+  workload must record no kernel compile (the ``trace.*`` counters
+  ARE the compile count — obs counter contract) and no plan miss.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+import legate_sparse_tpu.linalg as linalg
+from legate_sparse_tpu import obs
+from legate_sparse_tpu.engine import (
+    Engine, RequestExecutor, bucket, k_bucket, next_pow2,
+)
+from legate_sparse_tpu.ops import spmv as spmv_ops
+from legate_sparse_tpu.settings import settings
+
+
+@pytest.fixture
+def eng_settings():
+    """Snapshot/restore every setting the tests flip."""
+    saved = (settings.engine, settings.ell_max_expand,
+             settings.dia_max_expand, settings.engine_bucket_ladder,
+             settings.engine_min_bucket)
+    yield settings
+    (settings.engine, settings.ell_max_expand,
+     settings.dia_max_expand, settings.engine_bucket_ladder,
+     settings.engine_min_bucket) = saved
+
+
+def _random_csr(n, density=0.02, dtype=np.float32, seed=0):
+    """Random CSR + the same structure as a scipy reference.  Random
+    columns defeat band detection, so the matrix is engine-eligible."""
+    rng = np.random.default_rng(seed)
+    A_sp = sp.random(n, n, density=density, format="csr",
+                     random_state=rng, dtype=np.float64)
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        A_sp = (A_sp + 1j * sp.random(
+            n, n, density=density, format="csr",
+            random_state=np.random.default_rng(seed + 1),
+            dtype=np.float64)).tocsr()
+    A_sp = A_sp.astype(dtype)
+    return lst.csr_array(A_sp), A_sp
+
+
+def _x(n, dtype, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(n)
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        x = x + 1j * rng.standard_normal(n)
+    return jnp.asarray(x.astype(dtype))
+
+
+def _ref_spmv(A, x):
+    return spmv_ops.csr_spmv_rowids(
+        A.data, A.indices, A._get_row_ids(), x, A.shape[0])
+
+
+def _bitident(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_bucket_policy():
+    assert next_pow2(1) == 1 and next_pow2(5) == 8
+    assert bucket(1000, ladder=(), minimum=64) == 1024
+    assert bucket(1024, ladder=(), minimum=64) == 1024   # exact
+    assert bucket(3, ladder=(), minimum=64) == 64        # floor
+    # Ladder: smallest holding rung; above the top -> pow2.
+    assert bucket(900, ladder=(1000, 5000), minimum=1) == 1000
+    assert bucket(1000, ladder=(1000, 5000), minimum=1) == 1000
+    assert bucket(4000, ladder=(1000, 5000), minimum=1) == 5000
+    assert bucket(6000, ladder=(1000, 5000), minimum=1) == 8192
+    assert k_bucket(3) == 4 and k_bucket(1) == 1
+
+
+def test_ladder_setting_applies(eng_settings):
+    settings.engine_bucket_ladder = (500, 2000)
+    settings.engine_min_bucket = 1
+    assert bucket(400) == 500
+    assert bucket(1999) == 2000
+
+
+# ---------------------------------------------------- bucketed correctness
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex64])
+def test_bucketed_spmv_bitident_fuzz(dtype):
+    """Differential fuzz (ISSUE 4 satellite): bucketed SpMV == unpadded
+    kernel bit-for-bit, across sizes including the bucket boundary
+    (n == rows_b: row padding zero, nnz tail still masked)."""
+    eng = Engine()
+    for n, seed in [(100, 0), (256, 1), (300, 2), (511, 3)]:
+        A, _ = _random_csr(n, dtype=dtype, seed=seed)
+        x = _x(n, dtype, seed=seed + 10)
+        y = eng.matvec(A, x)
+        assert y is not None and y.shape == (n,)
+        assert _bitident(y, _ref_spmv(A, x)), (dtype, n)
+
+
+def test_bucketed_spmv_boundary_exact_nnz():
+    """Both shape terms exactly at their buckets (n = 256 = rows_b,
+    nnz = 4096 = nnz_b): zero padding anywhere — the masked kernel
+    must still match bit-for-bit."""
+    n, per_row = 256, 16            # nnz = 4096, a power of two
+    rng = np.random.default_rng(5)
+    indptr = np.arange(n + 1, dtype=np.int64) * per_row
+    indices = rng.integers(0, n, size=n * per_row).astype(np.int32)
+    row_ids = np.repeat(np.arange(n), per_row)
+    order = np.lexsort((indices, row_ids))
+    data = rng.standard_normal(n * per_row).astype(np.float32)
+    A = lst.csr_array((data, indices[order], indptr), shape=(n, n))
+    assert A.nnz == 4096
+    x = _x(n, np.float32)
+    y = Engine().matvec(A, x)
+    assert y is not None
+    assert _bitident(y, _ref_spmv(A, x))
+
+
+def test_bucketed_spmv_nonfinite_x_masked_tail():
+    """Padded slots must contribute an EXACT zero even against inf/nan
+    x entries (masked product, not 0*x)."""
+    n = 200
+    A, _ = _random_csr(n, seed=4)
+    x = np.array(np.asarray(_x(n, np.float32)))
+    x[7] = np.inf
+    x[11] = np.nan
+    x = jnp.asarray(x)
+    y = Engine().matvec(A, x)
+    assert y is not None
+    assert _bitident(y, _ref_spmv(A, x))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64])
+def test_bucketed_spmm_bitident(dtype):
+    n, k = 220, 3            # k buckets to 4: one padded column
+    A, _ = _random_csr(n, dtype=dtype, seed=6)
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((n, k))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        X = X + 1j * rng.standard_normal((n, k))
+    X = jnp.asarray(X.astype(dtype))
+    Y = Engine().matmat(A, X)
+    assert Y is not None and Y.shape == (n, k)
+    Y_ref = spmv_ops.csr_spmm_rowids(
+        A.data, A.indices, A._get_row_ids(), X, n)
+    assert _bitident(Y, Y_ref)
+
+
+def test_bucketed_solve_bitident(eng_settings):
+    """cg routed through the engine's traceable matvec must produce
+    bit-for-bit the iterates of the plain csr-rowids path: the closure
+    slices back to n before every reduction."""
+    settings.ell_max_expand = 0.0   # force the csr-rowids base path
+    settings.dia_max_expand = 0.0
+    n = 300
+    A_sp = sp.random(n, n, density=0.02, format="csr",
+                     random_state=np.random.default_rng(8),
+                     dtype=np.float32)
+    A_spd = (A_sp + A_sp.T + sp.eye(n, dtype=np.float32) * 10).tocsr()
+    b = np.ones(n, np.float32)
+    settings.engine = True
+    x_eng, it_eng = linalg.cg(lst.csr_array(A_spd), b, maxiter=40)
+    settings.engine = False
+    x_ref, it_ref = linalg.cg(lst.csr_array(A_spd), b, maxiter=40)
+    assert int(it_eng) == int(it_ref)
+    assert _bitident(x_eng, x_ref)
+
+
+# -------------------------------------------------------------- plan cache
+
+
+def test_plan_hit_zero_retrace():
+    """ISSUE 4 acceptance: the second call of a same-bucket
+    different-n workload records NO kernel compile (trace.* counters
+    unchanged) and no plan miss."""
+    eng = Engine()
+    A1, _ = _random_csr(1000, seed=11)
+    A2, _ = _random_csr(1010, seed=12)
+    x1, x2 = _x(1000, np.float32), _x(1010, np.float32)
+    y1 = eng.matvec(A1, x1)
+    assert y1 is not None
+    trace0 = obs.counters.snapshot("trace.")
+    miss0 = obs.counters.get("engine.plan.misses")
+    hit0 = obs.counters.get("engine.plan.hits")
+    y2 = eng.matvec(A2, x2)
+    assert y2 is not None
+    trace1 = obs.counters.snapshot("trace.")
+    assert trace1 == trace0, "plan hit must not retrace any kernel"
+    assert obs.counters.get("engine.plan.misses") == miss0
+    assert obs.counters.get("engine.plan.hits") == hit0 + 1
+    assert _bitident(y2, _ref_spmv(A2, x2))
+
+
+def test_warmup_prevents_cold_miss():
+    eng = Engine()
+    A, _ = _random_csr(700, seed=13)
+    ids = eng.warmup([{"op": "spmv", "dtype": "float32",
+                       "rows": 700, "nnz": A.nnz}])
+    assert len(ids) == 1
+    miss0 = obs.counters.get("engine.plan.misses")
+    y = eng.matvec(A, _x(700, np.float32))
+    assert y is not None
+    assert obs.counters.get("engine.plan.misses") == miss0
+
+
+def test_settings_epoch_invalidates(eng_settings):
+    eng = Engine()
+    A, _ = _random_csr(90, seed=14)
+    x = _x(90, np.float32)
+    assert eng.matvec(A, x) is not None
+    miss0 = obs.counters.get("engine.plan.misses")
+    ep0 = settings.epoch
+    # No-op rewrites and non-lowering flags must NOT invalidate...
+    settings.ell_max_expand = settings.ell_max_expand
+    settings.obs = settings.obs
+    assert settings.epoch == ep0
+    assert eng.matvec(A, x) is not None
+    assert obs.counters.get("engine.plan.misses") == miss0
+    # ...a real value change of a lowering-relevant setting must.
+    settings.ell_max_expand = settings.ell_max_expand + 1.0
+    assert settings.epoch == ep0 + 1
+    assert eng.matvec(A, x) is not None
+    assert obs.counters.get("engine.plan.misses") == miss0 + 1
+
+
+def test_plan_lru_eviction():
+    eng = Engine(plan_capacity=1)
+    A1, _ = _random_csr(80, seed=15)
+    A2, _ = _random_csr(600, seed=16)   # different bucket
+    ev0 = obs.counters.get("engine.plan.evictions")
+    assert eng.matvec(A1, _x(80, np.float32)) is not None
+    assert eng.matvec(A2, _x(600, np.float32)) is not None
+    assert obs.counters.get("engine.plan.evictions") == ev0 + 1
+
+
+def test_pack_invalidation_on_data_mutation():
+    eng = Engine()
+    A, A_sp = _random_csr(150, seed=17)
+    x = _x(150, np.float32)
+    y1 = eng.matvec(A, x)
+    A.data = jnp.asarray(A.data) * 2.0      # setter invalidates caches
+    y2 = eng.matvec(A, x)
+    assert _bitident(y2, _ref_spmv(A, x))
+    assert np.allclose(np.asarray(y2), 2 * np.asarray(y1),
+                       rtol=1e-6, atol=1e-6)
+
+
+def test_engine_declines_banded_and_tracers():
+    eng = Engine()
+    n = 256
+    A_band = lst.csr_array(sp.diags(
+        [np.ones(n - 1), np.full(n, 2.0), np.ones(n - 1)],
+        [-1, 0, 1], format="csr", dtype=np.float32))
+    assert A_band._get_dia() is not None
+    assert eng.matvec(A_band, _x(n, np.float32)) is None
+    A, _ = _random_csr(100, seed=18)
+
+    # Inside an ambient trace the eager route declines (falls back).
+    @jax.jit
+    def traced(x):
+        return eng.matvec(A, x)
+
+    assert traced(_x(100, np.float32)) is None
+
+
+def test_matvec_shape_validation():
+    eng = Engine()
+    A, _ = _random_csr(64, seed=19)
+    with pytest.raises(ValueError):
+        eng.matvec(A, _x(65, np.float32))
+    with pytest.raises(ValueError):
+        eng.matmat(A, jnp.ones((63, 2), jnp.float32))
+
+
+# ---------------------------------------------------------------- executor
+
+
+def test_executor_batched_bitident_and_counters():
+    eng = Engine()
+    A, _ = _random_csr(400, seed=20)
+    ex = RequestExecutor(eng, max_batch=4, queue_depth=32, timeout_ms=0)
+    xs = [_x(400, np.float32, seed=30 + i) for i in range(6)]
+    b0 = obs.counters.get("engine.exec.batches")
+    futs = [ex.submit(A, x) for x in xs]
+    ex.flush()                      # 4 dispatched at max_batch, +2 here
+    for f, x in zip(futs, xs):
+        assert _bitident(f.result(timeout=30), _ref_spmv(A, x))
+    assert obs.counters.get("engine.exec.batches") == b0 + 2
+    ex.shutdown()
+
+
+def test_executor_timeout_worker():
+    eng = Engine()
+    A, _ = _random_csr(120, seed=21)
+    ex = RequestExecutor(eng, max_batch=64, queue_depth=128,
+                         timeout_ms=5)
+    futs = [ex.submit(A, _x(120, np.float32, seed=40 + i))
+            for i in range(3)]
+    for f in futs:                  # worker must flush on timeout
+        assert f.result(timeout=30).shape == (120,)
+    ex.shutdown()
+
+
+def test_executor_backpressure_inline_dispatch():
+    eng = Engine()
+    A, _ = _random_csr(130, seed=22)
+    ex = RequestExecutor(eng, max_batch=64, queue_depth=2, timeout_ms=0)
+    bp0 = obs.counters.get("engine.exec.backpressure")
+    futs = [ex.submit(A, _x(130, np.float32, seed=50 + i))
+            for i in range(4)]
+    assert obs.counters.get("engine.exec.backpressure") >= bp0 + 1
+    ex.flush()
+    for f in futs:
+        assert f.result(timeout=30).shape == (130,)
+    ex.shutdown()
+
+
+def test_solver_route_not_stale_after_mutation(eng_settings):
+    """An operator wrapped BEFORE an in-place matrix mutation must not
+    solve the old matrix: the construction-time engine closure
+    captured padded copies, so the freshness check has to fall back to
+    the live dispatch."""
+    settings.ell_max_expand = 0.0
+    settings.dia_max_expand = 0.0
+    n = 220
+    A_sp = sp.random(n, n, density=0.02, format="csr",
+                     random_state=np.random.default_rng(30),
+                     dtype=np.float32)
+    A_spd = (A_sp + A_sp.T + sp.eye(n, dtype=np.float32) * 9).tocsr()
+    b = np.ones(n, np.float32)
+    settings.engine = True
+    A_lst = lst.csr_array(A_spd)
+    op = linalg.make_linear_operator(A_lst)   # engine closure built NOW
+    A_lst.data = jnp.asarray(A_lst.data) * 1.5     # in-place mutation
+    x_eng, it_eng = linalg.cg(op, b, maxiter=60)
+    settings.engine = False
+    A_ref = lst.csr_array(A_spd)
+    A_ref.data = jnp.asarray(A_ref.data) * 1.5
+    x_ref, it_ref = linalg.cg(A_ref, b, maxiter=60)
+    assert int(it_eng) == int(it_ref)
+    assert _bitident(x_eng, x_ref)
+
+
+def test_promoted_rhs_solve_not_downcast(eng_settings):
+    """f64 rhs over an f32 matrix: _promote_rhs runs the solve in f64,
+    and the engine's solver route must NOT downcast the iterates back
+    to f32 — the promoted solve takes the normal dispatch and matches
+    the engine-off result bit-for-bit."""
+    n = 200
+    A_sp = sp.random(n, n, density=0.02, format="csr",
+                     random_state=np.random.default_rng(29),
+                     dtype=np.float32)
+    A_spd = (A_sp + A_sp.T + sp.eye(n, dtype=np.float32) * 8).tocsr()
+    b = np.ones(n, np.float64)
+    settings.engine = True
+    x_eng, it_eng = linalg.cg(lst.csr_array(A_spd), b, maxiter=60)
+    settings.engine = False
+    x_ref, it_ref = linalg.cg(lst.csr_array(A_spd), b, maxiter=60)
+    assert x_eng.dtype == np.float64
+    assert int(it_eng) == int(it_ref)
+    assert _bitident(x_eng, x_ref)
+
+
+def test_executor_rejects_bad_shape_and_shutdown_submits():
+    """A wrong-length request raises at submit() — it must not poison
+    the futures batched with it — and a submit after shutdown raises
+    instead of enqueueing into a drained queue."""
+    eng = Engine()
+    A, _ = _random_csr(110, seed=27)
+    ex = RequestExecutor(eng, max_batch=4, queue_depth=8, timeout_ms=0)
+    good = ex.submit(A, _x(110, np.float32))
+    with pytest.raises(ValueError):
+        ex.submit(A, _x(111, np.float32))
+    with pytest.raises(ValueError):
+        ex.submit(A, [1.0] * 111)       # array-less operands too
+    ex.flush()
+    assert good.result(timeout=30).shape == (110,)
+    ex.shutdown()
+    with pytest.raises(RuntimeError):
+        ex.submit(A, _x(110, np.float32))
+
+
+def test_route_falls_back_on_engine_error(eng_settings, monkeypatch):
+    """'settings.engine = True is always safe': a plan build/dispatch
+    failure inside routing must fall back to the normal dispatch, not
+    surface through A @ x."""
+    from legate_sparse_tpu.engine import core as engine_core
+
+    settings.engine = True
+    A, _ = _random_csr(140, seed=28)
+    x = _x(140, np.float32)
+
+    def boom(self, A, x, _checked=False):
+        raise RuntimeError("synthetic plan build failure")
+
+    monkeypatch.setattr(engine_core.Engine, "matvec", boom)
+    e0 = obs.counters.get("engine.route.error")
+    y = A @ x
+    assert obs.counters.get("engine.route.error") == e0 + 1
+    # The fallback runs the NORMAL dispatch (which may pick ELL —
+    # different reduction order than the csr-rowids referee).
+    settings.engine = False
+    assert _bitident(y, A @ x)
+
+
+def test_solver_falls_back_on_engine_error(eng_settings, monkeypatch):
+    """The constructor route has the same safety contract: a plan
+    build failure (e.g. PlanBuildError off the negative cache) while
+    building the solver's traceable matvec must fall back to the
+    normal dispatch, not raise out of cg/gmres construction."""
+    from legate_sparse_tpu.engine import core as engine_core
+    from legate_sparse_tpu.engine.plan_cache import PlanBuildError
+
+    settings.engine = True
+    A_sp = sp.diags([np.full(120, 4.0), np.ones(119), np.ones(119)],
+                    [0, -1, 1], format="csr", dtype=np.float64)
+    rng = np.random.default_rng(3)
+    A_sp = A_sp + sp.random(120, 120, density=0.02, format="csr",
+                            random_state=rng, dtype=np.float64)
+    A_sp = (A_sp + A_sp.T).tocsr()
+    A = lst.csr_array(A_sp)
+    b = _x(120, np.float64)
+
+    def boom(self, A):
+        raise PlanBuildError("synthetic cached failure")
+
+    monkeypatch.setattr(engine_core.Engine, "traceable_matvec", boom)
+    e0 = obs.counters.get("engine.route.error")
+    x, _iters = linalg.cg(A, b, rtol=1e-8, maxiter=300)
+    assert obs.counters.get("engine.route.error") == e0 + 1
+    assert np.allclose(np.asarray(A_sp @ np.asarray(x)),
+                       np.asarray(b), atol=1e-6)
+
+
+def test_executor_ineligible_inline():
+    """A banded (DIA-path) matrix submits fine — served inline through
+    the normal dispatch, same Future contract."""
+    eng = Engine()
+    n = 256
+    A_band = lst.csr_array(sp.diags(
+        [np.ones(n - 1), np.full(n, 2.0), np.ones(n - 1)],
+        [-1, 0, 1], format="csr", dtype=np.float32))
+    ex = RequestExecutor(eng, max_batch=4, queue_depth=8, timeout_ms=0)
+    in0 = obs.counters.get("engine.exec.inline")
+    x = _x(n, np.float32)
+    f = ex.submit(A_band, x)
+    assert obs.counters.get("engine.exec.inline") == in0 + 1
+    assert _bitident(f.result(timeout=30), A_band @ x)
+    ex.shutdown()
+
+
+def test_executor_thread_safety():
+    """Concurrent submitters against one executor: every future
+    resolves to the right answer (host-side queue concurrency; device
+    launches serialize in the dispatching thread)."""
+    import threading
+
+    eng = Engine()
+    A, _ = _random_csr(200, seed=23)
+    ex = RequestExecutor(eng, max_batch=8, queue_depth=64,
+                         timeout_ms=50)
+    xs = [_x(200, np.float32, seed=60 + i) for i in range(16)]
+    refs = [_ref_spmv(A, x) for x in xs]
+    futs = [None] * len(xs)
+
+    def submit(lo, hi):
+        for i in range(lo, hi):
+            futs[i] = ex.submit(A, xs[i])
+
+    threads = [threading.Thread(target=submit, args=(i * 4, i * 4 + 4))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ex.flush()
+    for f, ref in zip(futs, refs):
+        assert _bitident(f.result(timeout=30), ref)
+    ex.shutdown()
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_dot_routes_through_engine(eng_settings):
+    settings.engine = True
+    A, _ = _random_csr(350, seed=24)
+    x = _x(350, np.float32)
+    obs.enable()
+    try:
+        obs.reset()
+        y = A @ x
+        spans = [r for r in obs.records()
+                 if r.get("type") == "span" and r["name"] == "spmv"]
+        assert spans and spans[-1]["attrs"]["path"] == "engine"
+        Y = A @ jnp.stack([np.asarray(x)] * 2, axis=1)
+        spans = [r for r in obs.records()
+                 if r.get("type") == "span" and r["name"] == "spmm"]
+        assert spans and spans[-1]["attrs"]["path"] == "engine"
+    finally:
+        obs.disable()
+        obs.reset()
+    assert _bitident(y, _ref_spmv(A, x))
+    assert Y.shape == (350, 2)
+
+
+def test_engine_off_is_inert(eng_settings):
+    """settings.engine = False: dispatch never touches the engine."""
+    settings.engine = False
+    A, _ = _random_csr(360, seed=25)
+    m0 = obs.counters.get("engine.plan.misses")
+    h0 = obs.counters.get("engine.plan.hits")
+    _ = A @ _x(360, np.float32)
+    assert obs.counters.get("engine.plan.misses") == m0
+    assert obs.counters.get("engine.plan.hits") == h0
+
+
+# ------------------------------------------------------------- distributed
+
+
+def test_mesh_fingerprint_stable_and_dist_plan_reuse():
+    from legate_sparse_tpu.parallel import (
+        make_row_mesh, mesh_fingerprint, shard_csr,
+    )
+    from legate_sparse_tpu.parallel.dist_csr import shard_vector
+
+    mesh1 = make_row_mesh()
+    mesh2 = make_row_mesh()
+    assert mesh_fingerprint(mesh1) == mesh_fingerprint(mesh2)
+
+    n = 1 << 10
+    eng = Engine()
+
+    def banded(seed):
+        rng = np.random.default_rng(seed)
+        return lst.csr_array(sp.diags(
+            [rng.standard_normal(n - 1).astype(np.float32),
+             np.full(n, 4.0, np.float32),
+             rng.standard_normal(n - 1).astype(np.float32)],
+            [-1, 0, 1], format="csr", dtype=np.float32))
+
+    A1, A2 = banded(1), banded(2)
+    dA1 = shard_csr(A1, mesh=mesh1)
+    dA2 = shard_csr(A2, mesh=mesh2)
+    x = shard_vector(np.ones(n, np.float32), mesh1, dA1.rows_padded)
+    m0 = obs.counters.get("engine.plan.misses")
+    h0 = obs.counters.get("engine.plan.hits")
+    y1 = eng.dist_matvec(dA1, x)
+    assert obs.counters.get("engine.plan.misses") == m0 + 1
+    y2 = eng.dist_matvec(dA2, x)
+    # Same layout + same physical mesh -> ONE plan: the second matrix
+    # is a hit, proving the compiled distributed program is shared.
+    assert obs.counters.get("engine.plan.misses") == m0 + 1
+    assert obs.counters.get("engine.plan.hits") == h0 + 1
+    ref1 = np.asarray(A1 @ np.ones(n, np.float32))
+    assert np.allclose(np.asarray(y1)[:n], ref1, rtol=1e-5, atol=1e-5)
+    assert y2.shape == y1.shape
+
+
+def test_dist_spmv_feeds_plan_ledger_when_routed(eng_settings):
+    """The PRODUCTION dist path (solvers/bench call dist_spmv
+    directly) records into the process engine's plan ledger when
+    routing is enabled — the reuse evidence doesn't require calling
+    dist_matvec by hand."""
+    from legate_sparse_tpu.engine import get_engine, reset_engine
+    from legate_sparse_tpu.parallel import make_row_mesh, shard_csr
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmv, shard_vector,
+    )
+
+    n = 1 << 9
+    A = lst.csr_array(sp.diags(
+        [np.ones(n - 1, np.float32), np.full(n, 4.0, np.float32),
+         np.ones(n - 1, np.float32)],
+        [-1, 0, 1], format="csr", dtype=np.float32))
+    mesh = make_row_mesh()
+    dA = shard_csr(A, mesh=mesh)
+    x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+    settings.engine = True
+    reset_engine()
+    try:
+        _ = dist_spmv(dA, x)
+        _ = dist_spmv(dA, x)
+        stats = get_engine().stats()["plans"]
+        dist_plans = {k: v for k, v in stats.items()
+                      if k.startswith("dist_spmv/")}
+        assert dist_plans, stats
+        assert sum(p["execs"] for p in dist_plans.values()) == 2
+    finally:
+        reset_engine()
+
+
+def test_failed_plan_build_negative_cache(eng_settings, monkeypatch):
+    """A reproducible plan-build failure is cached: the second routed
+    dispatch fails FAST (no repeat compile attempt) and still falls
+    back to the normal dispatch."""
+    from legate_sparse_tpu.engine import core as engine_core
+    from legate_sparse_tpu.engine import plan_cache as pc
+
+    calls = {"n": 0}
+
+    def bad_builder(key):
+        calls["n"] += 1
+        raise RuntimeError("synthetic XLA failure")
+
+    monkeypatch.setitem(pc.BUILDERS, "spmv", bad_builder)
+    monkeypatch.setitem(pc.BUILDERS, "spmm", bad_builder)
+    settings.engine = True
+    engine_core.reset_engine()
+    try:
+        A, _ = _random_csr(160, seed=31)
+        x = _x(160, np.float32)
+        y1 = A @ x          # build fails -> fallback
+        y2 = A @ x          # cached failure -> fast fallback
+        assert calls["n"] == 1, "failed build must not re-run"
+        # The executor honors the same contract: a batch whose plan
+        # cannot build resolves every future via the normal dispatch.
+        from legate_sparse_tpu.engine import RequestExecutor
+
+        ex = RequestExecutor(engine_core.get_engine(), max_batch=2,
+                             queue_depth=8, timeout_ms=0)
+        f1, f2 = ex.submit(A, x), ex.submit(A, x)
+        ex.shutdown()
+        settings.engine = False
+        assert _bitident(y1, A @ x) and _bitident(y2, A @ x)
+        assert _bitident(f1.result(timeout=30), A @ x)
+        assert _bitident(f2.result(timeout=30), A @ x)
+    finally:
+        engine_core.reset_engine()
+
+
+# ------------------------------------------------------------------ report
+
+
+def test_plans_table_renders():
+    from legate_sparse_tpu.obs import report
+
+    eng = Engine()
+    A, _ = _random_csr(70, seed=26)
+    _ = eng.matvec(A, _x(70, np.float32))
+    table = report.render_plans_table(obs.counters.snapshot())
+    assert "spmv/float32" in table
+    assert "plan cache:" in table

@@ -16,6 +16,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --stream-gbs 819
     python tools/trace_summary.py run.trace.json --events --counters
     python tools/trace_summary.py run.trace.json --comm
+    python tools/trace_summary.py run.trace.json --plans
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
 file's bench metadata when present (bench.py embeds its result blob).
@@ -87,6 +88,10 @@ def main(argv=None) -> int:
                     help="also render the comm.* ledger (per-op x "
                          "collective calls + predicted interconnect "
                          "bytes)")
+    ap.add_argument("--plans", action="store_true",
+                    help="also render the engine plan-cache table "
+                         "(per-plan builds/hits/execs + executor "
+                         "batching totals from the engine.* counters)")
     args = ap.parse_args(argv)
 
     records = report.load_records(args.trace_file)
@@ -131,6 +136,10 @@ def main(argv=None) -> int:
     if args.comm:
         print("\ncomm ledger:")
         print(render_comm_table(meta.get("counters") or {}))
+
+    if args.plans:
+        print("\nengine plans:")
+        print(report.render_plans_table(meta.get("counters") or {}))
     return 0
 
 
