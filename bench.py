@@ -63,6 +63,14 @@ zero-retrace hit path) vs micro-batched dispatch, recorded as
 plus the deterministic ``engine_plan_hits``/``engine_plan_misses``
 that the smoke golden pins.
 
+Resilience phase (schema_version 9, ``docs/RESILIENCE.md``): a
+deterministic fault drill — inject fail-twice-then-recover, trip a
+circuit breaker, shed one expired-deadline request — recording the
+exact ``resil_retries``/``resil_shed``/``resil_breaker_trips``/
+``resil_faults_injected`` the smoke golden pins, plus the
+recovered-vs-clean latency pair ``resil_clean_ms``/
+``resil_recovered_ms``.
+
 Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
 writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
 override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
@@ -560,8 +568,12 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # contract still holds within a version).  7 = comm/mem ledger fields
 # + dist phase + schema_version itself.  8 = execution-engine phase
 # (engine_cold_ms / engine_warm_ms / engine_batched_ms_per_req +
-# golden-gated engine_plan_hits / engine_plan_misses).
-SCHEMA_VERSION = 8
+# golden-gated engine_plan_hits / engine_plan_misses).  9 =
+# resilience phase (docs/RESILIENCE.md): deterministic fault drill
+# recording golden-gated resil_retries / resil_shed /
+# resil_breaker_trips / resil_faults_injected + the recovered-vs-clean
+# latency pair resil_clean_ms / resil_recovered_ms.
+SCHEMA_VERSION = 9
 
 
 def main() -> None:
@@ -1159,6 +1171,109 @@ def main() -> None:
                             warm_ms=result["engine_warm_ms"])
         except Exception as e:
             sys.stderr.write(f"bench: engine phase failed: {e!r}\n")
+
+    # Resilience phase (docs/RESILIENCE.md): a deterministic fault
+    # drill — fail-twice-then-recover on the csr.dot site (2 retries),
+    # a K=3 breaker trip with the typed fast-fail, and one deadline
+    # shed through the executor.  The counter deltas are exact given
+    # the call sequence, so the smoke golden pins them
+    # (resil_retries / resil_shed / resil_breaker_trips /
+    # resil_faults_injected) and the recovered-vs-clean latency pair
+    # joins the trajectory.  Everything restores on exit: the phase
+    # must not leak armed faults or flipped settings into later
+    # phases.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_RESIL",
+                           "0") != "1")
+            and not past_deadline(result, "resil")):
+        try:
+            import time as _time
+
+            from legate_sparse_tpu import resilience as _resil
+            from legate_sparse_tpu.engine import Engine as _REngine
+            from legate_sparse_tpu.engine import \
+                RequestExecutor as _RExecutor
+            from legate_sparse_tpu.resilience import deadline as _rdl
+            from legate_sparse_tpu.settings import settings as _rst
+
+            n_r = (1 << 12 if smoke else 1 << 16) - 57
+            saved = (_rst.resil, _rst.resil_retries,
+                     _rst.resil_backoff_ms, _rst.resil_breaker_k,
+                     _rst.resil_breaker_cooldown_ms)
+            with obs.span("bench.resil") as _sp:
+                try:
+                    _rst.resil = True
+                    _rst.resil_retries = 2
+                    _rst.resil_backoff_ms = 0.0
+                    _rst.resil_breaker_k = 3
+                    _rst.resil_breaker_cooldown_ms = 50.0
+                    _resil.reset()
+                    c0 = {k: obs.counters.get(k) for k in (
+                        "resil.retry.attempts", "resil.shed",
+                        "resil.breaker.trips", "resil.fault.injected")}
+                    A_r = _engine_config(sparse, n_r, nnz_per_row)
+                    x_r = jnp.ones((n_r,), jnp.float32)
+                    _ = float(np.asarray(A_r.dot(x_r)[0]))  # compile
+                    t0 = _time.perf_counter()
+                    _ = float(np.asarray(A_r.dot(x_r)[0]))
+                    clean_ms = (_time.perf_counter() - t0) * 1e3
+                    # Drill 1: fail-twice-then-succeed, same path.
+                    _resil.inject("csr.dot", kind="error", count=2)
+                    t0 = _time.perf_counter()
+                    _ = float(np.asarray(A_r.dot(x_r)[0]))
+                    recovered_ms = (_time.perf_counter() - t0) * 1e3
+                    _resil.faults.clear()
+                    # Drill 2: K consecutive failures trip the
+                    # breaker; the open breaker fast-fails typed.
+                    _rst.resil_retries = 0
+                    _resil.inject("csr.dot", kind="error", count=3)
+                    for _i in range(4):   # 3 faults + 1 short-circuit
+                        try:
+                            A_r.dot(x_r)
+                        except _resil.ResilienceError:
+                            pass
+                    _resil.faults.clear()
+                    _rst.resil_retries = 2
+                    # Drill 3: expired-deadline submit is shed with
+                    # the typed Rejected outcome, never dispatched.
+                    eng_r = _REngine()
+                    ex_r = _RExecutor(eng_r, max_batch=8,
+                                      queue_depth=64, timeout_ms=0)
+                    with _rdl.scope(0.0):
+                        fut = ex_r.submit(A_r, x_r)
+                    shed_out = fut.result(timeout=10)
+                    ex_r.shutdown()
+                    if type(shed_out).__name__ != "Rejected":
+                        raise RuntimeError(
+                            f"expected Rejected outcome, got "
+                            f"{type(shed_out).__name__}")
+                    result["resil_clean_ms"] = round(clean_ms, 4)
+                    result["resil_recovered_ms"] = round(recovered_ms,
+                                                         4)
+                    result["resil_recovery_delta_ms"] = round(
+                        recovered_ms - clean_ms, 4)
+                    result["resil_retries"] = int(obs.counters.get(
+                        "resil.retry.attempts")
+                        - c0["resil.retry.attempts"])
+                    result["resil_shed"] = int(obs.counters.get(
+                        "resil.shed") - c0["resil.shed"])
+                    result["resil_breaker_trips"] = int(
+                        obs.counters.get("resil.breaker.trips")
+                        - c0["resil.breaker.trips"])
+                    result["resil_faults_injected"] = int(
+                        obs.counters.get("resil.fault.injected")
+                        - c0["resil.fault.injected"])
+                    if _sp is not None:
+                        _sp.set(retries=result["resil_retries"],
+                                shed=result["resil_shed"],
+                                trips=result["resil_breaker_trips"])
+                finally:
+                    (_rst.resil, _rst.resil_retries,
+                     _rst.resil_backoff_ms, _rst.resil_breaker_k,
+                     _rst.resil_breaker_cooldown_ms) = saved
+                    _resil.reset()
+        except Exception as e:
+            sys.stderr.write(f"bench: resil phase failed: {e!r}\n")
 
     # Non-toy scale anchors (VERDICT r4 weak #6): one 1e6-row CG and
     # one 4096^2 pde datapoint, recorded REGARDLESS of tunnel state so

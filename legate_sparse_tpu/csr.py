@@ -38,6 +38,9 @@ from scipy.sparse import SparseEfficiencyWarning
 from . import obs as _obs
 from .engine import route_matmat as _engine_route_matmat
 from .engine import route_matvec as _engine_route_matvec
+from .resilience import faults as _rfaults
+from .resilience import policy as _rpolicy
+from .settings import settings as _rsettings
 from .base import CompressedBase, DenseSparseBase
 from .runtime import runtime
 from .types import check_nnz, coord_dtype_for, index_dtype, nnz_dtype
@@ -1153,7 +1156,30 @@ class csr_array(CompressedBase, DenseSparseBase):
         return self.dot(other)
 
     def dot(self, other, out=None):
-        """SpMV / SpMM / SpGEMM dispatch (reference ``csr.py:419-493``)."""
+        """SpMV / SpMM / SpGEMM dispatch (reference ``csr.py:419-493``).
+
+        With resilience on (``LEGATE_SPARSE_TPU_RESIL``,
+        docs/RESILIENCE.md) the dispatch runs under the ``csr.dot``
+        site policy: injectable via ``resilience.faults``, transient
+        failures retried with deterministic backoff, K consecutive
+        failures tripping the site breaker (typed fast-fail while
+        open).  Off — the default — this is one flag read."""
+        if _rsettings.resil and self._can_build_cache(self._data):
+            # Eager contexts only: inside an ambient jax trace the
+            # wrapper must vanish (a retry there would re-stage the
+            # traced program, and injection is trace-suppressed
+            # anyway).
+            def attempt():
+                # The fault hook wraps the VALUE so an armed
+                # ``nonfinite`` fault can poison the product; error/
+                # latency kinds fire before results are returned.
+                return _rfaults.fault_point(
+                    "csr.dot", self._dot_impl(other, out=out))
+
+            return _rpolicy.run("csr.dot", attempt)
+        return self._dot_impl(other, out=out)
+
+    def _dot_impl(self, other, out=None):
         require_supported_dtype(self.dtype)
         if _is_scipy_sparse(other):
             other = csr_array(other)  # adopt scipy operand for SpGEMM

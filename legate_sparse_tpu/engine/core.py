@@ -35,6 +35,9 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..resilience import faults as _rfaults
+from ..resilience import outcomes as _routcomes
+from ..resilience import policy as _rpolicy
 from ..settings import settings as _settings_ref
 from . import buckets as _buckets
 from .plan_cache import BUILDERS, Plan, PlanCache, PlanKey, \
@@ -218,6 +221,7 @@ class Engine:
             )
         if not _checked and not self._eligible(A, x.dtype):
             return None
+        _rfaults.fault_point("engine.exec.dispatch")
         key = self._key("spmv", A.shape[0], A.shape[1], A.nnz, A.dtype)
         plan, _hit = self._cache.get_or_build(key, BUILDERS["spmv"])
         pack = self._pack_for(A, key)
@@ -243,6 +247,7 @@ class Engine:
         k = int(X.shape[1])
         if k == 0:
             return None
+        _rfaults.fault_point("engine.exec.dispatch")
         key = self._key("spmm", A.shape[0], A.shape[1], A.nnz, A.dtype,
                         k=k)
         plan, _hit = self._cache.get_or_build(key, BUILDERS["spmm"])
@@ -411,26 +416,52 @@ def route_matvec(A, x):
     Routing must never make ``A @ x`` fail where the normal dispatch
     would succeed ("settings.engine = True is always safe"): a plan
     build/dispatch error — XLA compile failure on the padded shapes, a
-    misconfigured persist dir — is recorded and falls back."""
-    if not engine_enabled():
-        return None
-    try:
-        return get_engine().matvec(A, x)
-    except Exception as e:
-        _obs.inc("engine.route.error")
-        _obs.event("engine.route.error", op="spmv", error=repr(e)[:200])
-        return None
+    misconfigured persist dir — is recorded and falls back.
+
+    With resilience on, this is the top rung of the fallback ladder
+    (engine -> plain jit dispatch -> scipy-coverage fallback): dispatch
+    failures are retried per the ``engine.exec.dispatch`` policy, and
+    K consecutive failures trip its circuit breaker — an open breaker
+    short-circuits the engine rung entirely (returns None, so the
+    plain dispatch serves) until the half-open probe heals it."""
+    return _route(A, x, "matvec", "spmv")
 
 
 def route_matmat(A, X):
+    return _route(A, X, "matmat", "spmm")
+
+
+def _route(A, operand, method: str, op: str):
     if not engine_enabled():
         return None
+    if _settings_ref.resil:
+        # policy.run owns errors here: retries absorb transients, the
+        # breaker converts a persistent engine failure into a plain-
+        # dispatch flip (fallback=None result) instead of paying a
+        # doomed attempt per call.
+        try:
+            return _rpolicy.run(
+                "engine.exec.dispatch",
+                lambda: getattr(get_engine(), method)(A, operand),
+                fallback=lambda: _route_error(op, "ladder_flip"),
+            )
+        except _routcomes.FinalOutcomeError:
+            # A verdict from a NESTED engine site — an open
+            # engine.plan.build breaker fast-failing a plan compile —
+            # must not escape `A @ x`: the engine rung is unavailable,
+            # so flip the ladder to the plain dispatch ("engine on is
+            # always safe"), same as any other engine-rung failure.
+            return _route_error(op, "final_outcome_ladder_flip")
     try:
-        return get_engine().matmat(A, X)
+        return getattr(get_engine(), method)(A, operand)
     except Exception as e:
-        _obs.inc("engine.route.error")
-        _obs.event("engine.route.error", op="spmm", error=repr(e)[:200])
-        return None
+        return _route_error(op, repr(e)[:200])
+
+
+def _route_error(op: str, error: str):
+    _obs.inc("engine.route.error")
+    _obs.event("engine.route.error", op=op, error=error)
+    return None
 
 
 def warmup(plans: Iterable[Dict[str, Any]]) -> List[str]:

@@ -26,6 +26,17 @@ Contract
 - Ineligible submissions (matrix on a structure fast path, tracer
   context) dispatch inline through the normal ``A.dot`` — the Future
   contract holds either way.
+- Resilience (``LEGATE_SPARSE_TPU_RESIL``, docs/RESILIENCE.md): a
+  request submitted under a ``resilience.deadline`` scope carries its
+  deadline; queue wait counts against it, and an expired request is
+  SHED — its Future resolves with the typed ``outcomes.Rejected``
+  value instead of being dispatched (``resil.shed.*`` counters).
+- Shutdown safety: live executors are tracked in a module WeakSet and
+  drained by one ``atexit`` hook (idempotent ``close``), so requests
+  still queued when the interpreter exits are dispatched (or resolved
+  with the teardown error) rather than silently dropped with a
+  forever-pending Future — while an executor abandoned without
+  ``shutdown()`` stays garbage-collectable.
 
 Device-launch discipline: every batch dispatch happens in exactly one
 thread at a time per executor (submitting thread or the worker), which
@@ -40,22 +51,74 @@ plan id and batch width.
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs as _obs
+from ..resilience import deadline as _rdeadline
+from ..resilience import faults as _rfaults
+from ..resilience import outcomes as _routcomes
+from ..settings import settings as _rsettings
+
+
+# Executors with possibly-queued requests, drained once at interpreter
+# exit.  A WeakSet (not per-instance ``atexit.register(self.close)``,
+# which would hold a strong reference) so an executor abandoned without
+# shutdown() stays garbage-collectable — its _anchors dict pins whole
+# matrices, which must not accumulate for process lifetime in a
+# long-lived server.
+_LIVE_EXECUTORS: "weakref.WeakSet[RequestExecutor]" = weakref.WeakSet()
+
+
+def _drain_live_executors() -> None:
+    for ex in list(_LIVE_EXECUTORS):
+        ex.close()
+
+
+_exit_hook_installed = False
+
+
+def _install_exit_hook_once() -> None:
+    # Installed at FIRST construction, not module import: user code
+    # that registers its own atexit hooks after importing this module
+    # but before building an executor (the drain-regression drill
+    # does) still sees the drain run first under atexit's LIFO order,
+    # matching the old per-instance registration point.
+    global _exit_hook_installed
+    if not _exit_hook_installed:
+        _exit_hook_installed = True
+        atexit.register(_drain_live_executors)
 
 
 class _Request:
-    __slots__ = ("A", "x", "future", "t_ns")
+    __slots__ = ("A", "x", "future", "t_ns", "deadline")
 
     def __init__(self, A, x):
         self.A = A
         self.x = x
         self.future: Future = Future()
         self.t_ns = time.perf_counter_ns()
+        # Captured at submit time from the SUBMITTING thread's scope:
+        # the worker thread dispatching later sheds against the
+        # request's own budget, not its own (absent) scope.
+        self.deadline = (_rdeadline.current() if _rsettings.resil
+                         else None)
+
+    def shed(self, site: str) -> None:
+        """Resolve with the typed Rejected outcome (never dispatched)."""
+        waited_ms = (time.perf_counter_ns() - self.t_ns) / 1e6
+        _obs.inc("resil.shed")
+        _obs.inc(f"resil.shed.{site}")
+        _obs.event("resil.shed", site=site,
+                   waited_ms=round(waited_ms, 3))
+        self.future.set_result(_routcomes.Rejected(
+            site=site, reason="deadline", waited_ms=waited_ms,
+            deadline_ms=(self.deadline.total_ms
+                         if self.deadline is not None else None)))
 
 
 class RequestExecutor:
@@ -89,6 +152,14 @@ class RequestExecutor:
         # (tests/test_obs_concurrency.py), and collective-backed plans
         # will eventually route through here.
         self._dispatch_lock = threading.Lock()
+        # The worker is a daemon thread, so without the module's
+        # atexit drain any request still queued at interpreter exit
+        # would be silently dropped (its Future never resolves).
+        # close() is idempotent and swallows teardown-order errors
+        # (JAX may already be gone; the per-request error paths
+        # deliver what they can).
+        _install_exit_hook_once()
+        _LIVE_EXECUTORS.add(self)
 
     # ---------------- public API ----------------
 
@@ -108,13 +179,29 @@ class RequestExecutor:
                 f"engine submit: operand shape {x.shape} does not "
                 f"match matrix {A.shape}"
             )
+        req = _Request(A, x)
+        if _rsettings.resil:
+            # Resilience admission point.  An injected queue fault
+            # (error kind) degrades to inline service — the Future
+            # contract holds and the queue stays consistent; latency
+            # kind sleeps HERE, before the deadline check, so queue-
+            # admission delay counts against the request's budget.
+            try:
+                _rfaults.fault_point("engine.exec.queue")
+            except _rfaults.InjectedFault:
+                _obs.inc("resil.exec.queue_fault_inline")
+                self._resolve_inline(req)
+                return req.future
+            if req.deadline is not None and req.deadline.expired():
+                # Shed at admission: an expired request must never be
+                # dispatched (it would displace on-time work).
+                req.shed("engine.exec.queue")
+                return req.future
         if not self._engine._eligible(A, x.dtype):
             # Serve through the normal dispatch, same Future contract.
             _obs.inc("engine.exec.inline")
-            req = _Request(A, x)
             self._resolve_inline(req)
             return req.future
-        req = _Request(A, x)
         to_dispatch: List[Tuple[object, List[_Request]]] = []
         with self._cv:
             if self._shutdown:
@@ -164,6 +251,22 @@ class RequestExecutor:
         if worker is not None and wait:
             worker.join(timeout=5)
         self.flush()
+        try:
+            _LIVE_EXECUTORS.discard(self)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+    def close(self) -> None:
+        """Idempotent atexit drain: dispatch whatever is still queued
+        so no accepted request is silently dropped at interpreter
+        exit.  Safe late in teardown — a dispatch that fails because
+        JAX is already torn down delivers its error through the
+        per-request Future, and any residual error is swallowed (an
+        atexit hook must not mask the process's real exit)."""
+        try:
+            self.shutdown(wait=False)
+        except Exception:  # pragma: no cover - teardown-order dependent
+            pass
 
     def pending(self) -> int:
         with self._cv:
@@ -240,6 +343,19 @@ class RequestExecutor:
     def _dispatch_locked(self, A, group: List[_Request]) -> None:
         import jax.numpy as jnp
 
+        if any(r.deadline is not None for r in group):
+            # Flush-time load shedding: queue wait counted against
+            # each request's own deadline; expired ones resolve with
+            # the typed Rejected outcome instead of being dispatched.
+            live = []
+            for r in group:
+                if r.deadline is not None and r.deadline.expired():
+                    r.shed("engine.exec.dispatch")
+                else:
+                    live.append(r)
+            if not live:
+                return
+            group = live
         k = len(group)
         t_disp = time.perf_counter_ns()
         queue_ns = sum(t_disp - r.t_ns for r in group)

@@ -43,6 +43,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from .. import obs as _obs
+from ..resilience import faults as _rfaults
+from ..resilience import outcomes as _routcomes
+from ..resilience import policy as _rpolicy
 
 
 @dataclass(frozen=True)
@@ -160,9 +163,25 @@ class PlanCache:
         _obs.inc("engine.plan.misses")
         _obs.inc(f"engine.plan.{key.plan_id}.builds")
         t0 = time.perf_counter()
+
+        def _build():
+            # Resilience site: an injected (or real, transient) XLA
+            # compile failure is retried per the engine.plan.build
+            # policy before it reaches the negative cache below —
+            # only a failure that survives its retry ladder poisons
+            # the key.  Inert one flag read with RESIL off.
+            _rfaults.fault_point("engine.plan.build")
+            return builder(key)
+
         try:
             with _obs.span("engine.build", plan=key.plan_id):
-                plan = builder(key)
+                plan = _rpolicy.run("engine.plan.build", _build)
+        except _routcomes.FinalOutcomeError:
+            # A resilience verdict (the site's breaker is open) says
+            # nothing about THIS key's buildability — it was never
+            # attempted.  Do not poison the negative cache: the key
+            # must stay buildable after the breaker heals.
+            raise
         except Exception:
             with self._lock:
                 if len(self._failed) >= self._FAILED_CAP:

@@ -29,6 +29,11 @@ import jax
 import jax.numpy as jnp
 
 from . import obs as _obs
+from .resilience import deadline as _rdeadline
+from .resilience import faults as _rfaults
+from .resilience import health as _rhealth
+from .resilience import policy as _rpolicy
+from .settings import settings as _rsettings
 from .types import index_dtype
 
 from .csr import csr_array
@@ -321,17 +326,11 @@ def _get_atol_rtol(b_norm, tol=None, atol=0.0, rtol=1e-5):
 # --------------------------------------------------------------------------
 # CG (reference ``linalg.py:465-535``)
 # --------------------------------------------------------------------------
-def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
-             maxiter: int, conv_test_iters: int):
-    """Whole preconditioned-CG solve as one XLA while_loop.
-
-    State carries (x, r, p, rho, iters, done) plus the loop-invariant
-    (atol2, maxiter) *as state* — dynamic values rather than trace-time
-    constants, so solves with different tolerances/iteration budgets
-    (e.g. a warmup run followed by a timed run) reuse one compiled
-    loop instead of recompiling.
-    """
-    dtype = b.dtype
+def _cg_builders(A_mv: Callable, M_mv: Callable, conv_test_iters: int):
+    """The (cond, body) pair of the CG while_loop — shared verbatim by
+    the one-shot loop (``_cg_loop``) and the chunked resilience loop
+    (``_cg_loop_resil``), so the two apply the *identical* iteration
+    and differ only in where the loop bound lives."""
 
     def cond(state):
         x, r, p, rho, iters, done, atol2, miter = state
@@ -366,19 +365,112 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
         done = jnp.logical_or(done, jnp.logical_and(check, rnorm2 < atol2))
         return (x, r, p, rho, iters, done, atol2, miter)
 
+    return cond, body
+
+
+def _cg_state0(A_mv: Callable, b, x0, atol: float, maxiter: int):
     r0 = b - A_mv(x0)
-    state0 = (
+    return (
         x0,
         r0,
         jnp.zeros_like(b),
-        jnp.ones((), dtype=dtype),
+        jnp.ones((), dtype=b.dtype),
         jnp.asarray(0, dtype=index_dtype()),
         jnp.asarray(False),
         jnp.asarray(atol, dtype=jnp.real(b).dtype) ** 2,
         jnp.asarray(maxiter, dtype=index_dtype()),
     )
-    out = jax.lax.while_loop(cond, body, state0)
+
+
+def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
+             maxiter: int, conv_test_iters: int):
+    """Whole preconditioned-CG solve as one XLA while_loop.
+
+    State carries (x, r, p, rho, iters, done) plus the loop-invariant
+    (atol2, maxiter) *as state* — dynamic values rather than trace-time
+    constants, so solves with different tolerances/iteration budgets
+    (e.g. a warmup run followed by a timed run) reuse one compiled
+    loop instead of recompiling.
+    """
+    cond, body = _cg_builders(A_mv, M_mv, conv_test_iters)
+    out = jax.lax.while_loop(
+        cond, body, _cg_state0(A_mv, b, x0, atol, maxiter))
     return out[0], out[4]
+
+
+def _resil_solver_active() -> bool:
+    """Route a solve through the chunked resilience driver?  Requires
+    the master switch AND something that needs per-cycle host
+    decisions (an active deadline scope, or health detection opted
+    in) — so ``LEGATE_SPARSE_TPU_RESIL=1`` alone leaves the one-shot
+    while_loop path untouched."""
+    return _rsettings.resil and (
+        _rdeadline.current() is not None or _rhealth.active())
+
+
+def _cg_loop_resil(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
+                   maxiter: int, conv_test_iters: int,
+                   site: str = "solver.cg.conv"):
+    """Deadline/health-aware CG (docs/RESILIENCE.md): the SAME
+    while_loop body as ``_cg_loop``, dispatched in chunks of
+    ``conv_test_iters`` iterations with ONE stacked-scalar fetch
+    (iters, done, ||r||^2) per chunk — the existing convergence
+    cadence, so deadline and health checks add zero extra host syncs.
+    The carried Krylov state crosses chunk boundaries intact: the
+    sequence of body applications is identical to the one-shot loop.
+
+    Deadline expiry raises ``DeadlineExceeded`` with the partial
+    iterate; health verdicts (non-finite/divergence/stagnation, when
+    opted in) raise ``SolverHealthError``.  The per-chunk dispatch is
+    the ``solver.cg.conv`` fault/retry site: a chunk re-runs from its
+    entry state, so retries are bit-identical.
+
+    The carried state keeps the TRUE ``maxiter`` (the chunk bound is a
+    separate traced limit in the loop condition), so the in-kernel
+    convergence checks — including the ``iters == maxiter - 1`` final
+    check — fire at exactly the one-shot loop's iterations and the two
+    drivers converge at the same count."""
+    cond, body = _cg_builders(A_mv, M_mv, conv_test_iters)
+    rdt = jnp.real(b).dtype
+
+    def chunk(state, limit):
+        def cond_chunk(st):
+            return jnp.logical_and(cond(st), st[4] < limit)
+
+        out = jax.lax.while_loop(cond_chunk, body, state)
+        rn2 = jnp.real(jnp.vdot(out[1], out[1]))
+        stats = jnp.stack([out[4].astype(rdt), out[5].astype(rdt),
+                           rn2.astype(rdt)])
+        return out, stats
+
+    chunk_fn = maybe_jit(chunk)
+    state = _cg_state0(A_mv, b, x0, atol, maxiter)
+    step = max(int(conv_test_iters), 1)
+    monitor = _rhealth.Monitor(site)
+    it = 0
+    resid = None
+    while it < maxiter:
+        _rdeadline.raise_if_expired(site, iterations=it,
+                                    residual=resid, partial=state[0])
+        limit = jnp.asarray(min(it + step, maxiter),
+                            dtype=index_dtype())
+
+        def attempt(state=state, limit=limit):
+            out, stats = chunk_fn(state, limit)
+            return out, _rfaults.fault_point(site, stats)
+
+        state, stats = _rpolicy.run(site, attempt)
+        # The chunk's one host sync — the same fetch the convergence
+        # decision needs (counted like gmres's cadence counter).
+        _obs.inc("transfer.host_sync.cg_conv")
+        arr = np.asarray(stats)
+        it = int(arr[0])
+        done = bool(arr[1])
+        resid = float(np.sqrt(arr[2]))
+        monitor.observe(resid, it, partial=state[0])
+        if done:
+            break
+    return state[0], state[4]
 
 
 def cg(
@@ -425,7 +517,9 @@ def cg(
     _obs.inc("op.cg")
     if callback is None:
         with _obs.span("cg", n=n, maxiter=int(maxiter)) as sp:
-            xs, iters = _cg_loop(
+            loop = (_cg_loop_resil if _resil_solver_active()
+                    else _cg_loop)
+            xs, iters = loop(
                 A_op.matvec, M_op.matvec, b, x, atol, int(maxiter),
                 int(conv_test_iters),
             )
@@ -633,16 +727,44 @@ def gmres(
     )
 
     _obs.inc("op.gmres")
+    # Resilience (docs/RESILIENCE.md): the per-cycle dispatch is the
+    # ``solver.gmres.conv`` fault/retry site (a cycle re-runs from its
+    # entry iterate — bit-identical), the cycle fetch feeds the opt-in
+    # health monitor, and deadlines are enforced at the same cadence —
+    # all riding the one existing host sync per cycle.
+    resil = _rsettings.resil
+    monitor = _rhealth.Monitor("solver.gmres.conv") if resil else None
+    resid_f = None
     iters = 0
     while iters < maxiter:
+        if resil:
+            _rdeadline.raise_if_expired("solver.gmres.conv",
+                                        iterations=iters,
+                                        residual=resid_f, partial=x)
         with _obs.span("gmres.cycle", restart=restart, iters_done=iters):
-            x_new, stats = cycle(x, b)
+            if resil:
+                def _cycle_guarded(x=x):
+                    xn, st = cycle(x, b)
+                    return xn, _rfaults.fault_point("solver.gmres.conv",
+                                                    st)
+
+                x_new, stats = _rpolicy.run("solver.gmres.conv",
+                                            _cycle_guarded)
+            else:
+                x_new, stats = cycle(x, b)
             # The convergence cadence: ONE stacked-scalar fetch per
             # cycle — the only host sync in the restarted iteration
             # (the cycle body is sync-free; tests assert it through
             # this counter).
             _obs.inc("transfer.host_sync.gmres_conv")
             beta_f, resid_f = (float(v) for v in np.asarray(stats))
+            if monitor is not None:
+                # beta (cycle-start norm) going non-finite is the
+                # earliest silent-NaN signal; otherwise judge the
+                # cycle-end least-squares residual.
+                monitor.observe(
+                    beta_f if not np.isfinite(beta_f) else resid_f,
+                    iters + restart, partial=x_new)
             if beta_f < atol:
                 break          # converged at cycle start: keep x
             x = x_new

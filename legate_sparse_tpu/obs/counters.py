@@ -19,6 +19,11 @@ having had tracing enabled in advance.  Naming convention::
                          syncs that block on device results)
     scipy_fallback.<name>  host-scipy escape-hatch hits
     platform.<name>      accelerator probe / pinning outcomes
+    resil.<name>         resilience-layer accounting (retries,
+                         backoff ms, breaker transitions, shed
+                         requests, injected faults, health verdicts)
+                         — EXACT by contract: the fault drills assert
+                         equality, not >= (docs/RESILIENCE.md)
     obs.nnz_processed / obs.bytes_moved / obs.flops
                          accumulated from span attributes (only while
                          tracing is enabled — the attrs are computed

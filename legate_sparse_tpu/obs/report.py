@@ -247,3 +247,102 @@ def render_plans_table(counters: Dict[str, Any]) -> str:
             f"backpressure"
         )
     return "\n".join(lines)
+
+
+# Aggregate resil.retry.* counter names that are NOT per-site rollups.
+_RESIL_RETRY_AGG = ("attempts", "exhausted", "backoff_ms",
+                    "budget_exhausted")
+
+
+def render_resil_table(counters: Dict[str, Any]) -> str:
+    """Per-site resilience ledger from the ``resil.*`` counters
+    (``tools/trace_summary.py --resil``; naming contract in
+    docs/RESILIENCE.md): one row per site that saw any activity —
+    injected faults, retries, breaker trips and short-circuits,
+    fallback-ladder flips — plus summary lines for shedding,
+    deadlines, backoff, budgets, and health verdicts."""
+    per_site: Dict[str, Dict[str, float]] = {}
+
+    def row(site: str) -> Dict[str, float]:
+        return per_site.setdefault(site, {
+            "faults": 0, "retries": 0, "trips": 0,
+            "short_circuit": 0, "fallbacks": 0})
+
+    for name, val in counters.items():
+        if not name.startswith("resil."):
+            continue
+        body = name[len("resil."):]
+        # Aggregate counters (resil.fault.injected, resil.breaker.
+        # trips, ...) parse to an empty site below — the summary lines
+        # carry them; only non-empty sites get table rows.
+        if body.startswith("fault.") and body.endswith(".injected"):
+            site = body[len("fault."):-len(".injected")]
+            if site:
+                row(site)["faults"] += val
+        elif body.startswith("retry."):
+            site = body[len("retry."):]
+            if site and site not in _RESIL_RETRY_AGG:
+                row(site)["retries"] += val
+        elif body.startswith("breaker.") and body.endswith(".trips"):
+            site = body[len("breaker."):-len(".trips")]
+            if site:
+                row(site)["trips"] += val
+        elif (body.startswith("breaker.")
+                and body.endswith(".short_circuit")):
+            site = body[len("breaker."):-len(".short_circuit")]
+            if site:
+                row(site)["short_circuit"] += val
+        elif (body.startswith("fallback.")
+                and body != "fallback"):
+            site = body[len("fallback."):]
+            if site:
+                row(site)["fallbacks"] += val
+    lines = []
+    if per_site:
+        rows = [
+            [site, str(int(r["faults"])), str(int(r["retries"])),
+             str(int(r["trips"])), str(int(r["short_circuit"])),
+             str(int(r["fallbacks"]))]
+            for site, r in sorted(per_site.items())
+        ]
+        lines.append(format_table(
+            ["site", "faults", "retries", "trips", "short_circ",
+             "fallbacks"], rows))
+    else:
+        lines.append("no per-site resil.* counters recorded "
+                     "(resilience never engaged?)")
+    att = counters.get("resil.retry.attempts", 0)
+    if att or counters.get("resil.retry.exhausted", 0):
+        lines.append(
+            f"retries: {int(att)} attempts, "
+            f"{counters.get('resil.retry.backoff_ms', 0):.1f} ms "
+            f"backing off, "
+            f"{int(counters.get('resil.retry.exhausted', 0))} "
+            f"exhausted, "
+            f"{int(counters.get('resil.retry.budget_exhausted', 0))} "
+            f"budget-dry"
+        )
+    shed = counters.get("resil.shed", 0)
+    ddl = counters.get("resil.deadline.solver", 0)
+    if shed or ddl:
+        lines.append(
+            f"shedding: {int(shed)} requests shed "
+            f"({int(counters.get('resil.shed.engine.exec.queue', 0))} "
+            f"at admission, "
+            f"{int(counters.get('resil.shed.engine.exec.dispatch', 0))}"
+            f" at flush), {int(ddl)} solver deadline expiries"
+        )
+    health = {k[len("resil.health."):]: v for k, v in counters.items()
+              if k.startswith("resil.health.")
+              and "." not in k[len("resil.health."):]}
+    if health:
+        lines.append("health: " + ", ".join(
+            f"{int(v)} {cause}" for cause, v in sorted(health.items())))
+    inj = counters.get("resil.fault.injected", 0)
+    if inj:
+        lines.append(
+            f"faults: {int(inj)} injected, "
+            f"{int(counters.get('resil.fault.trace_skipped', 0))} "
+            f"trace-suppressed"
+        )
+    return "\n".join(lines)

@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from .. import obs as _obs
 from ..engine import engine_enabled as _engine_enabled
 from ..engine import get_engine as _get_engine
+from ..resilience import guarded_call as _resil_guarded
+from ..settings import settings as _rsettings
 from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -913,7 +915,22 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     x gathered per the column image (halo ppermute or all_gather).
     The underlying shard_map computations are structure-cached, so
     repeated calls on the same matrix structure reuse one compilation.
+
+    Resilience (``LEGATE_SPARSE_TPU_RESIL``, docs/RESILIENCE.md):
+    eager dispatches run under the ``dist.spmv`` site policy —
+    injectable, and transient collective failures retried with
+    backoff.  Calls staged inside an ambient trace (solver loops via
+    ``matvec_fn``) bypass the wrapper: a retry there would re-stage
+    the traced program, and the driver-level sites (``dist.cg``,
+    ``solver.*.conv``) own recovery for those.
     """
+    if _rsettings.resil and csr_array._can_build_cache(x):
+        return _resil_guarded("dist.spmv",
+                              lambda: _dist_spmv_impl(A, x))
+    return _dist_spmv_impl(A, x)
+
+
+def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
     halo = A.halo
     precise = A.gather_idx is not None
     _obs.inc("op.dist_spmv")
@@ -1624,7 +1641,9 @@ def dist_cg(
     Returns the solution truncated to the unpadded length, plus the
     iteration count.
     """
-    from ..linalg import _cg_loop, _get_atol_rtol
+    from ..linalg import (
+        _cg_loop, _cg_loop_resil, _get_atol_rtol, _resil_solver_active,
+    )
 
     _obs.inc("op.dist_cg")
     rows, b_sh, x0_sh, maxiter, cb = _shard_system(
@@ -1644,10 +1663,25 @@ def dist_cg(
                        maxiter=int(maxiter),
                        preconditioned=M is not None) as sp, \
                 _mem.watermark("dist_cg", n=rows, shards=A.num_shards):
-            x, iters = _cg_loop(
-                A.matvec_fn(), M_mv, b_sh, x0_sh, atol, int(maxiter),
-                int(conv_test_iters),
-            )
+            # Resilience: the whole loop dispatch is the ``dist.cg``
+            # site — an injected (or real) collective failure retries
+            # the solve from x0, which re-converges to the identical
+            # answer instead of corrupting the Krylov state.  An
+            # active deadline scope / health opt-in swaps in the
+            # chunked driver (one fetch per conv_test_iters cycle —
+            # the existing cadence).
+            def _solve():
+                loop = (_cg_loop_resil if _resil_solver_active()
+                        else _cg_loop)
+                return loop(
+                    A.matvec_fn(), M_mv, b_sh, x0_sh, atol,
+                    int(maxiter), int(conv_test_iters),
+                )
+
+            if _rsettings.resil:
+                x, iters = _resil_guarded("dist.cg", _solve)
+            else:
+                x, iters = _solve()
             if sp is not None:
                 # One host sync for honest timing + the true iteration
                 # count (tracing mode only; see linalg.cg).  The same

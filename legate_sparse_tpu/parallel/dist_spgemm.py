@@ -708,7 +708,23 @@ def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     ESC.  Differentially tested against scipy on the 8-device CPU mesh
     (``tests/test_dist_spgemm.py``), including the GMG Galerkin
     triple product R @ A @ P.
+
+    Resilience (``LEGATE_SPARSE_TPU_RESIL``, docs/RESILIENCE.md): the
+    whole multiply is the ``dist.spgemm`` site — SpGEMM is a driver of
+    eager collective phases with host syncs between them, so a
+    transient failure in any phase retries the multiply from its
+    immutable inputs (bit-identical on success).
     """
+    from ..resilience import guarded_call as _resil_guarded
+    from ..settings import settings as _rsettings
+
+    if _rsettings.resil:
+        return _resil_guarded("dist.spgemm",
+                              lambda: _dist_spgemm_impl(A, B))
+    return _dist_spgemm_impl(A, B)
+
+
+def _dist_spgemm_impl(A: DistCSR, B: DistCSR) -> DistCSR:
     if A.shape[1] != B.shape[0]:
         raise ValueError(f"dimension mismatch: {A.shape} @ {B.shape}")
     if A.mesh is not B.mesh and A.mesh != B.mesh:

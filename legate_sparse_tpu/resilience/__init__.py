@@ -1,0 +1,87 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""legate_sparse_tpu.resilience: the request-lifecycle failure layer.
+
+The north star is a service under heavy traffic, and under heavy
+traffic partial failure is the steady state: a transient compile
+error, a hung collective, a NaN-producing solve.  Before this
+subsystem, each of those either raised out of the top-level API or
+returned silent garbage.  Now failures are **injectable, bounded, and
+observable** (``docs/RESILIENCE.md``):
+
+- ``faults``   — deterministic, seedable fault injection at a closed
+                 catalog of named sites (``fault_point("dist.spmv")``)
+                 threaded through the engine, ``csr_array.dot``, the
+                 distributed collectives, and the solver host-sync
+                 points.  ``tools/check_fault_sites.py`` keeps the
+                 catalog honest.
+- ``policy``   — per-site retry with deterministic exponential
+                 backoff, retry budgets, and circuit breakers whose
+                 trip flips the existing fallback ladder (engine ->
+                 plain jit dispatch -> scipy-coverage fallback).
+- ``deadline`` — request deadlines propagated via contextvars; the
+                 engine executor sheds expired requests with a typed
+                 ``Rejected`` outcome, the solvers check at their
+                 existing one-fetch-per-cycle cadence (zero extra
+                 host syncs) and raise ``DeadlineExceeded`` with the
+                 partial iterate.
+- ``health``   — opt-in non-finite/divergence/stagnation detection at
+                 the same sync points, surfaced as a structured
+                 ``HealthReport`` instead of silent NaN results.
+- ``outcomes`` — the typed outcome/error vocabulary shared by all of
+                 the above.
+
+Inert by default: with ``LEGATE_SPARSE_TPU_RESIL`` unset every hook is
+one flag read, no site adds a host sync, and behavior is bit-for-bit
+the pre-subsystem package.  Every retry, breaker transition, shed
+request, and injected fault lands in ``resil.*`` obs counters and
+events; ``tools/trace_summary.py --resil`` renders the ledger.
+"""
+
+from __future__ import annotations
+
+from . import deadline, faults, health, outcomes, policy  # noqa: F401
+from .faults import CATALOG, InjectedFault, fault_point, inject  # noqa: F401
+from .health import Monitor, SolverHealthError  # noqa: F401
+from .outcomes import (  # noqa: F401
+    DeadlineExceeded, FinalOutcomeError, HealthReport, Rejected,
+    ResilienceError,
+)
+from .policy import CircuitOpenError, breaker, run  # noqa: F401
+from ..settings import settings as _settings
+
+__all__ = [
+    "deadline", "faults", "health", "outcomes", "policy",
+    "CATALOG", "InjectedFault", "fault_point", "inject",
+    "Monitor", "SolverHealthError",
+    "DeadlineExceeded", "FinalOutcomeError", "HealthReport", "Rejected",
+    "ResilienceError",
+    "CircuitOpenError", "breaker", "run",
+    "active", "guarded_call", "reset",
+]
+
+
+def active() -> bool:
+    """The subsystem master switch (``settings.resil``) — the one flag
+    every instrumented site reads first."""
+    return bool(_settings.resil)
+
+
+def guarded_call(site: str, fn, fallback=None):
+    """The standard site wrap: ``fault_point(site)`` then ``fn()``,
+    under ``policy.run``'s retry/breaker ladder — so an injected (or
+    real) failure at the site is retried with backoff and accounted
+    per site.  Call only when :func:`active` (callers keep their
+    zero-overhead fast path explicit)."""
+    def attempt():
+        faults.fault_point(site)
+        return fn()
+
+    return policy.run(site, attempt, fallback=fallback)
+
+
+def reset() -> None:
+    """Disarm all faults, reset breakers, refill retry budgets
+    (tests / bench phases)."""
+    faults.clear()
+    policy.reset()

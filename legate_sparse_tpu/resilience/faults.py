@@ -1,0 +1,259 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Deterministic, seedable fault injection at named sites.
+
+Distributed sparse solves live where partial failure is the steady
+state (PAPERS.md: the GPGPU-cluster SpMV line treats per-node variance
+as a design input), but a failure mode that can only be reproduced by
+waiting for it cannot be tested.  This registry makes failures
+*injectable*: every resilience-instrumented dispatch point calls
+
+    fault_point("dist.spmv")            # error / latency sites
+    stats = fault_point("solver.cg.conv", stats)   # value sites
+
+which is a single flag read while the subsystem is off
+(``LEGATE_SPARSE_TPU_RESIL`` unset) and consults the armed-fault table
+when it is on.  Tests and the bench resilience phase arm faults with
+:func:`inject`; drills are deterministic — "fail calls 1..count, then
+succeed" — so retry/breaker accounting can be asserted *exactly*, and
+optionally probabilistic with a seeded LCG (no global RNG state, no
+run-to-run wobble).
+
+Site names form a closed catalog (:data:`CATALOG`).  A ``fault_point``
+call with an unknown name raises while the subsystem is armed, and
+``tools/check_fault_sites.py`` statically cross-checks the package's
+call-site literals against the catalog and ``docs/RESILIENCE.md`` so
+injection coverage cannot rot silently.
+
+Kinds
+-----
+- ``error``     raise :class:`InjectedFault` (retry/breaker drills)
+- ``latency``   ``time.sleep(latency_ms)`` before proceeding (deadline
+                and shedding drills — queue wait counts against the
+                deadline)
+- ``nonfinite`` poison the value flowing through a value-carrying site
+                (last element set to NaN: the residual slot of the
+                solver convergence fetches) — the health-detection
+                drill; sites without a value treat it as a no-op fire.
+
+Trace safety: injection is suppressed inside an ambient jax trace
+(``resil.fault.trace_skipped``) — a fault fired at trace time would be
+baked into the compiled program and replayed forever, which is neither
+deterministic-count nor recoverable.  Every instrumented site executes
+its Python dispatch eagerly somewhere; drills target those calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from .. import obs as _obs
+from ..settings import settings as _settings
+from .outcomes import ResilienceError
+
+#: The closed site catalog: every ``fault_point`` in the package names
+#: one of these.  Keep in sync with docs/RESILIENCE.md (enforced by
+#: tools/check_fault_sites.py in tier-1).
+CATALOG: Dict[str, str] = {
+    "engine.plan.build":
+        "engine/plan_cache.py: AOT plan compile (XLA lower+compile)",
+    "engine.exec.queue":
+        "engine/executor.py: request admission into the micro-batch "
+        "queue",
+    "engine.exec.dispatch":
+        "engine/core.py: bucketed plan dispatch (matvec/matmat)",
+    "csr.dot":
+        "csr.py: csr_array.dot SpMV/SpMM/SpGEMM dispatch",
+    "dist.spmv":
+        "parallel/dist_csr.py: distributed SpMV collective dispatch",
+    "dist.cg":
+        "parallel/dist_csr.py: dist_cg solve dispatch (collective "
+        "loop)",
+    "dist.spgemm":
+        "parallel/dist_spgemm.py: distributed SpGEMM phases",
+    "solver.cg.conv":
+        "linalg.py: CG chunked convergence fetch (one per "
+        "conv_test_iters cycle)",
+    "solver.gmres.conv":
+        "linalg.py: GMRES per-restart-cycle convergence fetch",
+}
+
+#: Fault kinds a site can be armed with.
+KINDS = ("error", "latency", "nonfinite")
+
+
+class InjectedFault(ResilienceError):
+    """The exception an ``error``-kind armed site raises."""
+
+    def __init__(self, site: str, ordinal: int):
+        self.site = site
+        self.ordinal = ordinal
+        super().__init__(f"injected fault #{ordinal} at {site}")
+
+
+@dataclass
+class _Arm:
+    site: str
+    kind: str
+    count: int
+    after: int
+    latency_ms: float
+    p: float
+    seed: int
+    calls: int = 0
+    fired: int = 0
+    meta: dict = field(default_factory=dict)
+
+
+_lock = threading.Lock()
+_arms: Dict[str, _Arm] = {}
+
+
+def inject(site: str, kind: str = "error", count: int = 1,
+           after: int = 0, latency_ms: float = 5.0, p: float = 1.0,
+           seed: int = 0) -> None:
+    """Arm ``site`` to fire ``kind`` on its next ``count`` eligible
+    calls (skipping the first ``after``).  ``p < 1`` makes each
+    eligible call fire with probability ``p`` drawn from a
+    deterministic per-call LCG over ``seed`` — same seed, same
+    schedule, every run."""
+    if site not in CATALOG:
+        raise ValueError(
+            f"unknown fault site {site!r}; catalog: {sorted(CATALOG)}")
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+    with _lock:
+        _arms[site] = _Arm(site=site, kind=kind, count=int(count),
+                           after=int(after),
+                           latency_ms=float(latency_ms), p=float(p),
+                           seed=int(seed))
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Disarm one site, or every site."""
+    with _lock:
+        if site is None:
+            _arms.clear()
+        else:
+            _arms.pop(site, None)
+
+
+def armed(site: Optional[str] = None):
+    """Snapshot of the armed table (one site, or all): ``{site:
+    {kind, count, fired, calls}}``."""
+    with _lock:
+        items = ([_arms[site]] if site is not None and site in _arms
+                 else (list(_arms.values()) if site is None else []))
+        return {a.site: {"kind": a.kind, "count": a.count,
+                         "fired": a.fired, "calls": a.calls}
+                for a in items}
+
+
+def fired(site: str) -> int:
+    """How many times ``site``'s armed fault has fired."""
+    with _lock:
+        a = _arms.get(site)
+        return a.fired if a is not None else 0
+
+
+def _trace_clean() -> bool:
+    """True when no jax trace is ambient (mirrors
+    ``csr_array._can_build_cache``); unknown state counts as traced —
+    never inject where the effect could be staged into a program."""
+    try:
+        from jax._src.core import trace_state_clean
+    except ImportError:  # pragma: no cover - jax internals moved
+        return False
+    try:
+        return trace_state_clean()
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _lcg01(seed: int, n: int) -> float:
+    """Deterministic per-call uniform in [0, 1): one 64-bit LCG step
+    over (seed, call ordinal) — no global RNG state touched."""
+    x = (seed * 6364136223846793005 + n * 1442695040888963407
+         + 1442695040888963407) & 0xFFFFFFFFFFFFFFFF
+    x = (x * 6364136223846793005 + 1) & 0xFFFFFFFFFFFFFFFF
+    return (x >> 11) / float(1 << 53)
+
+
+def _poison(value: Any) -> Any:
+    """Return ``value`` with its LAST element set to NaN — the
+    residual slot of the stacked solver convergence fetches (the
+    leading slots carry iteration counters the drivers must keep
+    reading)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        arr = jnp.asarray(value)
+    except (TypeError, ValueError):
+        # Not array-like (e.g. the csr_array an SpGEMM dispatch flows
+        # through csr.dot): nonfinite degrades to a no-op fire rather
+        # than surfacing a bogus TypeError the retry ladder would then
+        # misread as a site failure.
+        return value
+    if not (jnp.issubdtype(arr.dtype, jnp.floating)
+            or jnp.issubdtype(arr.dtype, jnp.complexfloating)):
+        return value
+    if arr.ndim == 0:
+        return jnp.asarray(np.nan, dtype=arr.dtype)
+    flat = arr.reshape(-1)
+    flat = flat.at[flat.shape[0] - 1].set(np.nan)
+    return flat.reshape(arr.shape)
+
+
+def fault_point(site: str, value: Any = None) -> Any:
+    """The per-site injection hook (see module docstring).
+
+    Returns ``value`` unchanged on the overwhelmingly common path; an
+    armed ``error`` fault raises :class:`InjectedFault`, ``latency``
+    sleeps, ``nonfinite`` returns a poisoned copy of ``value``."""
+    if not _settings.resil:
+        return value
+    if site not in CATALOG:
+        raise ValueError(
+            f"fault_point({site!r}): site not in catalog "
+            f"(tools/check_fault_sites.py should have caught this)")
+    if not _arms:
+        return value
+    arm = _arms.get(site)
+    if arm is None:
+        return value
+    if not _trace_clean():
+        _obs.inc("resil.fault.trace_skipped")
+        return value
+    with _lock:
+        # Re-read under the lock (clear() may have raced the fast path).
+        arm = _arms.get(site)
+        if arm is None:
+            return value
+        arm.calls += 1
+        fire = (arm.calls > arm.after and arm.fired < arm.count
+                and (arm.p >= 1.0
+                     or _lcg01(arm.seed, arm.calls) < arm.p))
+        if fire:
+            arm.fired += 1
+            ordinal = arm.fired
+            kind = arm.kind
+            latency_ms = arm.latency_ms
+    if not fire:
+        return value
+    _obs.inc("resil.fault.injected")
+    _obs.inc(f"resil.fault.{site}.injected")
+    _obs.event("resil.fault", site=site, kind=kind, ordinal=ordinal)
+    if kind == "error":
+        raise InjectedFault(site, ordinal)
+    if kind == "latency":
+        if latency_ms > 0:
+            time.sleep(latency_ms / 1e3)
+        return value
+    # nonfinite
+    if value is None:
+        return None
+    return _poison(value)

@@ -1,0 +1,94 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Typed outcomes of the resilience layer.
+
+A failure the layer could not absorb never surfaces as a silent NaN
+result, a dropped request, or a hang — it surfaces as one of these
+types, each carrying enough structure (site, iterations completed,
+partial residual/result) for the caller to decide between degrading,
+re-queueing, and reporting.
+
+- :class:`Rejected` — a request shed *before* dispatch (expired
+  deadline at the executor's admission or flush point).  It is a
+  **value**, not an exception: the executor resolves the request's
+  Future with it, because for serving traffic "not done, and here is
+  why" is a normal response, not a crash.
+- :class:`DeadlineExceeded` — a solve cut off *mid-flight* at one of
+  its host-sync points.  Raised, because the caller asked for a
+  converged solution and is not getting one; the exception carries the
+  partial iterate so a caller with laxer requirements can still use
+  it.
+- :class:`ResilienceError` — base class of every exception this layer
+  raises (``policy.CircuitOpenError`` and
+  ``health.SolverHealthError`` included), so one ``except`` clause
+  covers the whole contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class ResilienceError(RuntimeError):
+    """Base class of every exception the resilience layer raises."""
+
+
+class FinalOutcomeError(ResilienceError):
+    """A resilience *verdict* (deadline expired, health failure, open
+    breaker) as opposed to a retryable fault: ``policy.run`` re-raises
+    these immediately — retrying a deadline expiry would re-run a
+    whole solve past its deadline, and a verdict is not a site
+    failure, so it never feeds the breaker either."""
+
+
+@dataclass(frozen=True)
+class Rejected:
+    """A request shed before dispatch (typed outcome, not an error).
+
+    ``site`` is the shedding point (``engine.exec.queue`` for
+    admission, ``engine.exec.dispatch`` for a flush-time shed),
+    ``waited_ms`` how long the request sat in the queue before the
+    shed decision, ``deadline_ms`` the budget it arrived with."""
+
+    site: str
+    reason: str = "deadline"
+    waited_ms: float = 0.0
+    deadline_ms: Optional[float] = None
+
+
+class DeadlineExceeded(FinalOutcomeError):
+    """A solve ran out of deadline at a host-sync point.
+
+    ``iterations`` is the count completed when the deadline check
+    fired, ``residual`` the last observed residual norm (None when the
+    site had not fetched one yet), ``partial`` the best iterate so far
+    (a device array — no extra transfer was paid to raise this)."""
+
+    def __init__(self, site: str, iterations: int = 0,
+                 residual: Optional[float] = None,
+                 partial: Any = None):
+        self.site = site
+        self.iterations = int(iterations)
+        self.residual = residual
+        self.partial = partial
+        super().__init__(
+            f"deadline exceeded at {site} after {iterations} "
+            f"iterations"
+            + (f" (residual {residual:.3e})"
+               if isinstance(residual, float) else ""))
+
+
+@dataclass(frozen=True)
+class HealthReport:
+    """Structured description of an unhealthy solve (see
+    ``health.SolverHealthError``): which sync point saw it, why
+    (``non_finite`` / ``stagnation`` / ``divergence``), how far the
+    solve got, and the residual that triggered the verdict."""
+
+    site: str
+    cause: str
+    iterations: int
+    residual: Optional[float] = None
+    detail: str = ""
+    extra: dict = field(default_factory=dict)

@@ -77,6 +77,32 @@ Settings
       (process-global: it captures every XLA compile, not only engine
       plans — scope caveat in ``docs/ENGINE.md``).
 
+``resil`` (``LEGATE_SPARSE_TPU_RESIL``)
+    Resilience subsystem (``legate_sparse_tpu.resilience``,
+    ``docs/RESILIENCE.md``): fault injection, retry/backoff ladders,
+    circuit breakers, deadline propagation and load shedding for the
+    engine and distributed ops.  Off by default — every instrumented
+    site is then one flag read with zero behavior change.  Knobs (all
+    env-overridable, prefix ``LEGATE_SPARSE_TPU_RESIL_``):
+
+    - ``resil_retries`` (``_RETRIES``, 2): re-executions per failed
+      site call.
+    - ``resil_backoff_ms`` / ``resil_backoff_mult`` /
+      ``resil_backoff_max_ms`` (``_BACKOFF_MS``/``_BACKOFF_MULT``/
+      ``_BACKOFF_MAX_MS``): deterministic exponential backoff
+      schedule between retries.
+    - ``resil_retry_budget`` (``_RETRY_BUDGET``, 64): per-site
+      per-process cap on total retries (amplification bound).
+    - ``resil_breaker_k`` / ``resil_breaker_cooldown_ms``
+      (``_BREAKER_K``/``_BREAKER_COOLDOWN_MS``): consecutive failures
+      that trip a site's circuit breaker, and the open->half-open
+      cooldown.
+    - ``resil_health`` (``_HEALTH``): opt-in solver health detection
+      (non-finite / divergence / stagnation raised as structured
+      outcomes); ``resil_stagnation_cycles`` (``_STAGNATION_CYCLES``,
+      0 = off) and ``resil_divergence_mult`` (``_DIVERGENCE_MULT``)
+      tune it.
+
 Settings epoch
 --------------
 ``settings.epoch`` is a monotone counter bumped by every post-import
@@ -244,6 +270,44 @@ class Settings:
         self.engine_persist_dir: str = os.environ.get(
             "LEGATE_SPARSE_TPU_ENGINE_PERSIST", ""
         )
+        # ---- resilience (legate_sparse_tpu.resilience) ----
+        self.resil: bool = _env_bool("LEGATE_SPARSE_TPU_RESIL", False)
+        self.resil_retries: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_RETRIES", "2")
+        )
+        self.resil_backoff_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_BACKOFF_MS", "1.0")
+        )
+        self.resil_backoff_mult: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_BACKOFF_MULT",
+                           "2.0")
+        )
+        self.resil_backoff_max_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_BACKOFF_MAX_MS",
+                           "50.0")
+        )
+        self.resil_retry_budget: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_RETRY_BUDGET",
+                           "64")
+        )
+        self.resil_breaker_k: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_BREAKER_K", "3")
+        )
+        self.resil_breaker_cooldown_ms: float = float(
+            os.environ.get(
+                "LEGATE_SPARSE_TPU_RESIL_BREAKER_COOLDOWN_MS", "100.0")
+        )
+        self.resil_health: bool = _env_bool(
+            "LEGATE_SPARSE_TPU_RESIL_HEALTH", False
+        )
+        self.resil_stagnation_cycles: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_STAGNATION_CYCLES",
+                           "0")
+        )
+        self.resil_divergence_mult: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_DIVERGENCE_MULT",
+                           "1e8")
+        )
         # Settings epoch: compiled-plan cache keys include it, so any
         # later settings mutation (see __setattr__) invalidates plans.
         self._epoch: int = 0
@@ -260,6 +324,15 @@ class Settings:
         "obs", "engine", "engine_max_batch", "engine_queue_depth",
         "engine_batch_timeout_ms", "engine_plan_cache_size",
         "engine_persist_dir", "_epoch", "_init_done",
+        # Resilience knobs shape retries/breakers/deadlines — the
+        # request lifecycle around a dispatch, never what a plan
+        # lowers to; flipping them (tests and the bench drill do, per
+        # phase) must not void warmup() guarantees.
+        "resil", "resil_retries", "resil_backoff_ms",
+        "resil_backoff_mult", "resil_backoff_max_ms",
+        "resil_retry_budget", "resil_breaker_k",
+        "resil_breaker_cooldown_ms", "resil_health",
+        "resil_stagnation_cycles", "resil_divergence_mult",
     })
 
     def __setattr__(self, name: str, value) -> None:

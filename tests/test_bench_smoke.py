@@ -21,12 +21,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 
 # Deterministic fields only: timings vary per machine, but the static
-# comm predictions, the mesh width, the schema — and the engine
-# phase's plan-cache hit/miss counts (a fixed call sequence against a
-# fresh engine) — do not.
+# comm predictions, the mesh width, the schema — the engine phase's
+# plan-cache hit/miss counts (a fixed call sequence against a fresh
+# engine) — and the resilience drill's exact fault/retry/shed/trip
+# accounting do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
                  "engine_plan_hits,engine_plan_misses,"
-                 "engine_batch_requests")
+                 "engine_batch_requests,"
+                 "resil_retries,resil_shed,resil_breaker_trips,"
+                 "resil_faults_injected")
 
 
 def _tool(name):
@@ -141,6 +144,39 @@ def test_smoke_engine_phase_numbers(smoke_run):
     assert result["engine_batch_requests"] == 8
     assert result["engine_plan_misses"] == 2
     assert result["engine_plan_hits"] == 6
+
+
+def test_smoke_resil_phase_numbers(smoke_run):
+    """ISSUE 5 acceptance: the smoke lane runs the deterministic
+    resilience drill — exactly 2 retries (fail-twice-then-recover on
+    csr.dot), 1 breaker trip (K=3 consecutive failures), 1 shed
+    request (expired-deadline submit), 5 injected faults (2 + 3) —
+    and records the recovered-vs-clean latency pair."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 9
+    assert result["resil_retries"] == 2
+    assert result["resil_breaker_trips"] == 1
+    assert result["resil_shed"] == 1
+    assert result["resil_faults_injected"] == 5
+    assert result["resil_clean_ms"] > 0
+    assert result["resil_recovered_ms"] > 0
+
+
+def test_smoke_trace_has_resil_ledger(smoke_run, capsys):
+    """The trace artifact carries the resil.* counters and
+    ``trace_summary --resil`` renders the per-site ledger."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("resil.retry.csr.dot", 0) == 2
+    assert ctrs.get("resil.breaker.csr.dot.trips", 0) == 1
+    assert ctrs.get("resil.shed", 0) == 1
+    rc = _tool("trace_summary").main([str(trace_path), "--resil"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "resilience ledger:" in out
+    assert "csr.dot" in out
+    assert "shedding: 1 requests shed" in out
 
 
 def test_smoke_trace_has_engine_plans(smoke_run, capsys):

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Static fault-site coverage check (tier-1 via tests/test_resilience).
+
+Injection coverage rots silently: a refactor that renames or drops a
+``fault_point("...")`` call leaves the catalog advertising a site that
+no longer exists, and the drills that "cover" it keep passing because
+they arm a hook nobody calls.  This pass makes the three views of the
+site list — the code's literals, ``resilience.faults.CATALOG``, and
+the ``docs/RESILIENCE.md`` site table — agree, and fails on any drift:
+
+1. every site literal passed to ``fault_point(`` / ``guarded_call(`` /
+   ``policy.run(`` in ``legate_sparse_tpu/`` must be in the catalog
+   (no unregistered sites);
+2. every catalog site must appear as a quoted literal somewhere in
+   the package OUTSIDE the catalog's own module (no orphaned catalog
+   entries — the rot case; ``faults.py`` itself is excluded because
+   the catalog defines every site as a quoted literal there, which
+   would make this rule unfalsifiable);
+3. every catalog site must appear in ``docs/RESILIENCE.md`` (the
+   operator-facing list stays complete).
+
+Usage::
+
+    python tools/check_fault_sites.py          # check, exit 0/1
+    python tools/check_fault_sites.py --list   # print the catalog
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+from legate_sparse_tpu.resilience.faults import CATALOG  # noqa: E402
+
+PKG_DIR = os.path.join(_REPO, "legate_sparse_tpu")
+DOC_PATH = os.path.join(_REPO, "docs", "RESILIENCE.md")
+
+# A quoted dotted lowercase name passed as the first argument of one
+# of the site-taking entry points.  ``\brun\(`` deliberately also
+# matches ``policy.run(``/``_rpolicy.run(``; the dotted-name shape
+# keeps unrelated ``run(`` calls (subprocess etc.) out.
+SITE_CALL_RE = re.compile(
+    r"(?:fault_point|guarded_call|_resil_guarded|\brun)\(\s*\n?\s*"
+    r"[\"']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)[\"']")
+
+
+def _py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def collect_call_sites(root: str = PKG_DIR):
+    """{site: [relpath, ...]} for every site literal at an entry
+    point, plus {site: count} of raw quoted occurrences anywhere."""
+    calls = {}
+    quoted = {}
+    for path in _py_files(root):
+        with open(path) as f:
+            text = f.read()
+        rel = os.path.relpath(path, _REPO)
+        for site in SITE_CALL_RE.findall(text):
+            calls.setdefault(site, []).append(rel)
+        if rel.replace(os.sep, "/") == (
+                "legate_sparse_tpu/resilience/faults.py"):
+            # The catalog's own module quotes every site by
+            # definition; counting it would make orphan detection
+            # (rule 2) unable to ever fire.
+            continue
+        for site in CATALOG:
+            if f'"{site}"' in text or f"'{site}'" in text:
+                quoted[site] = quoted.get(site, 0) + 1
+    return calls, quoted
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Cross-check fault-point call sites against the "
+                    "resilience catalog and docs.")
+    ap.add_argument("--list", action="store_true",
+                    help="print the catalog with call-site locations")
+    args = ap.parse_args(argv)
+
+    calls, quoted = collect_call_sites()
+    problems = []
+
+    unregistered = sorted(set(calls) - set(CATALOG))
+    for site in unregistered:
+        problems.append(
+            f"call site uses unregistered name {site!r} "
+            f"(in {', '.join(sorted(set(calls[site])))}) — add it to "
+            f"resilience.faults.CATALOG")
+
+    orphaned = sorted(s for s in CATALOG if not quoted.get(s))
+    for site in orphaned:
+        problems.append(
+            f"catalog site {site!r} has NO call-site literal in the "
+            f"package — injection coverage rotted")
+
+    try:
+        with open(DOC_PATH) as f:
+            doc = f.read()
+    except OSError as e:
+        doc = ""
+        problems.append(f"docs/RESILIENCE.md unreadable: {e}")
+    undocumented = sorted(s for s in CATALOG if s not in doc)
+    for site in undocumented:
+        problems.append(
+            f"catalog site {site!r} missing from docs/RESILIENCE.md")
+
+    if args.list:
+        width = max(len(s) for s in CATALOG)
+        for site in sorted(CATALOG):
+            where = ", ".join(sorted(set(calls.get(site, [])))) or "-"
+            print(f"{site.ljust(width)}  {where}")
+
+    if problems:
+        for p in problems:
+            print(f"check_fault_sites: {p}", file=sys.stderr)
+        print(f"check_fault_sites: FAILED ({len(problems)} problem(s))",
+              file=sys.stderr)
+        return 1
+    if not args.list:
+        print(f"check_fault_sites: OK — {len(CATALOG)} sites, all "
+              f"wired and documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

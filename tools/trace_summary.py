@@ -17,6 +17,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --events --counters
     python tools/trace_summary.py run.trace.json --comm
     python tools/trace_summary.py run.trace.json --plans
+    python tools/trace_summary.py run.trace.json --resil
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
 file's bench metadata when present (bench.py embeds its result blob).
@@ -92,6 +93,10 @@ def main(argv=None) -> int:
                     help="also render the engine plan-cache table "
                          "(per-plan builds/hits/execs + executor "
                          "batching totals from the engine.* counters)")
+    ap.add_argument("--resil", action="store_true",
+                    help="also render the resilience ledger (per-site "
+                         "faults/retries/breaker activity, shedding, "
+                         "health verdicts from the resil.* counters)")
     args = ap.parse_args(argv)
 
     records = report.load_records(args.trace_file)
@@ -140,6 +145,10 @@ def main(argv=None) -> int:
     if args.plans:
         print("\nengine plans:")
         print(report.render_plans_table(meta.get("counters") or {}))
+
+    if args.resil:
+        print("\nresilience ledger:")
+        print(report.render_resil_table(meta.get("counters") or {}))
     return 0
 
 
