@@ -71,6 +71,17 @@ exact ``resil_retries``/``resil_shed``/``resil_breaker_trips``/
 recovered-vs-clean latency pair ``resil_clean_ms``/
 ``resil_recovered_ms``.
 
+Saturation phase (schema_version 10, obs v3 —
+``docs/OBSERVABILITY.md``): a closed-loop arrival generator sweeps
+offered load (concurrent closed-loop clients) against the
+micro-batching request executor, recording per level the p50/p99
+request latency (from the always-on ``lat.engine.request.*``
+histograms), throughput, shed count, and mean batch occupancy
+(``saturation`` list + top-level ``saturation_p50_ms``/
+``saturation_p99_ms``), plus the golden-gated deterministic totals
+``saturation_requests``/``saturation_shed``/
+``saturation_batched_requests``.
+
 Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
 writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
 override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
@@ -572,8 +583,15 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # resilience phase (docs/RESILIENCE.md): deterministic fault drill
 # recording golden-gated resil_retries / resil_shed /
 # resil_breaker_trips / resil_faults_injected + the recovered-vs-clean
-# latency pair resil_clean_ms / resil_recovered_ms.
-SCHEMA_VERSION = 9
+# latency pair resil_clean_ms / resil_recovered_ms.  10 = saturation
+# phase (obs v3, docs/OBSERVABILITY.md): closed-loop offered-load
+# sweep against the request executor — per-level p50/p99 latency,
+# shed count, mean batch occupancy and throughput in ``saturation``,
+# top-level ``saturation_p50_ms``/``saturation_p99_ms`` (highest
+# level) and the golden-gated deterministic totals
+# ``saturation_requests`` / ``saturation_shed`` /
+# ``saturation_batched_requests``.
+SCHEMA_VERSION = 10
 
 
 def main() -> None:
@@ -1274,6 +1292,152 @@ def main() -> None:
                     _resil.reset()
         except Exception as e:
             sys.stderr.write(f"bench: resil phase failed: {e!r}\n")
+
+    # Saturation phase (schema_version 10, obs v3): offered load vs
+    # the request executor — the p50/p99-vs-load curve ROADMAP item 1
+    # (the serving gateway) is judged by.  A closed-loop arrival
+    # generator (``clients`` threads, each submit -> wait -> resubmit)
+    # sweeps concurrency levels against one executor; per level the
+    # always-on ``lat.engine.request.*`` histograms yield p50/p99 and
+    # the counter deltas yield throughput and mean batch occupancy.
+    # SpMM plans for every batch width are warmed first, so the curve
+    # measures queueing + dispatch, not compiles.  Totals are
+    # deterministic given the fixed sweep (request count, occupancy
+    # total = every request batched exactly once, and one
+    # deadline-shed drill request), so the smoke golden pins
+    # ``saturation_requests`` / ``saturation_shed`` /
+    # ``saturation_batched_requests``; per-level timings stay
+    # informational (thread-timing dependent).
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_SATURATION",
+                           "0") != "1")
+            and not past_deadline(result, "saturation")):
+        try:
+            import threading as _threading
+            import time as _time
+
+            from legate_sparse_tpu.engine import Engine as _SEngine
+            from legate_sparse_tpu.engine import \
+                RequestExecutor as _SExecutor
+            from legate_sparse_tpu.obs import latency as _lat_s
+            from legate_sparse_tpu.resilience import deadline as _sdl
+            from legate_sparse_tpu.settings import settings as _sst
+
+            n_s = (1 << 12 if smoke else 1 << 16) - 73
+            levels = [1, 2, 4, 8] if smoke else [1, 2, 4, 8, 16]
+            per_client = 4 if smoke else 8
+            with obs.span("bench.saturation") as _sp:
+                A_s = _engine_config(sparse, n_s, nnz_per_row)
+                x_s = jnp.ones((n_s,), jnp.float32)
+                eng_s = _SEngine()
+                ex_s = _SExecutor(eng_s, max_batch=8, queue_depth=64,
+                                  timeout_ms=0.5)
+                # Pre-compile every plan the sweep can hit (spmv for
+                # width-1 flushes, spmm per pow2 batch width): the
+                # latency curve must measure queueing + dispatch, not
+                # XLA compiles.  The whole sweep runs under
+                # try/finally: a failed level or drill must not leak
+                # the executor (daemon worker + anchored matrix) into
+                # the phases that follow.
+                try:
+                    eng_s.warmup(
+                        [{"op": "spmv", "rows": n_s, "nnz": A_s.nnz}]
+                        + [{"op": "spmm", "rows": n_s,
+                            "nnz": A_s.nnz, "k": k} for k in levels
+                           if 1 < k <= 8])  # widths cap at max_batch=8
+                    _ = np.asarray(ex_s.submit(A_s, x_s).result(
+                        timeout=60))     # pack build outside the sweep
+                    c0 = {k: obs.counters.get(k) for k in (
+                        "engine.exec.outcome.resolved",
+                        "engine.exec.batched_requests",
+                        "resil.shed")}
+                    sat_levels = []
+                    for clients in levels:
+                        _lat_s.reset("lat.engine.request")
+                        b0_breq = obs.counters.get(
+                            "engine.exec.batched_requests")
+                        b0_bat = obs.counters.get(
+                            "engine.exec.batches")
+                        errors = []
+
+                        def _client():
+                            try:
+                                for _r in range(per_client):
+                                    f = ex_s.submit(A_s, x_s)
+                                    _ = np.asarray(
+                                        f.result(timeout=120))
+                            except Exception as e:  # raised after join
+                                errors.append(e)
+
+                        t0 = _time.perf_counter()
+                        ts = [_threading.Thread(target=_client)
+                              for _c in range(clients)]
+                        for t in ts:
+                            t.start()
+                        for t in ts:
+                            t.join()
+                        wall = _time.perf_counter() - t0
+                        if errors:
+                            raise errors[0]
+                        merged = None
+                        for h in _lat_s.snapshot(
+                                "lat.engine.request").values():
+                            merged = (h if merged is None
+                                      else merged.merge(h))
+                        d_bat = (obs.counters.get(
+                            "engine.exec.batches") - b0_bat)
+                        d_breq = (obs.counters.get(
+                            "engine.exec.batched_requests") - b0_breq)
+                        reqs = clients * per_client
+                        sat_levels.append({
+                            "clients": clients,
+                            "requests": reqs,
+                            "p50_ms": round(merged.quantile(0.5), 4),
+                            "p99_ms": round(merged.quantile(0.99), 4),
+                            "throughput_rps": round(
+                                reqs / max(wall, 1e-9), 1),
+                            "mean_batch_occupancy": round(
+                                d_breq / max(d_bat, 1), 2),
+                            "shed": 0,   # no deadlines in the sweep
+                        })
+                    # Deadline-shed drill: one pre-expired request
+                    # proves the shed path records its wait and the
+                    # shed total moves — deterministic (+1),
+                    # golden-gated.
+                    saved_res = _sst.resil
+                    try:
+                        _sst.resil = True
+                        with _sdl.scope(0.0):
+                            fut = ex_s.submit(A_s, x_s)
+                        out_shed = fut.result(timeout=10)
+                        if type(out_shed).__name__ != "Rejected":
+                            raise RuntimeError(
+                                f"expected Rejected outcome, got "
+                                f"{type(out_shed).__name__}")
+                    finally:
+                        _sst.resil = saved_res
+                finally:
+                    # A failed level/drill must not leak the executor
+                    # (daemon worker + anchored 65k-row matrix) into
+                    # the phases that follow.
+                    ex_s.shutdown()
+                result["saturation"] = sat_levels
+                result["saturation_requests"] = int(
+                    obs.counters.get("engine.exec.outcome.resolved")
+                    - c0["engine.exec.outcome.resolved"])
+                result["saturation_shed"] = int(
+                    obs.counters.get("resil.shed") - c0["resil.shed"])
+                result["saturation_batched_requests"] = int(
+                    obs.counters.get("engine.exec.batched_requests")
+                    - c0["engine.exec.batched_requests"])
+                result["saturation_p50_ms"] = sat_levels[-1]["p50_ms"]
+                result["saturation_p99_ms"] = sat_levels[-1]["p99_ms"]
+                if _sp is not None:
+                    _sp.set(levels=len(levels),
+                            requests=result["saturation_requests"],
+                            p99_ms=result["saturation_p99_ms"])
+        except Exception as e:
+            sys.stderr.write(f"bench: saturation phase failed: {e!r}\n")
 
     # Non-toy scale anchors (VERDICT r4 weak #6): one 1e6-row CG and
     # one 4096^2 pde datapoint, recorded REGARDLESS of tunnel state so

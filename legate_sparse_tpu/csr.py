@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from scipy.sparse import SparseEfficiencyWarning
 
 from . import obs as _obs
+from .obs import latency as _lat
 from .engine import route_matmat as _engine_route_matmat
 from .engine import route_matvec as _engine_route_matvec
 from .resilience import faults as _rfaults
@@ -1203,7 +1204,13 @@ class csr_array(CompressedBase, DenseSparseBase):
             _obs.inc("op.spmv")
             A, x = cast_to_common_type(self, other_arr)
             src = self if A is self else None
-            with _obs.span("spmv") as sp:
+            # Always-on dispatch-latency histogram, keyed by the pow2
+            # shape bucket (obs/latency.py): the distribution the
+            # autotuner/serving arc consult — spans only exist while
+            # tracing is enabled.
+            with _lat.timer("lat.spmv."
+                            + _lat.shape_bucket(self.shape[0])), \
+                    _obs.span("spmv") as sp:
                 if src is not None:
                     # Engine route (settings.engine): bucketed plan
                     # dispatch with zero retraces under n/nnz drift.
@@ -1280,7 +1287,9 @@ class csr_array(CompressedBase, DenseSparseBase):
             _obs.inc("op.spmm")
             A, X = cast_to_common_type(self, other_arr)
             src = self if A is self else None
-            with _obs.span("spmm") as sp:
+            with _lat.timer("lat.spmm."
+                            + _lat.shape_bucket(self.shape[0])), \
+                    _obs.span("spmm") as sp:
                 if src is not None:
                     Y = _engine_route_matmat(src, X)
                     if Y is not None:
@@ -1817,8 +1826,9 @@ def spgemm_csr_csr_csr(A: csr_array, B: csr_array) -> csr_array:
     from .settings import settings
 
     _obs.inc("op.spgemm")
-    with _obs.span("spgemm", m=m, k=k, n=n, nnz_a=A.nnz,
-                   nnz_b=B.nnz) as sp:
+    with _lat.timer("lat.spgemm." + _lat.shape_bucket(m)), \
+            _obs.span("spgemm", m=m, k=k, n=n, nnz_a=A.nnz,
+                      nnz_b=B.nnz) as sp:
         dia_a = A._get_dia()
         dia_b = B._get_dia() if dia_a is not None else None
         if (

@@ -47,11 +47,29 @@ Counters: ``engine.exec.submitted`` / ``.batches`` /
 ``.batched_requests`` / ``.inline`` / ``.backpressure`` /
 ``.queue_ns``; each dispatch records an ``engine.batch`` span with the
 plan id and batch width.
+
+Request lifecycle telemetry (obs v3, docs/OBSERVABILITY.md): every
+submit gets a process-unique request id and timestamped transitions —
+submit(=queued) -> batched (popped from the queue into a dispatch
+group) -> dispatched -> resolved/shed/inline/fallback/error/rejected.
+At
+resolution the request emits ONE ``engine.request`` span (cross-thread
+complete-span: start at submit, duration = full lifetime) carrying the
+decomposition as attrs (``queue_ms`` wait-for-batch, ``batch_ms``
+pop-to-dispatch-start, ``dispatch_ms`` dispatch-to-result), an
+``engine.exec.outcome.<outcome>`` counter, and the always-on
+histograms ``lat.engine.wait.<outcome>`` (queue wait — recorded for
+EVERY outcome, so the shed and served wait distributions are
+comparable) plus ``lat.engine.request.<shape-bucket>`` (end-to-end
+latency; resolved, inline- and fallback-served requests).
+``lat.engine.batch_occupancy`` records the width of every dispatched
+batch.
 """
 
 from __future__ import annotations
 
 import atexit
+import itertools
 import threading
 import time
 import weakref
@@ -59,6 +77,8 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs as _obs
+from ..obs import latency as _latency
+from ..obs import trace as _trace
 from ..resilience import deadline as _rdeadline
 from ..resilience import faults as _rfaults
 from ..resilience import outcomes as _routcomes
@@ -94,19 +114,65 @@ def _install_exit_hook_once() -> None:
         atexit.register(_drain_live_executors)
 
 
+# Process-unique request ids (itertools.count: next() is GIL-atomic).
+_REQUEST_IDS = itertools.count(1)
+
+
 class _Request:
-    __slots__ = ("A", "x", "future", "t_ns", "deadline")
+    __slots__ = ("A", "x", "future", "rid", "t_ns", "t_popped",
+                 "deadline", "_finished")
 
     def __init__(self, A, x):
         self.A = A
         self.x = x
         self.future: Future = Future()
+        self.rid = next(_REQUEST_IDS)
         self.t_ns = time.perf_counter_ns()
+        # Stamped when the request is popped from the queue into a
+        # dispatch group ("batched"); None when it never queued
+        # (inline service, admission shed, rejection).
+        self.t_popped: Optional[int] = None
+        self._finished = False
         # Captured at submit time from the SUBMITTING thread's scope:
         # the worker thread dispatching later sheds against the
         # request's own budget, not its own (absent) scope.
         self.deadline = (_rdeadline.current() if _rsettings.resil
                          else None)
+
+    def finish(self, outcome: str, t_dispatch: Optional[int] = None,
+               batch_k: int = 0) -> None:
+        """Close the lifecycle ledger for this request — exactly once,
+        whatever path resolved it.  ``queue_ms`` is submit -> popped
+        (for never-queued outcomes: submit -> now, the full wait),
+        ``batch_ms`` popped -> dispatch-body start, ``dispatch_ms``
+        dispatch start -> result."""
+        if self._finished:
+            return
+        self._finished = True
+        now = time.perf_counter_ns()
+        t_pop = self.t_popped if self.t_popped is not None else now
+        queue_ms = (t_pop - self.t_ns) / 1e6
+        batch_ms = ((t_dispatch - t_pop) / 1e6
+                    if t_dispatch is not None else 0.0)
+        dispatch_ms = ((now - t_dispatch) / 1e6
+                       if t_dispatch is not None else 0.0)
+        _obs.inc(f"engine.exec.outcome.{outcome}")
+        # Queue wait for EVERY outcome (the shed-vs-served wait
+        # comparison the shedder is judged by); end-to-end latency by
+        # shape bucket for requests that produced a result.
+        _latency.observe(f"lat.engine.wait.{outcome}", queue_ms)
+        if outcome in ("resolved", "inline", "fallback"):
+            _latency.observe(
+                "lat.engine.request."
+                + _latency.shape_bucket(self.A.shape[0]),
+                (now - self.t_ns) / 1e6)
+        _trace.complete_span(
+            "engine.request", self.t_ns, now - self.t_ns,
+            rid=self.rid, outcome=outcome,
+            queue_ms=round(queue_ms, 4),
+            batch_ms=round(batch_ms, 4),
+            dispatch_ms=round(dispatch_ms, 4),
+            batch_k=batch_k)
 
     def shed(self, site: str) -> None:
         """Resolve with the typed Rejected outcome (never dispatched)."""
@@ -115,6 +181,7 @@ class _Request:
         _obs.inc(f"resil.shed.{site}")
         _obs.event("resil.shed", site=site,
                    waited_ms=round(waited_ms, 3))
+        self.finish("shed")
         self.future.set_result(_routcomes.Rejected(
             site=site, reason="deadline", waited_ms=waited_ms,
             deadline_ms=(self.deadline.total_ms
@@ -208,6 +275,7 @@ class RequestExecutor:
                 # Checked under the lock: a submit racing shutdown()
                 # must either land before the final flush or raise —
                 # never enqueue into a drained queue (orphaned future).
+                req.finish("rejected")
                 raise RuntimeError("executor is shut down")
             if self._pending >= self.queue_depth:
                 # Bounded queue without a deadlockable wait: the
@@ -225,6 +293,7 @@ class RequestExecutor:
                 self._groups.pop(token)
                 self._anchors.pop(token)
                 self._pending -= len(group)
+                self._stamp_popped(group)
                 to_dispatch.append((A, group))
             elif self.timeout_ms > 0:
                 self._ensure_worker_locked()
@@ -274,6 +343,14 @@ class RequestExecutor:
 
     # ---------------- internals ----------------
 
+    @staticmethod
+    def _stamp_popped(group: List[_Request]) -> None:
+        """Lifecycle transition queued -> batched: the group just left
+        the queue as one dispatch unit."""
+        now = time.perf_counter_ns()
+        for r in group:
+            r.t_popped = now
+
     def _pop_largest_locked(self):
         if not self._groups:
             return None
@@ -281,6 +358,7 @@ class RequestExecutor:
         group = self._groups.pop(token)
         A = self._anchors.pop(token)
         self._pending -= len(group)
+        self._stamp_popped(group)
         return A, group
 
     def _pop_oldest_locked(self):
@@ -291,6 +369,7 @@ class RequestExecutor:
         group = self._groups.pop(token)
         A = self._anchors.pop(token)
         self._pending -= len(group)
+        self._stamp_popped(group)
         return A, group
 
     def _pop_expired_locked(self, now_ns: int):
@@ -299,6 +378,7 @@ class RequestExecutor:
         for token in [t for t, g in self._groups.items()
                       if now_ns - g[0].t_ns >= limit]:
             group = self._groups.pop(token)
+            self._stamp_popped(group)
             ready.append((self._anchors.pop(token), group))
             self._pending -= len(group)
         return ready
@@ -327,10 +407,25 @@ class RequestExecutor:
             for A, group in ready:
                 self._dispatch(A, group)
 
-    def _resolve_inline(self, req: _Request) -> None:
+    def _resolve_inline(self, req: _Request,
+                        outcome: str = "inline") -> None:
+        # Inline service still decomposes: wait ends HERE (the request
+        # leaves the queue path), service time is the dispatch leg —
+        # lat.engine.wait.inline must stay comparable to the shed/
+        # resolved wait distributions, not absorb A.dot's runtime.
+        # ``outcome`` distinguishes never-queued inline service
+        # ("inline", ~0 wait) from a queued-and-batched request served
+        # here after its batch dispatch failed ("fallback", real
+        # queue wait) — conflating them would corrupt the ledger.
+        t0 = time.perf_counter_ns()
+        if req.t_popped is None:
+            req.t_popped = t0
         try:
-            req.future.set_result(req.A.dot(req.x))
+            y = req.A.dot(req.x)
+            req.finish(outcome, t_dispatch=t0)
+            req.future.set_result(y)
         except BaseException as e:   # noqa: BLE001 - future contract
+            req.finish("error", t_dispatch=t0)
             req.future.set_exception(e)
 
     def _dispatch(self, A, group: List[_Request]) -> None:
@@ -362,6 +457,7 @@ class RequestExecutor:
         _obs.inc("engine.exec.batches")
         _obs.inc("engine.exec.batched_requests", k)
         _obs.inc("engine.exec.queue_ns", queue_ns)
+        _latency.observe("lat.engine.batch_occupancy", k)
         try:
             with _obs.span("engine.batch", reqs=k, rows=A.shape[0],
                            nnz=A.nnz) as sp:
@@ -371,6 +467,8 @@ class RequestExecutor:
                 if k == 1:
                     y = self._engine.matvec(A, group[0].x,
                                             _checked=True)
+                    group[0].finish("resolved", t_dispatch=t_disp,
+                                    batch_k=1)
                     group[0].future.set_result(y)
                     if sp is not None:
                         sp.set(path="spmv")
@@ -382,6 +480,7 @@ class RequestExecutor:
                 if sp is not None:
                     sp.set(path="spmm", k=k)
                 for i, r in enumerate(group):
+                    r.finish("resolved", t_dispatch=t_disp, batch_k=k)
                     r.future.set_result(Y[:, i])
         except Exception:
             # Engine-side failure (e.g. a cached plan-build error):
@@ -392,8 +491,9 @@ class RequestExecutor:
             _obs.inc("engine.exec.dispatch_fallback")
             for r in group:
                 if not r.future.done():
-                    self._resolve_inline(r)
+                    self._resolve_inline(r, outcome="fallback")
         except BaseException as e:   # noqa: BLE001 - deliver, don't die
             for r in group:
                 if not r.future.done():
+                    r.finish("error")
                     r.future.set_exception(e)

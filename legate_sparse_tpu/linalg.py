@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from . import obs as _obs
+from .obs import latency as _lat
 from .resilience import deadline as _rdeadline
 from .resilience import faults as _rfaults
 from .resilience import health as _rhealth
@@ -459,7 +460,11 @@ def _cg_loop_resil(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
             out, stats = chunk_fn(state, limit)
             return out, _rfaults.fault_point(site, stats)
 
-        state, stats = _rpolicy.run(site, attempt)
+        # Per-chunk cadence latency (dispatch + the convergence fetch
+        # below is timed separately — the chunk IS the cadence unit).
+        with _lat.timer("lat.cg.chunk."
+                        + _lat.shape_bucket(b.shape[0])):
+            state, stats = _rpolicy.run(site, attempt)
         # The chunk's one host sync — the same fetch the convergence
         # decision needs (counted like gmres's cadence counter).
         _obs.inc("transfer.host_sync.cg_conv")
@@ -516,7 +521,8 @@ def cg(
 
     _obs.inc("op.cg")
     if callback is None:
-        with _obs.span("cg", n=n, maxiter=int(maxiter)) as sp:
+        with _lat.timer("lat.cg.solve." + _lat.shape_bucket(n)), \
+                _obs.span("cg", n=n, maxiter=int(maxiter)) as sp:
             loop = (_cg_loop_resil if _resil_solver_active()
                     else _cg_loop)
             xs, iters = loop(
@@ -741,7 +747,9 @@ def gmres(
             _rdeadline.raise_if_expired("solver.gmres.conv",
                                         iterations=iters,
                                         residual=resid_f, partial=x)
-        with _obs.span("gmres.cycle", restart=restart, iters_done=iters):
+        with _lat.timer("lat.gmres.cycle." + _lat.shape_bucket(n)), \
+                _obs.span("gmres.cycle", restart=restart,
+                          iters_done=iters):
             if resil:
                 def _cycle_guarded(x=x):
                     xn, st = cycle(x, b)
@@ -896,7 +904,9 @@ def bicgstab(
               else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
     _obs.inc("op.bicgstab")
     if callback is None:
-        with _obs.span("bicgstab", n=n, maxiter=int(maxiter)) as sp:
+        with _lat.timer("lat.bicgstab.solve."
+                        + _lat.shape_bucket(n)), \
+                _obs.span("bicgstab", n=n, maxiter=int(maxiter)) as sp:
             xs, iters = _bicgstab_loop(
                 A_op.matvec, M_op.matvec, b, x0_arr, atol, int(maxiter),
                 int(conv_test_iters),

@@ -3,7 +3,7 @@
 """legate_sparse_tpu.obs: observability — op-level tracing, counters,
 and structured perf evidence.
 
-Six pieces (see each module's docstring for the design contract):
+Eight pieces (see each module's docstring for the design contract):
 
 - ``trace``    — near-zero-overhead spans (``with obs.span("spmv",
                  nnz=...)``) recording wall time + first-call vs
@@ -16,9 +16,17 @@ Six pieces (see each module's docstring for the design contract):
                  hot-loop sites.
 - ``report``   — aggregation into a per-op table with achieved GB/s
                  against the measured stream roofline.
+- ``latency``  — always-on streaming latency histograms (``lat.*``):
+                 mergeable fixed-log2-bucket distributions with a
+                 documented quantile error bound, written through the
+                 same lock-free per-thread-handle pattern as counters.
 - ``comm``     — the communication ledger: per-collective interconnect
                  byte predictions from static shard shapes, recorded
                  as ``comm.*`` counters and solver-span attrs.
+- ``export``   — OpenMetrics/Prometheus text rendering of all counters
+                 and histograms (``snapshot_openmetrics()`` /
+                 ``write_openmetrics``; ``LEGATE_SPARSE_TPU_OBS_PROM``
+                 arms an atexit snapshot-to-file).
 - ``memory``   — phase memory watermarks (``mem.*`` events: RSS,
                  device stats, optional tracemalloc peaks).
 - ``regress``  — the bench-trajectory regression gate behind
@@ -37,23 +45,30 @@ Disabled (the default) the span API is a no-op returning a shared
 null context manager; counters stay live either way.
 """
 
-from . import comm, counters, memory, regress, report, trace  # noqa: F401
+from . import (  # noqa: F401
+    comm, counters, export, latency, memory, regress, report, trace,
+)
 from .counters import inc, snapshot  # noqa: F401
+from .export import snapshot_openmetrics, write_openmetrics  # noqa: F401
+from .latency import observe  # noqa: F401
 from .trace import (  # noqa: F401
     disable, enable, enabled, event, records, reset, span,
     to_chrome_trace, write_chrome_trace, write_jsonl,
 )
 
 __all__ = [
-    "comm", "counters", "memory", "regress", "report", "trace",
-    "inc", "snapshot",
+    "comm", "counters", "export", "latency", "memory", "regress",
+    "report", "trace",
+    "inc", "snapshot", "observe",
+    "snapshot_openmetrics", "write_openmetrics",
     "enable", "disable", "enabled", "event", "records", "reset", "span",
     "to_chrome_trace", "write_chrome_trace", "write_jsonl",
 ]
 
 
 def reset_all() -> None:
-    """Convenience: drop buffered trace records AND zero counters
-    (test isolation / between bench phases)."""
+    """Convenience: drop buffered trace records AND zero counters and
+    histograms (test isolation / between bench phases)."""
     trace.reset()
     counters.reset()
+    latency.reset()

@@ -1,0 +1,146 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""OpenMetrics / Prometheus text export of counters and histograms.
+
+The serving gateway (ROADMAP item 1) needs scrapeable metrics; this
+module makes every always-on ``counters.*`` value and every
+``latency.*`` histogram renderable as OpenMetrics text with zero
+instrumentation changes — the exposition layer is a pure read of the
+snapshots the package already maintains.
+
+Two metric families, name-labelled (one family per kind keeps the
+family set closed while the counter/histogram name space stays open):
+
+- ``legate_sparse_tpu_counter_total{name="op.spmv"} 42`` — every
+  counter, rendered as an OpenMetrics counter sample.
+- ``legate_sparse_tpu_latency{name="lat.spmv.n4096", ...}`` — every
+  histogram as a classic cumulative-bucket histogram (``_bucket`` with
+  ascending ``le`` boundaries ending in ``+Inf``, plus ``_sum`` and
+  ``_count``).  Bucket boundaries are the fixed log2 grid of
+  :mod:`.latency`; only occupied buckets are emitted (cumulative
+  counts stay correct — an absent boundary merges into the next one).
+
+API::
+
+    from legate_sparse_tpu import obs
+    text = obs.export.snapshot_openmetrics()     # the exposition text
+    obs.export.write_openmetrics("metrics.prom") # snapshot-to-file
+
+``LEGATE_SPARSE_TPU_OBS_PROM=<path>`` arms an atexit snapshot-to-file
+(best effort — a failed write must never mask the process's real
+exit), so any run can leave a scrapeable artifact behind without code
+changes; long-lived servers call ``write_openmetrics`` on their scrape
+path instead.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Dict, Optional
+
+from . import counters as _counters
+from . import latency as _latency
+
+ENV_PROM_FILE = "LEGATE_SPARSE_TPU_OBS_PROM"
+
+_PREFIX = "legate_sparse_tpu"
+
+
+def _escape_label(value: str) -> str:
+    """OpenMetrics label-value escaping: backslash, quote, newline."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v) -> str:
+    """Sample value: integers render bare (counter totals), floats in
+    repr precision (no scientific-notation surprises for small ms)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_openmetrics(
+        counters_snap: Optional[Dict] = None,
+        histograms: Optional[Dict[str, "_latency.Histogram"]] = None,
+) -> str:
+    """Render the given (or live) snapshots as OpenMetrics text,
+    ``# EOF`` terminated.  Deterministic: families and samples are
+    name-sorted."""
+    if counters_snap is None:
+        counters_snap = _counters.snapshot()
+    if histograms is None:
+        histograms = _latency.snapshot()
+    lines = []
+
+    lines.append(f"# TYPE {_PREFIX}_counter counter")
+    lines.append(f"# HELP {_PREFIX}_counter Always-on process counters"
+                 " (docs/OBSERVABILITY.md naming contract).")
+    for name in sorted(counters_snap):
+        lines.append(
+            f'{_PREFIX}_counter_total{{name="{_escape_label(name)}"}} '
+            f"{_fmt_value(counters_snap[name])}")
+
+    lines.append(f"# TYPE {_PREFIX}_latency histogram")
+    lines.append(f"# HELP {_PREFIX}_latency Streaming log2-bucket"
+                 " histograms (obs/latency.py; ms unless the name says"
+                 " otherwise).")
+    for name in sorted(histograms):
+        hist = histograms[name]
+        label = _escape_label(name)
+        acc = 0
+        for slot, count in hist.nonzero_buckets():
+            acc += count
+            le = _latency.slot_upper(slot)
+            lines.append(
+                f'{_PREFIX}_latency_bucket{{name="{label}",'
+                f'le="{_fmt_value(le)}"}} {acc}')
+        lines.append(
+            f'{_PREFIX}_latency_bucket{{name="{label}",le="+Inf"}} '
+            f"{acc}")
+        lines.append(f'{_PREFIX}_latency_sum{{name="{label}"}} '
+                     f"{_fmt_value(hist.sum)}")
+        lines.append(f'{_PREFIX}_latency_count{{name="{label}"}} '
+                     f"{acc}")
+
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot_openmetrics() -> str:
+    """Live snapshot of all counters + histograms as OpenMetrics text
+    (the scrape-path API)."""
+    return render_openmetrics()
+
+
+def write_openmetrics(path: Optional[str] = None) -> str:
+    """Write the live snapshot to ``path`` (default: the
+    ``LEGATE_SPARSE_TPU_OBS_PROM`` env value).  Returns the path."""
+    if path is None:
+        path = os.environ.get(ENV_PROM_FILE)
+    if not path:
+        raise ValueError(
+            f"write_openmetrics: no path given and {ENV_PROM_FILE} "
+            f"is unset")
+    text = snapshot_openmetrics()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)       # atomic vs a concurrent scraper read
+    return path
+
+
+def _atexit_snapshot() -> None:  # pragma: no cover - exercised via env
+    try:
+        write_openmetrics()
+    except Exception:
+        # Best effort by contract: a failed metrics write must never
+        # mask the process's real exit status.
+        pass
+
+
+if os.environ.get(ENV_PROM_FILE):
+    atexit.register(_atexit_snapshot)

@@ -249,6 +249,46 @@ def render_plans_table(counters: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_latency_table(histograms: Dict[str, Any]) -> str:
+    """Histogram ledger table from serialized ``lat.*`` histograms
+    (the ``histograms`` blob in a Chrome-trace artifact, or
+    ``{name: h.to_dict()}`` from a live ``latency.snapshot()``):
+    count / mean / p50 / p95 / p99 / max per op and shape bucket.
+    ``tools/trace_summary.py --latency`` renders this."""
+    from . import latency as _latency
+
+    if not histograms:
+        return ("no latency histograms recorded "
+                "(no instrumented ops ran?)")
+    rows = []
+    for name in sorted(histograms):
+        try:
+            h = _latency.Histogram.from_dict(name, histograms[name])
+        except ValueError:
+            # Artifact from a build with a different bucket grid
+            # (SUB): the distribution is unreadable, not zero.
+            rows.append([name, "?"] + ["(incompatible grid)"]
+                        + ["-"] * 4)
+            continue
+        if h.count == 0:
+            continue
+        rows.append([
+            name,
+            str(h.count),
+            _fmt(h.mean, "{:.4f}"),
+            _fmt(h.quantile(0.5), "{:.4f}"),
+            _fmt(h.quantile(0.95), "{:.4f}"),
+            _fmt(h.quantile(0.99), "{:.4f}"),
+            _fmt(h.max(), "{:.4f}"),
+        ])
+    if not rows:
+        return ("no latency histograms recorded "
+                "(no instrumented ops ran?)")
+    return format_table(
+        ["histogram", "count", "mean", "p50", "p95", "p99", "max"],
+        rows)
+
+
 # Aggregate resil.retry.* counter names that are NOT per-site rollups.
 _RESIL_RETRY_AGG = ("attempts", "exhausted", "backoff_ms",
                     "budget_exhausted")

@@ -201,6 +201,36 @@ def span(name: str, **attrs: Any):
     return Span(name, attrs)
 
 
+def complete_span(name: str, start_ns: int, dur_ns: int,
+                  **attrs: Any) -> None:
+    """Record an externally-timed span — a lifecycle that starts in
+    one thread and ends in another (the executor's ``engine.request``
+    spans), where a context manager can't bracket it.  Takes the same
+    per-name sequence slot and buffer-cap treatment as ``Span``;
+    ``depth`` is 0 (cross-thread lifecycles have no nesting stack)."""
+    if not _enabled:
+        return
+    with _lock:
+        seq = _seq_by_name.get(name, 0)
+        _seq_by_name[name] = seq + 1
+        if len(_records) >= MAX_RECORDS:
+            _counters.inc("obs.dropped_records")
+            return
+        rec: Dict[str, Any] = {
+            "type": "span",
+            "name": name,
+            "ts_ns": int(start_ns),
+            "dur_ns": int(dur_ns),
+            "depth": 0,
+            "seq": seq,
+            "first": seq == 0,
+            "tid": threading.get_ident(),
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        _records.append(rec)
+
+
 def event(name: str, **attrs: Any) -> None:
     """Record an instant (zero-duration) structured event — e.g. an
     accelerator-probe failure, a collective-realization decline."""
@@ -281,8 +311,15 @@ def to_chrome_trace(extra_metadata: Optional[Dict[str, Any]] = None
         if args:
             ev["args"] = args
         trace_events.append(ev)
+    from . import latency as _latency
+
     meta: Dict[str, Any] = {
         "counters": _counters.snapshot(),
+        # Sparse serialized histograms (obs/latency.py): the artifact
+        # carries the full distributions, so tools/trace_summary.py
+        # --latency renders p50/p95/p99 from the file alone.
+        "histograms": {name: h.to_dict()
+                       for name, h in _latency.snapshot().items()},
         "format": "legate_sparse_tpu.obs/1",
     }
     if extra_metadata:

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..obs import latency as _lat
 from ..engine import engine_enabled as _engine_enabled
 from ..engine import get_engine as _get_engine
 from ..resilience import guarded_call as _resil_guarded
@@ -952,10 +953,12 @@ def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
     )
     comm_bytes = _comm.record("dist_spmv", vols)
 
-    with _obs.span("dist_spmv", shards=A.num_shards, halo=halo,
-                   comm_bytes=comm_bytes,
-                   comm_calls=sum(1 for b in vols.values() if b > 0)
-                   ) as sp:
+    with _lat.timer("lat.dist_spmv."
+                    + _lat.shape_bucket(A.shape[0])), \
+            _obs.span("dist_spmv", shards=A.num_shards, halo=halo,
+                      comm_bytes=comm_bytes,
+                      comm_calls=sum(1 for b in vols.values() if b > 0)
+                      ) as sp:
         if A.dia_data is not None and halo >= 0 and not precise:
             # Banded fast path: halo exchange + static shifted-adds,
             # zero gathers (per-shard analog of ``ops.dia_ops.dia_spmv``).
@@ -1659,9 +1662,10 @@ def dist_cg(
 
     item = jnp.dtype(b_sh.dtype).itemsize
     if callback is None:
-        with _obs.span("dist_cg", n=rows, shards=A.num_shards,
-                       maxiter=int(maxiter),
-                       preconditioned=M is not None) as sp, \
+        with _lat.timer("lat.dist_cg.solve." + _lat.shape_bucket(rows)), \
+                _obs.span("dist_cg", n=rows, shards=A.num_shards,
+                          maxiter=int(maxiter),
+                          preconditioned=M is not None) as sp, \
                 _mem.watermark("dist_cg", n=rows, shards=A.num_shards):
             # Resilience: the whole loop dispatch is the ``dist.cg``
             # site — an injected (or real) collective failure retries

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..obs import latency as _lat
 from ..types import index_dtype
 from ._compat import shard_map
 from jax.sharding import PartitionSpec as P
@@ -827,10 +828,11 @@ def _dist_spgemm_impl(A: DistCSR, B: DistCSR) -> DistCSR:
         predicted_window_bytes=(_comm.total(win_vols)
                                 if win_vols is not None else None),
     )
-    with _obs.span("dist_spgemm", shards=R, m=m, n=n_cols,
-                   b_realization=realization,
-                   b_plan=b_plan, comm_bytes=comm_bytes,
-                   comm_calls=comm_calls) as sp:
+    with _lat.timer("lat.dist_spgemm." + _lat.shape_bucket(m)), \
+            _obs.span("dist_spgemm", shards=R, m=m, n=n_cols,
+                      b_realization=realization,
+                      b_plan=b_plan, comm_bytes=comm_bytes,
+                      comm_calls=comm_calls) as sp:
         return _dist_spgemm_phases(
             A, B, mesh, la, lb, plan, a_arrays, b_arrays, first_dev,
             rps, m, n_cols, col_dtype, R, sp,

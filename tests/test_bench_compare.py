@@ -5,25 +5,19 @@ tools/bench_compare.py): noise bands from the recorded stream spread,
 nonzero exit on synthetic regressions, a clean pass on the real
 archived round pair, and the trajectory table."""
 
-import importlib.util
 import json
 import os
 
 import pytest
 
 from legate_sparse_tpu.obs import regress
+from utils_test.tools import load_tool
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _tool():
-    spec = importlib.util.spec_from_file_location(
-        "bench_compare",
-        os.path.join(REPO, "tools", "bench_compare.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return load_tool("bench_compare")
 
 
 def _base(**over):

@@ -9,7 +9,6 @@ synthetically regressed copy.  This is the CI teeth of the obs v2
 tentpole: the wiring can no longer silently no-op between capture
 rounds."""
 
-import importlib.util
 import json
 import os
 import subprocess
@@ -23,23 +22,20 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # Deterministic fields only: timings vary per machine, but the static
 # comm predictions, the mesh width, the schema — the engine phase's
 # plan-cache hit/miss counts (a fixed call sequence against a fresh
-# engine) — and the resilience drill's exact fault/retry/shed/trip
-# accounting do not.
+# engine) — the resilience drill's exact fault/retry/shed/trip
+# accounting, and the saturation sweep's totals (fixed request plan;
+# every request batches exactly once; one deterministic shed drill)
+# do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
                  "engine_plan_hits,engine_plan_misses,"
                  "engine_batch_requests,"
                  "resil_retries,resil_shed,resil_breaker_trips,"
-                 "resil_faults_injected")
+                 "resil_faults_injected,"
+                 "saturation_requests,saturation_shed,"
+                 "saturation_batched_requests")
 
 
-def _tool(name):
-    """Import a tools/ CLI in-process (a subprocess would re-import
-    the whole package — seconds of suite wall time for nothing)."""
-    spec = importlib.util.spec_from_file_location(
-        name, os.path.join(REPO, "tools", f"{name}.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from utils_test.tools import load_tool as _tool
 
 
 @pytest.fixture(scope="module")
@@ -170,13 +166,59 @@ def test_smoke_trace_has_resil_ledger(smoke_run, capsys):
     ctrs = doc["otherData"]["counters"]
     assert ctrs.get("resil.retry.csr.dot", 0) == 2
     assert ctrs.get("resil.breaker.csr.dot.trips", 0) == 1
-    assert ctrs.get("resil.shed", 0) == 1
+    # Process total: 1 from the resil drill + 1 from the saturation
+    # phase's deadline-shed drill (each phase's own delta stays 1).
+    assert ctrs.get("resil.shed", 0) == 2
     rc = _tool("trace_summary").main([str(trace_path), "--resil"])
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "resilience ledger:" in out
     assert "csr.dot" in out
-    assert "shedding: 1 requests shed" in out
+    assert "shedding: 2 requests shed" in out
+
+
+def test_smoke_saturation_phase_numbers(smoke_run):
+    """ISSUE 6 acceptance: the smoke lane records the saturation sweep
+    — per load level p50/p99 latency, shed count, mean batch occupancy
+    — and the deterministic totals the golden pins: 60 requests
+    ((1+2+4+8) clients x 4 closed-loop requests each), every one
+    batched exactly once, plus the 1 deadline-shed drill request."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 10
+    levels = result["saturation"]
+    assert [lv["clients"] for lv in levels] == [1, 2, 4, 8]
+    for lv in levels:
+        assert lv["requests"] == lv["clients"] * 4
+        assert lv["p50_ms"] > 0
+        assert lv["p99_ms"] >= lv["p50_ms"]
+        assert lv["throughput_rps"] > 0
+        assert lv["mean_batch_occupancy"] >= 1.0
+        assert lv["shed"] == 0
+    assert result["saturation_requests"] == 60
+    assert result["saturation_batched_requests"] == 60
+    assert result["saturation_shed"] == 1
+    assert result["saturation_p99_ms"] >= result["saturation_p50_ms"]
+
+
+def test_smoke_trace_has_latency_histograms(smoke_run, capsys):
+    """The trace artifact embeds the lat.* histogram ledger (request
+    lifecycle + per-op dispatch latencies) and ``trace_summary
+    --latency`` renders it."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    hists = doc["otherData"].get("histograms") or {}
+    assert any(k.startswith("lat.engine.request.") and v["count"] > 0
+               for k, v in hists.items()), sorted(hists)
+    assert any(k.startswith("lat.engine.wait.") for k in hists)
+    assert any(k.startswith("lat.dist_spmv.") and v["count"] > 0
+               for k, v in hists.items()), sorted(hists)
+    occ = hists.get("lat.engine.batch_occupancy")
+    assert occ is not None and occ["count"] > 0
+    rc = _tool("trace_summary").main([str(trace_path), "--latency"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "latency histograms:" in out
+    assert "lat.engine.request." in out
 
 
 def test_smoke_trace_has_engine_plans(smoke_run, capsys):

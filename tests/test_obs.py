@@ -5,13 +5,14 @@ span recording/nesting, counters, disabled-mode no-op contract, export
 formats, per-op aggregation, and the wiring into the hot paths."""
 
 import json
+import re
 
 import numpy as np
 import pytest
 
 import legate_sparse_tpu as sparse
 from legate_sparse_tpu import obs
-from legate_sparse_tpu.obs import counters, report, trace
+from legate_sparse_tpu.obs import counters, latency, report, trace
 
 
 @pytest.fixture(autouse=True)
@@ -277,9 +278,6 @@ def test_jit_retrace_counter_counts_compiles_not_calls():
 
 
 def test_trace_summary_tool_renders_table(tmp_path, capsys):
-    import importlib.util
-    import os
-
     trace.enable()
     A = _banded()
     _ = A @ np.ones(A.shape[0], np.float32)
@@ -287,13 +285,7 @@ def test_trace_summary_tool_renders_table(tmp_path, capsys):
     path = tmp_path / "t.trace.json"
     obs.write_chrome_trace(str(path))
 
-    spec = importlib.util.spec_from_file_location(
-        "trace_summary",
-        os.path.join(os.path.dirname(__file__), "..", "tools",
-                     "trace_summary.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _tool("trace_summary")
     rc = mod.main([str(path)])
     out = capsys.readouterr().out
     assert rc == 0
@@ -325,3 +317,267 @@ def test_buffer_cap_drops_and_counts(monkeypatch):
             pass
     assert len(obs.records()) == 2
     assert counters.get("obs.dropped_records") == 2
+
+
+# ----------------------------------------------------- obs v3: latency --
+from utils_test.tools import load_tool as _tool
+
+
+def _heavy_row_csr(n=300, seed=0):
+    """Engine-eligible matrix (random columns + one heavy row defeat
+    the DIA/ELL/BSR structure fast paths on every platform)."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    S = sp.random(n, n, density=0.03, format="csr", random_state=rng,
+                  dtype=np.float32)
+    S = (S + sp.eye(n, format="csr", dtype=np.float32)).tocsr()
+    heavy = sp.csr_matrix(
+        (np.ones(n, np.float32), (np.zeros(n, np.int64),
+                                  np.arange(n))), shape=(n, n))
+    S = (S + heavy).tocsr()
+    return sparse.csr_array(
+        (S.data.astype(np.float32), S.indices, S.indptr), shape=S.shape)
+
+
+def test_dot_records_latency_histogram_per_shape_bucket():
+    A = _banded(48)           # bucket n64
+    x = np.ones(48, np.float32)
+    latency.reset("lat.")
+    for _ in range(5):
+        _ = np.asarray(A @ x)
+    hist = latency.get("lat.spmv.n64")
+    assert hist is not None and hist.count == 5
+    assert hist.quantile(0.5) > 0
+    # spmm keyed by the same bucket
+    _ = np.asarray(A @ np.ones((48, 3), np.float32))
+    assert latency.get("lat.spmm.n64").count == 1
+
+
+def test_latency_histograms_add_zero_sync_to_hot_path():
+    """Acceptance pin (mirrors the resilience inertness test):
+    steady-state dots with obs ON move latency histograms but leave
+    every trace.* (compile) and transfer.* (host-sync) counter
+    untouched — the recording is pure host-side arithmetic."""
+    trace.enable()
+    A = _banded(64)
+    x = np.ones(64, np.float32)
+    _ = np.asarray(A @ x)                  # warm compile
+    latency.reset("lat.")
+    before = {k: v for k, v in counters.snapshot().items()
+              if k.startswith("trace.") or k.startswith("transfer.")}
+    for _ in range(10):
+        _ = np.asarray(A @ x)
+    after = {k: v for k, v in counters.snapshot().items()
+             if k.startswith("trace.") or k.startswith("transfer.")}
+    assert after == before, "histogram traffic moved a sync counter"
+    assert latency.get("lat.spmv.n64").count == 10
+
+
+def test_solver_latency_histograms_recorded():
+    A = _banded(96)
+    b = np.ones(96, np.float32)
+    latency.reset("lat.")
+    _x, _it = sparse.linalg.cg(A, b, maxiter=10)
+    assert latency.get("lat.cg.solve.n128").count == 1
+    _x, _it = sparse.linalg.gmres(A, b, restart=5, maxiter=10)
+    h = latency.get("lat.gmres.cycle.n128")
+    assert h is not None and h.count >= 1
+
+
+def test_chrome_trace_embeds_histograms_and_summary_renders(
+        tmp_path, capsys):
+    trace.enable()
+    A = _banded()
+    _ = A @ np.ones(A.shape[0], np.float32)
+    path = tmp_path / "lat.trace.json"
+    obs.write_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    hists = doc["otherData"]["histograms"]
+    ser = hists.get("lat.spmv.n32")       # _banded() is n=32
+    assert ser is not None, sorted(hists)
+    assert ser["count"] >= 1 and ser["buckets"]
+
+    mod = _tool("trace_summary")
+    rc = mod.main([str(path), "--latency"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "latency histograms:" in out
+    assert "lat.spmv." in out and "p99" in out
+
+
+# ------------------------------------------- obs v3: request lifecycle --
+def test_engine_request_lifecycle_spans_and_wait_histograms():
+    from legate_sparse_tpu.engine import Engine, RequestExecutor
+
+    trace.enable()
+    latency.reset("lat.engine.")
+    counters.reset("engine.exec.outcome.")
+    A = _heavy_row_csr()
+    x = np.ones(A.shape[1], np.float32)
+    ex = RequestExecutor(Engine(), max_batch=4, queue_depth=64,
+                         timeout_ms=0)
+    try:
+        futs = [ex.submit(A, x) for _ in range(4)]   # max-batch
+        extra = ex.submit(A, x)
+        ex.flush()                                   # k=1 dispatch
+        for f in futs + [extra]:
+            _ = np.asarray(f.result(timeout=60))
+    finally:
+        ex.shutdown()
+    recs = [r for r in obs.records() if r["name"] == "engine.request"]
+    assert len(recs) == 5
+    rids = [r["attrs"]["rid"] for r in recs]
+    assert len(set(rids)) == 5, "request ids must be unique"
+    for r in recs:
+        at = r["attrs"]
+        assert at["outcome"] == "resolved"
+        assert at["queue_ms"] >= 0 and at["batch_ms"] >= 0
+        assert at["dispatch_ms"] > 0
+        assert r["dur_ns"] > 0
+    ks = sorted(r["attrs"]["batch_k"] for r in recs)
+    assert ks == [1, 4, 4, 4, 4]
+    assert counters.get("engine.exec.outcome.resolved") == 5
+    assert latency.get("lat.engine.wait.resolved").count == 5
+    occ = latency.get("lat.engine.batch_occupancy")
+    assert occ.count == 2 and occ.sum == pytest.approx(5.0)
+    req_hists = latency.snapshot("lat.engine.request.")
+    assert sum(h.count for h in req_hists.values()) == 5
+
+
+def test_engine_request_inline_and_shed_record_waits():
+    """Satellite pin: EVERY outcome records its wait — the inline
+    (ineligible-matrix) path and the shed path, not just shed."""
+    from legate_sparse_tpu.engine import Engine, RequestExecutor
+    from legate_sparse_tpu.resilience import deadline as rdeadline
+    from legate_sparse_tpu.settings import settings
+
+    trace.enable()
+    latency.reset("lat.engine.")
+    counters.reset("engine.exec.outcome.")
+    A_banded = _banded(64)            # DIA fast path -> inline service
+    x = np.ones(64, np.float32)
+    ex = RequestExecutor(Engine(), max_batch=4, queue_depth=64,
+                         timeout_ms=0)
+    try:
+        f = ex.submit(A_banded, x)
+        _ = np.asarray(f.result(timeout=60))
+        assert counters.get("engine.exec.outcome.inline") == 1
+        assert latency.get("lat.engine.wait.inline").count == 1
+
+        A_el = _heavy_row_csr(seed=2)
+        x_el = np.ones(A_el.shape[1], np.float32)
+        saved = settings.resil
+        try:
+            settings.resil = True
+            with rdeadline.scope(0.0):
+                fut = ex.submit(A_el, x_el)
+            out = fut.result(timeout=10)
+            assert type(out).__name__ == "Rejected"
+            assert out.waited_ms >= 0
+        finally:
+            settings.resil = saved
+        assert counters.get("engine.exec.outcome.shed") == 1
+        assert latency.get("lat.engine.wait.shed").count == 1
+    finally:
+        ex.shutdown()
+    outs = {r["attrs"]["outcome"]
+            for r in obs.records() if r["name"] == "engine.request"}
+    assert outs == {"inline", "shed"}
+
+
+# ------------------------------------------------ obs v3: OpenMetrics --
+def test_openmetrics_snapshot_parses_minimal_format():
+    """The exposition text must satisfy a minimal OpenMetrics parse:
+    valid sample syntax, counter samples ending in _total, histogram
+    bucket series cumulative with ascending le ending at +Inf ==
+    _count, terminated by # EOF."""
+    counters.reset("omt.")
+    latency.reset("lat.omt.")
+    counters.inc("omt.calls", 3)
+    for v in (0.5, 1.5, 1.5, 200.0, 0.0):
+        latency.observe("lat.omt.demo", v)
+    text = obs.snapshot_openmetrics()
+    assert text.endswith("# EOF\n")
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(?:\{([a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*)\})? '
+        r'(\S+)$')
+    buckets = {}
+    sums = {}
+    cnts = {}
+    seen_counter = False
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (TYPE|HELP|EOF)", line), line
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        metric, labels, value = m.groups()
+        labels = dict(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                 labels or ""))
+        if metric == "legate_sparse_tpu_counter_total":
+            seen_counter = True
+            if labels.get("name") == "omt.calls":
+                assert float(value) == 3
+        elif metric == "legate_sparse_tpu_latency_bucket":
+            buckets.setdefault(labels["name"], []).append(
+                (labels["le"], float(value)))
+        elif metric == "legate_sparse_tpu_latency_sum":
+            sums[labels["name"]] = float(value)
+        elif metric == "legate_sparse_tpu_latency_count":
+            cnts[labels["name"]] = float(value)
+    assert seen_counter
+    assert "lat.omt.demo" in buckets
+    series = buckets["lat.omt.demo"]
+    assert series[-1][0] == "+Inf"
+    les = [float(le) for le, _c in series[:-1]]
+    assert les == sorted(les), "le boundaries must ascend"
+    vals = [c for _le, c in series]
+    assert vals == sorted(vals), "bucket counts must be cumulative"
+    assert series[-1][1] == cnts["lat.omt.demo"] == 5
+    assert sums["lat.omt.demo"] == pytest.approx(203.5)
+    counters.reset("omt.")
+    latency.reset("lat.omt.")
+
+
+def test_write_openmetrics_to_file_and_env(tmp_path, monkeypatch):
+    from legate_sparse_tpu.obs import export
+
+    counters.inc("omt.file", 1)
+    p = tmp_path / "metrics.prom"
+    out = export.write_openmetrics(str(p))
+    assert out == str(p)
+    text = p.read_text()
+    assert text.endswith("# EOF\n")
+    assert 'name="omt.file"' in text
+    # env-default path
+    monkeypatch.setenv(export.ENV_PROM_FILE, str(tmp_path / "e.prom"))
+    export.write_openmetrics()
+    assert (tmp_path / "e.prom").read_text().endswith("# EOF\n")
+    with monkeypatch.context() as mc:
+        mc.delenv(export.ENV_PROM_FILE)
+        with pytest.raises(ValueError):
+            export.write_openmetrics()
+    counters.reset("omt.")
+
+
+# ------------------------------------------ obs v3: docs coverage gate --
+def test_check_obs_docs_passes(capsys):
+    rc = _tool("check_obs_docs").main([])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_check_obs_docs_catches_rot(tmp_path, capsys, monkeypatch):
+    """An undocumented emission literal must fail the pass — that is
+    the rot the tool exists to catch."""
+    mod = _tool("check_obs_docs")
+    rogue = tmp_path / "rogue.py"
+    rogue.write_text('_obs.inc("zz.totally_undocumented")\n')
+    monkeypatch.setattr(mod, "PKG_DIR", str(tmp_path))
+    rc = mod.main([])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "zz.totally_undocumented" in out.err

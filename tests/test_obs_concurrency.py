@@ -1,9 +1,12 @@
 # Copyright 2026.
 # SPDX-License-Identifier: Apache-2.0
 """Obs under threads: counter monotonicity, tear-free snapshots, the
-per-thread buffered handles (lock-free hot path), and span nesting
-integrity while threaded distributed ops run on the virtual mesh."""
+per-thread buffered handles (lock-free hot path), streaming latency
+histograms (exact totals, tear-free merges, quantile error bound), and
+span nesting integrity while threaded distributed ops run on the
+virtual mesh."""
 
+import math
 import threading
 import time
 
@@ -14,7 +17,7 @@ import jax
 
 import legate_sparse_tpu as sparse
 from legate_sparse_tpu import obs
-from legate_sparse_tpu.obs import counters, trace
+from legate_sparse_tpu.obs import counters, latency, trace
 from legate_sparse_tpu.parallel import make_row_mesh, shard_csr
 from legate_sparse_tpu.parallel.dist_csr import dist_spmv, shard_vector
 
@@ -173,6 +176,184 @@ def test_dead_thread_handles_fold_and_compact():
     assert counters.get("cc.dead") == 15
     assert counters.snapshot("cc.")["cc.dead"] == 15
     counters.reset("cc.")
+
+
+# ----------------------------------------------------------- histograms --
+def _exact_quantile(sorted_vals, q):
+    """Nearest-rank comparator matching Histogram.quantile's rank."""
+    rank = max(1, min(len(sorted_vals),
+                      math.ceil(q * len(sorted_vals))))
+    return sorted_vals[rank - 1]
+
+
+def test_histogram_concurrent_observe_exact_totals():
+    """One lock-free handle per thread feeding one histogram: the
+    merged count AND sum must be exact — no lost observations."""
+    N, M = 8, 5000
+    latency.reset("hh.")
+    start = threading.Barrier(N)
+
+    def worker(i):
+        h = latency.handle("hh.total")
+        start.wait()
+        for k in range(M):
+            h.observe(1.0 + (k % 7))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hist = latency.get("hh.total")
+    assert hist.count == N * M
+    expected_sum = N * sum(1.0 + (k % 7) for k in range(M))
+    assert hist.sum == pytest.approx(expected_sum, rel=1e-12)
+    latency.reset("hh.")
+
+
+def test_histogram_snapshots_tear_free_and_monotone_under_writers():
+    """Concurrent merged snapshots must be monotone per histogram
+    (counts never go backwards — the tear-free/rebase contract) and
+    exact once the writers join.  NOTE: no cross-histogram ordering is
+    asserted — snapshot() only promises per-histogram consistency
+    (writers don't take the module lock, so a reader can observe y
+    ahead of x between its two per-handle reads)."""
+    N, M = 4, 3000
+    latency.reset("hh.")
+    start = threading.Barrier(N + 1)
+    done = threading.Event()
+
+    def writer():
+        hx = latency.handle("hh.x")
+        hy = latency.handle("hh.y")
+        start.wait()
+        for _ in range(M):
+            hx.observe(2.0)
+            hy.observe(2.0)
+
+    threads = [threading.Thread(target=writer) for _ in range(N)]
+    for t in threads:
+        t.start()
+
+    seen = []
+
+    def reader():
+        start.wait()
+        while not done.is_set() and len(seen) < 2000:
+            snap = latency.snapshot("hh.")
+            seen.append((snap["hh.x"].count if "hh.x" in snap else 0,
+                         snap["hh.y"].count if "hh.y" in snap else 0))
+            time.sleep(0)
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    for t in threads:
+        t.join()
+    done.set()
+    rt.join()
+
+    assert latency.get("hh.x").count == N * M
+    assert latency.get("hh.y").count == N * M
+    prev = (0, 0)
+    for x, y in seen:
+        assert x >= prev[0] and y >= prev[1], "count went backwards"
+        prev = (x, y)
+    latency.reset("hh.")
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_histogram_quantile_error_bound_fuzzed(dtype):
+    """Quantile estimates must stay within the documented REL_ERR of
+    exact nearest-rank sorted quantiles, on log-uniform fuzzed samples
+    spanning the full 1..1e6 range (f32 and f64 sources)."""
+    rng = np.random.default_rng(42)
+    latency.reset("hh.")
+    for trial in range(3):
+        latency.reset("hh.fuzz")
+        vals = np.exp(rng.uniform(np.log(1.0), np.log(1e6),
+                                  size=4000)).astype(dtype)
+        h = latency.handle("hh.fuzz")
+        for v in vals:
+            h.observe(float(v))
+        hist = latency.get("hh.fuzz")
+        assert hist.count == len(vals)
+        svals = sorted(float(v) for v in vals)
+        for q in (0.05, 0.5, 0.9, 0.95, 0.99, 1.0):
+            est = hist.quantile(q)
+            exact = _exact_quantile(svals, q)
+            err = abs(est - exact) / exact
+            assert err <= latency.REL_ERR * (1 + 1e-6), (
+                dtype, trial, q, est, exact, err)
+        # max() is an upper bound within one bucket of the true max.
+        assert hist.max() >= svals[-1]
+        assert hist.max() <= svals[-1] * 2 ** (1.0 / latency.SUB)
+    latency.reset("hh.")
+
+
+def test_histogram_reset_rebases_and_merge_adds():
+    latency.reset("hh.")
+    h = latency.handle("hh.rebase")
+    for v in (1.0, 2.0, 4.0):
+        h.observe(v)
+    assert latency.get("hh.rebase").count == 3
+    latency.reset("hh.")
+    assert latency.get("hh.rebase").count == 0
+    h.observe(8.0)
+    hist = latency.get("hh.rebase")
+    assert hist.count == 1
+    assert hist.sum == pytest.approx(8.0)
+    # merge: counts and sums add, quantiles follow the merged mass.
+    latency.observe("hh.other", 8.0)
+    merged = hist.merge(latency.get("hh.other"))
+    assert merged.count == 2
+    assert merged.sum == pytest.approx(16.0)
+    assert merged.quantile(1.0) == pytest.approx(
+        8.0, rel=latency.REL_ERR * (1 + 1e-6))
+    latency.reset("hh.")
+
+
+def test_histogram_zero_and_serialization_roundtrip():
+    latency.reset("hh.")
+    h = latency.handle("hh.zero")
+    h.observe(0.0)
+    h.observe(-1.0)          # zero bucket, contributes 0 to the sum
+    h.observe(3.0)
+    hist = latency.get("hh.zero")
+    assert hist.count == 3
+    assert hist.sum == pytest.approx(3.0)
+    assert hist.quantile(0.1) == 0.0       # zero bucket reports 0.0
+    rt = latency.Histogram.from_dict("hh.zero", hist.to_dict())
+    assert rt.count == hist.count
+    assert rt.sum == pytest.approx(hist.sum)
+    assert rt.quantile(0.99) == hist.quantile(0.99)
+    latency.reset("hh.")
+
+
+def test_histogram_dead_thread_handles_fold_and_compact():
+    """Observations from finished threads must survive compaction —
+    the same leak bound as counters.Handle."""
+    latency.reset("hh.")
+
+    def short_lived():
+        latency.handle("hh.dead").observe(4.0)
+
+    threads = [threading.Thread(target=short_lived) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert latency.get("hh.dead").count == 5
+    with latency._lock:
+        before = sum(1 for h in latency._handles
+                     if h.name == "hh.dead")
+        latency._compact_locked()
+        after = sum(1 for h in latency._handles if h.name == "hh.dead")
+    assert before == 5 and after == 0
+    hist = latency.get("hh.dead")
+    assert hist.count == 5
+    assert hist.sum == pytest.approx(20.0)
+    latency.reset("hh.")
 
 
 # ---------------------------------------------------------------- spans --

@@ -1,0 +1,23 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Shared loader for the repo's ``tools/`` CLIs.
+
+Imports a tools/ script in-process (a subprocess would re-import the
+whole package — seconds of suite wall time for nothing).  One home
+instead of a per-test-file copy, so tool-loading changes happen once.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_tool(name):
+    """Import ``tools/<name>.py`` as a fresh module object."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

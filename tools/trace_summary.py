@@ -97,6 +97,11 @@ def main(argv=None) -> int:
                     help="also render the resilience ledger (per-site "
                          "faults/retries/breaker activity, shedding, "
                          "health verdicts from the resil.* counters)")
+    ap.add_argument("--latency", action="store_true",
+                    help="also render the latency-histogram ledger "
+                         "(count/p50/p95/p99/max per op and shape "
+                         "bucket from the lat.* histograms embedded "
+                         "in a Chrome-trace artifact)")
     args = ap.parse_args(argv)
 
     records = report.load_records(args.trace_file)
@@ -149,6 +154,10 @@ def main(argv=None) -> int:
     if args.resil:
         print("\nresilience ledger:")
         print(report.render_resil_table(meta.get("counters") or {}))
+
+    if args.latency:
+        print("\nlatency histograms:")
+        print(report.render_latency_table(meta.get("histograms") or {}))
     return 0
 
 
