@@ -82,6 +82,19 @@ histograms), throughput, shed count, and mean batch occupancy
 ``saturation_requests``/``saturation_shed``/
 ``saturation_batched_requests``.
 
+Autotune phase (schema_version 11, ``docs/AUTOTUNER.md``): the
+irregular-SpMV proof for the sparsity-fingerprint autotuner — a
+seeded power-law matrix (``gallery.powerlaw``) is tuned, one eager
+dispatch proves the verdict actually routes (``autotune.route.hits``
+delta), and the winning row-binned sliced-ELL kernel is timed against
+the flat CSR gather baseline: ``irregular_spmv_ms`` /
+``irregular_csr_ms`` / ``irregular_spmv_speedup`` (target >= 1.3x on
+the CPU lane) plus the routed-kernel label ``irregular_spmv_path``
+and the golden-gated deterministic ``autotune_verdicts``.  The smoke
+lane pins the verdict instead of measuring (deterministic golden);
+everything restores on exit — the autotuner stays inert for every
+other phase.
+
 Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
 writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
 override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
@@ -590,8 +603,12 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # top-level ``saturation_p50_ms``/``saturation_p99_ms`` (highest
 # level) and the golden-gated deterministic totals
 # ``saturation_requests`` / ``saturation_shed`` /
-# ``saturation_batched_requests``.
-SCHEMA_VERSION = 10
+# ``saturation_batched_requests``.  11 = autotune phase
+# (docs/AUTOTUNER.md): verdict-routed irregular SpMV on a seeded
+# power-law matrix — irregular_spmv_ms / irregular_csr_ms /
+# irregular_spmv_speedup / irregular_spmv_path + the golden-gated
+# autotune_verdicts.
+SCHEMA_VERSION = 11
 
 
 def main() -> None:
@@ -1438,6 +1455,94 @@ def main() -> None:
                             p99_ms=result["saturation_p99_ms"])
         except Exception as e:
             sys.stderr.write(f"bench: saturation phase failed: {e!r}\n")
+
+    # Autotune phase (schema_version 11, docs/AUTOTUNER.md): the
+    # irregular-SpMV speedup proof.  A seeded power-law matrix gets a
+    # sliced-ELL verdict (measured here in the full lane, PINNED in
+    # smoke so the golden stays deterministic), one eager dispatch
+    # proves the verdict routes (autotune.route.hits delta), and the
+    # routed kernel races the flat CSR gather baseline.  Settings and
+    # the process verdict store restore on exit: the autotuner must
+    # stay inert for every other phase.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_AUTOTUNE",
+                           "0") != "1")
+            and not past_deadline(result, "autotune")):
+        try:
+            from legate_sparse_tpu import autotune as _at
+            from legate_sparse_tpu import gallery as _gallery
+            from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+            from legate_sparse_tpu.ops import spmv as _at_spmv
+            from legate_sparse_tpu.settings import settings as _ast
+
+            n_at = 1 << 10 if smoke else 1 << 18
+            saved_at = _ast.autotune
+            with obs.span("bench.autotune") as _sp, \
+                    obs.memory.watermark("bench.autotune"):
+                try:
+                    _at.reset()
+                    _ast.autotune = True
+                    A_at = _gallery.powerlaw(
+                        n_at, nnz_per_row=4 if smoke else 8, rng=11)
+                    A_at.sum_duplicates()
+                    x_at = jnp.ones((n_at,), dtype=A_at.dtype)
+                    rec0 = obs.counters.get("autotune.verdict.records")
+                    if smoke:
+                        # Pinned verdict: no measurement, so the
+                        # golden totals stay exact.
+                        key_at = _at.key_for(A_at, "spmv")
+                        _at.get_store().record(key_at, "sliced-ell", {})
+                        label_at = "sliced-ell"
+                    else:
+                        verdict_at = _at.tune(A_at, x_at)
+                        label_at = verdict_at.label
+                    hits0 = obs.counters.get("autotune.route.hits")
+                    y_at = A_at @ x_at    # eager: the verdict routes
+                    _ = float(np.asarray(y_at[0]))
+                    if obs.counters.get("autotune.route.hits") <= hits0:
+                        raise RuntimeError(
+                            "autotune verdict did not route "
+                            "(decline ladder drifted?)")
+                    # Kernel race at honest iteration counts: routing
+                    # declines inside jitted loop bodies by design
+                    # (tracer contexts), so the proof times the routed
+                    # kernel and the CSR baseline directly.
+                    bins_at = A_at._get_sliced_ell()
+                    rid_at = A_at._get_row_ids()
+                    # deadline_s bounds escalation per kernel: on the
+                    # CPU lane the flat-CSR baseline runs seconds per
+                    # iteration at 1<<18, and this phase must not eat
+                    # the whole bench budget.
+                    k_hi_at = 4 if smoke else None
+                    sliced_ms = loop_ms_per_iter(
+                        lambda v: _at_spmv.sliced_ell_spmv(
+                            bins_at, v, n_at),
+                        x_at, k_lo=2 if smoke else 5, k_hi=k_hi_at,
+                        deadline_s=None if smoke else 90.0)
+                    csr_ms = loop_ms_per_iter(
+                        lambda v: _at_spmv.csr_spmv_rowids(
+                            A_at.data, A_at.indices, rid_at, v, n_at),
+                        x_at, k_lo=2 if smoke else 5, k_hi=k_hi_at,
+                        deadline_s=None if smoke else 90.0)
+                    result["irregular_spmv_n"] = n_at
+                    result["irregular_spmv_nnz"] = A_at.nnz
+                    result["irregular_spmv_ms"] = round(sliced_ms, 4)
+                    result["irregular_csr_ms"] = round(csr_ms, 4)
+                    result["irregular_spmv_speedup"] = round(
+                        csr_ms / max(sliced_ms, 1e-9), 2)
+                    result["irregular_spmv_path"] = label_at
+                    result["autotune_verdicts"] = int(
+                        obs.counters.get("autotune.verdict.records")
+                        - rec0)
+                    if _sp is not None:
+                        _sp.set(n=n_at, nnz=A_at.nnz, path=label_at,
+                                speedup=result[
+                                    "irregular_spmv_speedup"])
+                finally:
+                    _ast.autotune = saved_at
+                    _at.reset()
+        except Exception as e:
+            sys.stderr.write(f"bench: autotune phase failed: {e!r}\n")
 
     # Non-toy scale anchors (VERDICT r4 weak #6): one 1e6-row CG and
     # one 4096^2 pde datapoint, recorded REGARDLESS of tunnel state so

@@ -1,0 +1,151 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Sparsity-fingerprint autotuner: measured kernel selection.
+
+``legate_sparse_tpu`` carries several SpMV/SpMM kernel families
+(segment-sum vs rowids CSR, flat ELL, sliced ELL, DIA, BSR) picked by
+hardcoded thresholds.  This package replaces the threshold guesswork
+with measurement where it matters — the gather-class kernels whose
+ranking depends on structure the thresholds can't see:
+
+- :mod:`.fingerprint` — cheap deterministic structure descriptors,
+  cached on ``csr_array``, discretized into a class label;
+- :mod:`.registry` — the candidate-kernel catalog (cross-checked by
+  ``tools/check_kernel_registry.py``);
+- :mod:`.harness` — warmup + median-of-k candidate races;
+- :mod:`.store` — the verdict LRU with epoch/platform invalidation
+  and optional on-disk JSON warm start.
+
+Routing (``route_matvec`` / ``route_matmat``, consulted by
+``csr_array.dot`` right after the engine rung) serves a stored verdict
+or silently declines — tuning off (``LEGATE_SPARSE_TPU_AUTOTUNE``
+unset, the default), tracer contexts, dtype promotion, DIA/BSR
+structure, or a store miss all fall through to today's heuristics.
+The engine consults :func:`plan_preference` in its eligibility check
+and defers to any verdict naming a non-CSR kernel.
+
+Off is inert by contract: every dispatch site pays one settings
+attribute read and nothing else (pinned by ``tests/test_autotune.py``
+via the ``trace.*`` compile counters).  On, a routed dispatch runs the
+verdict's kernel exactly as a direct dispatch of that kernel would —
+bit-for-bit (same jitted entry point, same operands).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .. import obs as _obs
+from ..settings import settings as _settings_ref
+from .fingerprint import Fingerprint, compute_fingerprint  # noqa: F401
+from .harness import (  # noqa: F401
+    eligible_candidates, measure_candidates, time_kernel, tune,
+)
+from .registry import CANDIDATES, Candidate  # noqa: F401
+from .store import (  # noqa: F401
+    Verdict, VerdictKey, VerdictStore, key_for, platform_fingerprint,
+)
+
+_store: Optional[VerdictStore] = None
+_store_lock = threading.Lock()
+
+
+def get_store() -> VerdictStore:
+    """The process-wide verdict store (created on first use)."""
+    global _store
+    if _store is None:
+        with _store_lock:
+            if _store is None:
+                _store = VerdictStore()
+    return _store
+
+
+def reset() -> None:
+    """Drop the process store (tests / bench phase hygiene)."""
+    global _store
+    with _store_lock:
+        _store = None
+
+
+def autotune_enabled() -> bool:
+    """Fast routing check: one attribute read on the settings
+    singleton (the same inert-off contract as ``engine_enabled``)."""
+    return _settings_ref.autotune
+
+
+def route_matvec(A, x):
+    """Verdict-routed ``A @ x``: ``(y, path_label)`` or None (fall
+    through to the heuristic dispatch chain)."""
+    if not _settings_ref.autotune:
+        return None
+    return _route(A, x, "spmv")
+
+
+def route_matmat(A, X):
+    if not _settings_ref.autotune:
+        return None
+    return _route(A, X, "spmm")
+
+
+def _route(A, operand, op: str):
+    from ..csr import csr_array
+
+    if not isinstance(A, csr_array):
+        return None
+    if not csr_array._can_build_cache(A.data, A.indices, A.indptr,
+                                      operand):
+        _obs.inc("autotune.route.decline")
+        return None  # ambient trace / tracer operands: caches would leak
+    if np.result_type(A.dtype, operand.dtype) != A.dtype:
+        _obs.inc("autotune.route.decline")
+        return None  # promotion: verdicts are keyed on the matrix dtype
+    if A._get_dia() is not None or A._get_bsr() is not None:
+        _obs.inc("autotune.route.decline")
+        return None  # structure-specialized paths keep priority
+    k = 1
+    if op == "spmm":
+        k = int(operand.shape[1])
+        if k == 0:
+            _obs.inc("autotune.route.decline")
+            return None
+    key = key_for(A, op, k=k)
+    if key is None:
+        _obs.inc("autotune.route.decline")
+        return None
+    verdict = get_store().lookup(key)
+    if verdict is None:
+        _obs.inc("autotune.route.miss")
+        return None  # no measurement yet: heuristics serve
+    cand = CANDIDATES.get(verdict.label)
+    if cand is None or op not in cand.ops or not cand.eligible(A):
+        # A stale/foreign verdict naming a kernel this matrix can't
+        # run (e.g. flat ELL over budget) must not error the dispatch.
+        _obs.inc("autotune.route.decline")
+        return None
+    y = cand.run(A, operand, op)
+    _obs.inc("autotune.route.hits")
+    _obs.inc("autotune.route." + verdict.label)
+    return y, verdict.label
+
+
+def plan_preference(A) -> Optional[str]:
+    """Engine-side consult: the stored SpMV verdict label for ``A``'s
+    key, or None (tuning off / tracer context / store miss).  The
+    engine declines routing when this names a non-CSR kernel, so the
+    autotune route right below it in ``csr_array.dot`` serves."""
+    if not _settings_ref.autotune:
+        return None
+    from ..csr import csr_array
+
+    if not isinstance(A, csr_array):
+        return None
+    if not csr_array._can_build_cache(A.data, A.indices, A.indptr):
+        return None
+    key = key_for(A, "spmv")
+    if key is None:
+        return None
+    verdict = get_store().lookup(key)
+    return verdict.label if verdict is not None else None

@@ -1,0 +1,105 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Measurement harness: race the eligible candidates, record a verdict.
+
+Timing discipline is borrowed from ``bench_timing.py``: warmup
+dispatches absorb compile + first-touch allocation, every sample ends
+on a ``block_until_ready`` sync, and the reported figure is the median
+of k samples (outlier-robust without the variance bookkeeping).  The
+harness deliberately stops short of ``loop_ms_per_iter``'s chained
+fori_loop protocol: a verdict compares kernels *against each other on
+the same matrix*, so the fixed per-dispatch cost biases every
+candidate equally and a quick median settles the ranking in
+milliseconds.  Bench phases proving absolute numbers (the irregular
+SpMV speedup) keep using ``loop_ms_per_iter``.
+
+The trial/warmup budget comes from ``settings.autotune_trials`` /
+``settings.autotune_warmup`` (``LEGATE_SPARSE_TPU_AUTOTUNE_TRIALS`` /
+``_WARMUP``) unless overridden per call.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import obs as _obs
+from ..settings import settings as _settings
+from .registry import CANDIDATES
+from .store import key_for
+
+
+def time_kernel(fn, warmup: Optional[int] = None,
+                trials: Optional[int] = None) -> float:
+    """Median-of-k wall ms of ``fn()`` (a zero-arg dispatch closure),
+    after ``warmup`` unmeasured calls.  Each call is synced."""
+    warmup = _settings.autotune_warmup if warmup is None else warmup
+    trials = _settings.autotune_trials if trials is None else trials
+    for _ in range(max(int(warmup), 1)):
+        jax.block_until_ready(fn())
+    samples = []
+    for _ in range(max(int(trials), 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        samples.append((time.perf_counter() - t0) * 1e3)
+    _obs.inc("autotune.measure.trials", len(samples))
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def eligible_candidates(A, op: str = "spmv") -> dict:
+    """{label: Candidate} of the registry entries that can serve
+    ``op`` on this matrix (structural predicates; builds lazy caches
+    the same way the dispatch chain would)."""
+    return {label: cand for label, cand in CANDIDATES.items()
+            if op in cand.ops and cand.eligible(A)}
+
+
+def measure_candidates(A, x=None, op: str = "spmv",
+                       warmup: Optional[int] = None,
+                       trials: Optional[int] = None
+                       ) -> Dict[str, float]:
+    """Time every eligible candidate for ``op`` on ``A``; returns
+    {label: median ms}.  ``x`` defaults to a ones operand of the
+    matrix dtype (k=4 columns for spmm)."""
+    if x is None:
+        if op == "spmv":
+            x = jnp.ones((A.shape[1],), dtype=A.dtype)
+        else:
+            x = jnp.ones((A.shape[1], 4), dtype=A.dtype)
+    timings: Dict[str, float] = {}
+    for label, cand in eligible_candidates(A, op).items():
+        timings[label] = time_kernel(
+            lambda c=cand: c.run(A, x, op),
+            warmup=warmup, trials=trials)
+    return timings
+
+
+def tune(A, x=None, op: str = "spmv", store=None,
+         warmup: Optional[int] = None, trials: Optional[int] = None):
+    """Race the candidates and record the winner into ``store`` (the
+    process store by default).  Returns the recorded
+    :class:`~.store.Verdict`, or None when no key/candidate is
+    available (tracer context, empty registry slice)."""
+    timings = measure_candidates(A, x=x, op=op,
+                                 warmup=warmup, trials=trials)
+    if not timings:
+        return None
+    k = 1
+    if op == "spmm" and x is not None and getattr(x, "ndim", 1) == 2:
+        k = int(x.shape[1])
+    key = key_for(A, op, k=k)
+    if key is None:
+        return None
+    if store is None:
+        from . import get_store
+
+        store = get_store()
+    label = min(timings, key=timings.get)
+    trials_used = (_settings.autotune_trials if trials is None
+                   else int(trials))
+    return store.record(key, label, timings_ms=timings,
+                        trials=trials_used)

@@ -1,0 +1,86 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Candidate-kernel registry: what the autotuner may race and route.
+
+One :class:`Candidate` per routable kernel family, keyed by its
+dispatch label (the same labels ``csr_array.dot`` records as the span
+``path`` attr).  Each entry declares:
+
+- ``kernel`` — its ``ops/spmv.py`` entry point (must exist and bump a
+  ``trace.<kernel>`` counter: the instrumentation contract);
+- ``ops`` — which dispatch ops it can serve;
+- ``eligible`` — a structural predicate (builds/reads the matrix's
+  lazy caches; False means the candidate is skipped, never errored);
+- ``run`` — the dispatch closure the harness times and routing serves.
+
+``tools/check_kernel_registry.py`` cross-checks this catalog three
+ways (mirroring ``check_fault_sites.py``): kernel entry points exist
+and are trace-counted, every label appears as a quoted literal at a
+dispatch site outside this module (rot detection), and every label is
+documented in ``docs/AUTOTUNER.md``.
+
+Deliberately absent: DIA and BSR.  Those structure-specialized paths
+keep unconditional dispatch priority (the engine makes the same call),
+so the autotuner only races the gather-class kernels where measurement
+can actually change the choice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Tuple
+
+from ..ops import spmv as _sp
+
+
+def _run_csr_rowids(A, operand, op: str):
+    rid = A._get_row_ids()
+    if op == "spmv":
+        return _sp.csr_spmv_rowids(
+            A.data, A.indices, rid, operand, A.shape[0])
+    return _sp.csr_spmm_rowids(
+        A.data, A.indices, rid, operand, A.shape[0])
+
+
+def _run_ell(A, operand, op: str):
+    ell = A._get_ell()
+    if op == "spmv":
+        return _sp.ell_spmv(ell[0], ell[1], ell[2], operand)
+    return _sp.ell_spmm(ell[0], ell[1], ell[2], operand)
+
+
+def _run_sliced_ell(A, operand, op: str):
+    return _sp.sliced_ell_spmv(A._get_sliced_ell(), operand, A.shape[0])
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One routable kernel family (see module docstring)."""
+
+    label: str
+    kernel: str
+    ops: Tuple[str, ...]
+    eligible: Callable
+    run: Callable
+
+
+CANDIDATES = {
+    "csr-rowids": Candidate(
+        label="csr-rowids", kernel="csr_spmv_rowids",
+        ops=("spmv", "spmm"),
+        eligible=lambda A: True,
+        run=_run_csr_rowids,
+    ),
+    "ell": Candidate(
+        label="ell", kernel="ell_spmv",
+        ops=("spmv", "spmm"),
+        eligible=lambda A: A._get_ell() is not None,
+        run=_run_ell,
+    ),
+    "sliced-ell": Candidate(
+        label="sliced-ell", kernel="sliced_ell_spmv",
+        ops=("spmv",),
+        eligible=lambda A: A._get_sliced_ell() is not None,
+        run=_run_sliced_ell,
+    ),
+}

@@ -39,6 +39,8 @@ from . import obs as _obs
 from .obs import latency as _lat
 from .engine import route_matmat as _engine_route_matmat
 from .engine import route_matvec as _engine_route_matvec
+from .autotune import route_matmat as _autotune_route_matmat
+from .autotune import route_matvec as _autotune_route_matvec
 from .resilience import faults as _rfaults
 from .resilience import policy as _rpolicy
 from .settings import settings as _rsettings
@@ -207,6 +209,10 @@ class csr_array(CompressedBase, DenseSparseBase):
         # Engine bucket pack: (key terms, padded operands) — built by
         # legate_sparse_tpu.engine on first routed dispatch.
         self._engine_pack = None
+        # Autotune caches: structure fingerprint (verdict-key term) and
+        # the row-binned sliced-ELL pack (False = tried, not viable).
+        self._fingerprint = None
+        self._sliced_ell = None
         self.shape: Tuple[int, int] = tuple(int(s) for s in shape)
         assert self._indptr.shape[0] == self.shape[0] + 1, (
             f"indptr length {self._indptr.shape[0]} != rows+1 "
@@ -261,6 +267,7 @@ class csr_array(CompressedBase, DenseSparseBase):
         out._row_ids = self._row_ids  # sparsity structure is shared
         out._ell_width = self._ell_width
         out._dia_offsets = self._dia_offsets
+        out._fingerprint = self._fingerprint
         out._sorted = self._sorted
         return out
 
@@ -587,6 +594,43 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._row_ids = _convert.row_ids_from_indptr(self._indptr, self.nnz)
         return self._row_ids
 
+    def _get_fingerprint(self):
+        """Cached sparsity fingerprint (``autotune.Fingerprint``), or
+        None when it can't be built now (tracer structure / ambient
+        trace — fingerprints feed verdict keys, which only concrete
+        dispatches consult)."""
+        if self._fingerprint is not None:
+            return self._fingerprint
+        if not self._can_build_cache(self._data, self._indices,
+                                     self._indptr):
+            return None
+        from .autotune import compute_fingerprint
+
+        self._fingerprint = compute_fingerprint(self)
+        return self._fingerprint
+
+    def _get_sliced_ell(self):
+        """Cached row-binned ("sliced") ELL pack, or None (empty /
+        oversized / can't build under an active trace).  Unlike flat
+        ELL there is no expansion budget: pow2 row bins bound padding
+        below 2x nnz regardless of row-length skew."""
+        if self._sliced_ell is not None:
+            return self._sliced_ell if self._sliced_ell is not False else None
+        if not self._can_build_cache(self._data, self._indices,
+                                     self._indptr):
+            return None
+        rows = self.shape[0]
+        if rows == 0 or self.nnz == 0 or rows > np.iinfo(np.int32).max:
+            self._sliced_ell = False
+            return None
+        self._sliced_ell = _spmv_ops.sliced_ell_pack(
+            self._data, self._indices, self._indptr, rows
+        )
+        if self._sliced_ell is None:
+            self._sliced_ell = False
+            return None
+        return self._sliced_ell
+
     # ---------------- conversions ----------------
     def todense(self, order=None, out=None):
         if order is not None:
@@ -710,6 +754,8 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_fused = None
         self._bsr = None
         self._engine_pack = None
+        self._fingerprint = None
+        self._sliced_ell = None
 
     def sort_indices(self):
         """Sort column indices within each row in place (stable; no
@@ -733,6 +779,8 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_fused = None
         self._bsr = None
         self._engine_pack = None
+        self._fingerprint = None  # block_score reads stored-entry order
+        self._sliced_ell = None
 
     def power(self, n, dtype=None):
         """Element-wise power (scipy semantics: duplicates are summed
@@ -1228,6 +1276,23 @@ class csr_array(CompressedBase, DenseSparseBase):
                         if squeeze:
                             y = y[:, None]
                         return fill_out(y, out)
+                if src is not None:
+                    # Autotune route (settings.autotune): a stored
+                    # measured verdict picks the kernel.  Declines
+                    # (off — the default, tracer context, dtype
+                    # promotion, DIA/BSR structure, verdict miss)
+                    # fall through to the heuristic chain below.
+                    routed = _autotune_route_matvec(src, x)
+                    if routed is not None:
+                        y, path = routed
+                        if sp is not None:
+                            sp.set(path=path, rows=self.shape[0],
+                                   nnz=self.nnz, flops=2 * self.nnz,
+                                   bytes=A.spmv_traffic_bytes(
+                                       x, path=path))
+                        if squeeze:
+                            y = y[:, None]
+                        return fill_out(y, out)
                 dia = src._get_dia() if src is not None else None
                 bsr = (src._get_bsr() if src is not None and dia is None
                        else None)
@@ -1300,6 +1365,18 @@ class csr_array(CompressedBase, DenseSparseBase):
                                    flops=2 * self.nnz * k,
                                    bytes=A.spmv_traffic_bytes(
                                        X, path="csr"))
+                        return fill_out(Y, out)
+                if src is not None:
+                    routed = _autotune_route_matmat(src, X)
+                    if routed is not None:
+                        Y, path = routed
+                        if sp is not None:
+                            k = int(X.shape[1])
+                            sp.set(path=path, rows=self.shape[0],
+                                   k=k, nnz=self.nnz,
+                                   flops=2 * self.nnz * k,
+                                   bytes=A.spmv_traffic_bytes(
+                                       X, path=path))
                         return fill_out(Y, out)
                 dia = src._get_dia() if src is not None else None
                 from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
@@ -1395,6 +1472,16 @@ class csr_array(CompressedBase, DenseSparseBase):
                 mask_bytes = mask.size
             return int(dia_data.size * dia_data.dtype.itemsize
                        + mask_bytes + x_bytes + out_bytes)
+        if path == "sliced-ell" and self._sliced_ell not in (None, False):
+            # Each pow2 row bin streams its (rows_b, W_b) data+cols
+            # blocks plus the count/row-index sideband.
+            total = x_bytes + out_bytes
+            for ell_data, ell_cols, cnt, row_idx in self._sliced_ell:
+                total += (ell_data.size * ell_data.dtype.itemsize
+                          + ell_cols.size * ell_cols.dtype.itemsize
+                          + cnt.size * cnt.dtype.itemsize
+                          + row_idx.size * row_idx.dtype.itemsize)
+            return int(total)
         ell = self._ell if self._ell is not False else None
         if path is not None and path != "ell":
             ell = None
@@ -1425,10 +1512,12 @@ class csr_array(CompressedBase, DenseSparseBase):
         self._dia_fused = None
         self._bsr = None
         self._engine_pack = None
+        self._sliced_ell = None  # packs values, not just structure
         if structure_changed:
             self._row_ids = None
             self._ell_width = None
             self._dia_offsets = None
+            self._fingerprint = None
             self._canonical = None
             self._sorted = None
 

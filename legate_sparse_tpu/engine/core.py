@@ -138,6 +138,15 @@ class Engine:
             # there.  On CPU the two are the same class, so bucketed
             # plans take ELL-packable matrices too.
             return False
+        from .. import autotune as _autotune
+
+        pref = _autotune.plan_preference(A)
+        if pref is not None and pref != "csr-rowids":
+            # A measured verdict picked a non-CSR kernel; the engine's
+            # bucketed plans only serve the CSR gather form, so defer
+            # and let the autotune route downstream serve the verdict.
+            _obs.inc("autotune.engine.defer")
+            return False
         return True
 
     # ---------------- plans ----------------

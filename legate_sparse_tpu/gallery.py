@@ -366,6 +366,86 @@ def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
     return A.asformat(format)
 
 
+def powerlaw(m, n=None, nnz_per_row=8, alpha=1.8, rng=None,
+             format="csr", dtype=None):
+    """Power-law (heavy-tailed row-length) random sparse matrix — the
+    autotuner's irregular-SpMV workload.  Row lengths are drawn as
+    ``nnz_per_row * Zipf(alpha)`` capped at ``n``; columns are uniform.
+    ``alpha`` near 1.5-2 gives the web-graph / social-network skew
+    (most rows short, a few huge hubs) that defeats flat-ELL padding
+    budgets and starves segment-sum SpMV.  Seeded ``rng`` makes the
+    structure deterministic (bench/test usage).  Duplicate coordinates
+    survive construction (COO semantics) and merge on the first
+    canonicalizing op, so ``nnz`` may slightly undercount after
+    ``sum_duplicates``."""
+    from .csr import csr_array
+
+    m = int(m)
+    n = m if n is None else int(n)
+    rng = rng if isinstance(rng, np.random.Generator) else (
+        np.random.default_rng(rng)
+    )
+    counts = np.minimum(
+        nnz_per_row * rng.zipf(alpha, size=m), n
+    ).astype(np.int64)
+    rows = np.repeat(np.arange(m, dtype=np.int64), counts)
+    nnz = int(counts.sum())
+    cols = rng.integers(0, n, size=nnz)
+    out_dtype = (np.dtype(dtype) if dtype is not None
+                 else runtime.default_float)
+    vals = rng.random(nnz).astype(out_dtype)
+    order = np.lexsort((cols, rows))
+    A = csr_array(
+        (vals[order], (rows[order], cols[order])), shape=(m, n)
+    )
+    return A.asformat(format)
+
+
+def rmat(scale, nnz_per_row=8, a=0.57, b=0.19, c=0.19, rng=None,
+         format="csr", dtype=None):
+    """R-MAT (recursive-matrix) random graph, Graph500-style defaults:
+    ``2**scale`` square with ``nnz_per_row * 2**scale`` edges sampled
+    by recursive quadrant descent with probabilities ``(a, b, c,
+    1-a-b-c)``.  The skewed quadrants produce the power-law degree AND
+    community block structure real graphs show — a harder irregular
+    workload than :func:`powerlaw`'s independent rows.  Vectorized:
+    one ``(nnz, scale)`` uniform block, no Python-level recursion.
+    Duplicate edges survive construction (see :func:`powerlaw`)."""
+    from .csr import csr_array
+
+    scale = int(scale)
+    m = 1 << scale
+    d = 1.0 - a - b - c
+    if d < 0 or min(a, b, c) < 0:
+        raise ValueError(f"quadrant probabilities ({a}, {b}, {c}, "
+                         f"{d}) must be non-negative")
+    rng = rng if isinstance(rng, np.random.Generator) else (
+        np.random.default_rng(rng)
+    )
+    nnz = int(nnz_per_row) * m
+    rows = np.zeros(nnz, dtype=np.int64)
+    cols = np.zeros(nnz, dtype=np.int64)
+    for _ in range(scale):
+        u1 = rng.random(nnz)
+        u2 = rng.random(nnz)
+        # First split top/bottom by P(bottom) = c + d, then left/right
+        # conditioned on the row half (the standard 2x2 factorization).
+        row_bit = u1 >= a + b
+        p_right = np.where(row_bit, d / max(c + d, 1e-300),
+                           b / max(a + b, 1e-300))
+        col_bit = u2 < p_right
+        rows = rows * 2 + row_bit
+        cols = cols * 2 + col_bit
+    out_dtype = (np.dtype(dtype) if dtype is not None
+                 else runtime.default_float)
+    vals = rng.random(nnz).astype(out_dtype)
+    order = np.lexsort((cols, rows))
+    A = csr_array(
+        (vals[order], (rows[order], cols[order])), shape=(m, m)
+    )
+    return A.asformat(format)
+
+
 def find(A):
     """(row, col, values) of the nonzero entries (scipy ``find``):
     duplicates summed, explicit zeros dropped, returned as numpy

@@ -266,7 +266,8 @@ TRAJECTORY_FIELDS = [
     "gmg_cycle_ms", "pde_ms_per_iter", "pde_roofline_ratio",
     "dist_spmv_comm_bytes", "comm_total_bytes",
     "engine_warm_ms", "engine_batched_ms_per_req",
-    "saturation_p99_ms", "bench_wall_s",
+    "saturation_p99_ms", "irregular_spmv_ms", "irregular_spmv_speedup",
+    "irregular_spmv_path", "autotune_verdicts", "bench_wall_s",
 ]
 
 

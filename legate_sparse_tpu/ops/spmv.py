@@ -120,6 +120,83 @@ def ell_spmv(ell_data, ell_cols, ell_counts, x):
     return jnp.sum(prod, axis=1)
 
 
+def sliced_ell_pack(data, indices, indptr, rows: int):
+    """Row-binned ("sliced") ELL pack: rows grouped by next-pow2 row
+    length, one (rows_bin, W_bin) ELL block per bin.
+
+    Flat ELL pads every row to the matrix max W, so one heavy row in a
+    power-law matrix blows the ``ell_max_expand`` budget and the whole
+    matrix falls back to the gather/segment-sum path.  Binning rows by
+    ``next_pow2(len)`` bounds padding at < 2x the true nnz regardless
+    of skew (each row pads to at most twice its own length), at the
+    cost of one masked-row-reduction dispatch per occupied bin
+    (<= log2(max row length) bins).
+
+    Returns a tuple of ``(ell_data, ell_cols, ell_counts, row_idx)``
+    bins — ``row_idx`` maps each bin row back to its original row —
+    or None for an empty matrix.  Padded slots replicate the row's
+    last valid column with value 0, exactly like :func:`ell_pack`;
+    the kernel masks padded *products* so non-finite x entries cannot
+    inject NaN through padding.  Bin membership is computed on host
+    from the (rows+1,) indptr; the block gathers run on device.
+    """
+    nnz = int(indices.shape[0])
+    if nnz == 0 or rows == 0:
+        return None
+    indptr_h = np.asarray(indptr)
+    counts = (indptr_h[1:] - indptr_h[:-1]).astype(np.int64)
+    nzr = counts > 0
+    # next_pow2 per row; float64 log2 is exact for the int32-bounded
+    # row lengths a single shard can hold.
+    widths = np.ones_like(counts)
+    widths[nzr] = (
+        2 ** np.ceil(np.log2(counts[nzr])).astype(np.int64))
+    indptr_d = jnp.asarray(indptr)
+    bins = []
+    for W in np.unique(widths[nzr]):
+        sel = np.nonzero(nzr & (widths == W))[0]
+        W = int(W)
+        row_idx = jnp.asarray(sel.astype(np.int32))
+        cnt = jnp.asarray(counts[sel].astype(np.int32))
+        row_start = indptr_d[row_idx].astype(jnp.int32)
+        row_last = jnp.clip(
+            indptr_d[row_idx + 1].astype(jnp.int32) - 1, 0, nnz - 1)
+        slot = jnp.arange(W, dtype=jnp.int32)
+        src = jnp.minimum(row_start[:, None] + slot[None, :],
+                          row_last[:, None])
+        valid = slot[None, :] < cnt[:, None]
+        ell_cols = indices[src]
+        ell_data = jnp.where(valid, data[src],
+                             jnp.zeros((1, 1), dtype=data.dtype))
+        bins.append((ell_data, ell_cols, cnt, row_idx))
+    return tuple(bins)
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def sliced_ell_spmv(bins, x, rows: int):
+    """SpMV over a :func:`sliced_ell_pack` structure.
+
+    One masked ELL row-reduction per bin (same IEEE masking contract
+    as :func:`ell_spmv`), scattered back to original row order with a
+    unique-sorted ``.at[].set`` — rows with zero stored entries keep
+    the exact-0 initial value.  The bin tuple is a pytree argument, so
+    one compiled program covers a matrix's pack; a different bin
+    structure retraces (counted below)."""
+    _obs.inc("trace.sliced_ell_spmv")
+    out_dtype = jnp.result_type(bins[0][0].dtype, x.dtype)
+    y = jnp.zeros((rows,), dtype=out_dtype)
+    for ell_data, ell_cols, cnt, row_idx in bins:
+        W = ell_data.shape[1]
+        slot = jnp.arange(W, dtype=cnt.dtype)
+        valid = slot[None, :] < cnt[:, None]
+        prod = jnp.where(valid, ell_data * x[ell_cols],
+                         jnp.zeros((1, 1), dtype=ell_data.dtype))
+        y = y.at[row_idx].set(
+            jnp.sum(prod, axis=1).astype(out_dtype),
+            indices_are_sorted=True, unique_indices=True)
+    return y
+
+
 # Above this many intermediate elements (rows*W*k), ell_spmm switches to
 # a W-slice accumulation loop instead of materializing the full
 # (rows, W, k) product tensor (~512 MB of f32 at the default cap).

@@ -103,6 +103,22 @@ Settings
       0 = off) and ``resil_divergence_mult`` (``_DIVERGENCE_MULT``)
       tune it.
 
+``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
+    Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
+    ``docs/AUTOTUNER.md``): measured kernel selection for the
+    gather-class SpMV/SpMM paths, keyed on a structure fingerprint.
+    Off by default — every dispatch site then pays one attribute read
+    and nothing else.  Knobs (all env-overridable):
+
+    - ``autotune_store_path`` (``..._AUTOTUNE_STORE``): optional JSON
+      file verdicts persist to / warm-start from (epoch- and
+      platform-invalidated on load).
+    - ``autotune_store_size`` (``..._AUTOTUNE_VERDICTS``, 256): verdict
+      LRU capacity.
+    - ``autotune_trials`` (``..._AUTOTUNE_TRIALS``, 5) and
+      ``autotune_warmup`` (``..._AUTOTUNE_WARMUP``, 1): median-of-k
+      measurement budget per candidate.
+
 Settings epoch
 --------------
 ``settings.epoch`` is a monotone counter bumped by every post-import
@@ -308,6 +324,22 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_RESIL_DIVERGENCE_MULT",
                            "1e8")
         )
+        # ---- autotuner (legate_sparse_tpu.autotune) ----
+        self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
+                                        False)
+        self.autotune_store_path: str = os.environ.get(
+            "LEGATE_SPARSE_TPU_AUTOTUNE_STORE", ""
+        )
+        self.autotune_store_size: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_AUTOTUNE_VERDICTS",
+                           "256")
+        )
+        self.autotune_trials: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_AUTOTUNE_TRIALS", "5")
+        )
+        self.autotune_warmup: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_AUTOTUNE_WARMUP", "1")
+        )
         # Settings epoch: compiled-plan cache keys include it, so any
         # later settings mutation (see __setattr__) invalidates plans.
         self._epoch: int = 0
@@ -333,6 +365,13 @@ class Settings:
         "resil_retry_budget", "resil_breaker_k",
         "resil_breaker_cooldown_ms", "resil_health",
         "resil_stagnation_cycles", "resil_divergence_mult",
+        # Autotune knobs pick *which already-compiled kernel* serves a
+        # dispatch (routing) or shape the measurement budget — never
+        # what any kernel lowers to.  Verdict keys carry the epoch
+        # separately, so lowering-relevant mutations still invalidate
+        # verdicts without these bumping the epoch themselves.
+        "autotune", "autotune_store_path", "autotune_store_size",
+        "autotune_trials", "autotune_warmup",
     })
 
     def __setattr__(self, name: str, value) -> None:

@@ -84,6 +84,7 @@ def test_eigsh_explicit_sigma_clean_stays_native():
 
 
 # ------------------------------------------------- lobpcg block seed --
+@pytest.mark.slow
 def test_lobpcg_generalized_block_seed_survives_bad_first_column():
     """X[:, 0] an exact eigenvector of the WRONG end of the spectrum:
     the old single-column seed handed Lanczos an immediate breakdown

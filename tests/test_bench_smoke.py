@@ -23,16 +23,17 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # comm predictions, the mesh width, the schema — the engine phase's
 # plan-cache hit/miss counts (a fixed call sequence against a fresh
 # engine) — the resilience drill's exact fault/retry/shed/trip
-# accounting, and the saturation sweep's totals (fixed request plan;
-# every request batches exactly once; one deterministic shed drill)
-# do not.
+# accounting, the saturation sweep's totals (fixed request plan;
+# every request batches exactly once; one deterministic shed drill),
+# and the autotune phase's verdict count (one pinned verdict against a
+# fresh store) do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
                  "engine_plan_hits,engine_plan_misses,"
                  "engine_batch_requests,"
                  "resil_retries,resil_shed,resil_breaker_trips,"
                  "resil_faults_injected,"
                  "saturation_requests,saturation_shed,"
-                 "saturation_batched_requests")
+                 "saturation_batched_requests,autotune_verdicts")
 
 
 from utils_test.tools import load_tool as _tool
@@ -198,6 +199,37 @@ def test_smoke_saturation_phase_numbers(smoke_run):
     assert result["saturation_batched_requests"] == 60
     assert result["saturation_shed"] == 1
     assert result["saturation_p99_ms"] >= result["saturation_p50_ms"]
+
+
+def test_smoke_autotune_phase_numbers(smoke_run):
+    """ISSUE 8 acceptance (smoke lane): the autotune phase pins one
+    sliced-ELL verdict against a fresh store, proves it actually
+    routes an eager dispatch, and records the kernel race — the
+    verdict count is golden-pinned; the timings are informational."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 11
+    assert result["autotune_verdicts"] == 1
+    assert result["irregular_spmv_path"] == "sliced-ell"
+    assert result["irregular_spmv_ms"] > 0
+    assert result["irregular_csr_ms"] > 0
+    assert result["irregular_spmv_speedup"] > 0
+    assert result["irregular_spmv_nnz"] > 0
+
+
+def test_smoke_trace_has_autotune_ledger(smoke_run, capsys):
+    """The trace artifact carries the autotune.* counters and
+    ``trace_summary --autotune`` renders the routing/verdict table."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("autotune.verdict.records", 0) == 1
+    assert ctrs.get("autotune.route.hits", 0) >= 1
+    assert ctrs.get("autotune.route.sliced-ell", 0) >= 1
+    rc = _tool("trace_summary").main([str(trace_path), "--autotune"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "autotune ledger:" in out
+    assert "autotune.route.hits" in out
 
 
 def test_smoke_trace_has_latency_histograms(smoke_run, capsys):

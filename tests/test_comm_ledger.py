@@ -254,6 +254,7 @@ def test_windowed_realization_predicts_fewer_bytes_than_all_gather():
         "comm.dist_spgemm.window_probe.all_gather") == 2
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(R < 8, reason="needs the 8-device mesh")
 def test_gmg_hierarchy_prices_its_cycle():
     # Same operator/mesh construction as test_grid_mesh's
@@ -373,6 +374,7 @@ def test_density_bucket_edges():
     assert _spg._density_bucket(100, 0) == -1
 
 
+@pytest.mark.slow
 @needs_mesh
 def test_builders_set_nnz_hint():
     from legate_sparse_tpu.parallel import dist_diags

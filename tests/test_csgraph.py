@@ -235,6 +235,7 @@ def test_shortest_path_stored_zero_edges():
         sparse.csgraph.floyd_warshall(A), scsg.floyd_warshall(B))
 
 
+@pytest.mark.slow
 def test_minimum_spanning_tree_native():
     # Symmetric distinct weights: MST unique, exact scipy equality.
     rng = np.random.default_rng(12)

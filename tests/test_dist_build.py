@@ -25,7 +25,8 @@ needs_multi = pytest.mark.skipif(
 @pytest.mark.parametrize("n,offsets", [
     pytest.param(64, [0], marks=pytest.mark.slow),
     (64, [-1, 0, 1]),
-    (61, [-7, -1, 0, 1, 7]),       # non-divisible rows
+    pytest.param(61, [-7, -1, 0, 1, 7],  # non-divisible rows
+                 marks=pytest.mark.slow),
     pytest.param(40, [-33, 0, 33],  # reach > rps -> all_gather layout
                  marks=pytest.mark.slow),
 ])
@@ -61,6 +62,7 @@ def test_dist_diags_array_and_callable_bands():
     )
 
 
+@pytest.mark.slow
 @needs_multi
 def test_dist_poisson2d_matches_host_and_solves():
     N = 24

@@ -146,6 +146,7 @@ def test_dist_eigsh_shift_invert():
     assert np.all(resid < 1e-5)
 
 
+@pytest.mark.slow
 @needs_multi
 def test_dist_eigsh_sm_and_be():
     n = 264

@@ -146,6 +146,7 @@ def test_dist_spgemm_result_feeds_spmv():
     np.testing.assert_allclose(y, y_ref, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 @needs_multi
 def test_dist_band_spgemm_fast_path():
     """Exactly-banded square operands take the ppermute-halo banded
@@ -217,6 +218,7 @@ def _spgemm_mod():
         "legate_sparse_tpu.parallel.dist_spgemm")
 
 
+@pytest.mark.slow
 @needs_multi
 def test_windowed_b_banded_general_path():
     """A holey band drives the general ESC with a narrow A-column

@@ -35,6 +35,7 @@ def test_eigsh_native_matches_scipy(which):
     assert np.all(resid < 1e-6)
 
 
+@pytest.mark.slow
 def test_eigsh_f32_and_linear_operator():
     A_sp, A = _lap1d(90, np.float32)
     w, _ = linalg.eigsh(A, k=3, which="LA")
@@ -416,6 +417,7 @@ def test_eigsh_generalized_native_matches_scipy(monkeypatch, which):
     np.testing.assert_allclose(gram, np.eye(3), atol=1e-7)
 
 
+@pytest.mark.slow
 def test_eigsh_generalized_complex_hermitian(monkeypatch):
     _no_fallback(monkeypatch)
     n = 64
@@ -434,7 +436,8 @@ def test_eigsh_generalized_complex_hermitian(monkeypatch):
     assert np.all(resid < 1e-5)
 
 
-@pytest.mark.parametrize("largest", [True, False])
+@pytest.mark.parametrize(
+    "largest", [pytest.param(True, marks=pytest.mark.slow), False])
 def test_lobpcg_generalized_native(monkeypatch, largest):
     _no_fallback(monkeypatch)
     n = 72
@@ -497,6 +500,7 @@ def test_eigsh_generalized_sm_routes_through_shift_invert(monkeypatch):
     np.testing.assert_allclose(np.sort(w), np.sort(w_ref), rtol=1e-7)
 
 
+@pytest.mark.slow
 def test_eigsh_generalized_small_norm_pencil_precise(monkeypatch):
     # Code-review repro: a 1e-6-scaled operator must NOT lose digits to
     # an absolute inner tolerance (the rhs of the M-solve has norm
@@ -663,6 +667,7 @@ def test_svds_rank_deficient():
 
 # ---- non-symmetric Arnoldi (eigs) ----
 
+@pytest.mark.slow
 def test_eigs_nonsymmetric_vs_analytic():
     # Asymmetric tridiagonal: analytic spectrum 4 + 2*sqrt(bc)*cos(.).
     # Non-normal with exponentially ill-conditioned eigenvectors, so
@@ -683,6 +688,7 @@ def test_eigs_nonsymmetric_vs_analytic():
         assert np.max(np.abs(np.sort(key(w)) - want)) < 2e-2
 
 
+@pytest.mark.slow
 def test_eigs_random_matches_scipy_with_residuals():
     rng = np.random.default_rng(0)
     n = 150
@@ -703,6 +709,7 @@ def test_eigs_random_matches_scipy_with_residuals():
                                np.sort(np.abs(wsm_ref)), rtol=1e-8)
 
 
+@pytest.mark.slow
 def test_eigs_complex_pairs_and_complex_operator():
     rng = np.random.default_rng(1)
     n = 120

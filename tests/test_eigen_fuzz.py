@@ -48,7 +48,8 @@ def test_fuzz_eigsh_sigma(seed):
     assert np.all(resid < 1e-6)
 
 
-@pytest.mark.parametrize("seed", [3, 4])
+@pytest.mark.parametrize(
+    "seed", [3, pytest.param(4, marks=pytest.mark.slow)])
 def test_fuzz_eigsh_generalized_modes(seed):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(40, 80))
@@ -89,7 +90,8 @@ def test_fuzz_eigsh_hermitian_sigma(seed):
     np.testing.assert_allclose(np.sort(w), np.sort(ref), rtol=1e-8)
 
 
-@pytest.mark.parametrize("seed", [7, 8])
+@pytest.mark.parametrize(
+    "seed", [7, pytest.param(8, marks=pytest.mark.slow)])
 def test_fuzz_eigs_generalized(seed):
     rng = np.random.default_rng(seed)
     n = int(rng.integers(40, 70))
@@ -115,7 +117,8 @@ def test_fuzz_eigs_generalized(seed):
         np.sort(np.real(w_si)), np.sort(np.real(ref_si)), rtol=1e-6)
 
 
-@pytest.mark.parametrize("seed", [9, 10])
+@pytest.mark.parametrize(
+    "seed", [pytest.param(9, marks=pytest.mark.slow), 10])
 def test_fuzz_svds_sm(seed):
     rng = np.random.default_rng(seed)
     m = int(rng.integers(40, 60))

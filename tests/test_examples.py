@@ -79,6 +79,7 @@ def test_diffusion2D_spd(tpu_backend):
     assert w.min() > 0
 
 
+@pytest.mark.slow
 def test_gmg_converges(tpu_backend):
     import gmg
     import common
@@ -148,6 +149,7 @@ def test_pde_operator_matches_scipy(tpu_backend):
     )
 
 
+@pytest.mark.slow
 def test_pde_distributed_operator_and_solve(tpu_backend):
     """pde.py --distributed path: the shard-locally built operator
     (dist_diags, no host CSR) equals the host build, and the collective
@@ -189,6 +191,7 @@ def test_pde_distributed_operator_and_solve(tpu_backend):
     assert res <= 1e-8 * np.linalg.norm(b)
 
 
+@pytest.mark.slow
 def test_spectral_example_pipeline(tpu_backend):
     """spectral.py pipeline: clustered graph -> components ->
     normalized Laplacian -> smallest eigenpairs, vs host scipy."""

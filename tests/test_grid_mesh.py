@@ -128,6 +128,7 @@ def test_dist_spmm_row_mesh_matches_scipy():
     )
 
 
+@pytest.mark.slow
 def test_full_dist_stack_on_grid_mesh():
     """SpGEMM, GMG hierarchy and preconditioned CG all run on a 2-D
     grid mesh (sparse blocks replicated along the column axis)."""

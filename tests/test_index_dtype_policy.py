@@ -76,6 +76,7 @@ print("no-x64-clean")
 """
 
 
+@pytest.mark.slow
 def test_no_int64_requests_under_no_x64_process():
     r = subprocess.run([sys.executable, "-c", _NO_X64_SNIPPET],
                        capture_output=True, text=True, timeout=240)

@@ -86,6 +86,7 @@ def _run_ranks(grid_n: int, extra=()):
         assert f"MULTIPROC-OK {rank}" in out, out[-500:]
 
 
+@pytest.mark.slow
 def test_two_process_dist_spmv_and_cg():
     _run_ranks(16)
 

@@ -38,11 +38,19 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# The alternate-lowering rungs ride the slow lane: each rung is a
+# full subprocess compile of the same compositions, and tier-1 keeps
+# one representative on its single-core budget (pyproject addopts).
 RUNGS = [
     ("pallas", {}),
-    ("pallas-shift3", {"LEGATE_SPARSE_TPU_PALLAS_INPUTS": "distinct"}),
-    ("pallas-jroll", {"LEGATE_SPARSE_TPU_PALLAS_ROLL": "xla"}),
+    pytest.param("pallas-shift3",
+                 {"LEGATE_SPARSE_TPU_PALLAS_INPUTS": "distinct"},
+                 marks=pytest.mark.slow),
+    pytest.param("pallas-jroll",
+                 {"LEGATE_SPARSE_TPU_PALLAS_ROLL": "xla"},
+                 marks=pytest.mark.slow),
 ]
+RUNG_IDS = ["pallas", "pallas-shift3", "pallas-jroll"]
 
 
 def _run(code: str, env_extra: dict, timeout_s: int = 420) -> None:
@@ -260,7 +268,7 @@ print("all-ok")
 
 
 @pytest.mark.parametrize("name,env_extra", RUNGS,
-                         ids=[r[0] for r in RUNGS])
+                         ids=RUNG_IDS)
 def test_tpu_export_every_rung(name, env_extra):
     """Every ladder rung's kernels + the r3 crash composition must
     lower and serialize for the TPU platform from this CPU host."""
@@ -268,7 +276,7 @@ def test_tpu_export_every_rung(name, env_extra):
 
 
 @pytest.mark.parametrize("name,env_extra", RUNGS,
-                         ids=[r[0] for r in RUNGS])
+                         ids=RUNG_IDS)
 def test_interpret_crash_composition_every_rung(name, env_extra):
     """The exact chained-fori_loop composition that crashed the r3
     worker, executed (interpret mode) with numeric checks, per rung."""
@@ -332,7 +340,7 @@ print("all-ok")
 
 
 @pytest.mark.parametrize("name,env_extra", RUNGS,
-                         ids=[r[0] for r in RUNGS])
+                         ids=RUNG_IDS)
 def test_dist_mosaic_tpu_export_every_rung(name, env_extra):
     """Distributed shard_map + Pallas band SpMV/SpMM (and the looped
     solver composition) must lower and serialize for the TPU platform

@@ -272,6 +272,7 @@ def test_spgemm_band_matches_scipy(offsets, rng):
                                C_ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_spgemm_band_large_offsets(rng):
     n = 4096
     A, A_sp = _exact_band(n, [-640, 0, 640], rng)

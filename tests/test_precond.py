@@ -41,6 +41,7 @@ def test_block_jacobi_matches_explicit_inverse():
     np.testing.assert_allclose(np.asarray(M.matvec(v)), want, rtol=1e-10)
 
 
+@pytest.mark.slow
 def test_block_jacobi_accelerates_anisotropic_cg():
     # Line blocks along the strong coupling direction: large iteration
     # win on the anisotropic operator.

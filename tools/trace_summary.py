@@ -18,6 +18,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --comm
     python tools/trace_summary.py run.trace.json --plans
     python tools/trace_summary.py run.trace.json --resil
+    python tools/trace_summary.py run.trace.json --autotune
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
 file's bench metadata when present (bench.py embeds its result blob).
@@ -70,6 +71,21 @@ def render_comm_table(counters: dict) -> str:
     return report.format_table(headers, lines, left_cols=2)
 
 
+def render_autotune_table(counters: dict) -> str:
+    """Routing/measurement ledger from the ``autotune.*`` counters
+    embedded in a Chrome-trace artifact: verdict store activity, the
+    route hit/miss/decline funnel, and per-kernel routed-dispatch
+    counts (the dynamic ``autotune.route.<label>`` rows)."""
+    rows = {name: val for name, val in counters.items()
+            if name.startswith("autotune.")}
+    if not rows:
+        return ("no autotune.* counters recorded (autotuner off — "
+                "LEGATE_SPARSE_TPU_AUTOTUNE unset?)")
+    headers = ["counter", "value"]
+    lines = [[name, str(int(val))] for name, val in sorted(rows.items())]
+    return report.format_table(headers, lines, left_cols=1)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Per-op table from a legate_sparse_tpu trace file."
@@ -97,6 +113,11 @@ def main(argv=None) -> int:
                     help="also render the resilience ledger (per-site "
                          "faults/retries/breaker activity, shedding, "
                          "health verdicts from the resil.* counters)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="also render the autotune ledger (verdict "
+                         "store activity, route hit/miss/decline "
+                         "funnel, per-kernel routed dispatches from "
+                         "the autotune.* counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -154,6 +175,10 @@ def main(argv=None) -> int:
     if args.resil:
         print("\nresilience ledger:")
         print(report.render_resil_table(meta.get("counters") or {}))
+
+    if args.autotune:
+        print("\nautotune ledger:")
+        print(render_autotune_table(meta.get("counters") or {}))
 
     if args.latency:
         print("\nlatency histograms:")

@@ -1,17 +1,30 @@
 # Copyright 2026.
 # SPDX-License-Identifier: Apache-2.0
-"""On-chip irregular-path shoot-out: XLA ELL gather vs block-sparse.
+"""On-chip irregular-path shoot-out — a thin CLI over the autotuner.
 
-Measures random-sparsity CSR SpMV (the reference's general path,
-``src/sparse/array/csr/spmv.cc:36-44``) through:
-1. the XLA ELL gather kernel (``ops/spmv.py::ell_spmv``),
-2. the Pallas BSR kernel (``ops/bsr.py``) across densities,
-3. a clustered config (dense 8x8 sub-blocks scattered randomly — the
-   FEM-node pattern) where BSR's per-present-block population, not
-   global density, sets the rate (IRREGULAR.md law).
+Races the autotune candidate registry (``csr-rowids`` / ``ell`` /
+``sliced-ell``, ``legate_sparse_tpu/autotune/registry.py``) on the
+irregular configs the reference's general path serves
+(``src/sparse/array/csr/spmv.cc:36-44``), records each winning verdict
+into the autotune store, and additionally times the Pallas BSR kernel
+(``ops/bsr.py`` — not a registry candidate: it keeps unconditional
+dispatch priority) across densities plus a clustered config (dense 8x8
+sub-blocks scattered randomly — the FEM-node pattern) where BSR's
+per-present-block population, not global density, sets the rate
+(IRREGULAR.md law).
+
+Candidate timing goes through ``autotune.measure_candidates`` — the
+same harness ``tune()`` and the bench autotune phase use, so this tool
+and the runtime agree by construction.  The winner is cross-checked
+with the chained-fori_loop protocol (``bench_timing.py``), because on
+this TPU tunnel ``block_until_ready`` can return at dispatch-ack
+(bench.py header): a large gap between ``<label>_ms`` and
+``winner_loop_ms`` flags the sync problem instead of hiding it.
 
 Appends a JSON block to TPU_EVIDENCE.md.  Run from the repo root when
 the accelerator answers: ``python tools/tune_irregular.py``.
+``LEGATE_SPARSE_TPU_SHOOTOUT_TIMEOUT`` bounds the inner measurement
+subprocess (seconds, default 3000).
 """
 
 from __future__ import annotations
@@ -27,41 +40,57 @@ OUT = os.path.join(ROOT, "TPU_EVIDENCE.md")
 SHOOTOUT = r"""
 import json
 import numpy as np, jax, jax.numpy as jnp
-import scipy.sparse as sp
 import legate_sparse_tpu as sparse
+from legate_sparse_tpu import autotune, gallery
 from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+from legate_sparse_tpu.csr import csr_array
 from legate_sparse_tpu.ops import spmv as spmv_ops
 from legate_sparse_tpu.ops.bsr import bsr_pack, BsrStructure
+from legate_sparse_tpu.settings import settings
 
-out = {"platform": jax.devices()[0].platform, "configs": []}
+settings.autotune = True
+out = {"platform": jax.devices()[0].platform,
+       "platform_fp": autotune.platform_fingerprint(), "configs": []}
 rng = np.random.default_rng(0)
 
-def measure(A_sp, label):
-    rows, cols = A_sp.shape
-    nnz = A_sp.nnz
-    x = jnp.asarray(rng.standard_normal(cols).astype(np.float32))
+def measure(A, label):
+    A.sum_duplicates()
+    rows, cols = A.shape
+    nnz = A.nnz
+    x = jnp.asarray(rng.standard_normal(cols).astype(A.dtype))
     cfg = {"label": label, "rows": rows, "nnz": nnz,
-           "density": round(nnz / (rows * cols), 6)}
+           "density": round(nnz / (rows * cols), 6),
+           "fingerprint": A._get_fingerprint().klass}
     useful_bytes = nnz * 8  # value + col index, CSR-equivalent terms
 
-    # XLA ELL gather
-    W = max(int(np.diff(A_sp.indptr).max()), 1)
-    ell = spmv_ops.ell_pack_device(
-        jnp.asarray(A_sp.data.astype(np.float32)),
-        jnp.asarray(A_sp.indices.astype(np.int32)),
-        jnp.asarray(A_sp.indptr.astype(np.int32)), rows, W)
+    # Candidate race through the autotune harness (the runtime's own
+    # timing path); the winner becomes a stored verdict.
     try:
-        ms = loop_ms_per_iter(
-            lambda v: spmv_ops.ell_spmv(ell[0], ell[1], ell[2], v),
-            x, k_lo=2, k_hi=6)
-        cfg["ell_xla_ms"] = round(ms, 3)
-        cfg["ell_xla_gbs"] = round(useful_bytes / ms / 1e6, 2)
+        timings = autotune.measure_candidates(A, x, warmup=1, trials=5)
+        for lbl, ms in timings.items():
+            k = lbl.replace("-", "_")
+            cfg[k + "_ms"] = round(ms, 3)
+            cfg[k + "_gbs"] = round(useful_bytes / ms / 1e6, 2)
+        winner = min(timings, key=timings.get)
+        cfg["verdict"] = winner
+        key = autotune.key_for(A, "spmv")
+        if key is not None:
+            autotune.get_store().record(key, winner,
+                                        timings_ms=timings, trials=5)
+            cfg["verdict_key"] = key.key_id
+        # Dispatch-ack cross-check: the chained-loop protocol cannot
+        # be fooled by an early block_until_ready return.
+        run = autotune.CANDIDATES[winner].run
+        ms = loop_ms_per_iter(lambda v: run(A, v, "spmv"), x,
+                              k_lo=2, k_hi=6)
+        cfg["winner_loop_ms"] = round(ms, 3)
     except Exception as e:
-        cfg["ell_xla_error"] = repr(e)[:200]
+        cfg["candidates_error"] = repr(e)[:300]
 
-    # Pallas BSR
-    pack = bsr_pack(A_sp.data, A_sp.indices, A_sp.indptr, A_sp.shape,
-                    max_expand=1e9)
+    # Pallas BSR (kept outside the registry: structure-specialized
+    # priority path, measured here for the density law).
+    pack = bsr_pack(np.asarray(A.data), np.asarray(A.indices),
+                    np.asarray(A.indptr), A.shape, max_expand=1e9)
     if pack is not None:
         st = BsrStructure(*pack, rows, cols)
         cfg["nblocks"] = st.nblocks
@@ -77,14 +106,21 @@ def measure(A_sp, label):
             cfg["bsr_error"] = repr(e)[:300]
     out["configs"].append(cfg)
 
+def from_coo(r, c, n):
+    order = np.lexsort((c, r))
+    vals = np.ones(r.shape[0], np.float32)
+    return csr_array((vals[order], (r[order], c[order])), shape=(n, n))
+
 # Uniform random at increasing density, fixed 64 MB-ish footprint.
 for n, d in [(1 << 14, 0.005), (1 << 14, 0.02), (1 << 13, 0.08)]:
     nnz = int(n * n * d)
     r = rng.integers(0, n, nnz); c = rng.integers(0, n, nnz)
-    A = sp.coo_matrix((np.ones(nnz, np.float32), (r, c)),
-                      shape=(n, n)).tocsr()
-    A.sum_duplicates()
-    measure(A, f"uniform_{n}_{d}")
+    measure(from_coo(r, c, n), f"uniform_{n}_{d}")
+
+# Power-law rows (the autotuner's home turf: flat ELL blows its
+# padding budget, sliced ELL bins the skew away).
+measure(gallery.powerlaw(1 << 18, nnz_per_row=8, rng=11),
+        "powerlaw_2e18_w8")
 
 # Clustered: dense 8x8 sub-blocks at random positions (FEM pattern),
 # ~27 blocks per block-row like a 3-D stencil.
@@ -97,22 +133,18 @@ rr = (br[:, None] * bs + np.arange(bs)[None, :]).ravel()
 r = np.repeat(rr, bs)
 c = ((bc[:, None] * bs + np.arange(bs)[None, :])[:, None, :]
      + np.zeros((1, bs, 1), np.int64)).ravel()
-A = sp.coo_matrix((np.ones(r.shape[0], np.float32), (r, c)),
-                  shape=(n, n)).tocsr()
-A.sum_duplicates()
-measure(A, "clustered_fem_8x8")
+measure(from_coo(r, c, n), "clustered_fem_8x8")
 
 # Hyper-sparse tail (the adversarial config): expect BSR over budget,
-# XLA gather is the ceiling; record it honestly.
+# the gather candidates are the ceiling; record it honestly.
 n = 1 << 22
 W = 11
 nnz = n * W
 r = np.repeat(np.arange(n), W)
 c = rng.integers(0, n, nnz)
-A = sp.coo_matrix((np.ones(nnz, np.float32), (r, c)), shape=(n, n)).tocsr()
-A.sum_duplicates()
-measure(A, "hyper_sparse_2e22_W11")
+measure(from_coo(r, c, n), "hyper_sparse_2e22_W11")
 
+out["verdicts"] = len(autotune.get_store())
 print(json.dumps(out))
 """
 
