@@ -95,6 +95,16 @@ lane pins the verdict instead of measuring (deterministic golden);
 everything restores on exit — the autotuner stays inert for every
 other phase.
 
+Gateway fairness phase (schema_version 12, ``docs/ENGINE.md``): a
+3-tenant sweep against the multi-tenant admission gateway — a WFQ
+packing stage (the interactive tenant's alternating same-bucket
+matrices dispatch as stacked multi-matrix batches) and a flood stage
+(a background tenant offers 4x its queue quota and deterministically
+rejects ``queue_full`` while interactive service is unaffected) —
+recording the golden-gated ``gateway_requests`` /
+``gateway_dispatches`` / ``gateway_packed`` /
+``gateway_rejected_queue_full`` and per-tenant served/shed totals.
+
 Observability: with ``LEGATE_SPARSE_TPU_OBS=1`` the run additionally
 writes a ``BENCH_<stamp>.trace.json`` Chrome-trace artifact (path
 override: ``LEGATE_SPARSE_TPU_OBS_FILE``) containing phase spans
@@ -443,7 +453,7 @@ def _banded_config(sparse, n: int, nnz_per_row: int, dtype=np.float32):
                         dtype=dtype)
 
 
-def _engine_config(sparse, n: int, nnz_per_row: int):
+def _engine_config(sparse, n: int, nnz_per_row: int, seed: int = 7):
     """Random-column CSR with a DETERMINISTIC nnz and one heavy row:
     random columns defeat band detection and the heavy row blows the
     ELL (and BSR) budgets, so the matrix is engine-eligible on every
@@ -451,8 +461,11 @@ def _engine_config(sparse, n: int, nnz_per_row: int):
     roofline gather path wins there), and a uniform-row config would
     silently skip the whole phase.  nnz = nnz_per_row * (n + 63)
     exactly, so the shape buckets — and the golden-gated plan
-    hit/miss counts — are the same on every machine."""
-    rng = np.random.default_rng(7)
+    hit/miss counts — are the same on every machine.  ``seed`` varies
+    the column pattern/values only, never the nnz: different seeds
+    yield DISTINCT matrices in the SAME shape bucket (the gateway
+    phase packs them into one stacked dispatch)."""
+    rng = np.random.default_rng(seed)
     counts = np.full(n, nnz_per_row, dtype=np.int64)
     counts[0] = min(64 * nnz_per_row, n)   # ELL-budget breaker
     indptr = np.zeros(n + 1, dtype=np.int64)
@@ -607,8 +620,12 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # (docs/AUTOTUNER.md): verdict-routed irregular SpMV on a seeded
 # power-law matrix — irregular_spmv_ms / irregular_csr_ms /
 # irregular_spmv_speedup / irregular_spmv_path + the golden-gated
-# autotune_verdicts.
-SCHEMA_VERSION = 11
+# autotune_verdicts.  12 = gateway fairness phase (docs/ENGINE.md):
+# 3-tenant admission-gateway sweep (WFQ packing stage + flood stage)
+# with the golden-gated deterministic totals ``gateway_requests`` /
+# ``gateway_dispatches`` / ``gateway_packed`` /
+# ``gateway_rejected_queue_full`` / per-tenant served/shed.
+SCHEMA_VERSION = 12
 
 
 def main() -> None:
@@ -1455,6 +1472,124 @@ def main() -> None:
                             p99_ms=result["saturation_p99_ms"])
         except Exception as e:
             sys.stderr.write(f"bench: saturation phase failed: {e!r}\n")
+
+    # Gateway fairness phase (schema_version 12, docs/ENGINE.md): the
+    # multi-tenant admission gateway under a 3-tenant load, in two
+    # stages.  Stage A (max_batch=4) proves WFQ batch formation and
+    # cross-matrix packing: the interactive tenant alternates two
+    # distinct same-bucket matrices, so its batches dispatch as ONE
+    # stacked multi-matrix kernel (gateway.packed moves).  Stage B
+    # (flush-only, wide batch, tenant_quota=8) proves overload
+    # isolation: a background tenant floods 32 requests against an
+    # 8-deep quota — deterministically 24 ``queue_full`` rejections —
+    # while the interactive tenant's served count is unaffected.  All
+    # totals are deterministic given the fixed submission sequence, so
+    # the smoke golden pins them.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_GATEWAY",
+                           "0") != "1")
+            and not past_deadline(result, "gateway")):
+        try:
+            from legate_sparse_tpu.engine import Engine as _GEngine
+            from legate_sparse_tpu.engine import Gateway as _GGateway
+            from legate_sparse_tpu.settings import settings as _gst
+
+            n_g = (1 << 12 if smoke else 1 << 14) - 91
+            with obs.span("bench.gateway") as _sp:
+                A_g1 = _engine_config(sparse, n_g, nnz_per_row)
+                A_g2 = _engine_config(sparse, n_g, nnz_per_row,
+                                      seed=13)
+                A_g3 = _engine_config(sparse, n_g, nnz_per_row,
+                                      seed=29)
+                x_g = jnp.ones((n_g,), jnp.float32)
+                gw_counters = (
+                    "gateway.submitted",
+                    "gateway.dispatches",
+                    "gateway.packed",
+                    "gateway.rejected.queue_full",
+                    "gateway.tenant.interactive.served",
+                    "gateway.tenant.interactive.shed",
+                    "gateway.tenant.batch.served",
+                    "gateway.tenant.background.served",
+                    "gateway.tenant.background.shed",
+                )
+                c0g = {k: obs.counters.get(k) for k in gw_counters}
+                saved_gw = _gst.gateway
+                try:
+                    _gst.gateway = True
+
+                    def _load(gw):
+                        futs = []
+                        for i in range(8):
+                            futs.append(gw.submit(
+                                A_g1 if i % 2 == 0 else A_g2, x_g,
+                                tenant="interactive",
+                                qos="interactive"))
+                        for _i in range(8):
+                            futs.append(gw.submit(
+                                A_g3, x_g, tenant="batch",
+                                qos="batch"))
+                        for _i in range(32):
+                            futs.append(gw.submit(
+                                A_g1, x_g, tenant="background",
+                                qos="background"))
+                        gw.flush()
+                        for f in futs:
+                            _ = f.result(timeout=120)
+
+                    # Stage A: tight batches — the 4th pending request
+                    # triggers dispatch in the submitting thread, so
+                    # the interactive tenant's alternating matrices
+                    # land in packed multi-matrix batches.
+                    gw_a = _GGateway(
+                        _GEngine(), max_batch=4, queue_depth=128,
+                        tenant_quota=64, rate=0.0, burst=16.0,
+                        slack_ms=5.0, timeout_ms=0.0)
+                    try:
+                        _load(gw_a)
+                    finally:
+                        gw_a.shutdown()
+                    # Stage B: flood — nothing dispatches during
+                    # submission (max_batch exceeds the offered load),
+                    # so the background tenant fills its 8-deep quota
+                    # and the remaining 24 submissions reject.
+                    gw_b = _GGateway(
+                        _GEngine(), max_batch=32, queue_depth=128,
+                        tenant_quota=8, rate=0.0, burst=16.0,
+                        slack_ms=5.0, timeout_ms=0.0)
+                    try:
+                        _load(gw_b)
+                    finally:
+                        gw_b.shutdown()
+                finally:
+                    _gst.gateway = saved_gw
+
+                def _dg(name):
+                    return int(obs.counters.get(name) - c0g[name])
+
+                result["gateway_requests"] = _dg("gateway.submitted")
+                result["gateway_dispatches"] = _dg(
+                    "gateway.dispatches")
+                result["gateway_packed"] = _dg("gateway.packed")
+                result["gateway_rejected_queue_full"] = _dg(
+                    "gateway.rejected.queue_full")
+                result["gateway_interactive_served"] = _dg(
+                    "gateway.tenant.interactive.served")
+                result["gateway_interactive_shed"] = _dg(
+                    "gateway.tenant.interactive.shed")
+                result["gateway_batch_served"] = _dg(
+                    "gateway.tenant.batch.served")
+                result["gateway_background_served"] = _dg(
+                    "gateway.tenant.background.served")
+                result["gateway_background_shed"] = _dg(
+                    "gateway.tenant.background.shed")
+                if _sp is not None:
+                    _sp.set(requests=result["gateway_requests"],
+                            packed=result["gateway_packed"],
+                            rejected=result[
+                                "gateway_rejected_queue_full"])
+        except Exception as e:
+            sys.stderr.write(f"bench: gateway phase failed: {e!r}\n")
 
     # Autotune phase (schema_version 11, docs/AUTOTUNER.md): the
     # irregular-SpMV speedup proof.  A seeded power-law matrix gets a

@@ -18,6 +18,13 @@ The serving layer between user traffic and the kernels (see
   micro-batches same-plan SpMV requests into one stacked SpMM
   dispatch, with queue-depth/timeout/backpressure knobs in
   ``settings``.
+- **admission gateway** (``gateway``, ``LEGATE_SPARSE_TPU_GATEWAY``):
+  the multi-tenant layer above the executor — QoS classes, per-tenant
+  token buckets and queue quotas, weighted-fair-queueing batch
+  formation (cross-matrix batches pack into one stacked
+  ``multi_matvec`` dispatch), deadline-aware dispatch and typed
+  shedding (``tools/trace_summary.py --gateway`` renders the
+  per-tenant ledger).
 
 Enable with ``LEGATE_SPARSE_TPU_ENGINE=1`` (or ``settings.engine =
 True``): eligible ``csr_array.dot`` and ``linalg.cg`` hot paths then
@@ -32,6 +39,9 @@ from .core import (  # noqa: F401
     route_matvec, warmup,
 )
 from .executor import RequestExecutor  # noqa: F401
+from .gateway import (  # noqa: F401
+    QOS_CLASSES, QOS_WEIGHTS, Gateway, get_gateway, reset_gateway,
+)
 from .plan_cache import (  # noqa: F401
     Plan, PlanCache, PlanKey, maybe_enable_persistent_cache,
 )
@@ -41,5 +51,7 @@ __all__ = [
     "Engine", "engine_enabled", "get_engine", "reset_engine",
     "route_matvec", "route_matmat", "warmup",
     "RequestExecutor",
+    "QOS_CLASSES", "QOS_WEIGHTS", "Gateway", "get_gateway",
+    "reset_gateway",
     "Plan", "PlanCache", "PlanKey", "maybe_enable_persistent_cache",
 ]

@@ -275,6 +275,65 @@ class Engine:
                    X_p)
         return Y_p[: A.shape[0], :k]
 
+    def multi_matvec(self, pairs, _checked: bool = False):
+        """``[A_i @ x_i]`` for matrices sharing ONE shape bucket, as a
+        single stacked dispatch (the gateway's cross-tenant batch
+        path).  ``pairs`` is a list of ``(A, x)``; every matrix must
+        land in the same ``(rows_b, cols_b, nnz_b, dtype)`` bucket —
+        the caller groups by that key, so a mismatch raises rather
+        than silently splitting.  Returns the list of results, or
+        None when any matrix is ineligible or the stacked segment-id
+        domain would leave int32 (caller falls back to per-request
+        dispatch).  Per matrix the result is bit-for-bit the
+        single-matrix plan's (kernel contract)."""
+        import jax.numpy as jnp
+
+        if not pairs:
+            return []
+        if len(pairs) == 1:
+            A, x = pairs[0]
+            y = self.matvec(A, x, _checked=_checked)
+            return None if y is None else [y]
+        if not _checked:
+            for A, x in pairs:
+                if not self._eligible(A, jnp.asarray(x).dtype):
+                    return None
+        A0 = pairs[0][0]
+        key = self._key("spmv_multi", A0.shape[0], A0.shape[1],
+                        A0.nnz, A0.dtype, k=len(pairs))
+        terms = (key.rows_b, key.cols_b, key.nnz_b, key.dtype)
+        for A, _x in pairs[1:]:
+            k1 = self._key("spmv", A.shape[0], A.shape[1], A.nnz,
+                           A.dtype)
+            if (k1.rows_b, k1.cols_b, k1.nnz_b, k1.dtype) != terms:
+                raise ValueError(
+                    "engine.multi_matvec: matrices span different "
+                    "shape buckets")
+        if key.k_b * (key.rows_b + 1) > _INT32_MAX:
+            return None     # offset segment ids leave int32
+        _rfaults.fault_point("engine.exec.dispatch")
+        plan, _hit = self._cache.get_or_build(
+            key, BUILDERS["spmv_multi"])
+        packs = [self._pack_for(A, key) for A, _x in pairs]
+        b_pad = key.k_b - len(pairs)
+        # Batch-padding slots reuse pack 0's arrays with valid_nnz=0
+        # (every product masked to an exact 0) and a zero operand.
+        data = jnp.stack([p.data for p in packs]
+                         + [packs[0].data] * b_pad)
+        indices = jnp.stack([p.indices for p in packs]
+                            + [packs[0].indices] * b_pad)
+        row_ids = jnp.stack([p.row_ids for p in packs]
+                            + [packs[0].row_ids] * b_pad)
+        valid = jnp.stack(
+            [p.valid for p in packs]
+            + [jnp.zeros((), dtype=jnp.int32)] * b_pad)
+        zero_x = jnp.zeros((key.cols_b,), dtype=A0.dtype)
+        X = jnp.stack(
+            [_pad_tail(jnp.asarray(x).astype(A.dtype), key.cols_b, 0)
+             for A, x in pairs] + [zero_x] * b_pad)
+        Y = plan(data, indices, row_ids, valid, X)
+        return [Y[i, : A.shape[0]] for i, (A, _x) in enumerate(pairs)]
+
     def traceable_matvec(self, A) -> Optional[Callable]:
         """A jax-traceable ``x -> A @ x`` closure over the bucketed
         plan — for solver loops (``linalg.cg`` et al.), where the AOT

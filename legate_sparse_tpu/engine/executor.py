@@ -22,7 +22,12 @@ Contract
   ``flush`` only).
 - Backpressure: at ``settings.engine_queue_depth`` pending requests, a
   ``submit`` converts into an inline dispatch of the largest group
-  (bounded queue without a deadlockable wait).
+  (bounded queue without a deadlockable wait) — unless some group's
+  oldest request has aged past 2x the batch timeout, in which case the
+  oldest such group wins the eviction pick instead (largest-first
+  alone would let a small old group starve indefinitely under
+  sustained load; ``engine.exec.backpressure_aged`` counts these
+  fairness picks).
 - Ineligible submissions (matrix on a structure fast path, tracer
   context) dispatch inline through the normal ``A.dot`` — the Future
   contract holds either way.
@@ -174,16 +179,16 @@ class _Request:
             dispatch_ms=round(dispatch_ms, 4),
             batch_k=batch_k)
 
-    def shed(self, site: str) -> None:
+    def shed(self, site: str, reason: str = "deadline_shed") -> None:
         """Resolve with the typed Rejected outcome (never dispatched)."""
         waited_ms = (time.perf_counter_ns() - self.t_ns) / 1e6
         _obs.inc("resil.shed")
         _obs.inc(f"resil.shed.{site}")
-        _obs.event("resil.shed", site=site,
+        _obs.event("resil.shed", site=site, reason=reason,
                    waited_ms=round(waited_ms, 3))
         self.finish("shed")
         self.future.set_result(_routcomes.Rejected(
-            site=site, reason="deadline", waited_ms=waited_ms,
+            site=site, reason=reason, waited_ms=waited_ms,
             deadline_ms=(self.deadline.total_ms
                          if self.deadline is not None else None)))
 
@@ -352,9 +357,27 @@ class RequestExecutor:
             r.t_popped = now
 
     def _pop_largest_locked(self):
+        """Backpressure eviction pick: normally the LARGEST group
+        (best amortization for the inline dispatch the submitter is
+        about to pay for) — but a largest-first pick alone is unfair
+        under sustained load: a small old group can sit behind an
+        endless series of fuller ones and never dispatch.  Any group
+        whose oldest request has aged past 2x the batch timeout
+        therefore wins the pick (oldest such group first); with
+        ``timeout_ms <= 0`` (deterministic flush-only mode) the bound
+        is zero and the pick is simply oldest-first."""
         if not self._groups:
             return None
-        token = max(self._groups, key=lambda t: len(self._groups[t]))
+        now = time.perf_counter_ns()
+        age_bound_ns = 2.0 * self.timeout_ms * 1e6
+        aged = [t for t, g in self._groups.items()
+                if now - g[0].t_ns >= age_bound_ns]
+        if aged:
+            _obs.inc("engine.exec.backpressure_aged")
+            token = min(aged, key=lambda t: self._groups[t][0].t_ns)
+        else:
+            token = max(self._groups,
+                        key=lambda t: len(self._groups[t]))
         group = self._groups.pop(token)
         A = self._anchors.pop(token)
         self._pending -= len(group)

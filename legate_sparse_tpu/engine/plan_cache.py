@@ -344,7 +344,46 @@ def build_spmm_plan(key: PlanKey) -> Plan:
                 meta={"kernel": "csr_spmm_rowids_masked"})
 
 
+def build_spmv_multi_plan(key: PlanKey) -> Plan:
+    """Stacked multi-matrix SpMV plan: ``k_b`` independent matrices
+    from the SAME shape bucket (different tenants/matrices, one
+    gateway batch) dispatched as one executable.
+
+    Operand slot ``i`` carries matrix ``i``'s pack (the same
+    per-matrix pack the SpMV/SpMM plans consume — pack cache terms
+    exclude the op, so no re-padding) and its own x vector; segment
+    ids are offset per slot by ``rows_b + 1`` so every pack's
+    out-of-range padding row id stays in its own discarded segment
+    (bit-for-bit contract, see ``csr_multi_spmv_rowids_masked``)."""
+    import jax
+
+    from ..ops import spmv as spmv_ops
+    from ..types import coord_dtype_for
+
+    dt = np.dtype(key.dtype)
+    cdt = coord_dtype_for(max(key.cols_b, 1))
+    sds = jax.ShapeDtypeStruct
+    b = key.k_b
+    specs = (
+        sds((b, key.nnz_b), dt),          # stacked data
+        sds((b, key.nnz_b), cdt),         # stacked indices
+        sds((b, key.nnz_b), np.int32),    # stacked row_ids
+        sds((b,), np.int32),              # per-matrix valid_nnz
+        sds((b, key.cols_b), dt),         # per-matrix x
+    )
+    compiled = _aot(spmv_ops.csr_multi_spmv_rowids_masked, key, specs,
+                    rows=key.rows_b, b=b)
+
+    def traced(data, indices, row_ids, valid, X):
+        return spmv_ops.csr_multi_spmv_rowids_masked(
+            data, indices, row_ids, valid, X, rows=key.rows_b, b=b)
+
+    return Plan(key, compiled=compiled, traced=traced,
+                meta={"kernel": "csr_multi_spmv_rowids_masked"})
+
+
 BUILDERS: Dict[str, Callable[[PlanKey], Plan]] = {
     "spmv": build_spmv_plan,
     "spmm": build_spmm_plan,
+    "spmv_multi": build_spmv_multi_plan,
 }

@@ -386,3 +386,79 @@ def render_resil_table(counters: Dict[str, Any]) -> str:
             f"trace-suppressed"
         )
     return "\n".join(lines)
+
+
+# Rejection-reason vocabulary shared with outcomes.REJECT_REASONS
+# (kept literal here: report renders artifacts from other builds).
+_GW_REASONS = ("deadline_shed", "quota", "queue_full", "breaker")
+
+
+def render_gateway_table(counters: Dict[str, Any]) -> str:
+    """Per-tenant admission-gateway ledger from the ``gateway.*``
+    counters (``tools/trace_summary.py --gateway``; naming contract in
+    docs/OBSERVABILITY.md): one row per tenant that submitted anything
+    — submitted / served / shed / error — plus summary lines for batch
+    formation (dispatches, packed multi-matrix batches, occupancy),
+    per-reason rejections, and degraded-mode inline serves."""
+    per_tenant: Dict[str, Dict[str, float]] = {}
+    for name, val in counters.items():
+        if not name.startswith("gateway.tenant."):
+            continue
+        body = name[len("gateway.tenant."):]
+        tenant, _, kind = body.rpartition(".")
+        if not tenant or kind not in ("submitted", "served", "shed",
+                                      "error"):
+            continue
+        per_tenant.setdefault(tenant, {
+            "submitted": 0, "served": 0, "shed": 0, "error": 0,
+        })[kind] += val
+    lines = []
+    if per_tenant:
+        rows = [
+            [t, str(int(r["submitted"])), str(int(r["served"])),
+             str(int(r["shed"])), str(int(r["error"]))]
+            for t, r in sorted(per_tenant.items(),
+                               key=lambda kv: (-kv[1]["submitted"],
+                                               kv[0]))
+        ]
+        lines.append(format_table(
+            ["tenant", "submitted", "served", "shed", "error"], rows))
+    else:
+        lines.append("no gateway.tenant.* counters recorded "
+                     "(gateway never engaged?)")
+    subs = counters.get("gateway.submitted", 0)
+    if subs:
+        disp = counters.get("gateway.dispatches", 0)
+        dreq = counters.get("gateway.dispatched_requests", 0)
+        lines.append(
+            f"gateway: {int(subs)} submitted, "
+            f"{int(counters.get('gateway.admitted', 0))} admitted, "
+            f"{int(disp)} dispatches "
+            f"({dreq / max(disp, 1):.1f} reqs/batch, "
+            f"{int(counters.get('gateway.packed', 0))} packed "
+            f"multi-matrix), "
+            f"{int(counters.get('gateway.inline', 0))} inline, "
+            f"{int(counters.get('gateway.evicted', 0))} evicted"
+        )
+    rej = {r: counters.get(f"gateway.rejected.{r}", 0)
+           for r in _GW_REASONS}
+    if any(rej.values()):
+        lines.append("rejections: " + ", ".join(
+            f"{int(v)} {r}" for r, v in rej.items() if v))
+    degraded = (counters.get("gateway.breaker_inline", 0)
+                + counters.get("gateway.admit_fault_inline", 0)
+                + counters.get("gateway.dispatch_fault_inline", 0)
+                + counters.get("gateway.dispatch_fallback", 0))
+    if degraded:
+        lines.append(
+            f"degraded serves: "
+            f"{int(counters.get('gateway.breaker_inline', 0))} "
+            f"breaker-inline, "
+            f"{int(counters.get('gateway.admit_fault_inline', 0))} "
+            f"admit-fault, "
+            f"{int(counters.get('gateway.dispatch_fault_inline', 0))} "
+            f"dispatch-fault, "
+            f"{int(counters.get('gateway.dispatch_fallback', 0))} "
+            f"dispatch-fallback"
+        )
+    return "\n".join(lines)

@@ -100,6 +100,39 @@ def csr_spmm_rowids_masked(data, indices, row_ids, valid_nnz, X, rows: int):
     )
 
 
+@partial(jax.jit, static_argnames=("rows", "b"))
+def csr_multi_spmv_rowids_masked(data, indices, row_ids, valid_nnz, X,
+                                 rows: int, b: int):
+    """``b`` independent masked SpMVs in ONE stacked dispatch (the
+    gateway's cross-tenant batch kernel): operand slot ``i`` holds
+    matrix ``i``'s padded pack and its own x vector.
+
+    Each matrix's segment ids are offset by ``i * (rows + 1)`` — the
+    ``+ 1`` keeps the pack's out-of-bounds padding row id (``rows``)
+    inside matrix ``i``'s own discarded segment instead of aliasing
+    matrix ``i+1``'s row 0.  Per matrix this performs exactly the
+    masked product and in-order segment reduction of
+    :func:`csr_spmv_rowids_masked`, so packing requests from
+    different tenants/matrices is bit-for-bit invisible to each of
+    them.  A slot with ``valid_nnz == 0`` (batch padding up to the
+    bucketed width) contributes only exact zeros."""
+    _obs.inc("trace.csr_multi_spmv_rowids_masked")
+    nnz = data.shape[1]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    gathered = jnp.take_along_axis(X, indices, axis=1)
+    prod = jnp.where(
+        slot[None, :] < valid_nnz[:, None], data * gathered,
+        jnp.zeros((1, 1), dtype=data.dtype),
+    )
+    offs = jnp.arange(b, dtype=jnp.int32)[:, None] * (rows + 1)
+    seg = (row_ids + offs).reshape(-1)
+    out = jax.ops.segment_sum(
+        prod.reshape(-1), seg, num_segments=b * (rows + 1),
+        indices_are_sorted=True,
+    )
+    return out.reshape(b, rows + 1)[:, :rows]
+
+
 @jax.jit
 def ell_spmv(ell_data, ell_cols, ell_counts, x):
     """SpMV over ELL-packed structure: the TPU fast path.

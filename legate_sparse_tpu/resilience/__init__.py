@@ -30,6 +30,10 @@ observable** (``docs/RESILIENCE.md``):
                  ``HealthReport`` instead of silent NaN results.
 - ``outcomes`` — the typed outcome/error vocabulary shared by all of
                  the above.
+- ``chaos``    — composed-fault drill harness: random faults from the
+                 closed catalog under live multi-tenant gateway load,
+                 with exactly-once / exact-accounting / bitwise-parity
+                 invariant checks (``docs/RESILIENCE.md``).
 
 Inert by default: with ``LEGATE_SPARSE_TPU_RESIL`` unset every hook is
 one flag read, no site adds a host sync, and behavior is bit-for-bit
@@ -40,7 +44,7 @@ events; ``tools/trace_summary.py --resil`` renders the ledger.
 
 from __future__ import annotations
 
-from . import deadline, faults, health, outcomes, policy  # noqa: F401
+from . import chaos, deadline, faults, health, outcomes, policy  # noqa: F401
 from .faults import CATALOG, InjectedFault, fault_point, inject  # noqa: F401
 from .health import Monitor, SolverHealthError  # noqa: F401
 from .outcomes import (  # noqa: F401
@@ -51,7 +55,7 @@ from .policy import CircuitOpenError, breaker, run  # noqa: F401
 from ..settings import settings as _settings
 
 __all__ = [
-    "deadline", "faults", "health", "outcomes", "policy",
+    "chaos", "deadline", "faults", "health", "outcomes", "policy",
     "CATALOG", "InjectedFault", "fault_point", "inject",
     "Monitor", "SolverHealthError",
     "DeadlineExceeded", "FinalOutcomeError", "HealthReport", "Rejected",

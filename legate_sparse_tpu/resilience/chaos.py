@@ -1,0 +1,233 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Chaos drill harness: composed random faults under multi-tenant load.
+
+Single-fault drills (tests/test_resilience.py) prove each mechanism in
+isolation; what they cannot prove is *composition* — that a tenant's
+injected faults, breaker trips, and deadline storms stay contained
+while OTHER tenants' traffic flows through the same gateway and
+engine.  :func:`run_drill` drives exactly that scenario and checks the
+gateway's isolation contract as hard invariants:
+
+1. **Exactly-once resolution** — every submitted Future resolves
+   (never hangs) with a typed outcome: a result array or an
+   ``outcomes.Rejected``; an exception surfacing to a caller is a
+   violation (the gateway's degradation paths must absorb injected
+   faults).
+2. **Exact accounting** — per-tenant and global ``gateway.*`` counter
+   deltas must balance: ``submitted == served + shed + error`` for
+   every tenant, and the global roll-ups agree with the per-tenant
+   sums.
+3. **Bitwise parity** — every served result equals, bit-for-bit, one
+   of the two legitimate clean dispatch paths, computed with all
+   faults cleared: the engine's bucketed plan (every batch route —
+   packed, grouped, and single-request dispatches are mutually
+   bit-identical by the kernel contract) or the plain ``A.dot``
+   (the inline/degraded route; the autotuner may pick a
+   differently-rounding kernel there).  An injected fault may delay,
+   reroute, or shed a request — never corrupt its value.
+
+The fault schedule is drawn from a seeded ``random.Random`` over the
+closed site catalog (``faults.CATALOG``) — same seed, same schedule,
+every run; no global RNG state is touched.  Faults are cleared between
+rounds and the policy registry is reset at the end, so a drill leaves
+no armed state behind.
+
+Usage (the shape ``tests/test_gateway.py`` drives)::
+
+    report = chaos.run_drill(
+        gw,
+        tenants=[
+            {"name": "a", "qos": "interactive", "A": A1, "xs": xs1},
+            {"name": "b", "qos": "background", "A": A2, "xs": xs2,
+             "deadline_ms": 0.0},     # deadline-storm tenant
+        ],
+        rounds=4, seed=7)
+    assert report.ok(), report.violations
+
+Requires ``settings.gateway`` and ``settings.resil`` on (the drill is
+about the armed system; with either off there is nothing to compose).
+"""
+
+from __future__ import annotations
+
+import random
+from concurrent.futures import TimeoutError as _FutTimeoutError
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs as _obs
+from ..settings import settings as _settings
+from . import deadline as _deadline
+from . import faults as _faults
+from . import policy as _policy
+from .outcomes import Rejected
+
+#: Default fault-site pool: the two gateway sites plus the engine
+#: sites a gateway dispatch can reach.
+DEFAULT_SITES = ("gateway.admit", "gateway.dispatch",
+                 "engine.exec.dispatch", "engine.plan.build")
+
+#: Fault kinds composed by default.  ``nonfinite`` is excluded: the
+#: gateway sites carry no value for it to poison (it degrades to a
+#: no-op fire), so it adds schedule noise without exercising anything.
+DEFAULT_KINDS = ("error", "latency")
+
+
+@dataclass
+class ChaosReport:
+    """Outcome ledger of one drill (violations empty == contract
+    held)."""
+
+    rounds: int = 0
+    submitted: int = 0
+    served: int = 0
+    shed: int = 0
+    errors: int = 0
+    faults_armed: int = 0
+    faults_fired: int = 0
+    per_tenant: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    violations: List[str] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _arm_random_faults(rng: random.Random, sites: Sequence[str],
+                       kinds: Sequence[str],
+                       report: ChaosReport) -> None:
+    """Arm 1-2 faults for this round, drawn deterministically from
+    ``rng`` (sites may repeat across rounds — re-arming replaces)."""
+    for _ in range(rng.randint(1, 2)):
+        site = rng.choice(list(sites))
+        kind = rng.choice(list(kinds))
+        _faults.inject(site, kind=kind, count=rng.randint(1, 3),
+                       latency_ms=1.0)
+        report.faults_armed += 1
+
+
+def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
+              seed: int = 0,
+              sites: Sequence[str] = DEFAULT_SITES,
+              kinds: Sequence[str] = DEFAULT_KINDS,
+              result_timeout_s: float = 30.0) -> ChaosReport:
+    """Run ``rounds`` of composed-fault multi-tenant load through
+    ``gateway`` and verify the isolation invariants (module
+    docstring).
+
+    Each tenant spec is a dict: ``name``, ``qos``, ``A`` (the
+    tenant's matrix), ``xs`` (operand vectors submitted each round),
+    and optional ``deadline_ms`` — when set, that tenant's submissions
+    run inside ``deadline.scope(deadline_ms)`` (``0.0`` = a deadline
+    storm: every one of its requests arrives already expired)."""
+    if not (_settings.gateway and _settings.resil):
+        raise RuntimeError(
+            "chaos.run_drill needs settings.gateway and settings.resil "
+            "on — the drill composes faults through the armed system")
+    rng = random.Random(seed)
+    report = ChaosReport(rounds=rounds)
+    c0 = _obs.counters.snapshot("gateway.")
+    names = [str(spec["name"]) for spec in tenants]
+    try:
+        for _round in range(rounds):
+            _faults.clear()
+            _arm_random_faults(rng, sites, kinds, report)
+            inflight: List[Tuple[dict, object, object]] = []
+            for spec in tenants:
+                dl: Optional[float] = spec.get("deadline_ms")
+                for x in spec["xs"]:
+                    if dl is not None:
+                        with _deadline.scope(dl):
+                            fut = gateway.submit(
+                                spec["A"], x, tenant=spec["name"],
+                                qos=spec.get("qos", "batch"))
+                    else:
+                        fut = gateway.submit(
+                            spec["A"], x, tenant=spec["name"],
+                            qos=spec.get("qos", "batch"))
+                    report.submitted += 1
+                    inflight.append((spec, x, fut))
+            gateway.flush()
+            report.faults_fired += sum(
+                a["fired"] for a in _faults.armed().values())
+            # Quiesce injection BEFORE computing parity references:
+            # the reference dispatch must be clean.
+            _faults.clear()
+            for spec, x, fut in inflight:
+                try:
+                    out = fut.result(timeout=result_timeout_s)
+                except (_FutTimeoutError, TimeoutError):
+                    report.violations.append(
+                        f"hang: tenant {spec['name']} future never "
+                        f"resolved")
+                    continue
+                except BaseException as e:  # noqa: BLE001 - ledger
+                    report.errors += 1
+                    report.violations.append(
+                        f"exception surfaced to tenant "
+                        f"{spec['name']}: {e!r}")
+                    continue
+                if isinstance(out, Rejected):
+                    report.shed += 1
+                    if out.reason not in (
+                            "deadline_shed", "quota", "queue_full",
+                            "breaker"):
+                        report.violations.append(
+                            f"untyped rejection reason {out.reason!r}")
+                    continue
+                report.served += 1
+                out_np = np.asarray(out)
+                refs = [np.asarray(spec["A"].dot(x))]
+                eng = getattr(gateway, "_engine", None)
+                if eng is not None:
+                    y_eng = eng.matvec(spec["A"], x)
+                    if y_eng is not None:
+                        refs.append(np.asarray(y_eng))
+                if not any(np.array_equal(out_np, r) for r in refs):
+                    report.violations.append(
+                        f"bitwise parity violated for tenant "
+                        f"{spec['name']}")
+    finally:
+        _faults.clear()
+        _policy.reset()
+    # ---- exact accounting over the counter deltas ----
+    c1 = _obs.counters.snapshot("gateway.")
+
+    def delta(name: str) -> int:
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+    if delta("gateway.submitted") != report.submitted:
+        report.violations.append(
+            f"gateway.submitted moved {delta('gateway.submitted')} "
+            f"!= {report.submitted} submitted")
+    tot_served = tot_shed = tot_err = 0
+    for name in names:
+        sub = delta(f"gateway.tenant.{name}.submitted")
+        srv = delta(f"gateway.tenant.{name}.served")
+        shd = delta(f"gateway.tenant.{name}.shed")
+        err = delta(f"gateway.tenant.{name}.error")
+        report.per_tenant[name] = {
+            "submitted": sub, "served": srv, "shed": shd, "error": err}
+        tot_served += srv
+        tot_shed += shd
+        tot_err += err
+        if sub != srv + shd + err:
+            report.violations.append(
+                f"tenant {name} ledger leak: submitted {sub} != "
+                f"served {srv} + shed {shd} + error {err}")
+    if tot_served != report.served:
+        report.violations.append(
+            f"served roll-up {tot_served} != observed {report.served}")
+    if tot_shed != report.shed:
+        report.violations.append(
+            f"shed roll-up {tot_shed} != observed {report.shed}")
+    reasons = sum(delta(f"gateway.rejected.{r}")
+                  for r in ("deadline_shed", "quota", "queue_full",
+                            "breaker"))
+    if reasons != tot_shed:
+        report.violations.append(
+            f"per-reason rejections {reasons} != tenant shed sum "
+            f"{tot_shed}")
+    return report

@@ -44,16 +44,23 @@ from .outcomes import Rejected  # noqa: F401  (re-export convenience)
 @dataclass(frozen=True)
 class Deadline:
     """An absolute expiry on the monotonic clock plus the budget it
-    was created with (for reporting)."""
+    was created with (for reporting).
 
-    t_end: float            # time.monotonic() seconds
+    Expiry arithmetic is integer ``time.monotonic_ns()`` — never wall
+    clock (NTP steps would expire or resurrect budgets), and never
+    float seconds (whose 2^53 mantissa silently coarsens long-uptime
+    monotonic readings below the sub-ms budgets used here).  The
+    clock source is read through the ``time`` module attribute at
+    every call so tests can freeze/step it with ``monkeypatch``."""
+
+    t_end_ns: int           # time.monotonic_ns() expiry
     total_ms: float
 
     def remaining_ms(self) -> float:
-        return (self.t_end - time.monotonic()) * 1e3
+        return (self.t_end_ns - time.monotonic_ns()) / 1e6
 
     def expired(self) -> bool:
-        return time.monotonic() >= self.t_end
+        return time.monotonic_ns() >= self.t_end_ns
 
 
 _var: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
@@ -64,9 +71,9 @@ _var: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
 def scope(ms: float) -> Iterator[Deadline]:
     """Bind a deadline ``ms`` milliseconds from now for the enclosed
     code (sooner-wins under nesting)."""
-    d = Deadline(time.monotonic() + float(ms) / 1e3, float(ms))
+    d = Deadline(time.monotonic_ns() + int(float(ms) * 1e6), float(ms))
     cur = _var.get()
-    if cur is not None and cur.t_end < d.t_end:
+    if cur is not None and cur.t_end_ns < d.t_end_ns:
         d = cur
     token = _var.set(d)
     try:

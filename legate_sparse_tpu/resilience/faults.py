@@ -79,6 +79,12 @@ CATALOG: Dict[str, str] = {
         "conv_test_iters cycle)",
     "solver.gmres.conv":
         "linalg.py: GMRES per-restart-cycle convergence fetch",
+    "gateway.admit":
+        "engine/gateway.py: multi-tenant admission (quota / token "
+        "bucket / deadline triage)",
+    "gateway.dispatch":
+        "engine/gateway.py: WFQ batch dispatch (stacked multi-matrix "
+        "or per-matrix plan execution)",
 }
 
 #: Fault kinds a site can be armed with.

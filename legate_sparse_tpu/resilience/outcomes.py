@@ -42,19 +42,44 @@ class FinalOutcomeError(ResilienceError):
     failure, so it never feeds the breaker either."""
 
 
+#: Closed vocabulary of shed/reject causes.  ``deadline_shed`` — the
+#: request's deadline expired (at admission, flush, or a deadline
+#: storm eviction); ``quota`` — the tenant's token bucket ran dry;
+#: ``queue_full`` — a per-tenant queue quota or the global pending
+#: bound was hit (including backpressure eviction of a queued
+#: victim); ``breaker`` — shed during a breaker-open degraded window.
+REJECT_REASONS = ("deadline_shed", "quota", "queue_full", "breaker")
+
+
 @dataclass(frozen=True)
 class Rejected:
     """A request shed before dispatch (typed outcome, not an error).
 
     ``site`` is the shedding point (``engine.exec.queue`` for
-    admission, ``engine.exec.dispatch`` for a flush-time shed),
+    admission, ``engine.exec.dispatch`` for a flush-time shed,
+    ``gateway.admit`` / ``gateway.dispatch`` for the multi-tenant
+    gateway), ``reason`` one of :data:`REJECT_REASONS`,
     ``waited_ms`` how long the request sat in the queue before the
-    shed decision, ``deadline_ms`` the budget it arrived with."""
+    shed decision, ``deadline_ms`` the budget it arrived with, and
+    ``tenant`` the owning tenant when shed by the gateway.
+
+    Backward compatible: the pre-typed spelling ``reason="deadline"``
+    (PR 5..8 executor sheds) normalizes to ``deadline_shed``; any
+    string outside the vocabulary fails loudly at construction."""
 
     site: str
-    reason: str = "deadline"
+    reason: str = "deadline_shed"
     waited_ms: float = 0.0
     deadline_ms: Optional[float] = None
+    tenant: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.reason == "deadline":        # legacy spelling
+            object.__setattr__(self, "reason", "deadline_shed")
+        if self.reason not in REJECT_REASONS:
+            raise ValueError(
+                f"Rejected.reason={self.reason!r}: expected one of "
+                f"{REJECT_REASONS}")
 
 
 class DeadlineExceeded(FinalOutcomeError):

@@ -69,10 +69,14 @@ class CircuitBreaker:
         self.site = site
         self.k = max(int(k), 1)
         self.cooldown_s = max(float(cooldown_s), 0.0)
+        # Cooldown arithmetic on integer monotonic_ns (clock-step
+        # safe; the source is read via the ``time`` module attribute
+        # at call time so tests can freeze it).
+        self.cooldown_ns = int(self.cooldown_s * 1e9)
         self._lock = threading.Lock()
         self._state = "closed"
         self._failures = 0
-        self._opened_at = 0.0
+        self._opened_at_ns = 0
         self._probing = False
 
     @property
@@ -84,9 +88,9 @@ class CircuitBreaker:
         with self._lock:
             if self._state == "closed":
                 return True
-            now = time.monotonic()
+            now_ns = time.monotonic_ns()
             if self._state == "open":
-                if now - self._opened_at < self.cooldown_s:
+                if now_ns - self._opened_at_ns < self.cooldown_ns:
                     return False
                 self._state = "half_open"
                 self._probing = True
@@ -126,7 +130,7 @@ class CircuitBreaker:
 
     def _trip_locked(self, reopen: bool) -> None:
         self._state = "open"
-        self._opened_at = time.monotonic()
+        self._opened_at_ns = time.monotonic_ns()
         self._failures = 0
         self._probing = False
         _obs.inc("resil.breaker.trips")

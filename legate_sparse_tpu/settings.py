@@ -103,6 +103,31 @@ Settings
       0 = off) and ``resil_divergence_mult`` (``_DIVERGENCE_MULT``)
       tune it.
 
+``gateway`` (``LEGATE_SPARSE_TPU_GATEWAY``)
+    Multi-tenant admission gateway (``legate_sparse_tpu.engine.gateway``,
+    ``docs/ENGINE.md``): per-tenant QoS classes, token-bucket rate
+    limits, queue quotas, weighted-fair-queueing batch formation and
+    deadline-aware dispatch in front of the execution engine.  Off by
+    default — no existing call path routes through the gateway, and
+    ``Gateway.submit`` degrades to a transparent inline dispatch, so
+    behavior and counters stay bit-for-bit those of the engine alone.
+    Knobs (all env-overridable, prefix ``LEGATE_SPARSE_TPU_GATEWAY_``):
+
+    - ``gateway_max_batch`` (``_BATCH``, 8): requests packed per
+      stacked dispatch.
+    - ``gateway_queue_depth`` (``_QUEUE``, 128): global pending bound —
+      beyond it admission evicts by least-slack/lowest-class.
+    - ``gateway_tenant_quota`` (``_TENANT_QUOTA``, 32): per-tenant
+      queued-request cap (reason ``queue_full`` beyond it).
+    - ``gateway_rate`` / ``gateway_burst`` (``_RATE``/``_BURST``):
+      per-tenant token-bucket refill (requests/s, 0 = unlimited) and
+      capacity (reason ``quota`` when empty).
+    - ``gateway_slack_ms`` (``_SLACK_MS``, 5.0): deadline slack below
+      which a request is dispatched immediately, never held for a
+      fuller batch.
+    - ``gateway_timeout_ms`` (``_TIMEOUT_MS``, 2.0): background drain
+      cadence; ``<= 0`` = deterministic flush-only mode (tests).
+
 ``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
     Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
     ``docs/AUTOTUNER.md``): measured kernel selection for the
@@ -324,6 +349,32 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_RESIL_DIVERGENCE_MULT",
                            "1e8")
         )
+        # ---- multi-tenant gateway (legate_sparse_tpu.engine.gateway) ----
+        self.gateway: bool = _env_bool("LEGATE_SPARSE_TPU_GATEWAY",
+                                       False)
+        self.gateway_max_batch: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_BATCH", "8")
+        )
+        self.gateway_queue_depth: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_QUEUE", "128")
+        )
+        self.gateway_tenant_quota: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_TENANT_QUOTA",
+                           "32")
+        )
+        self.gateway_rate: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_RATE", "0.0")
+        )
+        self.gateway_burst: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_BURST", "16.0")
+        )
+        self.gateway_slack_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_SLACK_MS", "5.0")
+        )
+        self.gateway_timeout_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_TIMEOUT_MS",
+                           "2.0")
+        )
         # ---- autotuner (legate_sparse_tpu.autotune) ----
         self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
                                         False)
@@ -365,6 +416,13 @@ class Settings:
         "resil_retry_budget", "resil_breaker_k",
         "resil_breaker_cooldown_ms", "resil_health",
         "resil_stagnation_cycles", "resil_divergence_mult",
+        # Gateway knobs shape admission, fairness and queueing in
+        # front of the engine — pure request-lifecycle policy, never
+        # what a plan lowers to (the stacked multi-matrix plan is
+        # keyed on its own bucketed batch size, not on these knobs).
+        "gateway", "gateway_max_batch", "gateway_queue_depth",
+        "gateway_tenant_quota", "gateway_rate", "gateway_burst",
+        "gateway_slack_ms", "gateway_timeout_ms",
         # Autotune knobs pick *which already-compiled kernel* serves a
         # dispatch (routing) or shape the measurement budget — never
         # what any kernel lowers to.  Verdict keys carry the epoch

@@ -25,15 +25,22 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # engine) — the resilience drill's exact fault/retry/shed/trip
 # accounting, the saturation sweep's totals (fixed request plan;
 # every request batches exactly once; one deterministic shed drill),
-# and the autotune phase's verdict count (one pinned verdict against a
-# fresh store) do not.
+# the autotune phase's verdict count (one pinned verdict against a
+# fresh store), and the gateway fairness sweep's admission/packing/
+# rejection totals (fixed submission sequence, flush-only dispatch)
+# do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
                  "engine_plan_hits,engine_plan_misses,"
                  "engine_batch_requests,"
                  "resil_retries,resil_shed,resil_breaker_trips,"
                  "resil_faults_injected,"
                  "saturation_requests,saturation_shed,"
-                 "saturation_batched_requests,autotune_verdicts")
+                 "saturation_batched_requests,autotune_verdicts,"
+                 "gateway_requests,gateway_dispatches,gateway_packed,"
+                 "gateway_rejected_queue_full,"
+                 "gateway_interactive_served,gateway_interactive_shed,"
+                 "gateway_batch_served,gateway_background_served,"
+                 "gateway_background_shed")
 
 
 from utils_test.tools import load_tool as _tool
@@ -230,6 +237,57 @@ def test_smoke_trace_has_autotune_ledger(smoke_run, capsys):
     assert rc == 0, out
     assert "autotune ledger:" in out
     assert "autotune.route.hits" in out
+
+
+def test_smoke_gateway_phase_numbers(smoke_run):
+    """Gateway fairness sweep acceptance: the 3-tenant sweep's totals
+    are deterministic given the fixed submission sequence.  Stage A
+    (max_batch=4): 48 requests in 12 batches, the interactive tenant's
+    two alternating same-bucket matrices land in 2 packed multi-matrix
+    dispatches (+1 mixed-tenant pack in stage B's single wide batch =
+    3 packed).  Stage B (flood, tenant_quota=8): the background tenant
+    offers 32 and rejects exactly 24 ``queue_full`` — while the
+    interactive tenant serves everything it submitted (16 across both
+    stages, 0 shed): the isolation headline."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 12
+    assert result["gateway_requests"] == 96
+    assert result["gateway_dispatches"] == 13
+    assert result["gateway_packed"] == 3
+    assert result["gateway_rejected_queue_full"] == 24
+    assert result["gateway_interactive_served"] == 16
+    assert result["gateway_interactive_shed"] == 0
+    assert result["gateway_batch_served"] == 16
+    assert result["gateway_background_served"] == 40
+    assert result["gateway_background_shed"] == 24
+
+
+def test_smoke_trace_has_gateway_ledger(smoke_run, capsys):
+    """The trace artifact carries the gateway.* counters with exact
+    per-tenant accounting, and ``trace_summary --gateway`` renders the
+    per-tenant ledger."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("gateway.submitted", 0) == 96
+    assert ctrs.get("gateway.rejected.queue_full", 0) == 24
+    # Per-tenant ledgers balance: submitted == served + shed.
+    for tenant, served, shed in (("interactive", 16, 0),
+                                 ("batch", 16, 0),
+                                 ("background", 40, 24)):
+        assert ctrs.get(f"gateway.tenant.{tenant}.submitted", 0) == (
+            served + shed), tenant
+        assert ctrs.get(f"gateway.tenant.{tenant}.served", 0) == served
+        assert ctrs.get(f"gateway.tenant.{tenant}.shed", 0) == shed
+    hists = doc["otherData"].get("histograms") or {}
+    assert any(k.startswith("lat.gateway.wait.") and v["count"] > 0
+               for k, v in hists.items()), sorted(hists)
+    rc = _tool("trace_summary").main([str(trace_path), "--gateway"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "gateway ledger:" in out
+    assert "interactive" in out and "background" in out
+    assert "24 queue_full" in out
 
 
 def test_smoke_trace_has_latency_histograms(smoke_run, capsys):

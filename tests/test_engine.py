@@ -333,6 +333,37 @@ def test_executor_backpressure_inline_dispatch():
     ex.shutdown()
 
 
+def test_executor_backpressure_age_bound_beats_largest_group():
+    """Backpressure fairness regression: the eviction pick must not
+    starve a small old group behind an endless series of fuller ones.
+    Any group older than 2x the batch timeout wins the pick — with
+    ``timeout_ms=0`` (flush-only) the bound is 0, so the OLDEST group
+    always wins deterministically: here the lone request for A (group
+    of 1, submitted first) must dispatch ahead of the fuller group for
+    B when the 5th submit trips the queue bound."""
+    eng = Engine()
+    A, _ = _random_csr(140, seed=23)
+    B, _ = _random_csr(140, seed=24)
+    ex = RequestExecutor(eng, max_batch=64, queue_depth=4, timeout_ms=0)
+    aged0 = obs.counters.get("engine.exec.backpressure_aged")
+    xa = _x(140, np.float32, seed=60)
+    fut_a = ex.submit(A, xa)                      # oldest, group of 1
+    futs_b = [ex.submit(B, _x(140, np.float32, seed=61 + i))
+              for i in range(3)]                  # larger group
+    trigger = ex.submit(B, _x(140, np.float32, seed=70))
+    # The 5th submit hit the queue bound: pre-fix the LARGEST group
+    # (B) would have been dispatched inline and A left to starve; the
+    # age bound dispatches the oldest group instead.
+    assert fut_a.done(), "aged group was not the eviction pick"
+    assert not any(f.done() for f in futs_b)
+    assert obs.counters.get("engine.exec.backpressure_aged") == aged0 + 1
+    assert _bitident(fut_a.result(timeout=30), _ref_spmv(A, xa))
+    ex.flush()
+    for f in futs_b + [trigger]:
+        assert f.result(timeout=30).shape == (140,)
+    ex.shutdown()
+
+
 def test_solver_route_not_stale_after_mutation(eng_settings):
     """An operator wrapped BEFORE an in-place matrix mutation must not
     solve the old matrix: the construction-time engine closure

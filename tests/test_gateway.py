@@ -1,0 +1,531 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Multi-tenant admission gateway drills (docs/ENGINE.md,
+docs/RESILIENCE.md).
+
+The gateway's load-bearing contracts, each pinned here:
+
+- **off == inert**: with ``LEGATE_SPARSE_TPU_GATEWAY`` unset, submit is
+  a transparent inline dispatch — bit-for-bit the plain ``A.dot`` and
+  zero ``gateway.*`` counter movement;
+- **WFQ fairness**: batch formation follows virtual finish tags
+  (weights 8:4:1), so queued interactive work always leads queued
+  background work;
+- **typed admission control**: token-bucket (``quota``), per-tenant
+  queue bound (``queue_full``), backpressure eviction of the weakest
+  request, deadline shedding at admit and at the flush point, breaker
+  degraded mode — every rejection is a typed ``outcomes.Rejected``;
+- **exactly-once + exact accounting + bitwise parity**, proven under
+  composed random faults by the chaos drill
+  (``resilience.chaos.run_drill``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+from legate_sparse_tpu import obs, resilience
+from legate_sparse_tpu.engine import (
+    Engine, Gateway, QOS_CLASSES, QOS_WEIGHTS, get_gateway,
+    reset_gateway,
+)
+from legate_sparse_tpu.obs import report as obs_report
+from legate_sparse_tpu.resilience import chaos
+from legate_sparse_tpu.resilience import deadline as rdeadline
+from legate_sparse_tpu.resilience import faults as rfaults
+from legate_sparse_tpu.resilience import policy as rpolicy
+from legate_sparse_tpu.resilience.outcomes import Rejected
+from legate_sparse_tpu.settings import settings
+
+# One engine for the whole module: gateways are cheap, plans are not,
+# and sharing the plan cache is exactly the production shape.
+_ENG = Engine()
+
+
+@pytest.fixture
+def gw_on():
+    """Gateway armed, restored after the test."""
+    saved = settings.gateway
+    settings.gateway = True
+    yield settings
+    settings.gateway = saved
+
+
+_RESIL_KNOBS = (
+    "resil", "resil_retries", "resil_backoff_ms", "resil_breaker_k",
+    "resil_breaker_cooldown_ms",
+)
+
+
+@pytest.fixture
+def armed(gw_on):
+    """Gateway + resilience armed (the chaos-drill configuration)."""
+    saved = {k: getattr(settings, k) for k in _RESIL_KNOBS}
+    settings.resil = True
+    settings.resil_backoff_ms = 0.0
+    resilience.reset()
+    yield settings
+    for k, v in saved.items():
+        setattr(settings, k, v)
+    resilience.reset()
+
+
+def _random_csr(n=400, density=0.03, seed=0):
+    """Engine-eligible random CSR; ``sp.random`` draws EXACTLY
+    ``int(density*n*n)`` nonzeros, so different seeds land in the same
+    ``(rows_b, cols_b, nnz_b)`` bucket — the cross-matrix pack setup."""
+    S = sp.random(n, n, density=density, format="csr",
+                  random_state=np.random.default_rng(seed),
+                  dtype=np.float32)
+    return lst.csr_array(S)
+
+
+def _tridiag(n=256):
+    return lst.diags(
+        [np.full(n, 4.0, np.float32), np.full(n - 1, -1.0, np.float32),
+         np.full(n - 1, -1.0, np.float32)],
+        [0, 1, -1], format="csr", dtype=np.float32)
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+
+def _flush_only(engine=_ENG, **kw):
+    """A deterministic gateway: no drain worker (timeout_ms=0), wide
+    defaults; tests override the knob under drill."""
+    base = dict(max_batch=64, queue_depth=128, tenant_quota=64,
+                rate=0.0, burst=16.0, slack_ms=1.0, timeout_ms=0.0)
+    base.update(kw)
+    return Gateway(engine, **base)
+
+
+def _delta(c0, c1, name):
+    return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+
+def _ref(A, x):
+    """Reference for every QUEUED serve: the engine's single-request
+    bucketed dispatch.  The packed/grouped batch paths are bit-for-bit
+    this value (kernel contract); the plain ``A.dot`` may route a
+    differently-rounding autotuned kernel and is the reference only
+    for the inline paths."""
+    return np.asarray(_ENG.matvec(A, x, _checked=True))
+
+
+# ---------------------------------------------------------------------------
+# off-by-default contract
+# ---------------------------------------------------------------------------
+def test_gateway_off_is_bit_for_bit_and_counter_inert():
+    assert settings.gateway is False, "suite must run with GATEWAY unset"
+    A = _random_csr(seed=3)
+    x = _x(A.shape[1], seed=5)
+    expect = np.asarray(A.dot(x))
+    gw = Gateway(_ENG)
+    c0 = obs.counters.snapshot("gateway.")
+    fut = gw.submit(A, x, tenant="off", qos="interactive")
+    assert fut.done(), "inert mode resolves inline, no queueing"
+    assert np.array_equal(np.asarray(fut.result()), expect)
+    c1 = obs.counters.snapshot("gateway.")
+    assert c0 == c1, "gateway off must move no gateway.* counters"
+    gw.shutdown()
+
+
+def test_submit_validation_is_mode_independent():
+    A = _random_csr(seed=3)
+    gw = Gateway(_ENG)
+    with pytest.raises(ValueError, match="unknown qos"):
+        gw.submit(A, _x(A.shape[1]), qos="platinum")
+    with pytest.raises(ValueError, match="does not match"):
+        gw.submit(A, _x(A.shape[1] + 1))
+    gw.shutdown()
+
+
+def test_get_gateway_singleton_and_reset(gw_on):
+    try:
+        g1 = get_gateway()
+        assert get_gateway() is g1
+        reset_gateway()
+        g2 = get_gateway()
+        assert g2 is not g1
+    finally:
+        reset_gateway()
+
+
+def test_submit_after_shutdown_raises(gw_on):
+    gw = _flush_only()
+    gw.shutdown()
+    A = _random_csr(seed=3)
+    with pytest.raises(RuntimeError, match="shut down"):
+        gw.submit(A, _x(A.shape[1]))
+
+
+# ---------------------------------------------------------------------------
+# WFQ batch formation
+# ---------------------------------------------------------------------------
+def test_wfq_interactive_leads_background(gw_on):
+    """Background arrives FIRST; WFQ still orders the batch by virtual
+    finish tag, so all interactive requests lead."""
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(6)]
+    gw = _flush_only()
+    try:
+        futs = []
+        for i in range(3):
+            futs.append(gw.submit(A, xs[i], tenant="bg",
+                                  qos="background"))
+        for i in range(3, 6):
+            futs.append(gw.submit(A, xs[i], tenant="ia",
+                                  qos="interactive"))
+        with gw._cv:
+            batch = gw._pop_batch_locked()
+        assert [r.tenant for r in batch] == ["ia"] * 3 + ["bg"] * 3
+        # Virtual-finish-tag math: start at the tenant's last finish,
+        # advance by 1/weight.
+        w_ia, w_bg = QOS_WEIGHTS["interactive"], QOS_WEIGHTS["background"]
+        assert [r.vtag for r in batch[:3]] == [
+            (k + 1) / w_ia for k in range(3)]
+        assert [r.vtag for r in batch[3:]] == [
+            (k + 1) / w_bg for k in range(3)]
+        gw._dispatch(batch)
+        for i, fut in enumerate(futs):
+            assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                                  _ref(A, xs[i]))
+    finally:
+        gw.shutdown()
+
+
+def test_qos_classes_are_the_eviction_ranking():
+    assert QOS_CLASSES == ("interactive", "batch", "background")
+    assert (QOS_WEIGHTS["interactive"] > QOS_WEIGHTS["batch"]
+            > QOS_WEIGHTS["background"])
+
+
+# ---------------------------------------------------------------------------
+# typed admission control
+# ---------------------------------------------------------------------------
+def test_token_bucket_rejects_with_quota_reason(gw_on):
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(4)]
+    gw = _flush_only(rate=0.001, burst=2.0)
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        futs = [gw.submit(A, x, tenant="limited") for x in xs]
+        for fut in futs[2:]:
+            out = fut.result(timeout=5)
+            assert isinstance(out, Rejected)
+            assert out.reason == "quota"
+            assert out.site == "gateway.admit"
+            assert out.tenant == "limited"
+        gw.flush()
+        for i, fut in enumerate(futs[:2]):
+            assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                                  _ref(A, xs[i]))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.rejected.quota") == 2
+    # exact per-tenant accounting
+    assert _delta(c0, c1, "gateway.tenant.limited.submitted") == 4
+    assert _delta(c0, c1, "gateway.tenant.limited.served") == 2
+    assert _delta(c0, c1, "gateway.tenant.limited.shed") == 2
+
+
+def test_tenant_quota_rejects_noisy_tenant_only(gw_on):
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(6)]
+    gw = _flush_only(tenant_quota=2)
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        noisy = [gw.submit(A, x, tenant="noisy") for x in xs[:5]]
+        calm = gw.submit(A, xs[5], tenant="calm", qos="interactive")
+        for fut in noisy[2:]:
+            out = fut.result(timeout=5)
+            assert isinstance(out, Rejected)
+            assert out.reason == "queue_full"
+        gw.flush()
+        assert np.array_equal(np.asarray(calm.result(timeout=30)),
+                              _ref(A, xs[5]))
+        for i, fut in enumerate(noisy[:2]):
+            assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                                  _ref(A, xs[i]))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.rejected.queue_full") == 3
+    assert _delta(c0, c1, "gateway.tenant.calm.shed") == 0
+
+
+def test_backpressure_evicts_weakest_class(gw_on):
+    """Queue full + stronger arrival: the queued background request is
+    evicted (typed ``queue_full``), never the interactive ones."""
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(3)]
+    gw = _flush_only(queue_depth=2)
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        f_ia1 = gw.submit(A, xs[0], tenant="ia", qos="interactive")
+        f_bg = gw.submit(A, xs[1], tenant="bg", qos="background")
+        f_ia2 = gw.submit(A, xs[2], tenant="ia", qos="interactive")
+        out = f_bg.result(timeout=5)
+        assert isinstance(out, Rejected)
+        assert out.reason == "queue_full"
+        assert out.tenant == "bg"
+        gw.flush()
+        assert np.array_equal(np.asarray(f_ia1.result(timeout=30)),
+                              _ref(A, xs[0]))
+        assert np.array_equal(np.asarray(f_ia2.result(timeout=30)),
+                              _ref(A, xs[2]))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.evicted") == 1
+
+
+def test_backpressure_rejects_weak_incoming(gw_on):
+    """Queue full of interactive work + background arrival: the
+    incoming request IS the weakest and is the one rejected — queued
+    strong work is never displaced by weaker traffic."""
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(3)]
+    gw = _flush_only(queue_depth=2)
+    try:
+        strong = [gw.submit(A, x, tenant="ia", qos="interactive")
+                  for x in xs[:2]]
+        weak = gw.submit(A, xs[2], tenant="bg", qos="background")
+        out = weak.result(timeout=5)
+        assert isinstance(out, Rejected)
+        assert out.reason == "queue_full"
+        assert out.tenant == "bg"
+        gw.flush()
+        for i, fut in enumerate(strong):
+            assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                                  _ref(A, xs[i]))
+    finally:
+        gw.shutdown()
+
+
+def test_ineligible_matrix_served_inline(gw_on):
+    """A structure-specialized matrix (banded -> DIA fast path) skips
+    the queue entirely: inline service, ``gateway.inline`` counter."""
+    A = _tridiag()
+    x = _x(A.shape[1], seed=9)
+    gw = _flush_only()
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        fut = gw.submit(A, x, tenant="banded")
+        assert fut.done()
+        assert np.array_equal(np.asarray(fut.result()),
+                              np.asarray(A.dot(x)))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.inline") == 1
+    assert _delta(c0, c1, "gateway.tenant.banded.served") == 1
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware batching (needs resil: deadline scopes)
+# ---------------------------------------------------------------------------
+def test_urgent_request_dispatches_immediately(armed):
+    """A near-deadline request is never held for a fuller batch: its
+    arrival seeds an immediate dispatch that also drains same-bucket
+    queued work."""
+    A = _random_csr(seed=3)
+    x0, x1 = _x(A.shape[1], seed=0), _x(A.shape[1], seed=1)
+    gw = _flush_only(slack_ms=10_000.0)
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        f0 = gw.submit(A, x0, tenant="calm")          # no deadline
+        assert not f0.done(), "queued, waiting for a batch"
+        with rdeadline.scope(5_000.0):                # slack <= 10s
+            f1 = gw.submit(A, x1, tenant="urgent",
+                           qos="interactive")
+        assert f0.done() and f1.done(), \
+            "urgent arrival must dispatch NOW, taking batchmates along"
+        assert np.array_equal(np.asarray(f0.result()), _ref(A, x0))
+        assert np.array_equal(np.asarray(f1.result()), _ref(A, x1))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.dispatches") == 1
+    assert _delta(c0, c1, "gateway.dispatched_requests") == 2
+
+
+def test_expired_deadline_shed_at_admission(armed):
+    A = _random_csr(seed=3)
+    gw = _flush_only()
+    try:
+        with rdeadline.scope(0.0):
+            fut = gw.submit(A, _x(A.shape[1]), tenant="storm")
+        out = fut.result(timeout=5)
+        assert isinstance(out, Rejected)
+        assert out.reason == "deadline_shed"
+        assert out.site == "gateway.admit"
+        assert out.deadline_ms == 0.0
+    finally:
+        gw.shutdown()
+
+
+def test_deadline_expiring_in_queue_shed_at_dispatch(armed):
+    """A request that expires while queued is triaged at the flush
+    point (site ``gateway.dispatch``), not served late."""
+    A = _random_csr(seed=3)
+    gw = _flush_only()          # slack_ms=1: 50ms budget is not urgent
+    try:
+        with rdeadline.scope(50.0):
+            fut = gw.submit(A, _x(A.shape[1]), tenant="late")
+        assert not fut.done()
+        time.sleep(0.06)
+        gw.flush()
+        out = fut.result(timeout=5)
+        assert isinstance(out, Rejected)
+        assert out.reason == "deadline_shed"
+        assert out.site == "gateway.dispatch"
+    finally:
+        gw.shutdown()
+
+
+def test_breaker_degraded_mode(armed):
+    """Dispatch breaker open: deferrable classes shed typed
+    ``breaker``; interactive traffic degrades to inline service."""
+    A = _random_csr(seed=3)
+    x = _x(A.shape[1], seed=2)
+    br = rpolicy.breaker("gateway.dispatch")
+    for _ in range(settings.resil_breaker_k):
+        br.record_failure()
+    assert br.state == "open"
+    gw = _flush_only()
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        out = gw.submit(A, x, tenant="bt",
+                        qos="batch").result(timeout=5)
+        assert isinstance(out, Rejected)
+        assert out.reason == "breaker"
+        f_ia = gw.submit(A, x, tenant="ia", qos="interactive")
+        assert f_ia.done()
+        assert np.array_equal(np.asarray(f_ia.result()),
+                              np.asarray(A.dot(x)))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.rejected.breaker") == 1
+    assert _delta(c0, c1, "gateway.breaker_inline") == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-matrix packing
+# ---------------------------------------------------------------------------
+def test_cross_matrix_batch_packs_one_dispatch(gw_on):
+    """Two different matrices in one shape bucket pack into a single
+    stacked dispatch (``gateway.packed``), bit-for-bit per request."""
+    A1, A2 = _random_csr(seed=3), _random_csr(seed=4)
+    assert A1.nnz == A2.nnz, "same density -> same nnz -> same bucket"
+    xs = [_x(A1.shape[1], seed=s) for s in range(4)]
+    mats = [A1, A2, A1, A2]
+    gw = _flush_only(max_batch=4)
+    c0 = obs.counters.snapshot("gateway.")
+    try:
+        futs = [gw.submit(M, x, tenant=f"t{i % 2}")
+                for i, (M, x) in enumerate(zip(mats, xs))]
+        # The 4th submit reached max_batch and dispatched in-thread.
+        for fut, M, x in zip(futs, mats, xs):
+            assert fut.done()
+            assert np.array_equal(np.asarray(fut.result()),
+                                  _ref(M, x))
+    finally:
+        gw.shutdown()
+    c1 = obs.counters.snapshot("gateway.")
+    assert _delta(c0, c1, "gateway.dispatches") == 1
+    assert _delta(c0, c1, "gateway.packed") == 1
+    assert _delta(c0, c1, "gateway.dispatched_requests") == 4
+
+
+def test_same_matrix_batch_is_bitwise(gw_on):
+    """Multiple requests against ONE matrix take the stacked-matmat
+    group path; each column must equal the single-request dispatch."""
+    A = _random_csr(seed=3)
+    xs = [_x(A.shape[1], seed=s) for s in range(3)]
+    gw = _flush_only()
+    try:
+        futs = [gw.submit(A, x, tenant="one") for x in xs]
+        gw.flush()
+        for fut, x in zip(futs, xs):
+            assert np.array_equal(np.asarray(fut.result(timeout=30)),
+                                  _ref(A, x))
+    finally:
+        gw.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: composed faults under live multi-tenant load
+# ---------------------------------------------------------------------------
+def test_chaos_drill_requires_armed_system():
+    with pytest.raises(RuntimeError, match="needs settings.gateway"):
+        chaos.run_drill(None, tenants=[])
+
+
+def test_chaos_drill_isolation_invariants(armed):
+    """The acceptance drill: randomized faults from the closed catalog
+    (admit/dispatch/engine sites) + a deadline-storm background tenant,
+    composed under live load.  Invariants (chaos module docstring):
+    exactly-once resolution, exact counter accounting, bitwise parity
+    — AND the good tenant rides through untouched."""
+    A_good, A_storm = _random_csr(seed=3), _random_csr(seed=4)
+    xs_good = [_x(A_good.shape[1], seed=s) for s in range(3)]
+    xs_storm = [_x(A_storm.shape[1], seed=s) for s in range(10, 13)]
+    gw = _flush_only(max_batch=8)
+    try:
+        report = chaos.run_drill(
+            gw,
+            tenants=[
+                {"name": "good", "qos": "interactive",
+                 "A": A_good, "xs": xs_good},
+                {"name": "storm", "qos": "background",
+                 "A": A_storm, "xs": xs_storm, "deadline_ms": 0.0},
+            ],
+            rounds=4, seed=7)
+    finally:
+        gw.shutdown()
+    assert report.ok(), report.violations
+    assert report.submitted == 24
+    assert report.served + report.shed + report.errors == 24
+    assert report.faults_armed >= 4, "every round arms at least one"
+    # Isolation: the storm tenant's expired flood and the injected
+    # faults never cost the good tenant a single request.
+    good = report.per_tenant["good"]
+    assert good["submitted"] == 12
+    assert good["served"] == 12
+    assert good["shed"] == 0 and good["error"] == 0
+    storm = report.per_tenant["storm"]
+    assert storm["submitted"] == 12
+    assert storm["shed"] >= 1, "a 0ms deadline storm must shed"
+    # A drill leaves no armed state behind.
+    assert not rfaults.armed()
+    assert rpolicy.breaker("gateway.dispatch").state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# ledger rendering
+# ---------------------------------------------------------------------------
+def test_gateway_ledger_renders_per_tenant_table(gw_on):
+    A = _random_csr(seed=3)
+    gw = _flush_only(tenant_quota=1)
+    try:
+        gw.submit(A, _x(A.shape[1], seed=0), tenant="render_a",
+                  qos="interactive")
+        gw.submit(A, _x(A.shape[1], seed=1), tenant="render_a")
+        gw.flush()
+    finally:
+        gw.shutdown()
+    table = obs_report.render_gateway_table(obs.counters.snapshot())
+    assert "render_a" in table
+    assert "submitted" in table and "queue_full" in table
+    # and the empty-counters fallback is graceful
+    assert "never engaged" in obs_report.render_gateway_table({})

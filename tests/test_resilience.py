@@ -773,3 +773,186 @@ def test_nonfinite_fault_on_spgemm_is_noop(resil):
     assert obs.counters.get("resil.retry.csr.dot") == 0
     assert np.array_equal(out, clean)
     resilience.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# satellite: typed Rejected.reason vocabulary (closed, backward compat)
+# ---------------------------------------------------------------------------
+def test_rejected_reason_typed_vocabulary():
+    from legate_sparse_tpu.resilience import outcomes
+
+    assert outcomes.Rejected(site="s.x").reason == "deadline_shed"
+    # Legacy spelling (pre-typed executor sheds) normalizes.
+    assert outcomes.Rejected(site="s.x",
+                             reason="deadline").reason == "deadline_shed"
+    for reason in outcomes.REJECT_REASONS:
+        assert outcomes.Rejected(site="s.x", reason=reason).reason == reason
+    with pytest.raises(ValueError):
+        outcomes.Rejected(site="s.x", reason="because")
+
+
+def test_executor_shed_carries_typed_reason(resil):
+    saved = settings.engine
+    from legate_sparse_tpu.engine import Engine, RequestExecutor
+
+    try:
+        settings.engine = True
+        A = _rand_csr(seed=23)
+        x = jnp.ones((A.shape[1],), jnp.float32)
+        ex = RequestExecutor(Engine(), max_batch=8, queue_depth=64,
+                             timeout_ms=0)
+        with rdeadline.scope(0.0):
+            out = ex.submit(A, x).result(timeout=10)
+        assert isinstance(out, resilience.Rejected)
+        assert out.reason == "deadline_shed"
+        ex.shutdown()
+    finally:
+        settings.engine = saved
+
+
+# ---------------------------------------------------------------------------
+# satellite: monotonic-clock internals (breaker cooldown, deadlines)
+# ---------------------------------------------------------------------------
+def test_breaker_cooldown_on_frozen_monotonic_clock(resil, monkeypatch):
+    """Breaker cooldown arithmetic runs on ``time.monotonic_ns()``
+    read at call time: under a frozen clock an open breaker never
+    half-opens, and advancing the fake clock past the cooldown
+    admits exactly the probe — no wall-clock sleeps, no flakiness."""
+    from legate_sparse_tpu.resilience import policy
+
+    now = {"ns": 1_000_000_000}
+    monkeypatch.setattr(time, "monotonic_ns", lambda: now["ns"])
+    br = policy.CircuitBreaker("drill.site", k=1, cooldown_s=0.05)
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()                      # frozen: still cooling
+    now["ns"] += 49_000_000
+    assert not br.allow()                      # 49 ms < 50 ms cooldown
+    now["ns"] += 2_000_000
+    assert br.allow()                          # past cooldown: probe
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_deadline_tracks_patched_monotonic_clock(resil, monkeypatch):
+    from legate_sparse_tpu.resilience import deadline as dl
+
+    now = {"ns": 5_000_000_000}
+    monkeypatch.setattr(time, "monotonic_ns", lambda: now["ns"])
+    with dl.scope(100.0):
+        d = dl.current()
+        assert d is not None
+        assert abs(d.remaining_ms() - 100.0) < 1e-9
+        assert not d.expired()
+        now["ns"] += 60_000_000
+        assert abs(d.remaining_ms() - 40.0) < 1e-9
+        now["ns"] += 40_000_000
+        assert d.expired()
+        assert d.remaining_ms() <= 0.0
+        # Sooner-wins nesting compares the integer end instants.
+        with dl.scope(10_000.0):
+            assert dl.current().t_end_ns == d.t_end_ns
+
+
+# ---------------------------------------------------------------------------
+# satellite: shutdown race — concurrent submit() vs close(), every
+# accepted Future resolves exactly once (or the submit raises)
+# ---------------------------------------------------------------------------
+def test_executor_shutdown_race_resolves_every_future(resil):
+    import threading
+
+    saved = settings.engine
+    from legate_sparse_tpu.engine import Engine, RequestExecutor
+
+    try:
+        settings.engine = True
+        A = _rand_csr(seed=24)
+        x = jnp.ones((A.shape[1],), jnp.float32)
+        expected = np.asarray(A @ x)
+        for trial in range(3):
+            ex = RequestExecutor(Engine(), max_batch=64,
+                                 queue_depth=256, timeout_ms=60000.0)
+            futs, raised = [], []
+            barrier = threading.Barrier(5)
+
+            def _submitter():
+                barrier.wait()
+                for _i in range(8):
+                    try:
+                        futs.append(ex.submit(A, x))
+                    except RuntimeError:
+                        # Landed after shutdown: allowed, as long as
+                        # nothing was enqueued (no orphaned Future).
+                        raised.append(1)
+
+            def _closer():
+                barrier.wait()
+                ex.close()
+
+            threads = ([threading.Thread(target=_submitter)
+                        for _t in range(4)]
+                       + [threading.Thread(target=_closer)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            ex.close()       # idempotent final drain
+            assert len(futs) + len(raised) == 32
+            for f in futs:
+                out = f.result(timeout=30)   # never hangs
+                if isinstance(out, resilience.Rejected):
+                    continue
+                assert np.array_equal(np.asarray(out), expected)
+    finally:
+        settings.engine = saved
+
+
+def test_gateway_shutdown_race_resolves_every_future(resil):
+    import threading
+
+    saved = settings.gateway
+    from legate_sparse_tpu.engine import Engine, Gateway
+
+    try:
+        settings.gateway = True
+        A = _rand_csr(seed=25)
+        x = jnp.ones((A.shape[1],), jnp.float32)
+        expected = np.asarray(A @ x)
+        for trial in range(3):
+            gw = Gateway(Engine(), max_batch=64, queue_depth=256,
+                         tenant_quota=64, rate=0.0, burst=16.0,
+                         slack_ms=5.0, timeout_ms=60000.0)
+            futs, raised = [], []
+            barrier = threading.Barrier(5)
+
+            def _submitter(name):
+                barrier.wait()
+                for _i in range(8):
+                    try:
+                        futs.append(gw.submit(A, x, tenant=name,
+                                              qos="batch"))
+                    except RuntimeError:
+                        raised.append(1)
+
+            def _closer():
+                barrier.wait()
+                gw.close()
+
+            threads = ([threading.Thread(target=_submitter,
+                                         args=(f"t{i}",))
+                        for i in range(4)]
+                       + [threading.Thread(target=_closer)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            gw.close()
+            assert len(futs) + len(raised) == 32
+            for f in futs:
+                out = f.result(timeout=30)   # never hangs
+                if isinstance(out, resilience.Rejected):
+                    continue
+                assert np.array_equal(np.asarray(out), expected)
+    finally:
+        settings.gateway = saved

@@ -19,7 +19,10 @@ the ``docs/RESILIENCE.md`` site table — agree, and fails on any drift:
    the catalog defines every site as a quoted literal there, which
    would make this rule unfalsifiable);
 3. every catalog site must appear in ``docs/RESILIENCE.md`` (the
-   operator-facing list stays complete).
+   operator-facing list stays complete);
+4. every site in the chaos drill's default pool
+   (``resilience.chaos.DEFAULT_SITES``) must be a catalog site — a
+   drill that arms an unregistered name silently tests nothing.
 
 Usage::
 
@@ -38,6 +41,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)
 sys.path.insert(0, _REPO)
 
+from legate_sparse_tpu.resilience.chaos import DEFAULT_SITES  # noqa: E402
 from legate_sparse_tpu.resilience.faults import CATALOG  # noqa: E402
 
 PKG_DIR = os.path.join(_REPO, "legate_sparse_tpu")
@@ -117,6 +121,11 @@ def main(argv=None) -> int:
     for site in undocumented:
         problems.append(
             f"catalog site {site!r} missing from docs/RESILIENCE.md")
+
+    for site in sorted(set(DEFAULT_SITES) - set(CATALOG)):
+        problems.append(
+            f"chaos.DEFAULT_SITES entry {site!r} is not a catalog "
+            f"site — the drill would arm a hook nobody calls")
 
     if args.list:
         width = max(len(s) for s in CATALOG)

@@ -18,6 +18,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --comm
     python tools/trace_summary.py run.trace.json --plans
     python tools/trace_summary.py run.trace.json --resil
+    python tools/trace_summary.py run.trace.json --gateway
     python tools/trace_summary.py run.trace.json --autotune
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
@@ -113,6 +114,11 @@ def main(argv=None) -> int:
                     help="also render the resilience ledger (per-site "
                          "faults/retries/breaker activity, shedding, "
                          "health verdicts from the resil.* counters)")
+    ap.add_argument("--gateway", action="store_true",
+                    help="also render the admission-gateway ledger "
+                         "(per-tenant submitted/served/shed/error, "
+                         "batch formation, per-reason rejections from "
+                         "the gateway.* counters)")
     ap.add_argument("--autotune", action="store_true",
                     help="also render the autotune ledger (verdict "
                          "store activity, route hit/miss/decline "
@@ -175,6 +181,10 @@ def main(argv=None) -> int:
     if args.resil:
         print("\nresilience ledger:")
         print(report.render_resil_table(meta.get("counters") or {}))
+
+    if args.gateway:
+        print("\ngateway ledger:")
+        print(report.render_gateway_table(meta.get("counters") or {}))
 
     if args.autotune:
         print("\nautotune ledger:")
