@@ -479,6 +479,29 @@ def _engine_config(sparse, n: int, nnz_per_row: int, seed: int = 7):
     return sparse.csr_array((data, indices, indptr), shape=(n, n))
 
 
+def _dist2d_config(sparse, n: int, nnz_per_row: int, seed: int = 7):
+    """Random-column CSR, symmetrized and diagonally dominated: the
+    random columns defeat band detection — so the 1-D baseline pays
+    the all_gather x realization a non-banded matrix forces at scale,
+    exactly the fight the 2-d-block layout exists to win — while
+    A + A^T + 2I keeps the fixed-iteration CG drill numerically tame.
+    nnz is a pure function of (n, seed), so the shard shapes — and
+    the ``dist2d_*_comm_bytes`` fields derived from them — are
+    deterministic and golden-pinnable."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(seed)
+    nnz = n * max(nnz_per_row // 2, 1)
+    rows = rng.integers(0, n, size=nnz)
+    cols = rng.integers(0, n, size=nnz)
+    vals = rng.standard_normal(nnz).astype(np.float32) / nnz_per_row
+    A = sp.coo_array((vals, (rows, cols)), shape=(n, n)).tocsr()
+    A = (A + A.T + 2.0 * sp.eye(n, format="csr")).tocsr()
+    return sparse.csr_array(
+        (A.data.astype(np.float32), A.indices.astype(np.int32),
+         A.indptr), shape=A.shape)
+
+
 def _irregular_config(sparse, n: int, nnz_per_row: int):
     """Random-sparsity CSR with skewed row lengths: defeats band/ELL
     detection (one heavy row) so the gather/segment-sum path runs."""
@@ -624,8 +647,16 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # 3-tenant admission-gateway sweep (WFQ packing stage + flood stage)
 # with the golden-gated deterministic totals ``gateway_requests`` /
 # ``gateway_dispatches`` / ``gateway_packed`` /
-# ``gateway_rejected_queue_full`` / per-tenant served/shed.
-SCHEMA_VERSION = 12
+# ``gateway_rejected_queue_full`` / per-tenant served/shed.  13 =
+# dist-2d phase (docs/DIST.md): the same all-device mesh factored as
+# a (rows, cols) grid with the auto layout router — golden-gated
+# deterministic ``dist2d_spmv_comm_bytes`` /
+# ``dist2d_spmv_1d_comm_bytes`` / ``dist2d_cg_comm_bytes`` /
+# ``dist2d_spgemm_comm_bytes`` / ``dist2d_spgemm_1d_comm_bytes``
+# (the 1-D fields are the equal-device-count baseline the 2-D layout
+# must beat) plus ``dist2d_layout`` / ``dist2d_grid`` /
+# ``dist2d_cg_iters`` and the timing field ``dist2d_spmv_ms``.
+SCHEMA_VERSION = 13
 
 
 def main() -> None:
@@ -1146,6 +1177,99 @@ def main() -> None:
                 obs.counters.get("comm.total_bytes"))
         except Exception as e:
             sys.stderr.write(f"bench: dist phase failed: {e!r}\n")
+
+    # Distributed 2-d-block phase (schema 13, docs/DIST.md): the same
+    # all-device mesh factored as a (rows, cols) grid, on a NON-banded
+    # matrix — the case where the 1-D layout degenerates to a full
+    # all_gather of x and the communication-avoiding 2-D program
+    # (x panels broadcast along mesh rows, partial products
+    # reduce-scattered along mesh columns) wins.  Both the 2-D fields
+    # and the equal-device-count 1-D baselines are recorded so the
+    # golden pins the WIN, not just the totals; the auto router's
+    # ``shard_csr.routing`` event cites both predictions.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_DIST",
+                           "0") != "1")
+            and not past_deadline(result, "dist2d")):
+        try:
+            from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+            from legate_sparse_tpu.parallel import (
+                dist_cg, dist_spgemm, make_grid_mesh, make_row_mesh,
+                shard_csr,
+            )
+            from legate_sparse_tpu.parallel.dist_csr import (
+                cg_comm_volumes, dist_spmv, shard_vector,
+                spmv_comm_volumes,
+            )
+
+            def _spgemm_ledger() -> int:
+                return sum(
+                    v for k, v in obs.counters.snapshot().items()
+                    if k.startswith("comm.dist_spgemm.")
+                    and k.endswith("_bytes"))
+
+            n_2 = 1 << (10 if smoke
+                        else (20 if platform != "cpu" else 14))
+            mesh_g = make_grid_mesh()
+            gr = int(mesh_g.shape[
+                "rows"]), int(mesh_g.shape["cols"])
+            with obs.span("bench.dist2d") as _sp2, \
+                    obs.memory.watermark("bench.dist2d"):
+                A_2 = _dist2d_config(sparse, n_2, nnz_per_row)
+                # Equal-device-count 1-D baseline (recorded bytes).
+                dA1 = shard_csr(A_2, mesh=make_row_mesh())
+                vols1 = spmv_comm_volumes(
+                    dA1, dA1.rows_padded // dA1.num_shards, 4)
+                result["dist2d_spmv_1d_comm_bytes"] = sum(
+                    vols1.values())
+                led0 = _spgemm_ledger()
+                C1 = dist_spgemm(dA1, dA1)
+                result["dist2d_spgemm_1d_comm_bytes"] = (
+                    _spgemm_ledger() - led0)
+                del C1, dA1
+                # 2-D block layout via the byte-predicting router.
+                dA2 = shard_csr(A_2, mesh=mesh_g, layout="auto")
+                result["dist2d_layout"] = dA2.layout
+                result["dist2d_grid"] = f"{gr[0]}x{gr[1]}"
+                vols2 = spmv_comm_volumes(
+                    dA2, dA2.rows_padded // dA2.num_shards, 4)
+                result["dist2d_spmv_comm_bytes"] = sum(vols2.values())
+                x_2 = shard_vector(np.ones(n_2, np.float32), mesh_g,
+                                   dA2.rows_padded, layout=dA2.layout)
+                _ = float(jnp.sum(dist_spmv(dA2, x_2)))  # compile+warm
+                try:
+                    ms_2 = loop_ms_per_iter(
+                        lambda v: dist_spmv(dA2, v), x_2,
+                        k_lo=2, k_hi=8 if smoke else 16,
+                    )
+                    result["dist2d_spmv_ms"] = round(ms_2, 4)
+                except RuntimeError as e:
+                    sys.stderr.write(
+                        f"bench: dist2d spmv timing: {e}\n")
+                # Fixed-iteration CG, as in the 1-D dist phase: the
+                # iteration count and so the comm volume are
+                # deterministic across machines.
+                maxit2 = 8 if smoke else 25
+                xs2, it2 = dist_cg(dA2, np.ones(n_2, np.float32),
+                                   rtol=0.0, maxiter=maxit2)
+                _ = float(np.asarray(xs2[0]))
+                it2 = int(it2)
+                cg2_vols, _cg2_calls = cg_comm_volumes(dA2, 4, it2)
+                result["dist2d_cg_iters"] = it2
+                result["dist2d_cg_comm_bytes"] = sum(cg2_vols.values())
+                led1 = _spgemm_ledger()
+                C2 = dist_spgemm(dA2, dA2)
+                result["dist2d_spgemm_comm_bytes"] = (
+                    _spgemm_ledger() - led1)
+                del C2
+                if _sp2 is not None:
+                    _sp2.set(grid=gr, layout=dA2.layout,
+                             comm_bytes=(sum(vols2.values())
+                                         + sum(cg2_vols.values())))
+            result["comm_total_bytes"] = int(
+                obs.counters.get("comm.total_bytes"))
+        except Exception as e:
+            sys.stderr.write(f"bench: dist2d phase failed: {e!r}\n")
 
     # Execution-engine phase (docs/ENGINE.md): cold (plan compile) vs
     # warm-cache (same bucket, DIFFERENT n — the zero-retrace hit

@@ -112,6 +112,30 @@ def all_to_all_bytes(row_elems: int, itemsize: int, shards: int) -> int:
     return shards * (shards - 1) * int(row_elems) * int(itemsize)
 
 
+def reduce_scatter_bytes(input_elems: int, itemsize: int,
+                         shards: int) -> int:
+    """Interconnect bytes of one tiled ``psum_scatter`` (ring
+    reduce-scatter) of an ``input_elems``-element per-device input over
+    ``shards`` devices: each device receives (R-1) partial chunks of
+    L/R elements, so the group total is (R-1)*L."""
+    if shards <= 1:
+        return 0
+    return (shards - 1) * int(input_elems) * int(itemsize)
+
+
+def transpose_moved_chunks(grid_rows: int, grid_cols: int) -> int:
+    """Number of vector chunks the 2-d-block input fixup ``ppermute``
+    actually moves: chunk k's destination under the row-major ->
+    column-panel transpose is (k % R) * C + k // R; fixed points
+    (including the whole permutation when R == 1 or C == 1) cost
+    nothing."""
+    n = grid_rows * grid_cols
+    return sum(
+        1 for k in range(n)
+        if (k % grid_rows) * grid_cols + k // grid_rows != k
+    )
+
+
 # --------------------------------------------------------------- ledger --
 def merge(*vols: Volumes) -> Volumes:
     """Sum per-collective volumes across several dicts."""
@@ -132,13 +156,19 @@ def total(vols: Volumes) -> int:
 
 
 def record(op: str, vols: Volumes,
-           calls: Optional[Dict[str, int]] = None) -> int:
+           calls: Optional[Dict[str, int]] = None,
+           layout: str = "1d-row") -> int:
     """Account one dispatch of ``op``: bump the ``comm.<op>.*``
     counters per collective kind and the process totals.  ``calls``
     optionally gives the collective-op count per kind (default 1 —
     pass the rotation/iteration counts for chained patterns).
     Zero-byte entries are dropped (nothing crossed the interconnect).
-    Returns the total predicted bytes."""
+    ``layout`` additionally groups the dispatch under the
+    ``comm.layout.<layout>.<op>[_bytes]`` aggregates (per-op totals
+    over collective kinds — NOT double-counted into
+    ``comm.total_*``), so the ledger can be sliced by partition
+    strategy (``tools/trace_summary.py --comm``).  Returns the total
+    predicted bytes."""
     total_b = 0
     total_c = 0
     for kind, nbytes in vols.items():
@@ -153,6 +183,8 @@ def record(op: str, vols: Volumes,
     if total_c:
         _counters.handle("comm.total_calls").inc(total_c)
         _counters.handle("comm.total_bytes").inc(total_b)
+        _counters.handle(f"comm.layout.{layout}.{op}").inc(total_c)
+        _counters.handle(f"comm.layout.{layout}.{op}_bytes").inc(total_b)
     return total_b
 
 
@@ -183,6 +215,34 @@ def spmv_volumes(*, shards: int, halo: int, precise_C: Optional[int],
         return {"ppermute": b} if b else {}
     return {"all_gather": all_gather_bytes(x_local_elems, itemsize,
                                            shards)}
+
+
+def spmv_volumes_2d(*, grid_rows: int, grid_cols: int, spc: int,
+                    rps: int, itemsize: int) -> Volumes:
+    """Per-call collective volumes of one 2-d-block distributed SpMV,
+    mirroring the ``_block_spmv_2d_fn`` dispatch exactly:
+
+    - input fixup: one ``ppermute`` over the flattened grid moving the
+      vector chunks (``spc`` elements each) that the row-major ->
+      column-panel transpose displaces — absent (zero bytes, no op in
+      the program) on degenerate 1-D grids;
+    - x panel assembly: one tiled ``all_gather`` along mesh rows in
+      each of the ``grid_cols`` column groups (group size
+      ``grid_rows``);
+    - output reduction: one tiled ``psum_scatter`` along mesh columns
+      in each of the ``grid_rows`` row groups, of the
+      ``rps``-element partial row block — recorded under the ``psum``
+      kind (it IS the reduce half of an all-reduce).
+    """
+    moved = transpose_moved_chunks(grid_rows, grid_cols)
+    vols = {
+        "ppermute": moved * int(spc) * int(itemsize),
+        "all_gather": grid_cols * all_gather_bytes(spc, itemsize,
+                                                  grid_rows),
+        "psum": grid_rows * reduce_scatter_bytes(rps, itemsize,
+                                                 grid_cols),
+    }
+    return {k: b for k, b in vols.items() if b > 0}
 
 
 def cg_iteration_volumes(spmv_vols: Volumes, itemsize: int,

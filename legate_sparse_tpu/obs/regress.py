@@ -265,6 +265,10 @@ TRAJECTORY_FIELDS = [
     "cpu_roofline_ratio", "cg_ms_per_iter", "spgemm_ms",
     "gmg_cycle_ms", "pde_ms_per_iter", "pde_roofline_ratio",
     "dist_spmv_comm_bytes", "comm_total_bytes",
+    "dist2d_layout", "dist2d_spmv_comm_bytes",
+    "dist2d_spmv_1d_comm_bytes", "dist2d_cg_comm_bytes",
+    "dist2d_spgemm_comm_bytes", "dist2d_spgemm_1d_comm_bytes",
+    "dist2d_spmv_ms",
     "engine_warm_ms", "engine_batched_ms_per_req",
     "saturation_p99_ms", "irregular_spmv_ms", "irregular_spmv_speedup",
     "irregular_spmv_path", "autotune_verdicts", "bench_wall_s",

@@ -8,13 +8,25 @@ NCCL communicator at ``csr.py:637``, projection functors
 ``projections.cc:23-64``): a 1-D ``jax.sharding.Mesh`` over the row
 dimension, ``shard_map``-ped kernels, and explicit ICI collectives
 (``all_gather``/``psum``/``ppermute``).
+
+``shard_csr`` takes a first-class ``layout`` strategy (``1d-row`` /
+``1d-col`` / ``2d-block`` / ``auto`` — docs/DIST.md): 2-d-block
+partitions over a ``make_grid_mesh(R, C)`` grid with x panels
+broadcast along mesh rows and partial products reduce-scattered along
+mesh columns, and ``auto`` routes by predicted interconnect bytes.
 """
 
 from .mesh import (  # noqa: F401
+    LAYOUT_1D_COL,
+    LAYOUT_1D_ROW,
+    LAYOUT_2D_BLOCK,
+    LAYOUT_AUTO,
+    LAYOUTS,
     factor_grid,
     init_distributed,
     make_grid_mesh,
     make_row_mesh,
+    resolve_layout,
     row_spec,
 )
 from .dist_csr import (  # noqa: F401

@@ -55,7 +55,11 @@ from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..csr import csr_array
-from .mesh import COL_AXIS, ROW_AXIS, make_row_mesh
+from .mesh import (
+    COL_AXIS, LAYOUT_1D_COL, LAYOUT_1D_ROW, LAYOUT_2D_BLOCK,
+    LAYOUT_AUTO, ROW_AXIS, factor_grid, make_grid_mesh, make_row_mesh,
+    resolve_layout,
+)
 
 
 @dataclass
@@ -130,15 +134,38 @@ class DistCSR:
     # ``global_nnz`` once and memoize here — keeping the device->host
     # counts fetch off every later call.
     nnz_hint: int = -1
+    # Partition layout strategy (docs/DIST.md).  "1d-row" is the
+    # historical row-block layout described above.  ``grid`` is set for
+    # the 2-d family ("2d-block" / "1d-col" = a (1, R) grid): blocks
+    # are (Rr, Rc, nnz_max) padded-CSR sharded P(rows, cols, None)
+    # with BLOCK-LOCAL column indices (global - j*cols_per_shard),
+    # counts (Rr, Rc) per-block valid nnz, and vectors sharded
+    # P((rows, cols)) in row-major grid chunks.
+    layout: str = LAYOUT_1D_ROW
+    grid: Optional[Tuple[int, int]] = None
 
     @property
     def num_shards(self) -> int:
+        if self.grid is not None:
+            return self.grid[0] * self.grid[1]
         blocks = self.data if self.data is not None else self.dia_data
         return blocks.shape[0]
 
     @property
     def rows_padded(self) -> int:
+        # 2-d grids: the row dimension is split over grid[0] mesh rows
+        # only (each row block further column-split over grid[1]).
+        if self.grid is not None:
+            return self.grid[0] * self.rows_per_shard
         return self.num_shards * self.rows_per_shard
+
+    @property
+    def cols_padded(self) -> int:
+        """Padded column count (2-d layouts; equals the padded x
+        length the SpMV consumes)."""
+        if self.grid is not None:
+            return self.grid[1] * self.cols_per_shard
+        return self.shape[1]
 
     # ---- int32-local / int64-global index split (SURVEY §7 hard part
     # 5; reference runs coord_ty = int64 throughout,
@@ -208,6 +235,8 @@ class DistCSR:
         rps = self.rows_per_shard
         if self.data is None:
             return self._dia_to_csr_host()
+        if self.grid is not None:
+            return self._grid_to_csr_host()
         starts = np.arange(R) * rps
         data_b = np.asarray(self.data)
         cols_b = np.asarray(self.cols)
@@ -255,6 +284,35 @@ class DistCSR:
         coo_v = (np.concatenate(coo_v) if coo_v
                  else np.zeros(0, self.dtype))
         keep = coo_r < rows  # drop padding rows
+        return csr_array(
+            (coo_v[keep], (coo_r[keep], coo_c[keep])), shape=self.shape
+        )
+
+    def _grid_to_csr_host(self):
+        """2-d-block matrix back to a host csr_array (test/inspection;
+        O(global nnz) on the host — not a scale path)."""
+        from ..csr import csr_array
+
+        Rr, Rc = self.grid
+        rps = self.rows_per_shard
+        cps = self.cols_per_shard
+        data_b = np.asarray(self.data)        # (Rr, Rc, nnz_max)
+        cols_b = np.asarray(self.cols)
+        rids_b = np.asarray(self.row_ids)
+        counts = np.asarray(self.counts)      # (Rr, Rc)
+        coo_r, coo_c, coo_v = [], [], []
+        for i in range(Rr):
+            for j in range(Rc):
+                ln = int(counts[i, j])
+                coo_r.append(rids_b[i, j, :ln].astype(np.int64)
+                             + i * rps)
+                coo_c.append(cols_b[i, j, :ln].astype(np.int64)
+                             + j * cps)
+                coo_v.append(data_b[i, j, :ln])
+        coo_r = np.concatenate(coo_r)
+        coo_c = np.concatenate(coo_c)
+        coo_v = np.concatenate(coo_v)
+        keep = (coo_r < self.shape[0]) & (coo_c < self.shape[1])
         return csr_array(
             (coo_v[keep], (coo_r[keep], coo_c[keep])), shape=self.shape
         )
@@ -424,27 +482,213 @@ def _device_put_sharded(arr, sharding):
     )
 
 
+def _grid_of(mesh: Optional[Mesh], layout: str) -> Tuple[int, int]:
+    """Resolve the (Rr, Rc) grid a 2-d-family layout would use on
+    ``mesh`` (or on all devices when None): "1d-col" is the (1, N)
+    degenerate grid, "2d-block" the mesh's own 2-D shape or the
+    near-square factorization."""
+    n = int(np.prod(mesh.devices.shape)) if mesh is not None \
+        else len(jax.devices())
+    if layout == LAYOUT_1D_COL:
+        return (1, n)
+    if (mesh is not None and len(mesh.devices.shape) == 2
+            and int(mesh.shape[ROW_AXIS]) > 1):
+        return (int(mesh.shape[ROW_AXIS]), int(mesh.shape[COL_AXIS]))
+    return factor_grid(n)
+
+
+def _grid_mesh_for(mesh: Optional[Mesh], grid: Tuple[int, int]) -> Mesh:
+    """A (rows, cols) mesh of shape ``grid`` over ``mesh``'s devices
+    (all devices when None), reusing ``mesh`` itself when it already
+    has that shape."""
+    if mesh is not None:
+        if (tuple(mesh.axis_names) == (ROW_AXIS, COL_AXIS)
+                and tuple(mesh.devices.shape) == tuple(grid)):
+            return mesh
+        return make_grid_mesh(list(mesh.devices.flat), shape=grid)
+    return make_grid_mesh(shape=grid)
+
+
+def _predict_1d_spmv_bytes(rows: int, cols: int, indptr, indices,
+                           R: int, itemsize: int) -> int:
+    """Predicted per-call x-realization bytes of the 1d-row SpMV at
+    shard count ``R`` — the same halo-vs-all_gather analysis
+    ``shard_csr`` performs, priced by ``obs.comm`` (precise images are
+    ignored: auto routing compares the default realizations)."""
+    from ..obs import comm as _comm
+
+    rps = math.ceil(rows / R) if rows else 1
+    if rows == cols and rows:
+        starts = np.minimum(np.arange(R) * rps, rows)
+        ends = np.minimum(starts + rps, rows)
+        lo, hi = indptr[starts], indptr[ends]
+        h = 0
+        for s in range(R):
+            if hi[s] > lo[s]:
+                win = indices[lo[s]:hi[s]]
+                h = max(h, int(max(starts[s] - win.min(),
+                                   win.max() + 1 - ends[s], 0)))
+        if h <= rps:
+            return _comm.halo_exchange_bytes(h, itemsize, R)
+    return _comm.all_gather_bytes(rps, itemsize, R)
+
+
+def _route_layout(A: csr_array, mesh: Optional[Mesh]) -> str:
+    """Evidence-based "auto" routing: pick 2d-block only when its
+    predicted per-SpMV interconnect bytes strictly beat the 1d-row
+    prediction at EQUAL device count, and record the decision (with
+    both predictions) as a ``shard_csr.routing`` obs event — the
+    layout analog of the SpGEMM window-vs-all_gather probe."""
+    from ..obs import comm as _comm
+
+    rows, cols = A.shape
+    grid = _grid_of(mesh, LAYOUT_2D_BLOCK)
+    Rr, Rc = grid
+    N = Rr * Rc
+    item = np.dtype(A.data.dtype).itemsize
+    bytes_1d = _predict_1d_spmv_bytes(
+        rows, cols, np.asarray(A.indptr), np.asarray(A.indices), N, item
+    )
+    rows_p = N * max(-(-rows // N), 1)
+    cols_p = N * max(-(-cols // N), 1)
+    vols_2d = _comm.spmv_volumes_2d(
+        grid_rows=Rr, grid_cols=Rc, spc=cols_p // N,
+        rps=rows_p // Rr, itemsize=item,
+    )
+    bytes_2d = _comm.total(vols_2d)
+    choice = LAYOUT_2D_BLOCK if bytes_2d < bytes_1d else LAYOUT_1D_ROW
+    _obs.event("shard_csr.routing", layout=choice, shards=N,
+               grid=grid, rows=rows, nnz=int(A.indptr[-1]),
+               predicted_1d_bytes=bytes_1d, predicted_2d_bytes=bytes_2d)
+    return choice
+
+
+def _shard_csr_2d(A: csr_array, mesh: Optional[Mesh],
+                  layout: str) -> DistCSR:
+    """2-d block partitioning: block (i, j) of the (Rr, Rc) grid holds
+    rows [i*rps, (i+1)*rps) x cols [j*cps, (j+1)*cps) as padded-CSR
+    with BLOCK-LOCAL column indices.  Rows/cols are padded to a
+    multiple of Rr*Rc so the flat vector chunks (P((rows, cols))
+    sharding, row-major grid order) divide evenly on both ends of the
+    SpMV, and so the same blocks feed the SUMMA-style ``dist_spgemm``
+    panels (A row panels gathered along mesh columns, B column panels
+    staged along mesh rows) with no re-partitioning."""
+    grid = _grid_of(mesh, layout)
+    mesh = _grid_mesh_for(mesh, grid)
+    Rr, Rc = grid
+    N = Rr * Rc
+    rows, cols = A.shape
+    rows_p = N * max(-(-rows // N), 1)
+    cols_p = N * max(-(-cols // N), 1)
+    rps, cps = rows_p // Rr, cols_p // Rc
+
+    indptr = np.asarray(A.indptr)
+    indices = np.asarray(A.indices)
+    data = np.asarray(A.data)
+    nnz = int(indptr[-1])
+    r_of = np.repeat(np.arange(rows, dtype=np.int64),
+                     np.diff(indptr)) if nnz else np.zeros(0, np.int64)
+    c_of = indices.astype(np.int64)
+
+    def blocks_of(bid, row_local, col_local, n_blocks, rid_pad):
+        """Pack entries into (n_blocks, nnz_max) padded-CSR arrays by
+        block id (CSR traversal order stays row-sorted per block)."""
+        per = np.bincount(bid, minlength=n_blocks) if nnz \
+            else np.zeros(n_blocks, np.int64)
+        cap = max(int(per.max()), 1) if nnz else 1
+        d_b = np.zeros((n_blocks, cap), dtype=data.dtype)
+        c_b = np.zeros((n_blocks, cap), dtype=np.int32)
+        r_b = np.full((n_blocks, cap), rid_pad, dtype=np.int32)
+        for g in range(n_blocks):
+            m = bid == g
+            ln = int(per[g])
+            if ln:
+                d_b[g, :ln] = data[m]
+                c_b[g, :ln] = col_local[m]
+                r_b[g, :ln] = row_local[m]
+        return d_b, c_b, r_b, per.astype(np.int32)
+
+    # Main blocks: grid-block id i*Rc + j.
+    bi, bj = r_of // rps, c_of // cps
+    d_b, c_b, r_b, cnt = blocks_of(
+        bi * Rc + bj, r_of - bi * rps, c_of - bj * cps, N,
+        max(rps - 1, 0),
+    )
+    spec3 = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS, None))
+    spec2 = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+
+    def put(arr, spec):
+        a = jnp.asarray(arr)
+        _obs.inc("transfer.shard_upload")
+        _obs.inc("transfer.shard_upload_bytes",
+                 int(a.size) * a.dtype.itemsize)
+        return _device_put_sharded(a, spec)
+
+    def grid3(arr):
+        return put(arr.reshape(Rr, Rc, -1), spec3)
+
+    _obs.event("shard_csr.layout", layout=layout, halo=-1,
+               precise=False, shards=N, rows=rows, nnz=nnz,
+               banded=False, grid=grid)
+    return DistCSR(
+        data=grid3(d_b), cols=grid3(c_b), counts=put(
+            cnt.reshape(Rr, Rc), spec2),
+        row_ids=grid3(r_b), shape=(rows, cols), rows_per_shard=rps,
+        halo=-1, ell=False, mesh=mesh, cols_per_shard=cps,
+        nnz_hint=nnz, layout=layout, grid=grid,
+    )
+
+
 def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
               force_all_gather: bool = False,
               ell_max_expand: Optional[float] = None,
-              precise: Optional[bool] = None) -> DistCSR:
-    """Partition a csr_array into row blocks over a 1-D mesh.
+              precise: Optional[bool] = None,
+              layout: Optional[str] = None) -> DistCSR:
+    """Partition a csr_array over a device mesh per a layout strategy.
 
-    Host-side build step (the analog of Legion solving partition
-    constraints once and caching them across solver iterations —
-    reference §3.2 note on partition caching).  Computes each shard's
-    column window min/max — the FAST_IMAGE_RANGE analog
+    ``layout`` picks the partition strategy (docs/DIST.md): "1d-row"
+    (the historical default — row blocks, x realized via
+    halo/all_gather/precise), "1d-col" / "2d-block" (the 2-d block
+    family — x broadcast per mesh column, partial products
+    reduce-scattered along mesh columns), or "auto" (route by
+    predicted interconnect bytes, recorded as a ``shard_csr.routing``
+    event).  Precedence is explicit: argument > the
+    ``LEGATE_SPARSE_TPU_DIST_LAYOUT`` env knob > "1d-row".
+
+    The 1d-row build is the host-side analog of Legion solving
+    partition constraints once and caching them across solver
+    iterations (reference §3.2 note on partition caching): it computes
+    each shard's column window min/max — the FAST_IMAGE_RANGE analog
     (``fast_image_partition.cu:29-55``) — and picks halo-exchange when
     every window fits within one neighbor shard on each side.
     """
     from ..settings import settings
 
     _obs.inc("op.shard_csr")
+    if precise and force_all_gather:
+        # Both knobs name an x realization and they contradict: honor
+        # neither silently (satellite of the argument>env precedence
+        # contract — see tests/test_dist_layout.py).
+        raise ValueError(
+            "shard_csr: precise=True conflicts with "
+            "force_all_gather=True — the two request different x "
+            "realizations; pass at most one"
+        )
+    lay = resolve_layout(layout)
+    if lay == LAYOUT_AUTO:
+        lay = _route_layout(A, mesh)
+    if lay in (LAYOUT_2D_BLOCK, LAYOUT_1D_COL):
+        if precise:
+            raise ValueError(
+                f"shard_csr: precise images are a 1d-row realization; "
+                f"not supported with layout={lay!r}"
+            )
+        return _shard_csr_2d(A, mesh, lay)
     if ell_max_expand is None:
         ell_max_expand = settings.ell_max_expand
     if precise is None:
         # Env default; an explicit force_all_gather argument wins over it
-        # (explicit precise=True still takes precedence over both).
+        # (explicit precise=True is a conflict, rejected above).
         precise = settings.precise_images and not force_all_gather
     if mesh is None:
         mesh = make_row_mesh()
@@ -637,8 +881,11 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     ))
 
 
-def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
-    """Pad a global vector to the sharded length and lay it out row-block."""
+def shard_vector(x, mesh: Mesh, rows_padded: int,
+                 layout: str = LAYOUT_1D_ROW) -> jax.Array:
+    """Pad a global vector to the sharded length and lay it out per the
+    matrix layout: row-block (P(rows)) for 1d-row, flat row-major grid
+    chunks (P((rows, cols))) for the 2-d family."""
     x = jnp.asarray(x)
     pad = rows_padded - x.shape[0]
     if pad:
@@ -646,10 +893,13 @@ def shard_vector(x, mesh: Mesh, rows_padded: int) -> jax.Array:
     _obs.inc("transfer.shard_upload")
     _obs.inc("transfer.shard_upload_bytes",
              int(x.size) * x.dtype.itemsize)
-    return _device_put_sharded(x, NamedSharding(mesh, P(ROW_AXIS)))
+    spec = (P((ROW_AXIS, COL_AXIS))
+            if layout in (LAYOUT_2D_BLOCK, LAYOUT_1D_COL)
+            else P(ROW_AXIS))
+    return _device_put_sharded(x, NamedSharding(mesh, spec))
 
 
-def mesh_fingerprint(mesh: Mesh) -> str:
+def mesh_fingerprint(mesh: Mesh, layout: Optional[str] = None) -> str:
     """Stable identity of the physical device set behind a mesh:
     axis names/shape plus every device's (platform, id).
 
@@ -657,7 +907,12 @@ def mesh_fingerprint(mesh: Mesh) -> str:
     (``docs/ENGINE.md``): a compiled collective program is only
     reusable on the exact device topology it was lowered for, and two
     meshes over the same devices in the same order ARE the same
-    topology even when the ``Mesh`` objects differ."""
+    topology even when the ``Mesh`` objects differ.
+
+    ``layout`` optionally folds the partition strategy into the
+    fingerprint: a 1d-row and a 2d-block partition over the SAME
+    device grid lower to different collective programs, so the
+    dist-plan ledger must not alias them."""
     import hashlib
 
     devs = tuple(
@@ -665,21 +920,24 @@ def mesh_fingerprint(mesh: Mesh) -> str:
         for d in mesh.devices.flat
     )
     desc = repr((tuple(mesh.axis_names), tuple(mesh.devices.shape),
-                 devs))
+                 devs) + ((layout,) if layout is not None else ()))
     return hashlib.sha1(desc.encode()).hexdigest()[:16]
 
 
 def dist_plan_fingerprint(A: DistCSR) -> str:
     """Mesh fingerprint + the layout terms the ``lru_cache``'d
-    shard_map builders key on (halo, ELL vs padded-CSR, precise
-    gather, rows-per-shard, banded prepack): two DistCSRs with equal
-    fingerprints reuse one compiled distributed program, and the
-    engine's ``dist_spmv`` plan entries record exactly that reuse."""
+    shard_map builders key on (partition strategy/grid, halo, ELL vs
+    padded-CSR, precise gather, rows-per-shard, banded prepack): two
+    DistCSRs with equal fingerprints reuse one compiled distributed
+    program, and the engine's ``dist_spmv`` plan entries record
+    exactly that reuse."""
     precise = A.gather_idx is not None
-    return (f"{mesh_fingerprint(A.mesh)}:h{A.halo}:e{int(A.ell)}"
+    grid = "-" if A.grid is None else f"{A.grid[0]}x{A.grid[1]}"
+    return (f"{mesh_fingerprint(A.mesh, layout=A.layout)}"
+            f":h{A.halo}:e{int(A.ell)}"
             f":p{int(precise)}:r{A.rows_per_shard}"
             f":d{int(A.dia_data is not None)}"
-            f":t{A.pdia_tile}")
+            f":t{A.pdia_tile}:g{grid}")
 
 
 def _extend_x(x_local, halo: int, axis: int = 0):
@@ -868,16 +1126,80 @@ def _block_spmv_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
     ))
 
 
+def _transpose_perm(grid: Tuple[int, int]) -> Tuple[Tuple[int, int], ...]:
+    """The chunk-transpose ppermute over the flattened (rows, cols)
+    grid: device l = i*Rc + j must end up holding vector chunk
+    k = j*Rr + i, so chunk k (living on device k) goes to linear
+    destination (k % Rr) * Rc + k // Rr.  Identity (no collective
+    emitted) when either grid axis is 1."""
+    Rr, Rc = grid
+    n = Rr * Rc
+    return tuple((k, (k % Rr) * Rc + k // Rr) for k in range(n))
+
+
+@lru_cache(maxsize=256)
+def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
+    """Cached shard_map callable for the 2-d-block dist SpMV: the
+    communication-avoiding program the layout exists for —
+
+    1. chunk-transpose ``ppermute`` over the flattened grid (input
+       fixup; elided on degenerate 1-D grids),
+    2. tiled ``all_gather`` along MESH ROWS only — x replicated per
+       mesh column (the panel each block's columns read), never
+       globally,
+    3. local padded-CSR SpMV of block (i, j) against its panel,
+    4. tiled ``psum_scatter`` along MESH COLUMNS — partial row-block
+       products reduced and scattered straight into the row-major
+       output chunks, half the bytes of a full ``psum``.
+    """
+    _obs.inc("jit_miss.dist_csr.block_spmv_2d_fn")
+    from ._compat import shard_map
+
+    from ..ops import spmv as _spmv_ops
+
+    Rr, Rc = grid
+    perm = _transpose_perm(grid)
+    skip_perm = all(s == d for s, d in perm)
+
+    def kernel(data, cols, row_ids, counts, x_local):
+        if not skip_perm:
+            x_local = jax.lax.ppermute(
+                x_local, (ROW_AXIS, COL_AXIS), perm
+            )
+        x_panel = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+        y_part = _spmv_ops.csr_spmv_rowids_masked(
+            data[0, 0], cols[0, 0], row_ids[0, 0], counts[0, 0],
+            x_panel, rps,
+        )
+        return jax.lax.psum_scatter(
+            y_part, COL_AXIS, scatter_dimension=0, tiled=True
+        )
+
+    in_specs = (P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS, None),
+                P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS),
+                P((ROW_AXIS, COL_AXIS)))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P((ROW_AXIS, COL_AXIS)), check_vma=False,
+    ))
+
+
 def spmv_comm_volumes(A: DistCSR, x_local_elems: int, itemsize: int,
                       cols: int = 1):
     """Per-call collective interconnect volumes of one ``dist_spmv``
     (or ``dist_spmm`` with ``cols`` > 1) on ``A`` — the realization
-    choice (precise all_to_all / halo ppermute / tiled all_gather) read
-    from the same static fields the dispatch branches on, priced by
-    ``obs.comm``.  ``x_local_elems`` is the per-device x block size
-    (already including ``cols`` for dense operands)."""
+    choice (2-d panel broadcast + reduce-scatter / precise all_to_all /
+    halo ppermute / tiled all_gather) read from the same static fields
+    the dispatch branches on, priced by ``obs.comm``.
+    ``x_local_elems`` is the per-device x block size (already
+    including ``cols`` for dense operands)."""
     from ..obs import comm as _comm
 
+    if A.grid is not None:
+        return _comm.spmv_volumes_2d(
+            grid_rows=A.grid[0], grid_cols=A.grid[1],
+            spc=x_local_elems, rps=A.rows_per_shard, itemsize=itemsize,
+        )
     precise_C = (int(A.gather_idx.shape[-1])
                  if A.gather_idx is not None else None)
     return _comm.spmv_volumes(
@@ -903,7 +1225,10 @@ def cg_comm_volumes(A: DistCSR, itemsize: int, iters: int):
     per_iter = _comm.cg_iteration_volumes(spmv, itemsize, R)
     vols = _comm.merge(_comm.scale(per_iter, iters), spmv)
     calls = {k: iters + 1 for k in spmv}
-    calls["psum"] = 3 * iters
+    # Additive, not an overwrite: the 2-d-block SpMV realization already
+    # carries a "psum" entry (its psum_scatter output reduction) that
+    # the scalar-reduction count must stack on top of.
+    calls["psum"] = calls.get("psum", 0) + 3 * iters
     return vols, calls
 
 
@@ -951,7 +1276,7 @@ def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
         A, int(x.shape[0]) // A.num_shards,
         jnp.dtype(x.dtype).itemsize,
     )
-    comm_bytes = _comm.record("dist_spmv", vols)
+    comm_bytes = _comm.record("dist_spmv", vols, layout=A.layout)
 
     with _lat.timer("lat.dist_spmv."
                     + _lat.shape_bucket(A.shape[0])), \
@@ -959,6 +1284,12 @@ def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
                       comm_bytes=comm_bytes,
                       comm_calls=sum(1 for b in vols.values() if b > 0)
                       ) as sp:
+        if A.grid is not None:
+            fn = _block_spmv_2d_fn(A.mesh, A.grid, A.rows_per_shard)
+            if sp is not None:
+                sp.set(path="2d-block", layout=A.layout)
+            return fn(A.data, A.cols, A.row_ids, A.counts, x)
+
         if A.dia_data is not None and halo >= 0 and not precise:
             # Banded fast path: halo exchange + static shifted-adds,
             # zero gathers (per-shard analog of ``ops.dia_ops.dia_spmv``).
@@ -1161,6 +1492,11 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     with the sparse blocks replicated along that axis.  Use
     ``shard_dense`` to lay out X.
     """
+    if A.grid is not None:
+        raise NotImplementedError(
+            "dist_spmm: 2-d-block layouts are SpMV/SpGEMM-only; "
+            "shard with layout='1d-row' for dense operands"
+        )
     A._require_blocks("dist_spmm")
     precise = A.gather_idx is not None
     col_sharded = COL_AXIS in A.mesh.shape
@@ -1341,9 +1677,9 @@ def _shard_system(A: DistCSR, b, x0, maxiter, callback):
     the iteration budget, and truncate callback iterates to the true
     row count."""
     rows = A.shape[0]
-    b_sh = shard_vector(b, A.mesh, A.rows_padded)
+    b_sh = shard_vector(b, A.mesh, A.rows_padded, layout=A.layout)
     x0_sh = (shard_vector(jnp.asarray(x0, dtype=b_sh.dtype), A.mesh,
-                          A.rows_padded)
+                          A.rows_padded, layout=A.layout)
              if x0 is not None else None)
     if maxiter is None:
         maxiter = rows * 10
@@ -1406,8 +1742,11 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
         n_psum = cycles * (restart_eff * (restart_eff + 1) // 2
                            + restart_eff + 1)
         calls = {k: cycles * (restart_eff + 1) for k in spmv}
-        calls["psum"] = n_psum
-        comm_bytes = _comm.record("dist_gmres", vols, calls)
+        # Additive: a 2-d-block SpMV realization already carries a
+        # "psum" call count (its psum_scatter output reduction).
+        calls["psum"] = calls.get("psum", 0) + n_psum
+        comm_bytes = _comm.record("dist_gmres", vols, calls,
+                                  layout=A.layout)
         if sp is not None:
             sp.set(iters=int(info), cycles=cycles,
                    comm_bytes=comm_bytes,
@@ -1503,11 +1842,11 @@ def dist_eigsh(A: DistCSR, k=6, which="LM", v0=None, ncv=None,
     if v0 is None:
         v0 = np.random.default_rng(0).standard_normal(rows)
     v0_sh = shard_vector(jnp.asarray(v0, dtype=A.dtype), A.mesh,
-                         A.rows_padded)
+                         A.rows_padded, layout=A.layout)
     # Valid-row mask keeps breakdown restarts out of the padding
     # subspace; max_rank caps the Krylov dimension at the true rows.
     mask = shard_vector(jnp.ones((rows,), dtype=A.dtype), A.mesh,
-                        A.rows_padded)
+                        A.rows_padded, layout=A.layout)
     if sigma is None:
         out = _lanczos_eigsh(
             A.matvec_fn(), A.rows_padded, np.dtype(A.dtype), int(k),
@@ -1540,6 +1879,11 @@ def dist_diagonal(A: DistCSR) -> jax.Array:
     """
     from ._compat import shard_map
 
+    if A.grid is not None:
+        raise NotImplementedError(
+            "dist_diagonal: 2-d-block layouts are SpMV/SpGEMM-only; "
+            "shard with layout='1d-row' for GMG/diagonal consumers"
+        )
     rps = A.rows_per_shard
 
     if A.dia_data is not None:
@@ -1696,7 +2040,8 @@ def dist_cg(
                 vols, calls = cg_comm_volumes(A, item, it)
                 sp.set(iters=it,
                        comm_bytes=_comm.record("dist_cg", vols,
-                                               calls),
+                                               calls,
+                                               layout=A.layout),
                        comm_calls=sum(
                            calls[k] for k, b in vols.items()
                            if b > 0))
@@ -1750,5 +2095,6 @@ def dist_cg(
         "dist_cg",
         {"psum": n_psum * _comm.psum_bytes(1, item, A.num_shards)},
         calls={"psum": n_psum},
+        layout=A.layout,
     )
     return x[:rows], iters

@@ -53,7 +53,7 @@ from ._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .dist_csr import DistCSR
-from .mesh import ROW_AXIS
+from .mesh import COL_AXIS, ROW_AXIS
 
 
 class _Layout(NamedTuple):
@@ -266,14 +266,24 @@ def _density_bucket(nnz: int, rows: int) -> int:
 def _decline_key(A: DistCSR, la: _Layout, lb: _Layout):
     """Cache key for a declined window: layout structure PLUS A's
     nnz-density bucket (the window width is a property of A's column
-    sparsity, which the layout alone does not capture).  ``nnz_hint``
-    is set by every builder; an externally constructed DistCSR pays
-    one counts fetch, memoized on the instance."""
+    sparsity, which the layout alone does not capture) PLUS the full
+    mesh+layout fingerprint.  The fingerprint term matters now that
+    one matrix shape can be sharded several ways: without it, a 1-D
+    verdict (window too wide at R row blocks) would be replayed
+    against a 2-d-block layout of the same shape — or against the
+    same shapes on a different device set — and wrongly pin it to
+    all_gather.  ``nnz_hint`` is set by every builder; an externally
+    constructed DistCSR pays one counts fetch, memoized on the
+    instance.  NOTE: ``_window_decline`` reads the density bucket at
+    ``key[2]`` — keep its position stable."""
+    from .dist_csr import mesh_fingerprint
+
     nnz = A.nnz_hint
     if nnz < 0:
         nnz = A.global_nnz
         A.nnz_hint = nnz
-    return (la, lb, _density_bucket(nnz, la.shape[0]))
+    return (la, lb, _density_bucket(nnz, la.shape[0]),
+            mesh_fingerprint(A.mesh, layout=A.layout))
 
 
 def _b_window_plan(A: DistCSR, la: _Layout, lb: _Layout, a_arrays):
@@ -496,18 +506,29 @@ def _b_window_flat(B: _Layout, plan, first_local, data, cols, counts,
 def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int,
                    row_base=0):
     """Shared expand + two-key sort producing (c_row, c_col, c_val,
-    heads, local_nnz) for one shard.  Invalid product slots carry the
-    sentinel row ``rps`` (sorts after every valid row) and value 0.
+    heads, local_nnz) for one shard — 1-D entry point (flattens the
+    shard's A block first); the 2-d path feeds its gathered row-panel
+    quad straight into ``_expand_sorted_flat``."""
+    return _expand_sorted_flat(
+        _a_local_flat(A, *a_args), b_args, T_cap, n_cols, A.rps,
+        row_base=row_base,
+    )
+
+
+def _expand_sorted_flat(a_flat, b_args, T_cap: int, n_cols: int,
+                        rps: int, row_base=0):
+    """Expansion core over a flat (a_row, a_col, a_val, a_valid) quad.
+    Invalid product slots carry the sentinel row ``rps`` (sorts after
+    every valid row) and value 0.
 
     ``row_base``: global B row of the realized buffer's first row (0
     for the all_gather realization; the shard's window start — traced —
     for the windowed one).  Every valid A column lies inside the window
     by construction, so the clip only ever moves invalid slots.
     """
-    a_row, a_col, a_val, a_valid = _a_local_flat(A, *a_args)
+    a_row, a_col, a_val, a_valid = a_flat
     b_data_g, b_cols_g, b_start, b_counts = b_args
 
-    rps = A.rps
     b_row = jnp.clip(a_col - row_base, 0, b_counts.shape[0] - 1)
     counts_per_a = jnp.where(a_valid, b_counts[b_row], 0).astype(index_dtype())
     starts = jnp.concatenate(
@@ -540,6 +561,30 @@ def _expand_sorted(A: _Layout, a_args, b_args, T_cap: int, n_cols: int,
     heads = jnp.logical_and(heads, valid_s)
     local_nnz = jnp.sum(heads.astype(jnp.int32))
     return c_row, c_col, c_val, heads, local_nnz
+
+
+def _compress_tail(c_row, c_col, c_val, heads, val_mask, local_nnz,
+                   nnz_cap: int, rps: int, col_dtype):
+    """Shared ESC compression: scatter-add run values into the padded
+    (nnz_cap,) output and gather run-head coordinates.  ``val_mask``
+    selects the product slots whose values may contribute (invalid
+    sentinel slots — and, on the 2-d path, any slot outside the
+    device's output block — add 0 wherever their clipped segment id
+    lands)."""
+    seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int32)) - 1, 0,
+                   nnz_cap - 1)
+    out_vals = jnp.zeros((nnz_cap,), c_val.dtype).at[seg].add(
+        jnp.where(val_mask, c_val, jnp.zeros((), c_val.dtype))
+    )
+    head_idx = jnp.nonzero(heads, size=nnz_cap, fill_value=0)[0]
+    slot = jnp.arange(nnz_cap, dtype=jnp.int32)
+    pad = slot >= local_nnz
+    out_cols = jnp.where(pad, 0, c_col[head_idx]).astype(col_dtype)
+    out_rows = jnp.where(
+        pad, max(rps - 1, 0), c_row[head_idx]
+    ).astype(jnp.int32)
+    out_vals = jnp.where(pad, jnp.zeros((), c_val.dtype), out_vals)
+    return out_vals, out_cols, out_rows
 
 
 def _dist_band_spgemm(A: DistCSR, B: DistCSR):
@@ -700,6 +745,292 @@ def _b_realization_volumes(B: DistCSR, lb: _Layout, plan):
     return ag_vols, ag_calls, win_vols, win_calls
 
 
+# ------------------------------------------------------------------ 2-D --
+# SUMMA-style SpGEMM over 2-d-block operands (docs/DIST.md): device
+# (i, j) owns C block (i, j) = sum_k A(i, k) @ B(k, j), so it realizes
+# its A ROW panel (all_gather along the mesh COLUMN axis — each A
+# element reaches Rc-1 receivers) and its B COLUMN panel (staged along
+# the mesh ROW axis — each B element reaches Rr-1 receivers, ledgered
+# as the ``bcast`` kind), then runs the SAME local ESC as the 1-D
+# kernel.  No product triple ever crosses the interconnect: every
+# partial product lands in the block that owns it, which is what makes
+# the 2-d layout communication-avoiding for SpGEMM (vs the 1-D path's
+# N-1-receiver all_gather of all of B).
+
+
+def _a_row_panel_flat(cps_a: int, data, cols, row_ids, counts):
+    """Gather this device's A row panel along the mesh column axis and
+    expose it as one flat (a_row, a_col, a_val, a_valid) quad: rows
+    stay BLOCK-local (every block of the row group shares the row
+    range), columns rebase to the global [0, cols_padded) domain via
+    each source block's column offset."""
+    data_g = jax.lax.all_gather(data, COL_AXIS)       # (Rc, capA)
+    cols_g = jax.lax.all_gather(cols, COL_AXIS)
+    rids_g = jax.lax.all_gather(row_ids, COL_AXIS)
+    counts_g = jax.lax.all_gather(counts, COL_AXIS)   # (Rc,)
+    cap = data.shape[-1]
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    a_valid = (slot[None, :] < counts_g[:, None]).reshape(-1)
+    off = jnp.arange(cols_g.shape[0], dtype=index_dtype()) * cps_a
+    a_col = (cols_g.astype(index_dtype()) + off[:, None]).reshape(-1)
+    a_row = rids_g.reshape(-1)
+    a_val = data_g.reshape(-1)
+    return a_row, a_col, a_val, a_valid
+
+
+_GRID_SPECS = (P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS, None),
+               P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS))
+
+
+@lru_cache(maxsize=128)
+def _esc2d_t_fn(mesh, cps_a: int, rps_b: int):
+    """Cached 2-d phase-1 (product count) shard_map: realizes only the
+    structural halves of both panels (A cols+counts along mesh cols,
+    B row_ids+counts along mesh rows)."""
+    _obs.inc("jit_miss.dist_spgemm.esc2d_t_fn")
+    in_specs = (P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS),
+                P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS))
+
+    def t_kernel(a_cols, a_counts, b_rids, b_counts):
+        ac, act = a_cols[0, 0], a_counts[0, 0]
+        cols_g = jax.lax.all_gather(ac, COL_AXIS)
+        cnts_g = jax.lax.all_gather(act, COL_AXIS)
+        slot = jnp.arange(ac.shape[-1], dtype=jnp.int32)
+        a_valid = (slot[None, :] < cnts_g[:, None]).reshape(-1)
+        off = jnp.arange(cols_g.shape[0], dtype=index_dtype()) * cps_a
+        a_col = (cols_g.astype(index_dtype()) + off[:, None]).reshape(-1)
+
+        br, bct = b_rids[0, 0], b_counts[0, 0]
+        rid_g = jax.lax.all_gather(br, ROW_AXIS)      # (Rr, capB)
+        cnt_g = jax.lax.all_gather(bct, ROW_AXIS)     # (Rr,)
+        slotb = jnp.arange(br.shape[-1], dtype=jnp.int32)
+        validb = slotb[None, :] < cnt_g[:, None]
+        ids_2d = jnp.where(validb, rid_g, rps_b)
+        one = jnp.ones_like(ids_2d, dtype=index_dtype())
+        percount = jax.vmap(
+            lambda ids, on: jax.ops.segment_sum(
+                on, ids, num_segments=rps_b + 1
+            )
+        )(ids_2d, one)[:, :rps_b]
+        b_cnt = percount.reshape(-1)                  # (rows_padded(B),)
+        b_row = jnp.clip(a_col, 0, b_cnt.shape[0] - 1)
+        t_local = jnp.sum(
+            jnp.where(a_valid, b_cnt[b_row], 0), dtype=index_dtype()
+        )
+        return t_local[None, None]
+
+    return jax.jit(shard_map(
+        t_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
+def _esc2d_nnz_fn(mesh, lb2: _Layout, cps_a: int, rps_a: int,
+                  T_cap: int):
+    """Cached 2-d phase-2 (output nnz) shard_map."""
+    _obs.inc("jit_miss.dist_spgemm.esc2d_nnz_fn")
+    in_specs = _GRID_SPECS + _GRID_SPECS
+    n_cols = lb2.shape[1]
+
+    def nnz_kernel(ad, ac, ar, act, bd, bc, br, bct):
+        a_flat = _a_row_panel_flat(
+            cps_a, ad[0, 0], ac[0, 0], ar[0, 0], act[0, 0]
+        )
+        b_args = _b_global_flat(lb2, bd[0, 0], bc[0, 0], bct[0, 0],
+                                br[0, 0])
+        *_, local_nnz = _expand_sorted_flat(
+            a_flat, b_args, T_cap, n_cols, rps_a
+        )
+        return local_nnz[None, None]
+
+    return jax.jit(shard_map(
+        nnz_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
+def _esc2d_numeric_fn(mesh, lb2: _Layout, cps_a: int, rps_a: int,
+                      T_cap: int, nnz_cap: int):
+    """Cached 2-d phase-3 (numeric) shard_map.  Output cols stay
+    BLOCK-local: the realized B panel carries block-local columns and
+    C block (i, j) inherits B block j's column range exactly."""
+    from ..types import coord_dtype_for
+
+    _obs.inc("jit_miss.dist_spgemm.esc2d_numeric_fn")
+    in_specs = _GRID_SPECS + _GRID_SPECS
+    n_cols = lb2.shape[1]
+    col_dtype = coord_dtype_for(n_cols)
+
+    def numeric_kernel(ad, ac, ar, act, bd, bc, br, bct):
+        a_flat = _a_row_panel_flat(
+            cps_a, ad[0, 0], ac[0, 0], ar[0, 0], act[0, 0]
+        )
+        b_args = _b_global_flat(lb2, bd[0, 0], bc[0, 0], bct[0, 0],
+                                br[0, 0])
+        c_row, c_col, c_val, heads, local_nnz = _expand_sorted_flat(
+            a_flat, b_args, T_cap, n_cols, rps_a
+        )
+        out_vals, out_cols, out_rows = _compress_tail(
+            c_row, c_col, c_val, heads, c_row < rps_a, local_nnz,
+            nnz_cap, rps_a, col_dtype,
+        )
+        return (out_vals[None, None], out_cols[None, None],
+                out_rows[None, None], local_nnz[None, None])
+
+    out_specs = (P(ROW_AXIS, COL_AXIS, None),
+                 P(ROW_AXIS, COL_AXIS, None),
+                 P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS))
+    return jax.jit(shard_map(
+        numeric_kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs, check_vma=False,
+    ))
+
+
+def _summa_volumes_2d(A: DistCSR, B: DistCSR, grid):
+    """Predicted interconnect volumes of the three 2-d ESC phases from
+    the static block shapes: A row panels (``all_gather`` along mesh
+    columns — Rr groups of Rc) and B column panels (``bcast`` staging
+    along mesh rows — Rc groups of Rr).  Phase 1 moves only the
+    structural halves; phases 2-3 move the full operand sets."""
+    from ..obs import comm as _comm
+
+    Rr, Rc = grid
+    capA = int(A.data.shape[-1])
+    capB = int(B.data.shape[-1])
+    ia_d = np.dtype(A.data.dtype).itemsize
+    ia_c = np.dtype(A.cols.dtype).itemsize
+    ib_d = np.dtype(B.data.dtype).itemsize
+    ib_c = np.dtype(B.cols.dtype).itemsize
+    a1 = Rr * _comm.all_gather_bytes(capA * ia_c + 4, 1, Rc)
+    a23 = Rr * _comm.all_gather_bytes(
+        capA * (ia_d + ia_c + 4) + 4, 1, Rc)
+    b1 = Rc * _comm.all_gather_bytes(capB * 4 + 4, 1, Rr)
+    b23 = Rc * _comm.all_gather_bytes(
+        capB * (ib_d + ib_c + 4) + 4, 1, Rr)
+    vols = {"all_gather": a1 + 2 * a23, "bcast": b1 + 2 * b23}
+    calls = {"all_gather": 2 + 2 * 4, "bcast": 2 + 2 * 4}
+    vols = {k: v for k, v in vols.items() if v > 0}
+    return vols, {k: calls[k] for k in vols}
+
+
+def _dist_spgemm_2d(A: DistCSR, B: DistCSR) -> DistCSR:
+    """C = A @ B for 2-d-block operands on a shared grid; returns a
+    2-d-block C on the same grid (rows from A's row blocks, columns
+    from B's column blocks — directly consumable by the 2-d SpMV or a
+    further SUMMA product)."""
+    from ..obs import comm as _comm
+    from ..obs import memory as _mem
+    from ..types import coord_dtype_for
+    from .dist_csr import _device_put_sharded
+
+    mesh = A.mesh
+    Rr, Rc = A.grid
+    N = Rr * Rc
+    rps = A.rows_per_shard
+    m, n_cols = A.shape[0], B.shape[1]
+    col_dtype = coord_dtype_for(n_cols)
+    # The gathered B panel has exactly the ``_b_global_flat`` shape
+    # contract over the mesh-row group: Rr source blocks of rps_b rows
+    # each, scalar per-block counts, block-local row ids — so the 1-D
+    # realization helper is reused verbatim with this synthetic layout.
+    lb2 = _Layout(
+        ell=False, rps=B.rows_per_shard, halo=-1, cps=0, has_ggl=False,
+        shape=B.shape, rows_padded=Rr * B.rows_per_shard,
+        num_shards=Rr, inner=int(B.data.shape[-1]),
+    )
+    _obs.inc("dist_spgemm.realization.2d_panel")
+    vols, calls = _summa_volumes_2d(A, B, A.grid)
+    comm_bytes = _comm.record("dist_spgemm", vols, calls,
+                              layout=A.layout)
+    # Evidence: the 1-D counterfactual at the same device count — a
+    # perfectly balanced all_gather realization of B over N row shards
+    # (inner = ceil(nnz/N)), priced by the same per-phase formula as
+    # ``_b_realization_volumes``.
+    nnzb = B.nnz_hint
+    if nnzb < 0:
+        nnzb = B.global_nnz
+        B.nnz_hint = nnzb
+    inner1 = max(-(-nnzb // N), 1)
+    ag1d = _comm.all_gather_bytes(
+        (4 + inner1 * 4)
+        + 2 * (inner1 * (np.dtype(B.data.dtype).itemsize
+                         + np.dtype(B.cols.dtype).itemsize + 4) + 4),
+        1, N)
+    _obs.event(
+        "dist_spgemm.realization", choice="2d-panel", shards=N,
+        grid=A.grid, predicted_bytes=comm_bytes,
+        predicted_all_gather_bytes=ag1d, predicted_window_bytes=None,
+    )
+    a_arrays = (A.data, A.cols, A.row_ids, A.counts)
+    b_arrays = (B.data, B.cols, B.row_ids, B.counts)
+    with _lat.timer("lat.dist_spgemm." + _lat.shape_bucket(m)), \
+            _obs.span("dist_spgemm", shards=N, m=m, n=n_cols,
+                      b_realization="2d-panel", b_plan=(),
+                      comm_bytes=comm_bytes,
+                      comm_calls=sum(calls.values())) as sp:
+        t_locals = _esc2d_t_fn(mesh, A.cols_per_shard,
+                               B.rows_per_shard)(
+            A.cols, A.counts, B.row_ids, B.counts)
+        _obs.inc("transfer.host_sync.dist_spgemm_T")
+        T_cap = int(jnp.max(t_locals))
+        val_dtype = jnp.result_type(A.data.dtype, B.data.dtype)
+        if T_cap == 0:
+            from jax.sharding import NamedSharding
+
+            z3 = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS, None))
+            z2 = NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+            return DistCSR(
+                data=_device_put_sharded(
+                    jnp.zeros((Rr, Rc, 1), val_dtype), z3),
+                cols=_device_put_sharded(
+                    jnp.zeros((Rr, Rc, 1), col_dtype), z3),
+                counts=_device_put_sharded(
+                    jnp.zeros((Rr, Rc), jnp.int32), z2),
+                row_ids=_device_put_sharded(
+                    jnp.full((Rr, Rc, 1), max(rps - 1, 0), jnp.int32),
+                    z3),
+                shape=(m, n_cols), rows_per_shard=rps, halo=-1,
+                ell=False, mesh=mesh,
+                cols_per_shard=B.cols_per_shard, nnz_hint=0,
+                layout=A.layout, grid=A.grid,
+            )
+
+        nnz_locals = _esc2d_nnz_fn(
+            mesh, lb2, A.cols_per_shard, rps, T_cap
+        )(*a_arrays, *b_arrays)
+        _obs.inc("transfer.host_sync.dist_spgemm_nnz")
+        nnz_cap = max(int(jnp.max(nnz_locals)), 1)
+        nnz_total = int(jnp.sum(nnz_locals)) if _obs.enabled() else -1
+        if sp is not None:
+            sp.set(T_cap=T_cap, nnz_cap=nnz_cap, nnz=nnz_total)
+
+        item_d = np.dtype(val_dtype).itemsize
+        out_mb = N * nnz_cap * (item_d + np.dtype(col_dtype).itemsize
+                                + 4) / 2**20
+        expand_mb = N * T_cap * (item_d + 2 * np.dtype(
+            index_dtype()).itemsize) / 2**20
+        with _mem.watermark("dist_spgemm", T_cap=T_cap,
+                            nnz_cap=nnz_cap, nnz=nnz_total,
+                            out_mb=round(out_mb, 2),
+                            expand_mb=round(expand_mb, 2)):
+            vals_b, cols_b, rids_b, counts_b = _esc2d_numeric_fn(
+                mesh, lb2, A.cols_per_shard, rps, T_cap, nnz_cap
+            )(*a_arrays, *b_arrays)
+
+    # cols_padded(C) == cols_padded(B): same global width, same
+    # multiple-of-N padding convention — so C inherits B's column
+    # blocking and stays a first-class 2-d operand.
+    return DistCSR(
+        data=vals_b, cols=cols_b, counts=counts_b.astype(jnp.int32),
+        row_ids=rids_b, shape=(m, n_cols), rows_per_shard=rps,
+        halo=-1, ell=False, mesh=mesh,
+        cols_per_shard=B.cols_per_shard, nnz_hint=nnz_total,
+        layout=A.layout, grid=A.grid,
+    )
+
+
 def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     """C = A @ B, both row-block distributed; returns a row-block C.
 
@@ -733,6 +1064,15 @@ def _dist_spgemm_impl(A: DistCSR, B: DistCSR) -> DistCSR:
     _obs.inc("op.dist_spgemm")
     from ..obs import comm as _comm
 
+    if A.grid is not None or B.grid is not None:
+        if A.grid is None or B.grid is None or A.grid != B.grid:
+            raise ValueError(
+                f"dist_spgemm: operands must share one 2-d grid "
+                f"(got {A.grid} and {B.grid}); reshard with the same "
+                f"layout"
+            )
+        return _dist_spgemm_2d(A, B)
+
     with _obs.span("dist_spgemm.band_probe"):
         C_band = _dist_band_spgemm(A, B)
     if C_band is not None:
@@ -745,7 +1085,8 @@ def _dist_spgemm_impl(A: DistCSR, B: DistCSR) -> DistCSR:
         nd_b = len(B.dia_offsets)
         band_vols = {"ppermute": _comm.halo_exchange_bytes(
             nd_b * h, np.dtype(B.dtype).itemsize, A.num_shards)}
-        band_bytes = _comm.record("dist_spgemm", band_vols)
+        band_bytes = _comm.record("dist_spgemm", band_vols,
+                                  layout=A.layout)
         _obs.event("dist_spgemm.realization", choice="band",
                    shards=A.num_shards, predicted_bytes=band_bytes)
         return C_band
@@ -816,10 +1157,12 @@ def _dist_spgemm_impl(A: DistCSR, B: DistCSR) -> DistCSR:
     ag_vols, ag_calls, win_vols, win_calls = _b_realization_volumes(
         B, lb, plan)
     if win is not None:
-        comm_bytes = _comm.record("dist_spgemm", win_vols, win_calls)
+        comm_bytes = _comm.record("dist_spgemm", win_vols, win_calls,
+                                  layout=A.layout)
         comm_calls = sum(win_calls.values())
     else:
-        comm_bytes = _comm.record("dist_spgemm", ag_vols, ag_calls)
+        comm_bytes = _comm.record("dist_spgemm", ag_vols, ag_calls,
+                                  layout=A.layout)
         comm_calls = sum(ag_calls.values())
     _obs.event(
         "dist_spgemm.realization", choice=realization,
@@ -1046,19 +1389,10 @@ def _esc_numeric_fn(mesh, la: _Layout, lb: _Layout, T_cap: int,
             la, _local(a_args), tuple(b_args), T_cap, n_cols,
             row_base=row_base,
         )
-        seg = jnp.clip(jnp.cumsum(heads.astype(jnp.int32)) - 1, 0,
-                       nnz_cap - 1)
-        out_vals = jnp.zeros((nnz_cap,), c_val.dtype).at[seg].add(
-            jnp.where(c_row < rps, c_val, jnp.zeros((), c_val.dtype))
+        out_vals, out_cols, out_rows = _compress_tail(
+            c_row, c_col, c_val, heads, c_row < rps, local_nnz,
+            nnz_cap, rps, col_dtype,
         )
-        head_idx = jnp.nonzero(heads, size=nnz_cap, fill_value=0)[0]
-        slot = jnp.arange(nnz_cap, dtype=jnp.int32)
-        pad = slot >= local_nnz
-        out_cols = jnp.where(pad, 0, c_col[head_idx]).astype(col_dtype)
-        out_rows = jnp.where(
-            pad, max(rps - 1, 0), c_row[head_idx]
-        ).astype(jnp.int32)
-        out_vals = jnp.where(pad, jnp.zeros((), c_val.dtype), out_vals)
         return (out_vals[None], out_cols[None], out_rows[None],
                 local_nnz[None])
 

@@ -22,6 +22,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 ROW_AXIS = "rows"
 COL_AXIS = "cols"
 
+# Partition layout strategies for shard_csr (docs/DIST.md).  "1d-row"
+# is the historical implicit default: row blocks over the flattened
+# mesh, x realized per the all_gather/halo/precise choice.  "1d-col"
+# is the transpose assignment (row blocks over the mesh's LAST axis,
+# provided for strategy-object completeness — same collective program
+# as 1d-row on a 1-D mesh).  "2d-block" block-partitions over a
+# (rows, cols) grid: x panels broadcast along mesh rows, partial
+# products reduce-scattered along mesh columns.  "auto" routes by
+# predicted interconnect bytes (recorded as a ``shard_csr.routing``
+# obs event citing both predictions).
+LAYOUT_1D_ROW = "1d-row"
+LAYOUT_1D_COL = "1d-col"
+LAYOUT_2D_BLOCK = "2d-block"
+LAYOUT_AUTO = "auto"
+LAYOUTS = (LAYOUT_1D_ROW, LAYOUT_1D_COL, LAYOUT_2D_BLOCK, LAYOUT_AUTO)
+
+
+def resolve_layout(layout: Optional[str] = None) -> str:
+    """Resolve a layout request to a concrete strategy name, with
+    explicit precedence: argument > ``LEGATE_SPARSE_TPU_DIST_LAYOUT``
+    env knob (``settings.dist_layout``) > ``"1d-row"`` default.  The
+    returned value may still be ``"auto"`` — shard_csr turns that into
+    a concrete layout from predicted bytes at build time."""
+    if layout is None:
+        from ..settings import settings
+
+        layout = settings.dist_layout or LAYOUT_1D_ROW
+    if layout not in LAYOUTS:
+        raise ValueError(
+            f"unknown dist layout {layout!r}; expected one of {LAYOUTS}"
+        )
+    return layout
+
 
 def factor_grid(n: int) -> tuple[int, int]:
     """Near-square factorization of ``n`` (the reference's
@@ -34,14 +67,20 @@ def factor_grid(n: int) -> tuple[int, int]:
 
 
 def make_grid_mesh(devices: Optional[Sequence | int] = None,
-                   shape: Optional[tuple[int, int]] = None) -> Mesh:
+                   shape: Optional[tuple[int, int] | int] = None) -> Mesh:
     """2-D mesh with axes ("rows", "cols") — the analog of the
     reference's 1-D-launch-onto-2-D-grid projection functors
     (``projections.cc:23-64``): the sparse matrix row-shards over
     "rows" while dense SpMM operands column-shard over "cols"
     (independent columns — zero extra communication).  ``shape``
     defaults to the near-square ``factor_grid`` of the device count.
+
+    ``make_grid_mesh(R, C)`` (both ints) is shorthand for an (R, C)
+    grid over the first R*C devices — the layout-strategy spelling
+    used by the 2-d-block docs and tests.
     """
+    if isinstance(devices, int) and isinstance(shape, int):
+        devices, shape = devices * shape, (devices, shape)
     if devices is None:
         devices = jax.devices()
     elif isinstance(devices, int):

@@ -234,6 +234,14 @@ class Settings:
     def __init__(self) -> None:
         self.precise_images: bool = _env_bool("LEGATE_SPARSE_PRECISE_IMAGES", False)
         self.fast_spgemm: bool = _env_bool("LEGATE_SPARSE_FAST_SPGEMM", False)
+        # Default partition layout for shard_csr when no explicit
+        # ``layout=`` argument is given: "1d-row" (historical default),
+        # "1d-col", "2d-block", or "auto" (route by predicted bytes).
+        # NOT epoch-exempt — the layout changes what dist plans lower
+        # to.  See docs/DIST.md.
+        self.dist_layout: str = os.environ.get(
+            "LEGATE_SPARSE_TPU_DIST_LAYOUT", "1d-row"
+        )
         self.x64: bool = _resolve_x64()
         self.check_bounds: bool = _env_bool(
             "LEGATE_SPARSE_TPU_CHECK_BOUNDS", False

@@ -29,7 +29,8 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # fresh store), and the gateway fairness sweep's admission/packing/
 # rejection totals (fixed submission sequence, flush-only dispatch)
 # do not.
-GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,schema_version,"
+GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
+                 "schema_version,"
                  "engine_plan_hits,engine_plan_misses,"
                  "engine_batch_requests,"
                  "resil_retries,resil_shed,resil_breaker_trips,"
@@ -129,6 +130,44 @@ def test_trace_summary_comm_table_renders(smoke_run, capsys):
     assert rc == 0, out
     assert "comm ledger:" in out
     assert "dist_spmv" in out and "ppermute" in out
+
+
+def test_smoke_dist2d_phase_numbers(smoke_run):
+    """ISSUE 10 acceptance: on the 8-virtual-device mesh the recorded
+    2-D SpMV and windowed-SpGEMM bytes beat the recorded 1-D bytes for
+    a non-banded matrix at equal device count, the auto router chose
+    2d-block, and the fixed-iteration CG volume is deterministic."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 13
+    assert result["dist2d_layout"] == "2d-block"
+    assert result["dist2d_grid"] == "2x4"
+    assert 0 < result["dist2d_spmv_comm_bytes"] < \
+        result["dist2d_spmv_1d_comm_bytes"]
+    assert 0 < result["dist2d_spgemm_comm_bytes"] < \
+        result["dist2d_spgemm_1d_comm_bytes"]
+    assert result["dist2d_cg_iters"] == 8
+    assert result["dist2d_cg_comm_bytes"] > \
+        result["dist2d_spmv_comm_bytes"]
+
+
+def test_smoke_trace_has_dist2d_evidence(smoke_run):
+    """The trace artifact carries the routing decision (citing both
+    predictions), the 2-d SpGEMM realization event, and the by-layout
+    comm aggregates."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "bench.dist2d" in names
+    routing = [ev for ev in doc["traceEvents"]
+               if ev["name"] == "shard_csr.routing"]
+    assert routing, sorted(names)
+    at = routing[-1].get("args") or {}
+    assert at.get("layout") == "2d-block"
+    assert 0 < at["predicted_2d_bytes"] < at["predicted_1d_bytes"]
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("comm.layout.2d-block.dist_spmv_bytes", 0) > 0
+    assert ctrs.get("comm.layout.2d-block.dist_spgemm_bytes", 0) > 0
+    assert ctrs.get("comm.layout.1d-row.dist_spmv_bytes", 0) > 0
 
 
 def test_smoke_engine_phase_numbers(smoke_run):

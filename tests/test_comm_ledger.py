@@ -374,6 +374,130 @@ def test_density_bucket_edges():
     assert _spg._density_bucket(100, 0) == -1
 
 
+# --------------------------------------------- 2-d-block exactness --
+needs_grid = pytest.mark.skipif(R < 8, reason="needs the 8-device mesh")
+
+
+def _random_sym(n, density=0.08, dtype=np.float64, seed=3):
+    rng = np.random.default_rng(seed)
+    A_sp = sp.random(n, n, density=density, random_state=rng,
+                     format="csr", dtype=np.float64)
+    A_sp = (A_sp + A_sp.T + 10.0 * sp.eye(n)).tocsr().astype(dtype)
+    return sparse.csr_array(A_sp)
+
+
+@needs_grid
+def test_2d_spmv_counters_match_static_prediction():
+    from legate_sparse_tpu.parallel import make_grid_mesh
+
+    mesh = make_grid_mesh(2, 4)
+    n = 96
+    A = _random_sym(n)
+    dA = shard_csr(A, mesh=mesh, layout="2d-block")
+    assert dA.grid == (2, 4) and dA.layout == "2d-block"
+    x = shard_vector(np.ones(n, np.float64), mesh, dA.rows_padded,
+                     layout=dA.layout)
+    vols = spmv_comm_volumes(dA, dA.rows_padded // dA.num_shards, 8)
+    assert set(vols) == {"ppermute", "all_gather", "psum"}
+    counters.reset("comm.")
+    _ = dist_spmv(dA, x)
+    for kind, nbytes in vols.items():
+        assert counters.get(f"comm.dist_spmv.{kind}") == 1, kind
+        assert counters.get(
+            f"comm.dist_spmv.{kind}_bytes") == nbytes, kind
+    assert counters.get(
+        "comm.layout.2d-block.dist_spmv_bytes") == sum(vols.values())
+    # And the 2-D program moves fewer predicted bytes than the 1-D
+    # all_gather the same matrix forces at equal device count.
+    dA1 = shard_csr(A, mesh=make_row_mesh(), force_all_gather=True)
+    vols1 = spmv_comm_volumes(dA1, dA1.rows_padded // 8, 8)
+    assert sum(vols.values()) < sum(vols1.values())
+
+
+@needs_grid
+def test_2d_model_matches_lowered_collectives():
+    """Anti-circularity for the 2-d-block program: the lowered HLO
+    carries exactly the collectives the ledger prices — one input
+    fixup permute, one x-panel all-gather, one reduce-scatter."""
+    from legate_sparse_tpu.parallel import make_grid_mesh
+
+    mesh = make_grid_mesh(2, 4)
+    n = 96
+    dA = shard_csr(_random_sym(n), mesh=mesh, layout="2d-block")
+    x = shard_vector(np.ones(n, np.float64), mesh, dA.rows_padded,
+                     layout=dA.layout)
+    hlo = jax.jit(lambda v: dist_spmv(dA, v)).lower(x).as_text()
+    assert hlo.count('"stablehlo.collective_permute"') == 1, hlo[:200]
+    assert hlo.count('"stablehlo.all_gather"') == 1
+    assert hlo.count('"stablehlo.reduce_scatter"') == 1
+
+
+@needs_grid
+def test_2d_cg_comm_matches_iteration_model():
+    from legate_sparse_tpu.parallel import make_grid_mesh
+
+    trace.enable()
+    mesh = make_grid_mesh(2, 4)
+    n = 96
+    dA = shard_csr(_random_sym(n), mesh=mesh, layout="2d-block")
+    counters.reset("comm.")
+    maxiter = 7
+    _, iters = dist_cg(dA, np.ones(n, np.float64), rtol=0.0,
+                       maxiter=maxiter, conv_test_iters=5)
+    it = int(iters)
+    assert it == maxiter
+    vols, calls = cg_comm_volumes(dA, 8, it)
+    # The SpMV's own psum_scatter merges ADDITIVELY with the solver's
+    # 3 scalar psums per iteration — the 2-D regression this guards:
+    # an overwrite would drop one or the other from the ledger.
+    assert calls["psum"] == (it + 1) + 3 * it
+    (span,) = [r for r in obs.records() if r["name"] == "dist_cg"]
+    assert span["attrs"]["comm_bytes"] == sum(vols.values())
+    spmv_vols = spmv_comm_volumes(dA, dA.rows_padded // 8, 8)
+    expect_psum = ((it + 1) * spmv_vols["psum"]
+                   + 3 * it * 2 * (8 - 1) * 8)
+    assert counters.get("comm.dist_cg.psum_bytes") == expect_psum
+    assert counters.get("comm.dist_cg.ppermute_bytes") == (
+        (it + 1) * spmv_vols["ppermute"])
+
+
+@needs_grid
+def test_2d_spgemm_counters_match_summa_prediction():
+    from legate_sparse_tpu.parallel import make_grid_mesh
+
+    trace.enable()
+    mesh = make_grid_mesh(2, 4)
+    n = 96
+    A = _random_sym(n)
+    dA = shard_csr(A, mesh=mesh, layout="2d-block")
+    vols, calls = _spg._summa_volumes_2d(dA, dA, dA.grid)
+    counters.reset("comm.")
+    C = dist_spgemm(dA, dA)
+    assert C.grid == (2, 4) and C.layout == "2d-block"
+    for kind, nbytes in vols.items():
+        assert counters.get(
+            f"comm.dist_spgemm.{kind}_bytes") == nbytes, kind
+        assert counters.get(
+            f"comm.dist_spgemm.{kind}") == calls[kind], kind
+    assert counters.get(
+        "comm.layout.2d-block.dist_spgemm_bytes") == sum(vols.values())
+    evs = [r for r in obs.records()
+           if r["name"] == "dist_spgemm.realization"]
+    at = evs[-1]["attrs"]
+    assert at["choice"] == "2d-panel"
+    assert at["predicted_bytes"] == sum(vols.values())
+    # Evidence of the win: the SUMMA panels undercut the recorded 1-D
+    # all_gather realization of the same product.
+    counters.reset("comm.")
+    dA1 = shard_csr(A, mesh=make_row_mesh(), force_all_gather=True)
+    _ = dist_spgemm(dA1, dA1)
+    bytes_1d = sum(
+        v for k, v in counters.snapshot().items()
+        if k.startswith("comm.dist_spgemm.") and k.endswith("_bytes"))
+    assert at["predicted_all_gather_bytes"] > 0
+    assert sum(vols.values()) < bytes_1d
+
+
 @pytest.mark.slow
 @needs_mesh
 def test_builders_set_nnz_hint():

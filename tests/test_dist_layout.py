@@ -1,0 +1,309 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Layout strategies (docs/DIST.md): shard_csr's first-class
+``layout`` argument — 1d-row / 1d-col / 2d-block / auto — with the
+explicit argument > env > default precedence, the byte-predicting
+auto router and its ``shard_csr.routing`` evidence event, the
+fingerprint separation the engine's dist-plan ledger relies on, and
+scipy-differential parity of the 2-d-block SpMV/SpGEMM programs
+against both the 1-D path and the local kernels."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import obs
+from legate_sparse_tpu.obs import trace
+from legate_sparse_tpu.parallel import (
+    LAYOUTS,
+    dist_cg,
+    dist_plan_fingerprint,
+    dist_spgemm,
+    dist_spmm,
+    dist_spmv,
+    make_grid_mesh,
+    make_row_mesh,
+    mesh_fingerprint,
+    resolve_layout,
+    shard_csr,
+)
+from legate_sparse_tpu.parallel.dist_csr import dist_diagonal, shard_vector
+from legate_sparse_tpu.settings import settings
+
+R = len(jax.devices())
+needs_grid = pytest.mark.skipif(R < 8, reason="needs the 8-device mesh")
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    yield
+    obs.reset_all()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+def _random_csr(n, m=None, density=0.08, dtype=np.float64, seed=0,
+                spd=False):
+    m = n if m is None else m
+    rng = np.random.default_rng(seed)
+    A_sp = sp.random(n, m, density=density, random_state=rng,
+                     format="csr", dtype=np.float64)
+    if spd:
+        A_sp = A_sp + A_sp.T + 10.0 * sp.eye(n)
+    return A_sp.tocsr().astype(dtype)
+
+
+# ------------------------------------------------------- resolution --
+def test_resolve_layout_precedence(monkeypatch):
+    assert resolve_layout(None) == "1d-row"          # default
+    monkeypatch.setattr(settings, "dist_layout", "2d-block")
+    assert resolve_layout(None) == "2d-block"        # env knob
+    assert resolve_layout("1d-row") == "1d-row"      # argument wins
+    for lay in LAYOUTS:
+        assert resolve_layout(lay) == lay
+    with pytest.raises(ValueError, match="unknown dist layout"):
+        resolve_layout("3d-torus")
+
+
+def test_env_knob_reaches_shard_csr(monkeypatch):
+    if R < 8:
+        pytest.skip("needs the 8-device mesh")
+    monkeypatch.setattr(settings, "dist_layout", "2d-block")
+    dA = shard_csr(sparse.csr_array(_random_csr(32)),
+                   mesh=make_grid_mesh(2, 4))
+    assert dA.layout == "2d-block" and dA.grid == (2, 4)
+    dB = shard_csr(sparse.csr_array(_random_csr(32)),
+                   mesh=make_row_mesh(), layout="1d-row")
+    assert dB.layout == "1d-row" and dB.grid is None
+
+
+@needs_grid
+def test_make_grid_mesh_two_int_shorthand():
+    mesh = make_grid_mesh(2, 4)
+    assert dict(mesh.shape) == {"rows": 2, "cols": 4}
+    mesh2 = make_grid_mesh(4, 2)
+    assert dict(mesh2.shape) == {"rows": 4, "cols": 2}
+
+
+# ----------------------------------------------------- fingerprints --
+@needs_grid
+def test_fingerprints_distinguish_layouts():
+    A = sparse.csr_array(_random_csr(64))
+    mesh_g = make_grid_mesh(2, 4)
+    d2 = shard_csr(A, mesh=mesh_g, layout="2d-block")
+    d1 = shard_csr(A, mesh=make_row_mesh(), layout="1d-row")
+    assert mesh_fingerprint(d1.mesh, layout=d1.layout) != \
+        mesh_fingerprint(d2.mesh, layout=d2.layout)
+    # Same device set, different strategy: the layout term alone must
+    # split the fingerprint (the dist-plan ledger aliasing hazard).
+    assert mesh_fingerprint(mesh_g, layout="1d-row") != \
+        mesh_fingerprint(mesh_g, layout="2d-block")
+    f2 = dist_plan_fingerprint(d2)
+    assert f2.endswith(":g2x4"), f2
+    assert dist_plan_fingerprint(d1).endswith(":g-")
+
+
+@needs_grid
+def test_window_decline_keyed_on_layout():
+    """Satellite: a 1-D window decline must not replay against a 2-D
+    layout of the same matrix shape — the decline key carries the
+    mesh+layout fingerprint."""
+    import importlib
+
+    _spg = importlib.import_module(
+        "legate_sparse_tpu.parallel.dist_spgemm")
+    A = sparse.csr_array(_random_csr(64))
+    d1 = shard_csr(A, mesh=make_row_mesh(), layout="1d-row")
+    d2 = shard_csr(A, mesh=make_grid_mesh(2, 4), layout="2d-block")
+    k1 = _spg._decline_key(d1, _spg._layout_of(d1), _spg._layout_of(d1))
+    k2 = _spg._decline_key(d2, _spg._layout_of(d2), _spg._layout_of(d2))
+    assert k1 != k2
+    # The mesh+layout fingerprint term splits the key even when the
+    # density bucket agrees (same matrix either way).
+    assert k1[2] == k2[2]
+    assert k1[-1] != k2[-1]
+
+
+# ------------------------------------------------------ auto router --
+@needs_grid
+def test_auto_routing_event_cites_both_predictions():
+    trace.enable()
+    A = sparse.csr_array(_random_csr(96))       # non-banded
+    dA = shard_csr(A, mesh=make_grid_mesh(2, 4), layout="auto")
+    assert dA.layout == "2d-block"              # random -> 2-D wins
+    evs = [r for r in obs.records() if r["name"] == "shard_csr.routing"]
+    at = evs[-1]["attrs"]
+    assert at["layout"] == "2d-block"
+    assert at["grid"] == (2, 4) and at["shards"] == 8
+    assert 0 < at["predicted_2d_bytes"] < at["predicted_1d_bytes"]
+
+
+@needs_grid
+def test_auto_routing_keeps_banded_on_1d():
+    """A tridiagonal band halo-exchanges a 1-element boundary in 1-D —
+    far below the 2-D program's panel traffic — so auto must keep it
+    on 1d-row."""
+    trace.enable()
+    n = 96
+    A = sparse.diags([1.0, 4.0, 1.0], [-1, 0, 1], shape=(n, n),
+                     format="csr")
+    dA = shard_csr(A, mesh=make_grid_mesh(2, 4), layout="auto")
+    assert dA.layout == "1d-row" and dA.grid is None
+    evs = [r for r in obs.records() if r["name"] == "shard_csr.routing"]
+    at = evs[-1]["attrs"]
+    assert at["layout"] == "1d-row"
+    assert at["predicted_1d_bytes"] <= at["predicted_2d_bytes"]
+
+
+# ------------------------------------ satellite: precise precedence --
+@pytest.mark.skipif(R < 2, reason="needs a multi-device mesh")
+def test_force_all_gather_wins_over_env_precise(monkeypatch):
+    """Regression (satellite): with ``LEGATE_SPARSE_PRECISE_IMAGES``
+    set at call time, an explicit ``force_all_gather=True`` argument
+    used to be silently ignored — argument > env."""
+    monkeypatch.setattr(settings, "precise_images", True)
+    A = sparse.diags([1.0, 2.0], [-1, 0], shape=(32, 32), format="csr")
+    dA = shard_csr(A, mesh=make_row_mesh(), force_all_gather=True)
+    assert dA.gather_idx is None       # not the precise realization
+    assert dA.halo == -1               # the all_gather realization
+    # Env alone (no conflicting argument) still selects precise.
+    dP = shard_csr(A, mesh=make_row_mesh())
+    assert dP.gather_idx is not None
+
+
+def test_explicit_precise_conflicts_with_force_all_gather():
+    A = sparse.diags([1.0, 2.0], [-1, 0], shape=(32, 32), format="csr")
+    with pytest.raises(ValueError, match="conflicts"):
+        shard_csr(A, mesh=make_row_mesh(), precise=True,
+                  force_all_gather=True)
+
+
+@needs_grid
+def test_precise_rejected_on_2d_layouts():
+    A = sparse.csr_array(_random_csr(32))
+    with pytest.raises(ValueError, match="1d-row realization"):
+        shard_csr(A, mesh=make_grid_mesh(2, 4), layout="2d-block",
+                  precise=True)
+
+
+# ------------------------------------------------ parity (scipy diff) --
+@needs_grid
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 1e-5),
+                                       (np.float64, 1e-12)])
+def test_spmv_parity_2d_vs_1d_vs_local(dtype, tol):
+    n = 96
+    A_sp = _random_csr(n, density=0.08, dtype=dtype, seed=1)
+    A = sparse.csr_array(A_sp)
+    x = np.linspace(-1.0, 1.0, n).astype(dtype)
+    y_local = np.asarray(A @ x)
+    y_ref = A_sp @ x
+
+    d2 = shard_csr(A, mesh=make_grid_mesh(2, 4), layout="2d-block")
+    x2 = shard_vector(x, d2.mesh, d2.rows_padded, layout=d2.layout)
+    y_2d = np.asarray(dist_spmv(d2, x2))[:n]
+
+    d1 = shard_csr(A, mesh=make_row_mesh())
+    x1 = shard_vector(x, d1.mesh, d1.rows_padded)
+    y_1d = np.asarray(dist_spmv(d1, x1))[:n]
+
+    np.testing.assert_allclose(y_2d, y_ref, rtol=tol, atol=tol)
+    np.testing.assert_allclose(y_1d, y_ref, rtol=tol, atol=tol)
+    np.testing.assert_allclose(y_local, y_ref, rtol=tol, atol=tol)
+
+
+@needs_grid
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 1e-5),
+                                       (np.float64, 1e-12)])
+def test_spgemm_parity_2d_vs_1d_vs_local(dtype, tol):
+    A_sp = _random_csr(64, 80, density=0.1, dtype=dtype, seed=2)
+    B_sp = _random_csr(80, 72, density=0.12, dtype=dtype, seed=3)
+    ref = (A_sp @ B_sp).toarray()
+    A, B = sparse.csr_array(A_sp), sparse.csr_array(B_sp)
+    local = (A @ B).todense()
+
+    mesh_g = make_grid_mesh(2, 4)
+    C2 = dist_spgemm(shard_csr(A, mesh=mesh_g, layout="2d-block"),
+                     shard_csr(B, mesh=mesh_g, layout="2d-block"))
+    mesh_r = make_row_mesh()
+    C1 = dist_spgemm(shard_csr(A, mesh=mesh_r),
+                     shard_csr(B, mesh=mesh_r))
+
+    np.testing.assert_allclose(np.asarray(C2.to_csr().todense()), ref,
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(C1.to_csr().todense()), ref,
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(local), ref,
+                               rtol=tol, atol=tol)
+    # The 2-D product is a first-class 2-D operand: chain it.
+    sq = _random_csr(64, density=0.1, dtype=np.float64, seed=4)
+    dsq = shard_csr(sparse.csr_array(sq), mesh=mesh_g,
+                    layout="2d-block")
+    D = dist_spgemm(dist_spgemm(dsq, dsq), dsq)
+    np.testing.assert_allclose(
+        np.asarray(D.to_csr().todense()), (sq @ sq @ sq).toarray(),
+        rtol=1e-10, atol=1e-10)
+
+
+@needs_grid
+def test_cg_parity_2d_vs_1d():
+    n = 96
+    A_sp = _random_csr(n, density=0.08, seed=5, spd=True)
+    A = sparse.csr_array(A_sp)
+    b = np.linspace(0.5, 1.5, n)
+    x2, it2 = dist_cg(shard_csr(A, mesh=make_grid_mesh(2, 4),
+                                layout="2d-block"),
+                      b, rtol=0.0, maxiter=8)
+    x1, it1 = dist_cg(shard_csr(A, mesh=make_row_mesh()),
+                      b, rtol=0.0, maxiter=8)
+    assert int(it2) == int(it1) == 8
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x1),
+                               rtol=1e-10, atol=1e-10)
+
+
+@needs_grid
+def test_1d_col_layout_spmv_parity():
+    n = 96
+    A_sp = _random_csr(n, density=0.08, seed=6)
+    dA = shard_csr(sparse.csr_array(A_sp), mesh=make_row_mesh(),
+                   layout="1d-col")
+    assert dA.grid == (1, 8)
+    x = np.linspace(-1.0, 1.0, n)
+    xs = shard_vector(x, dA.mesh, dA.rows_padded, layout=dA.layout)
+    y = np.asarray(dist_spmv(dA, xs))[:n]
+    np.testing.assert_allclose(y, A_sp @ x, rtol=1e-12, atol=1e-12)
+
+
+# ------------------------------------------------------- guard rails --
+@needs_grid
+def test_2d_rejects_unsupported_consumers():
+    A = sparse.csr_array(_random_csr(64))
+    mesh_g = make_grid_mesh(2, 4)
+    d2 = shard_csr(A, mesh=mesh_g, layout="2d-block")
+    with pytest.raises(NotImplementedError, match="2-d-block"):
+        dist_spmm(d2, np.ones((64, 4)))
+    with pytest.raises(NotImplementedError, match="2-d-block"):
+        dist_diagonal(d2)
+    d1 = shard_csr(A, mesh=make_row_mesh())
+    with pytest.raises(ValueError):
+        dist_spgemm(d2, d1)
+
+
+@needs_grid
+def test_round_trip_and_shard_vector_2d():
+    n, m = 56, 72                       # padded on both axes
+    A_sp = _random_csr(n, m, density=0.1, seed=7)
+    d2 = shard_csr(sparse.csr_array(A_sp), mesh=make_grid_mesh(2, 4),
+                   layout="2d-block")
+    np.testing.assert_allclose(
+        np.asarray(d2.to_csr().todense()), A_sp.toarray())
+    x = np.arange(n, dtype=np.float64)
+    xs = shard_vector(x, d2.mesh, d2.rows_padded, layout=d2.layout)
+    np.testing.assert_allclose(np.asarray(xs)[:n], x)

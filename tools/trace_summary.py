@@ -46,6 +46,7 @@ def render_comm_table(counters: dict) -> str:
     predicted interconnect bytes (obs/comm.py accounting convention:
     total across the mesh, counted once at each receiver)."""
     rows = {}
+    by_layout = {}
     for name, val in counters.items():
         if not name.startswith("comm.") or name.startswith("comm.total"):
             continue
@@ -53,6 +54,15 @@ def render_comm_table(counters: dict) -> str:
         is_bytes = body.endswith("_bytes")
         if is_bytes:
             body = body[: -len("_bytes")]
+        if body.startswith("layout."):
+            # comm.layout.<layout>.<op>[_bytes] aggregates: grouped in
+            # their own by-layout section, not the flat table (they
+            # would double-count the per-collective rows).
+            layout, _, op = body[len("layout."):].partition(".")
+            row = by_layout.setdefault((layout, op),
+                                       {"calls": 0, "bytes": 0})
+            row["bytes" if is_bytes else "calls"] += val
+            continue
         op, _, coll = body.rpartition(".")
         row = rows.setdefault((op, coll), {"calls": 0, "bytes": 0})
         row["bytes" if is_bytes else "calls"] += val
@@ -69,7 +79,19 @@ def render_comm_table(counters: dict) -> str:
     total_c = sum(r["calls"] for r in rows.values())
     lines.append(["TOTAL", "", str(int(total_c)), str(int(total_b)),
                   f"{total_b / 2**20:.3f}"])
-    return report.format_table(headers, lines, left_cols=2)
+    out = report.format_table(headers, lines, left_cols=2)
+    if by_layout:
+        lay_headers = ["layout", "op", "calls", "bytes", "MB"]
+        lay_lines = []
+        for (layout, op), row in sorted(by_layout.items(),
+                                        key=lambda kv: -kv[1]["bytes"]):
+            lay_lines.append([layout, op, str(int(row["calls"])),
+                              str(int(row["bytes"])),
+                              f"{row['bytes'] / 2**20:.3f}"])
+        out += ("\n\nby layout (partition strategy):\n"
+                + report.format_table(lay_headers, lay_lines,
+                                      left_cols=2))
+    return out
 
 
 def render_autotune_table(counters: dict) -> str:
