@@ -101,7 +101,11 @@ def read_cached_probe() -> bool | None:
             st = json.load(f)
         if not isinstance(st, dict):
             return None
-        age = time.time() - float(st["ts"])
+        # Wall clock is the contract here: the TTL compares against an
+        # epoch timestamp recorded in a FILE shared with an external
+        # watcher (tunnel_watch.sh) — monotonic time is per-process
+        # and cannot age a cross-process artifact.
+        age = time.time() - float(st["ts"])  # lint: disable=monotonic-clock — file-TTL vs shared epoch timestamp
         if age < 0 or age > ttl:
             return None
         verdict = st.get("verdict")
@@ -134,7 +138,7 @@ def write_probe_state(live: bool, source: str = "probe") -> None:
     try:
         payload = json.dumps({
             "verdict": "live" if live else "dead",
-            "ts": time.time(),
+            "ts": time.time(),  # lint: disable=monotonic-clock — epoch ts read cross-process by tunnel_watch.sh
             "tunnel_marker": _tunnel_marker_alive(),
             "source": source,
             "pid": os.getpid(),

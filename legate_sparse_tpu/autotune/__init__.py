@@ -56,11 +56,13 @@ _store_lock = threading.Lock()
 def get_store() -> VerdictStore:
     """The process-wide verdict store (created on first use)."""
     global _store
-    if _store is None:
+    # Double-checked init: the unlocked reads are GIL-atomic single
+    # references and can at worst observe None and take the lock.
+    if _store is None:  # lint: disable=lock-discipline — double-checked fast path
         with _store_lock:
             if _store is None:
                 _store = VerdictStore()
-    return _store
+    return _store  # lint: disable=lock-discipline — GIL-atomic ref read
 
 
 def reset() -> None:

@@ -455,11 +455,13 @@ _engine_lock = threading.Lock()
 def get_engine() -> Engine:
     """The process-wide engine (created on first use)."""
     global _engine
-    if _engine is None:
+    # Double-checked init: the unlocked reads are GIL-atomic single
+    # references and can at worst observe None and take the lock.
+    if _engine is None:  # lint: disable=lock-discipline — double-checked fast path
         with _engine_lock:
             if _engine is None:
                 _engine = Engine()
-    return _engine
+    return _engine  # lint: disable=lock-discipline — GIL-atomic ref read
 
 
 def reset_engine() -> None:

@@ -647,11 +647,13 @@ def get_gateway() -> Gateway:
     """The process-wide gateway over the process engine (created on
     first use)."""
     global _gateway
-    if _gateway is None:
+    # Double-checked init: the unlocked reads are GIL-atomic single
+    # references and can at worst observe None and take the lock.
+    if _gateway is None:  # lint: disable=lock-discipline — double-checked fast path
         with _gateway_lock:
             if _gateway is None:
                 _gateway = Gateway()
-    return _gateway
+    return _gateway  # lint: disable=lock-discipline — GIL-atomic ref read
 
 
 def reset_gateway() -> None:

@@ -168,7 +168,7 @@ def dist_diags(
         # vals_by_diag[d, r_l] = value of diagonal d at global row r.
         vals = []
         b_iter = iter(blocks)
-        for d, (k, spec) in enumerate(zip(offs.tolist(), diags_sorted)):
+        for d, (k, spec) in enumerate(zip(offs.tolist(), diags_sorted)):  # lint: disable=trace-purity — offs is a host np array; static per-diag unroll at trace time is deliberate
             if d in array_blocks:
                 vals.append(next(b_iter)[0])
             elif callable(spec):

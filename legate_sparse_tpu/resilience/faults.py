@@ -226,9 +226,12 @@ def fault_point(site: str, value: Any = None) -> Any:
         raise ValueError(
             f"fault_point({site!r}): site not in catalog "
             f"(tools/check_fault_sites.py should have caught this)")
-    if not _arms:
+    # Unlocked emptiness/get probes are GIL-atomic dict reads: the
+    # zero-arm common case must not take a lock per fault_point, and
+    # the hit path re-reads under the lock below before acting.
+    if not _arms:  # lint: disable=lock-discipline — lock-free zero-arm fast path
         return value
-    arm = _arms.get(site)
+    arm = _arms.get(site)  # lint: disable=lock-discipline — re-read under lock below
     if arm is None:
         return value
     if not _trace_clean():

@@ -166,7 +166,9 @@ _budgets: Dict[str, int] = {}
 def breaker(site: str) -> CircuitBreaker:
     """The site's breaker (created from the live settings knobs on
     first use)."""
-    br = _breakers.get(site)
+    # Unlocked .get is a GIL-atomic dict read on the hot path; a miss
+    # falls through to the locked double-checked create below.
+    br = _breakers.get(site)  # lint: disable=lock-discipline — double-checked fast path
     if br is not None:
         return br
     with _registry_lock:

@@ -1,0 +1,430 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""sparselint framework tests: per-rule good/bad fixtures, the
+falsifiability drill over every registered rule, suppression and
+baseline semantics, CLI modes, and the tier-1 full-repo gate.
+
+The falsifiability drill is the load-bearing test: a rule that cannot
+fire on its own seeded known-bad input checks nothing (the same
+own-module-excluded discipline the legacy checkers established).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import core  # noqa: E402
+from tools.lint import cli  # noqa: E402
+from tools.lint.core import (  # noqa: E402
+    Context, Finding, all_rules, get_rule, load_baseline, run_lint,
+    suppressed_by_line, write_baseline,
+)
+
+EXPECTED_RULES = {
+    "fault-sites", "kernel-registry", "knob-registry",
+    "lock-discipline", "monotonic-clock", "obs-docs", "settings-epoch",
+    "trace-purity",
+}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return Context()
+
+
+# ------------------------------------------------------------------ #
+# registry
+# ------------------------------------------------------------------ #
+
+def test_registry_is_complete():
+    rules = all_rules()
+    assert set(rules) == EXPECTED_RULES
+    for rid, rule in rules.items():
+        assert rule.id == rid
+        assert rule.description, f"rule {rid} has no description"
+        assert rule.severity in core.SEVERITIES
+        assert rule.scope_prefixes, f"rule {rid} declares no scope"
+
+
+def test_duplicate_rule_id_rejected():
+    class Dup(core.Rule):
+        id = "monotonic-clock"
+
+    with pytest.raises(ValueError, match="duplicate"):
+        core.register(Dup)
+
+
+# ------------------------------------------------------------------ #
+# falsifiability drill: every rule must fire on its known-bad input
+# ------------------------------------------------------------------ #
+
+@pytest.mark.parametrize("rule_id", sorted(EXPECTED_RULES))
+def test_rule_is_falsifiable(ctx, rule_id):
+    findings = get_rule(rule_id).falsifiability(ctx)
+    assert findings, f"rule {rule_id} produced no finding on its " \
+                     f"known-bad input — it checks nothing"
+    assert all(f.rule == rule_id for f in findings)
+    assert all(f.message for f in findings)
+
+
+# ------------------------------------------------------------------ #
+# per-rule behavior on the fixtures
+# ------------------------------------------------------------------ #
+
+def test_trace_purity_fixture_findings(ctx):
+    fixture = "tools/lint/fixtures/trace_purity_bad.py"
+    findings = list(get_rule("trace-purity").check(ctx, [fixture]))
+    msgs = "\n".join(f.message for f in findings)
+    # The six seeded violations, across a @jax.jit def and a
+    # lax.while_loop cond/body pair.
+    assert "print()" in msgs
+    assert "float(x)" in msgs
+    assert ".item()" in msgs
+    assert "bool(c)" in msgs
+    assert "np.asarray()" in msgs
+    assert "time.time()" in msgs
+    # The host-side function must stay clean: every finding names one
+    # of the traced regions.
+    owners = {f.message.split(":")[0] for f in findings}
+    assert owners <= {"in traced bad_jitted", "in traced cond",
+                      "in traced body"}
+
+
+def test_trace_purity_ignores_host_code(ctx, tmp_path):
+    tmp_ctx = Context(repo=str(tmp_path))
+    (tmp_path / "host.py").write_text(
+        "import time\n"
+        "import numpy as np\n"
+        "def host(x):\n"
+        "    print(float(np.asarray(x).item()), time.time())\n"
+        "    return x\n")
+    assert list(get_rule("trace-purity").check(tmp_ctx, ["host.py"])) \
+        == []
+
+
+def test_lock_discipline_fixture_findings(ctx):
+    rule = get_rule("lock-discipline")
+    findings = rule.falsifiability(ctx)
+    # Exactly the two unlocked accesses (bad_write / bad_read); the
+    # locked write and the parameter-shadowing function stay clean.
+    assert sorted(f.line for f in findings) == [11, 15]
+    for f in findings:
+        assert "'_STATE'" in f.message
+        assert "with _LOCK:" in f.message
+
+
+def test_lock_discipline_locked_helper_exempt(tmp_path):
+    tmp_ctx = Context(repo=str(tmp_path))
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "_state = {}\n"
+        "def _compact_locked():\n"
+        "    _state.clear()\n"        # caller-holds-lock convention
+        "def bad():\n"
+        "    _state.clear()\n")
+    reg = {"m.py": {"_lock": frozenset({"_state"})}}
+    findings = list(get_rule("lock-discipline").check(
+        tmp_ctx, ["m.py"], registry=reg))
+    assert [f.line for f in findings] == [7]
+
+
+def test_settings_epoch_fixture_findings(ctx):
+    fixture = "tools/lint/fixtures/settings_epoch_bad.py"
+    findings = list(get_rule("settings-epoch").check(ctx, [fixture]))
+    msgs = "\n".join(f.message for f in findings)
+    assert "settings.__dict__" in msgs
+    assert "object.__setattr__(settings" in msgs
+    assert "vars(settings)" in msgs
+    assert "settings.not_a_real_knob" in msgs
+    # The legitimate mutation and the epoch property read are clean.
+    assert len(findings) == 4
+
+
+def test_settings_epoch_stale_exemption(tmp_path):
+    tmp_ctx = Context(repo=str(tmp_path))
+    pkg = tmp_path / "legate_sparse_tpu"
+    pkg.mkdir()
+    (pkg / "settings.py").write_text(
+        "class Settings:\n"
+        "    _EPOCH_EXEMPT = frozenset({'real', 'ghost_attr'})\n"
+        "    def __init__(self):\n"
+        "        self.real = 1\n"
+        "settings = Settings()\n")
+    findings = list(get_rule("settings-epoch").check(
+        tmp_ctx, ["legate_sparse_tpu/settings.py"]))
+    assert len(findings) == 1
+    assert "'ghost_attr'" in findings[0].message
+    assert "stale exemption" in findings[0].message
+
+
+def test_knob_registry_fixture_findings(ctx):
+    fixture = "tools/lint/fixtures/knob_registry_bad.py"
+    findings = list(get_rule("knob-registry").check(ctx, [fixture]))
+    # Only the undocumented knob fires; LEGATE_SPARSE_TPU_OBS has a
+    # README row.
+    assert len(findings) == 1
+    assert "LEGATE_SPARSE_TPU_ZZ_UNDOCUMENTED" in findings[0].message
+
+
+def test_knob_registry_prefix_and_shorthand(ctx):
+    from tools.lint.rules.knob_registry import documented
+    doc = ("| `LEGATE_SPARSE_TPU_RESIL_RETRIES` | ... |\n"
+           "| `_PROBE_TIMEOUT` / `_PROBE_RETRIES` | ... |\n")
+    shorthands = {"_PROBE_TIMEOUT", "_PROBE_RETRIES"}
+    # Prefix literal covered by a documented knob extending it.
+    assert documented("LEGATE_SPARSE_TPU_RESIL_", doc, shorthands)
+    assert not documented("LEGATE_SPARSE_TPU_ZZ_", doc, shorthands)
+    # Shorthand suffix rows cover full names.
+    assert documented("LEGATE_SPARSE_TPU_PROBE_TIMEOUT", doc,
+                      shorthands)
+    assert not documented("LEGATE_SPARSE_TPU_PROBE_TTL", doc,
+                          shorthands)
+
+
+def test_monotonic_clock_fixture_findings(ctx):
+    fixture = "tools/lint/fixtures/monotonic_clock_bad.py"
+    findings = list(get_rule("monotonic-clock").check(ctx, [fixture]))
+    # Both time.time() calls, neither time.monotonic() call.
+    assert len(findings) == 2
+    assert all("time.time()" in f.message for f in findings)
+
+
+def test_fault_sites_rule_clean_on_repo(ctx):
+    assert list(get_rule("fault-sites").check(
+        ctx, get_rule("fault-sites").scope_files(ctx))) == []
+
+
+def test_kernel_registry_rule_clean_on_repo(ctx):
+    assert list(get_rule("kernel-registry").check(
+        ctx, get_rule("kernel-registry").scope_files(ctx))) == []
+
+
+def test_obs_docs_rule_clean_on_repo(ctx):
+    assert list(get_rule("obs-docs").check(
+        ctx, get_rule("obs-docs").scope_files(ctx))) == []
+
+
+# ------------------------------------------------------------------ #
+# suppression semantics
+# ------------------------------------------------------------------ #
+
+def _tmp_pkg_ctx(tmp_path, source):
+    """A throwaway repo whose package holds one module."""
+    pkg = tmp_path / "legate_sparse_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(source)
+    return Context(repo=str(tmp_path))
+
+
+def test_inline_suppression(tmp_path):
+    tmp_ctx = _tmp_pkg_ctx(
+        tmp_path,
+        "import time\n"
+        "def f():\n"
+        "    a = time.time()  # lint: disable=monotonic-clock — why\n"
+        "    b = time.time()  # lint: disable=all\n"
+        "    c = time.time()  # lint: disable=other-rule\n"
+        "    d = time.time()\n"
+        "    return a, b, c, d\n")
+    res = run_lint(tmp_ctx, rule_ids=["monotonic-clock"],
+                   baseline_path=None)
+    assert sorted(f.line for f in res.suppressed) == [3, 4]
+    assert sorted(f.line for f in res.active) == [5, 6]
+    assert res.exit_code == 1
+
+
+def test_suppressed_by_line_bounds(ctx):
+    # Whole-program findings (line 0) and out-of-range lines are never
+    # suppressed.
+    f0 = Finding(rule="fault-sites", path="docs/RESILIENCE.md", line=0,
+                 message="m")
+    assert not suppressed_by_line(ctx, f0)
+    f_oob = Finding(rule="monotonic-clock", path="README.md",
+                    line=10 ** 6, message="m")
+    assert not suppressed_by_line(ctx, f_oob)
+
+
+# ------------------------------------------------------------------ #
+# baseline semantics
+# ------------------------------------------------------------------ #
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    tmp_ctx = _tmp_pkg_ctx(
+        tmp_path,
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n")
+    baseline_path = str(tmp_path / "baseline.json")
+
+    res = run_lint(tmp_ctx, rule_ids=["monotonic-clock"],
+                   baseline_path=None)
+    assert len(res.active) == 1
+
+    write_baseline(baseline_path, res.active)
+    assert len(load_baseline(baseline_path)) == 1
+
+    # Baselined: the finding no longer fails the run.
+    res2 = run_lint(tmp_ctx, rule_ids=["monotonic-clock"],
+                    baseline_path=baseline_path)
+    assert res2.active == []
+    assert len(res2.baselined) == 1
+    assert res2.stale_baseline == []
+    assert res2.exit_code == 0
+
+    # Fix the code: the baseline entry must surface as stale.
+    (tmp_path / "fixed").mkdir()
+    fixed_ctx = _tmp_pkg_ctx(
+        tmp_path / "fixed",
+        "import time\n"
+        "def f():\n"
+        "    return time.monotonic()\n")
+    res3 = run_lint(fixed_ctx, rule_ids=["monotonic-clock"],
+                    baseline_path=baseline_path)
+    assert res3.active == []
+    assert res3.baselined == []
+    assert len(res3.stale_baseline) == 1
+    assert res3.exit_code == 0
+
+
+def test_baseline_is_line_number_free(tmp_path):
+    # Same finding at a different line still matches the baseline:
+    # the key is (rule, path, message).
+    baseline_path = str(tmp_path / "baseline.json")
+    f1 = Finding(rule="r", path="p.py", line=10, message="m")
+    write_baseline(baseline_path, [f1])
+    entries = load_baseline(baseline_path)
+    f2 = Finding(rule="r", path="p.py", line=99, message="m")
+    assert entries.get(f2.baseline_key()) == 1
+
+
+def test_committed_baseline_is_empty():
+    # The repo starts clean: the committed baseline holds no
+    # grandfathered findings (additions need a PR-visible diff here).
+    assert load_baseline(core.DEFAULT_BASELINE) == {}
+
+
+# ------------------------------------------------------------------ #
+# selection (--changed machinery)
+# ------------------------------------------------------------------ #
+
+def test_selection_scopes_non_whole_program_rules(tmp_path):
+    tmp_ctx = _tmp_pkg_ctx(
+        tmp_path,
+        "import time\n"
+        "def f():\n"
+        "    return time.time()\n")
+    other = tmp_path / "legate_sparse_tpu" / "other.py"
+    other.write_text("import time\n"
+                     "def g():\n"
+                     "    return time.time()\n")
+    # Only the selected file is scanned.
+    res = run_lint(tmp_ctx, selection=["legate_sparse_tpu/mod.py"],
+                   rule_ids=["monotonic-clock"], baseline_path=None)
+    assert {f.path for f in res.active} == {"legate_sparse_tpu/mod.py"}
+    # A selection outside every rule scope runs nothing.
+    res2 = run_lint(tmp_ctx, selection=["unrelated.txt"],
+                    rule_ids=["monotonic-clock"], baseline_path=None)
+    assert res2.rules_run == []
+    assert res2.active == []
+
+
+def test_selection_triggers_whole_program_rules(ctx):
+    # A doc edit re-runs the knob gate over its full scope.
+    res = run_lint(ctx, selection=["README.md"],
+                   rule_ids=["knob-registry"], baseline_path=None)
+    assert res.rules_run == ["knob-registry"]
+    assert res.active == []
+
+
+# ------------------------------------------------------------------ #
+# CLI
+# ------------------------------------------------------------------ #
+
+def test_cli_full_scan_ok(capsys):
+    rc = cli.main([])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "sparselint: OK — 0 findings" in out.out
+
+
+def test_cli_json_artifact(capsys):
+    rc = cli.main(["--json"])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert rc == 0
+    assert data["findings"] == []
+    assert data["exit_code"] == 0
+    assert set(data["rules_run"]) == EXPECTED_RULES
+    assert data["files_scanned"]
+
+
+def test_cli_list_rules(capsys):
+    rc = cli.main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in EXPECTED_RULES:
+        assert rid in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    rc = cli.main(["--rules", "no-such-rule"])
+    assert rc == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_rule_subset(capsys):
+    rc = cli.main(["--rules", "monotonic-clock,trace-purity"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "across 2 rule(s)" in out.out
+
+
+def test_cli_changed_mode(capsys):
+    # Runs against the live git worktree: must succeed whatever the
+    # current diff is (the repo itself stays lint-clean).
+    rc = cli.main(["--changed"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_path_selection(capsys):
+    rc = cli.main(["legate_sparse_tpu/resilience"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_findings_fail_with_renders(tmp_path, capsys, monkeypatch):
+    # Findings render as path:line: severity: [rule] message and flip
+    # the exit code.
+    pkg = tmp_path / "legate_sparse_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import time\n"
+                                "def f():\n"
+                                "    return time.time()\n")
+    monkeypatch.setattr(cli, "Context",
+                        lambda: Context(repo=str(tmp_path)))
+    rc = cli.main(["--rules", "monotonic-clock", "--baseline", "none"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "legate_sparse_tpu/mod.py:3: error: [monotonic-clock]" \
+        in out.out
+    assert "sparselint: FAILED — 1 finding(s)" in out.err
+
+
+# ------------------------------------------------------------------ #
+# tier-1 gate: the whole repo stays lint-clean
+# ------------------------------------------------------------------ #
+
+def test_full_repo_scan_is_clean(ctx):
+    res = run_lint(ctx)
+    assert res.active == [], "unsuppressed findings:\n" + "\n".join(
+        f.render() for f in res.active)
+    assert res.stale_baseline == []
+    assert set(res.rules_run) == EXPECTED_RULES

@@ -1,0 +1,5 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+# Package marker so ``tools.lint`` (sparselint) is importable from the
+# repo root; the single-file CLIs in this directory stay runnable as
+# plain scripts and loadable via tests/utils_test/tools.load_tool.

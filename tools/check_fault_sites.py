@@ -3,26 +3,19 @@
 # SPDX-License-Identifier: Apache-2.0
 """Static fault-site coverage check (tier-1 via tests/test_resilience).
 
+Thin back-compat wrapper: the analysis now lives in the sparselint
+``fault-sites`` rule (``tools/lint/rules/fault_sites.py``; run the
+whole suite with ``python tools/sparselint.py``).  This CLI keeps the
+legacy entry point, flags, message wording and exit semantics.
+
 Injection coverage rots silently: a refactor that renames or drops a
 ``fault_point("...")`` call leaves the catalog advertising a site that
 no longer exists, and the drills that "cover" it keep passing because
-they arm a hook nobody calls.  This pass makes the three views of the
+they arm a hook nobody calls.  The pass makes the three views of the
 site list — the code's literals, ``resilience.faults.CATALOG``, and
-the ``docs/RESILIENCE.md`` site table — agree, and fails on any drift:
-
-1. every site literal passed to ``fault_point(`` / ``guarded_call(`` /
-   ``policy.run(`` in ``legate_sparse_tpu/`` must be in the catalog
-   (no unregistered sites);
-2. every catalog site must appear as a quoted literal somewhere in
-   the package OUTSIDE the catalog's own module (no orphaned catalog
-   entries — the rot case; ``faults.py`` itself is excluded because
-   the catalog defines every site as a quoted literal there, which
-   would make this rule unfalsifiable);
-3. every catalog site must appear in ``docs/RESILIENCE.md`` (the
-   operator-facing list stays complete);
-4. every site in the chaos drill's default pool
-   (``resilience.chaos.DEFAULT_SITES``) must be a catalog site — a
-   drill that arms an unregistered name silently tests nothing.
+the ``docs/RESILIENCE.md`` site table — agree, and fails on any drift
+(unregistered call-site names, orphaned catalog entries, undocumented
+sites, chaos-pool entries outside the catalog).
 
 Usage::
 
@@ -34,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -44,47 +36,14 @@ sys.path.insert(0, _REPO)
 from legate_sparse_tpu.resilience.chaos import DEFAULT_SITES  # noqa: E402
 from legate_sparse_tpu.resilience.faults import CATALOG  # noqa: E402
 
+from tools.lint.rules.fault_sites import (  # noqa: E402
+    SITE_CALL_RE, collect_call_sites, problems_for)
+
+__all__ = ["CATALOG", "DEFAULT_SITES", "SITE_CALL_RE",
+           "collect_call_sites", "main"]
+
 PKG_DIR = os.path.join(_REPO, "legate_sparse_tpu")
 DOC_PATH = os.path.join(_REPO, "docs", "RESILIENCE.md")
-
-# A quoted dotted lowercase name passed as the first argument of one
-# of the site-taking entry points.  ``\brun\(`` deliberately also
-# matches ``policy.run(``/``_rpolicy.run(``; the dotted-name shape
-# keeps unrelated ``run(`` calls (subprocess etc.) out.
-SITE_CALL_RE = re.compile(
-    r"(?:fault_point|guarded_call|_resil_guarded|\brun)\(\s*\n?\s*"
-    r"[\"']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)[\"']")
-
-
-def _py_files(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def collect_call_sites(root: str = PKG_DIR):
-    """{site: [relpath, ...]} for every site literal at an entry
-    point, plus {site: count} of raw quoted occurrences anywhere."""
-    calls = {}
-    quoted = {}
-    for path in _py_files(root):
-        with open(path) as f:
-            text = f.read()
-        rel = os.path.relpath(path, _REPO)
-        for site in SITE_CALL_RE.findall(text):
-            calls.setdefault(site, []).append(rel)
-        if rel.replace(os.sep, "/") == (
-                "legate_sparse_tpu/resilience/faults.py"):
-            # The catalog's own module quotes every site by
-            # definition; counting it would make orphan detection
-            # (rule 2) unable to ever fire.
-            continue
-        for site in CATALOG:
-            if f'"{site}"' in text or f"'{site}'" in text:
-                quoted[site] = quoted.get(site, 0) + 1
-    return calls, quoted
 
 
 def main(argv=None) -> int:
@@ -95,37 +54,11 @@ def main(argv=None) -> int:
                     help="print the catalog with call-site locations")
     args = ap.parse_args(argv)
 
-    calls, quoted = collect_call_sites()
-    problems = []
-
-    unregistered = sorted(set(calls) - set(CATALOG))
-    for site in unregistered:
-        problems.append(
-            f"call site uses unregistered name {site!r} "
-            f"(in {', '.join(sorted(set(calls[site])))}) — add it to "
-            f"resilience.faults.CATALOG")
-
-    orphaned = sorted(s for s in CATALOG if not quoted.get(s))
-    for site in orphaned:
-        problems.append(
-            f"catalog site {site!r} has NO call-site literal in the "
-            f"package — injection coverage rotted")
-
-    try:
-        with open(DOC_PATH) as f:
-            doc = f.read()
-    except OSError as e:
-        doc = ""
-        problems.append(f"docs/RESILIENCE.md unreadable: {e}")
-    undocumented = sorted(s for s in CATALOG if s not in doc)
-    for site in undocumented:
-        problems.append(
-            f"catalog site {site!r} missing from docs/RESILIENCE.md")
-
-    for site in sorted(set(DEFAULT_SITES) - set(CATALOG)):
-        problems.append(
-            f"chaos.DEFAULT_SITES entry {site!r} is not a catalog "
-            f"site — the drill would arm a hook nobody calls")
+    # Read the module globals at call time (not via early-bound
+    # defaults) so tests can monkeypatch CATALOG/PKG_DIR/DOC_PATH.
+    pairs, calls = problems_for(CATALOG, DEFAULT_SITES, PKG_DIR,
+                                DOC_PATH, _REPO)
+    problems = [msg for msg, _rel in pairs]
 
     if args.list:
         width = max(len(s) for s in CATALOG)

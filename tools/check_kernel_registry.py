@@ -3,30 +3,19 @@
 # SPDX-License-Identifier: Apache-2.0
 """Static kernel-registry coverage check (tier-1 via tests/test_autotune).
 
+Thin back-compat wrapper: the analysis now lives in the sparselint
+``kernel-registry`` rule (``tools/lint/rules/kernel_registry.py``; run
+the whole suite with ``python tools/sparselint.py``).  This CLI keeps
+the legacy entry point, flags, message wording and exit semantics.
+
 The autotune candidate registry rots silently: a kernel rename in
 ``ops/spmv.py`` leaves ``autotune/registry.py`` advertising an entry
-point that no longer exists (the harness would only notice at measure
-time, and routing would error mid-dispatch), and a dropped dispatch
-label leaves verdicts that can never be served.  This pass makes the
-three views of the candidate list — the registry, the package's
-dispatch literals, and the ``docs/AUTOTUNER.md`` candidate table —
-agree, and fails on any drift:
-
-1. every ``Candidate.kernel`` must exist as a callable in
-   ``legate_sparse_tpu.ops.spmv`` AND its ``trace.<kernel>`` compile
-   counter must be bumped somewhere in the package (the
-   instrumentation contract every jitted kernel follows);
-2. every candidate label must appear as a quoted literal somewhere in
-   the package OUTSIDE the registry's own module (no orphaned
-   candidates — ``registry.py`` itself is excluded because it defines
-   every label as a quoted literal, which would make this rule
-   unfalsifiable);
-3. every candidate label must appear in ``docs/AUTOTUNER.md`` (the
-   operator-facing candidate table stays complete);
-
-plus the structural invariant that each ``CANDIDATES`` dict key equals
-its entry's ``label`` (verdicts store labels; a mismatched key would
-make a recorded verdict unroutable).
+point that no longer exists, and a dropped dispatch label leaves
+verdicts that can never be served.  The pass makes the three views of
+the candidate list — the registry, the package's dispatch literals,
+and the ``docs/AUTOTUNER.md`` candidate table — agree (plus the
+structural invariant that each ``CANDIDATES`` key equals its entry's
+label), and fails on any drift.
 
 Usage::
 
@@ -47,42 +36,13 @@ sys.path.insert(0, _REPO)
 from legate_sparse_tpu.autotune.registry import CANDIDATES  # noqa: E402
 from legate_sparse_tpu.ops import spmv as _spmv  # noqa: E402
 
+from tools.lint.rules.kernel_registry import (  # noqa: E402
+    collect_literals, problems_for)
+
+__all__ = ["CANDIDATES", "collect_literals", "main"]
+
 PKG_DIR = os.path.join(_REPO, "legate_sparse_tpu")
 DOC_PATH = os.path.join(_REPO, "docs", "AUTOTUNER.md")
-REGISTRY_REL = "legate_sparse_tpu/autotune/registry.py"
-
-
-def _py_files(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def collect_literals(root: str = PKG_DIR):
-    """{label: [relpath, ...]} of quoted label occurrences outside the
-    registry module, plus {kernel: True} for packages quoting the
-    ``trace.<kernel>`` counter name."""
-    quoted = {}
-    traced = {}
-    trace_names = {c.kernel: f"trace.{c.kernel}"
-                   for c in CANDIDATES.values()}
-    for path in _py_files(root):
-        with open(path) as f:
-            text = f.read()
-        rel = os.path.relpath(path, _REPO).replace(os.sep, "/")
-        for kernel, tname in trace_names.items():
-            if f'"{tname}"' in text or f"'{tname}'" in text:
-                traced[kernel] = True
-        if rel == REGISTRY_REL:
-            # The registry quotes every label by definition; counting
-            # it would make orphan detection (rule 2) unable to fire.
-            continue
-        for label in CANDIDATES:
-            if f'"{label}"' in text or f"'{label}'" in text:
-                quoted.setdefault(label, []).append(rel)
-    return quoted, traced
 
 
 def main(argv=None) -> int:
@@ -95,43 +55,11 @@ def main(argv=None) -> int:
                          "locations")
     args = ap.parse_args(argv)
 
-    quoted, traced = collect_literals()
-    problems = []
-
-    for key, cand in sorted(CANDIDATES.items()):
-        if key != cand.label:
-            problems.append(
-                f"registry key {key!r} != its entry's label "
-                f"{cand.label!r} — verdicts store labels, a mismatch "
-                f"makes them unroutable")
-        fn = getattr(_spmv, cand.kernel, None)
-        if not callable(fn):
-            problems.append(
-                f"candidate {cand.label!r} names kernel "
-                f"{cand.kernel!r}, which is not a callable in "
-                f"legate_sparse_tpu.ops.spmv — registry rotted")
-        elif not traced.get(cand.kernel):
-            problems.append(
-                f"kernel {cand.kernel!r} has no 'trace.{cand.kernel}' "
-                f"compile counter in the package — the jitted-kernel "
-                f"instrumentation contract is broken")
-
-    orphaned = sorted(l for l in CANDIDATES if not quoted.get(l))
-    for label in orphaned:
-        problems.append(
-            f"candidate label {label!r} has NO quoted literal outside "
-            f"the registry — no dispatch site serves it")
-
-    try:
-        with open(DOC_PATH) as f:
-            doc = f.read()
-    except OSError as e:
-        doc = ""
-        problems.append(f"docs/AUTOTUNER.md unreadable: {e}")
-    undocumented = sorted(l for l in CANDIDATES if l not in doc)
-    for label in undocumented:
-        problems.append(
-            f"candidate label {label!r} missing from docs/AUTOTUNER.md")
+    # Read the module globals at call time (not via early-bound
+    # defaults) so tests can monkeypatch CANDIDATES/PKG_DIR/DOC_PATH.
+    pairs, quoted = problems_for(CANDIDATES, _spmv, PKG_DIR, DOC_PATH,
+                                 _REPO)
+    problems = [msg for msg, _rel in pairs]
 
     if args.list:
         width = max(len(l) for l in CANDIDATES)

@@ -4,23 +4,20 @@
 """Static obs-name coverage check (tier-1, mirroring
 ``check_fault_sites.py``).
 
+Thin back-compat wrapper: the analysis now lives in the sparselint
+``obs-docs`` rule (``tools/lint/rules/obs_docs.py``; run the whole
+suite with ``python tools/sparselint.py``).  This CLI keeps the legacy
+entry point, flags, message wording and exit semantics.
+
 The observability contract rots silently: a new ``obs.inc``/span/
 histogram name ships, nobody adds it to the ``docs/OBSERVABILITY.md``
 tables, and six PRs later the operator-facing reference describes half
-the telemetry the package actually emits.  This pass extracts every
+the telemetry the package actually emits.  The pass extracts every
 name literal passed to an obs emission entry point in
-``legate_sparse_tpu/`` — counters (``inc``/``handle``), spans
-(``span``/``complete_span``), events (``event``), and latency
-histograms (``observe``/``handle``/``timer``) — and fails unless each
-appears in docs/OBSERVABILITY.md, either verbatim or covered by a
-documented prefix pattern (a backticked token ending in ``*`` or a
-``<placeholder>`` segment, e.g. ``resil.*`` or ``mem.<phase>``).
-
-f-strings contribute their literal prefix (``f"lat.spmv.{b}"`` →
-``lat.spmv.``), which must be covered by a documented prefix; names
-built entirely from variables are invisible to this pass (the same
-limitation as check_fault_sites — keep at least a literal prefix at
-emission sites).
+``legate_sparse_tpu/`` and fails unless each appears in
+docs/OBSERVABILITY.md, verbatim or via a documented prefix pattern.
+f-strings contribute their literal prefix; names built entirely from
+variables are invisible (keep a literal prefix at emission sites).
 
 Usage::
 
@@ -32,105 +29,21 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+from tools.lint.rules.obs_docs import (  # noqa: E402
+    DOC_TOKEN_RE, EMIT_RE, collect_emissions, doc_patterns, documented,
+    problems_for)
+
+__all__ = ["EMIT_RE", "DOC_TOKEN_RE", "collect_emissions",
+           "doc_patterns", "documented", "main"]
 
 PKG_DIR = os.path.join(_REPO, "legate_sparse_tpu")
 DOC_PATH = os.path.join(_REPO, "docs", "OBSERVABILITY.md")
-
-# A quoted (optionally f-string) name as the first argument of an obs
-# emission entry point.  The receiver alternatives cover the package's
-# import aliases (obs / _obs / counters / _counters / trace / _trace /
-# latency / _latency / _lat); the emission methods are the closed set
-# of name-taking APIs.
-EMIT_RE = re.compile(
-    r"(?:\b(?:_?obs|_?counters|_?trace|_?latency|_lat)\.)"
-    r"(?:inc|span|event|handle|observe|timer|complete_span)\(\s*\n?\s*"
-    r"(f?)[\"']([^\"'\n]+)[\"']")
-
-# Backticked tokens in the doc that look like emission names: dotted
-# lowercase (counters/histograms/events) or bare span names.
-DOC_TOKEN_RE = re.compile(r"`([A-Za-z0-9_.<>*/-]+)`")
-
-
-def _py_files(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def collect_emissions(root: str = PKG_DIR):
-    """{name: [relpath, ...]} of emitted name literals; f-string names
-    are reduced to their literal prefix and flagged: the value is
-    ``(name_or_prefix, is_prefix)`` keys."""
-    out = {}
-    for path in _py_files(root):
-        with open(path) as f:
-            text = f.read()
-        rel = os.path.relpath(path, _REPO)
-        for fprefix, raw in EMIT_RE.findall(text):
-            name = raw
-            is_prefix = False
-            if fprefix:
-                cut = raw.find("{")
-                if cut == 0:
-                    continue    # no literal prefix: invisible here
-                if cut > 0:
-                    name = raw[:cut]
-                    is_prefix = True
-            # Concatenated-literal emissions ("lat.spmv." +
-            # shape_bucket(...)) present as a trailing-dot literal —
-            # treat like an f-string prefix.
-            if name.endswith("."):
-                is_prefix = True
-            if not re.match(r"^[a-z][a-zA-Z0-9_.]*\.?$", name):
-                continue        # not an emission name (messages etc.)
-            out.setdefault((name, is_prefix), []).append(rel)
-    return out
-
-
-def doc_patterns(doc_text: str):
-    """(exact_names, prefixes) from the doc's backticked tokens.  A
-    token ending in ``*`` or containing a ``<placeholder>`` segment
-    contributes its literal head as a prefix pattern."""
-    exact = set()
-    prefixes = set()
-    for tok in DOC_TOKEN_RE.findall(doc_text):
-        cut = len(tok)
-        for ch in ("*", "<"):
-            pos = tok.find(ch)
-            if pos != -1:
-                cut = min(cut, pos)
-        if cut < len(tok):
-            head = tok[:cut]
-            if head:
-                prefixes.add(head)
-        else:
-            exact.add(tok)
-    return exact, prefixes
-
-
-def documented(name: str, is_prefix: bool, exact, prefixes) -> bool:
-    if not is_prefix and name in exact:
-        return True
-    for p in prefixes:
-        if name.startswith(p):
-            return True
-    if is_prefix:
-        # An f-string prefix is covered when some documented exact
-        # name or pattern head extends it (the doc names the family).
-        for t in exact:
-            if t.startswith(name):
-                return True
-        for p in prefixes:
-            if p.startswith(name):
-                return True
-    return False
 
 
 def main(argv=None) -> int:
@@ -143,24 +56,8 @@ def main(argv=None) -> int:
 
     # Read the module globals at call time (not via early-bound
     # defaults) so tests can monkeypatch PKG_DIR/DOC_PATH.
-    emissions = collect_emissions(PKG_DIR)
-    try:
-        with open(DOC_PATH) as f:
-            doc = f.read()
-    except OSError as e:
-        print(f"check_obs_docs: docs/OBSERVABILITY.md unreadable: {e}",
-              file=sys.stderr)
-        return 1
-    exact, prefixes = doc_patterns(doc)
-
-    problems = []
-    for (name, is_prefix), where in sorted(emissions.items()):
-        if not documented(name, is_prefix, exact, prefixes):
-            kind = "prefix" if is_prefix else "name"
-            problems.append(
-                f"emitted {kind} {name!r} (in "
-                f"{', '.join(sorted(set(where)))}) is not covered by "
-                f"any docs/OBSERVABILITY.md entry")
+    pairs, emissions = problems_for(PKG_DIR, DOC_PATH, _REPO)
+    problems = [msg for msg, _rel in pairs]
 
     if args.list:
         width = max(len(n) for (n, _p) in emissions) if emissions else 0

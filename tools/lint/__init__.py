@@ -1,0 +1,30 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""sparselint — the repo's rule-based AST static-analysis suite.
+
+The codebase's hardest-won invariants (host syncs stay out of traced
+code, guarded module globals are touched under their lock, settings
+mutations bump the plan-cache epoch, every env knob and obs name has a
+docs row, wall-clock never times latency/deadline/breaker paths) used
+to be enforced by convention plus three ad-hoc checkers.  This package
+makes them a framework: a rule registry, per-finding ``file:line``
+output with severity and rule id, inline ``# lint: disable=<rule>``
+suppressions, a committed baseline for grandfathered findings, and
+human/JSON output with deterministic exit codes.
+
+Entry points:
+
+- ``tools/sparselint.py`` — the CLI (full scan, ``--changed``,
+  ``--json``, ``--update-baseline``).
+- ``tools.lint.core.run_lint`` — the library API (tests use it).
+- ``tools/check_fault_sites.py`` / ``check_obs_docs.py`` /
+  ``check_kernel_registry.py`` — thin back-compat wrappers over the
+  migrated rules, exit semantics unchanged.
+
+See ``docs/LINT.md`` for the rule catalog and workflows.
+"""
+
+from .core import (  # noqa: F401
+    Finding, Rule, Context, all_rules, get_rule, register, run_lint,
+)
+from . import rules  # noqa: F401  (importing registers every rule)

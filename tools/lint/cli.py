@@ -1,0 +1,146 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""sparselint CLI (see ``tools/sparselint.py`` for the entry shim).
+
+Exit codes are deterministic: 0 = no unsuppressed, un-baselined
+findings; 1 = findings; 2 = usage/internal error (argparse's own
+convention for usage errors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from .core import (
+    Context, DEFAULT_BASELINE, all_rules, load_baseline, run_lint,
+    write_baseline,
+)
+
+
+def changed_files(repo: str):
+    """Repo-relative paths touched vs HEAD (unstaged + staged +
+    untracked) — the fast pre-commit selection."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            text = subprocess.run(
+                args, cwd=repo, capture_output=True, text=True,
+                check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise RuntimeError(f"--changed needs git: {e}") from e
+        out.update(l.strip() for l in text.splitlines() if l.strip())
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sparselint",
+        description="Rule-based AST static analysis for the repo's "
+                    "trace-purity / lock-discipline / settings-epoch "
+                    "/ knob-and-name-registry invariants "
+                    "(docs/LINT.md).")
+    ap.add_argument("paths", nargs="*",
+                    help="restrict the scan to these repo-relative "
+                         "files/dirs (default: each rule's full "
+                         "scope)")
+    ap.add_argument("--changed", action="store_true",
+                    help="scan only git-diff-touched files (pre-commit "
+                         "mode); whole-program rules re-run when a "
+                         "file in their scope changed")
+    ap.add_argument("--rules",
+                    help="comma-separated rule ids to run (default: "
+                         "all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings artifact on "
+                         "stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: "
+                         "tools/lint/baseline.json); 'none' disables")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "unsuppressed findings and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    ctx = Context()
+    rules = all_rules()
+
+    if args.list_rules:
+        width = max(len(r) for r in rules)
+        for rid in sorted(rules):
+            print(f"{rid.ljust(width)}  {rules[rid].description}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",")
+                    if r.strip()]
+        unknown = sorted(set(rule_ids) - set(rules))
+        if unknown:
+            print(f"sparselint: unknown rule(s): "
+                  f"{', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    selection = None
+    if args.changed:
+        try:
+            selection = changed_files(ctx.repo)
+        except RuntimeError as e:
+            print(f"sparselint: {e}", file=sys.stderr)
+            return 2
+    elif args.paths:
+        selection = []
+        for p in args.paths:
+            rel = os.path.relpath(
+                os.path.abspath(p), ctx.repo).replace(os.sep, "/")
+            if os.path.isdir(ctx.abspath(rel)):
+                selection.extend(ctx.py_files(rel))
+            else:
+                selection.append(rel)
+
+    baseline = None if args.baseline == "none" else args.baseline
+    if args.update_baseline:
+        res = run_lint(ctx, selection=selection, rule_ids=rule_ids,
+                       baseline_path=None)
+        write_baseline(baseline or DEFAULT_BASELINE,
+                       res.active)
+        print(f"sparselint: baseline rewritten with "
+              f"{len(res.active)} entry(ies) -> "
+              f"{baseline or DEFAULT_BASELINE}")
+        return 0
+
+    res = run_lint(ctx, selection=selection, rule_ids=rule_ids,
+                   baseline_path=baseline)
+
+    if args.as_json:
+        print(json.dumps(res.to_json(), indent=1, sort_keys=True))
+        return res.exit_code
+
+    for f in res.active:
+        print(f.render())
+    for key in res.stale_baseline:
+        print(f"sparselint: stale baseline entry {key!r} matched "
+              f"nothing — remove it", file=sys.stderr)
+    n_sup, n_base = len(res.suppressed), len(res.baselined)
+    extras = []
+    if n_sup:
+        extras.append(f"{n_sup} suppressed inline")
+    if n_base:
+        extras.append(f"{n_base} baselined")
+    extra = f" ({', '.join(extras)})" if extras else ""
+    if res.active:
+        print(f"sparselint: FAILED — {len(res.active)} finding(s) "
+              f"across {len(res.rules_run)} rule(s){extra}",
+              file=sys.stderr)
+        return 1
+    print(f"sparselint: OK — 0 findings across "
+          f"{len(res.rules_run)} rule(s), "
+          f"{len(res.files_scanned)} file(s){extra}")
+    return 0

@@ -1,0 +1,329 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""sparselint core: findings, rule registry, suppressions, baseline,
+and the runner.
+
+Design notes
+------------
+- A **rule** is a class with a stable ``id`` (kebab-case), a severity,
+  a one-line description, and a ``check(ctx, files)`` method yielding
+  ``Finding``s.  Rules register themselves via the ``@register``
+  decorator at import (``tools.lint.rules`` imports every rule
+  module); the registry is the single source of truth the CLI, the
+  falsifiability drill and the docs catalog all read.
+- **Scope**: each rule declares the repo-relative path prefixes it
+  reads (``scope_prefixes``) plus any non-Python inputs
+  (``doc_inputs`` — README/docs tables for the registry-gate rules).
+  The runner intersects a file selection (explicit paths or
+  ``--changed``) with each rule's scope; whole-program rules
+  (``whole_program = True``) run against their full scope whenever the
+  selection touches it, because their findings are properties of the
+  program, not of one file.
+- **Suppression** is inline and line-scoped: a trailing
+  ``# lint: disable=<rule>[,<rule>...]`` (or ``disable=all``) on the
+  finding's line silences it.  Suppressed findings are still counted
+  and reported in the summary — silence is visible, never free.
+- **Baseline**: grandfathered findings live in a committed JSON file
+  keyed ``(rule, path, message)`` — deliberately line-number-free so
+  unrelated edits above a grandfathered site don't resurrect it.
+  Entries that match nothing are reported as *stale* (warning, not a
+  failure) so the baseline shrinks instead of rotting.
+- **Falsifiability**: every rule carries a known-bad fixture under
+  ``tools/lint/fixtures/`` (or a synthetic-input override) and a
+  ``falsifiability(ctx)`` hook that must produce at least one finding.
+  ``tests/test_lint.py`` drills every registered rule through it — a
+  rule that cannot fire is a rule that checks nothing, the same
+  own-module-excluded discipline the legacy checkers established.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG_PREFIX = "legate_sparse_tpu/"
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures")
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+SEVERITIES = ("error", "warning")
+
+# Inline suppression: ``# lint: disable=rule-a,rule-b`` (or ``all``),
+# anywhere in the finding's source line.  A justification after the
+# rule list is encouraged: ``# lint: disable=monotonic-clock — file
+# mtimes are wall-clock``.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_,\-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str           # repo-relative, "/"-separated
+    line: int           # 1-based; 0 = whole-file/whole-program
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+
+class Context:
+    """Shared per-run state: repo root plus cached sources/ASTs."""
+
+    def __init__(self, repo: str = REPO):
+        self.repo = repo
+        self._sources: Dict[str, str] = {}
+        self._trees: Dict[str, ast.AST] = {}
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.repo, rel.replace("/", os.sep))
+
+    def source(self, rel: str) -> str:
+        if rel not in self._sources:
+            with open(self.abspath(rel)) as f:
+                self._sources[rel] = f.read()
+        return self._sources[rel]
+
+    def source_lines(self, rel: str) -> List[str]:
+        return self.source(rel).splitlines()
+
+    def tree(self, rel: str) -> ast.AST:
+        """Parsed AST with parent links (``_lint_parent``)."""
+        if rel not in self._trees:
+            tree = ast.parse(self.source(rel), filename=rel)
+            for node in ast.walk(tree):
+                for child in ast.iter_child_nodes(node):
+                    child._lint_parent = node
+            self._trees[rel] = tree
+        return self._trees[rel]
+
+    def py_files(self, prefix: str) -> List[str]:
+        """Repo-relative .py paths under ``prefix`` (sorted,
+        ``__pycache__`` excluded)."""
+        root = self.abspath(prefix)
+        out = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.repo)
+                    out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+
+class Rule:
+    """Base class; subclasses register with ``@register``."""
+
+    id: str = ""
+    severity: str = "error"
+    description: str = ""
+    # Repo-relative prefixes of the Python sources this rule reads.
+    scope_prefixes: Tuple[str, ...] = (PKG_PREFIX,)
+    # Non-Python inputs (docs tables etc.) whose edits re-trigger the
+    # rule under --changed.
+    doc_inputs: Tuple[str, ...] = ()
+    # Whole-program rules check cross-file properties: under a file
+    # selection they run over their FULL scope once any selected file
+    # triggers them.
+    whole_program: bool = False
+    # Known-bad fixture (repo-relative) for the falsifiability drill;
+    # rules with synthetic-input drills override falsifiability().
+    bad_fixture: Optional[str] = None
+
+    def scope_files(self, ctx: Context) -> List[str]:
+        out: List[str] = []
+        for p in self.scope_prefixes:
+            if p.endswith(".py"):
+                out.append(p)
+            else:
+                out.extend(ctx.py_files(p))
+        return out
+
+    def triggers(self, rel: str) -> bool:
+        """Does an edit to ``rel`` warrant re-running this rule?"""
+        return rel in self.doc_inputs or any(
+            rel.startswith(p) or rel == p for p in self.scope_prefixes)
+
+    def check(self, ctx: Context, files: Sequence[str]
+              ) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def falsifiability(self, ctx: Context) -> List[Finding]:
+        """Findings on the rule's seeded known-bad input.  Must be
+        non-empty — drilled by tests/test_lint.py."""
+        if self.bad_fixture is None:
+            raise NotImplementedError(
+                f"rule {self.id} has neither a bad_fixture nor a "
+                f"falsifiability override")
+        return list(self.check(ctx, [self.bad_fixture]))
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding an instance to the registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule class {cls.__name__} has no id")
+    if inst.id in _RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    _RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_RULES)
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _RULES[rule_id]
+
+
+# ------------------------------------------------------------------ #
+# suppression + baseline
+# ------------------------------------------------------------------ #
+
+def suppressed_by_line(ctx: Context, finding: Finding) -> bool:
+    """True when the finding's source line carries a matching inline
+    ``# lint: disable=`` comment."""
+    if finding.line <= 0:
+        return False
+    try:
+        lines = ctx.source_lines(finding.path)
+    except OSError:
+        return False
+    if finding.line > len(lines):
+        return False
+    m = _SUPPRESS_RE.search(lines[finding.line - 1])
+    if not m:
+        return False
+    names = {tok.strip() for tok in m.group(1).split(",")}
+    return finding.rule in names or "all" in names
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline entries as a multiset of (rule, path, message)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "message": f.message}
+         for f in findings),
+        key=lambda e: (e["rule"], e["path"], e["message"]))
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ #
+# runner
+# ------------------------------------------------------------------ #
+
+@dataclass
+class Result:
+    """One lint run's outcome, pre-split by disposition."""
+
+    active: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[Tuple[str, str, str]] = field(
+        default_factory=list)
+    rules_run: List[str] = field(default_factory=list)
+    files_scanned: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [asdict(f) for f in self.active],
+            "suppressed": [asdict(f) for f in self.suppressed],
+            "baselined": [asdict(f) for f in self.baselined],
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m}
+                for (r, p, m) in self.stale_baseline],
+            "rules_run": self.rules_run,
+            "files_scanned": self.files_scanned,
+            "exit_code": self.exit_code,
+        }
+
+
+def run_lint(ctx: Optional[Context] = None,
+             selection: Optional[Sequence[str]] = None,
+             rule_ids: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = DEFAULT_BASELINE) -> Result:
+    """Run rules and classify findings.
+
+    ``selection`` restricts to repo-relative files (``--changed`` /
+    explicit CLI paths); ``None`` = full scan.  ``rule_ids`` restricts
+    the rule set.  ``baseline_path=None`` disables baselining.
+    """
+    ctx = ctx or Context()
+    rules = [_RULES[r] for r in rule_ids] if rule_ids else (
+        [_RULES[k] for k in sorted(_RULES)])
+    sel = None
+    if selection is not None:
+        sel = {s.replace(os.sep, "/") for s in selection}
+
+    res = Result()
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    consumed: Dict[Tuple[str, str, str], int] = {}
+
+    for rule in rules:
+        scope = rule.scope_files(ctx)
+        if sel is None:
+            files = scope
+        else:
+            if not any(rule.triggers(s) for s in sel):
+                continue
+            files = scope if rule.whole_program else [
+                f for f in scope if f in sel]
+            if not files:
+                continue
+        res.rules_run.append(rule.id)
+        res.files_scanned.extend(
+            f for f in files if f not in res.files_scanned)
+        for f in sorted(rule.check(ctx, files),
+                        key=lambda f: (f.path, f.line, f.rule)):
+            if suppressed_by_line(ctx, f):
+                res.suppressed.append(f)
+            elif baseline.get(f.baseline_key(), 0) > consumed.get(
+                    f.baseline_key(), 0):
+                consumed[f.baseline_key()] = consumed.get(
+                    f.baseline_key(), 0) + 1
+                res.baselined.append(f)
+            else:
+                res.active.append(f)
+
+    for key, n in sorted(baseline.items()):
+        if consumed.get(key, 0) < n:
+            res.stale_baseline.append(key)
+    return res

@@ -1,0 +1,4 @@
+# Known-bad snippets for the sparselint falsifiability drill.  These
+# files are PARSED, never imported, and live outside every rule's
+# default scan scope — each exists so tests/test_lint.py can prove its
+# rule still fires (a rule that cannot fire checks nothing).

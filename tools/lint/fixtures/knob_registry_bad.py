@@ -1,0 +1,8 @@
+# Known-bad fixture for the knob-registry rule (parsed, never run).
+import os
+
+# BAD: no README/docs env-table row documents this knob.
+_UNDOCUMENTED = os.environ.get("LEGATE_SPARSE_TPU_ZZ_UNDOCUMENTED")
+
+# OK: documented knob.
+_DOCUMENTED = os.environ.get("LEGATE_SPARSE_TPU_OBS")

@@ -1,0 +1,24 @@
+# Known-bad fixture for the lock-discipline rule (parsed, never run).
+# The falsifiability drill registers {"_LOCK": {"_STATE"}} for this
+# file and expects findings on the unlocked accesses only.
+import threading
+
+_LOCK = threading.Lock()
+_STATE = {}                  # module-level init: exempt
+
+
+def bad_write(key, value):
+    _STATE[key] = value      # BAD: write outside 'with _LOCK:'
+
+
+def bad_read(key):
+    return _STATE.get(key)   # BAD: read outside 'with _LOCK:'
+
+
+def good_write(key, value):
+    with _LOCK:
+        _STATE[key] = value  # OK: under the declared lock
+
+
+def shadowed(_STATE):
+    return _STATE            # OK: parameter shadows the global

@@ -1,0 +1,12 @@
+# Known-bad fixture for the monotonic-clock rule (parsed, never run).
+import time
+
+
+def bad_deadline(budget_s):
+    start = time.time()      # BAD: wall clock in a timing path
+    return time.time() - start > budget_s
+
+
+def good_deadline(budget_s):
+    start = time.monotonic()
+    return time.monotonic() - start > budget_s

@@ -1,0 +1,8 @@
+# Known-bad fixture for the obs-docs rule (parsed, never run): this
+# directory stands in for the package root in the falsifiability
+# drill, and the emission below is covered by no OBSERVABILITY.md row.
+_obs = None  # the regex keys on the receiver/method shape, not types
+
+
+def rogue():
+    _obs.inc("zz.totally_undocumented_emission")

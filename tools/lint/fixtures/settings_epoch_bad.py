@@ -1,0 +1,17 @@
+# Known-bad fixture for the settings-epoch rule (parsed, never run).
+from legate_sparse_tpu.settings import settings
+
+
+def bad_bypass():
+    settings.__dict__["ell_max_expand"] = 0.0   # BAD: epoch bypass
+    object.__setattr__(settings, "x64", False)  # BAD: epoch bypass
+    vars(settings)["resil"] = True              # BAD: epoch bypass
+
+
+def bad_typo():
+    return settings.not_a_real_knob             # BAD: unknown attr
+
+
+def good_mutation():
+    settings.ell_max_expand = 2.0   # OK: goes through __setattr__
+    return settings.epoch           # OK: declared property

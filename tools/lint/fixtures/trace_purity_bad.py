@@ -1,0 +1,31 @@
+# Known-bad fixture for the trace-purity rule (parsed, never run).
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def bad_jitted(x):
+    print("trace-time only", x)     # BAD: print in traced code
+    y = float(x)                    # BAD: coerces a traced argument
+    return y + x.item()             # BAD: .item() host sync
+
+
+def bad_loop(x0):
+    def cond(c):
+        return bool(c)              # BAD: bool() on a traced param
+
+    def body(c):
+        np.asarray(c)               # BAD: host materialization
+        time.time()                 # BAD: trace-time clock read
+        return c + 1
+
+    return lax.while_loop(cond, body, x0)
+
+
+def good_host_code(x):
+    # Host-side code may do all of this freely — no findings here.
+    print("host", float(np.asarray(x).item()), time.time())
+    return int(np.ceil(x))

@@ -1,0 +1,16 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Rule modules.  Importing this package registers every rule with
+``tools.lint.core`` — the CLI, the falsifiability drill and docs/LINT.md
+all enumerate the same registry."""
+
+from . import (  # noqa: F401
+    fault_sites,
+    kernel_registry,
+    knob_registry,
+    lock_discipline,
+    monotonic_clock,
+    obs_docs,
+    settings_epoch,
+    trace_purity,
+)

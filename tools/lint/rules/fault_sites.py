@@ -1,0 +1,139 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""fault-sites: the fault-injection site catalog stays wired.
+
+Migrated from the ad-hoc ``tools/check_fault_sites.py`` (which remains
+as a thin CLI wrapper with identical exit semantics).  The three views
+of the site list — the code's ``fault_point(...)`` literals,
+``resilience.faults.CATALOG``, and the ``docs/RESILIENCE.md`` site
+table — must agree; see the wrapper docstring for the four sub-checks.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from ..core import Context, Finding, PKG_PREFIX, Rule, register
+
+DOC_REL = "docs/RESILIENCE.md"
+FAULTS_REL = "legate_sparse_tpu/resilience/faults.py"
+
+# A quoted dotted lowercase name passed as the first argument of one
+# of the site-taking entry points.  ``\brun\(`` deliberately also
+# matches ``policy.run(``/``_rpolicy.run(``; the dotted-name shape
+# keeps unrelated ``run(`` calls (subprocess etc.) out.
+SITE_CALL_RE = re.compile(
+    r"(?:fault_point|guarded_call|_resil_guarded|\brun)\(\s*\n?\s*"
+    r"[\"']([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)[\"']")
+
+
+def collect_call_sites(catalog, pkg_dir: str, repo: str):
+    """{site: [relpath, ...]} for every site literal at an entry
+    point, plus {site: count} of raw quoted occurrences anywhere."""
+    calls: Dict[str, List[str]] = {}
+    quoted: Dict[str, int] = {}
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                text = f.read()
+            rel = os.path.relpath(path, repo)
+            for site in SITE_CALL_RE.findall(text):
+                calls.setdefault(site, []).append(rel)
+            if rel.replace(os.sep, "/") == FAULTS_REL:
+                # The catalog's own module quotes every site by
+                # definition; counting it would make orphan detection
+                # (rule 2) unable to ever fire.
+                continue
+            for site in catalog:
+                if f'"{site}"' in text or f"'{site}'" in text:
+                    quoted[site] = quoted.get(site, 0) + 1
+    return calls, quoted
+
+
+def problems_for(catalog, default_sites, pkg_dir: str, doc_path: str,
+                 repo: str) -> Tuple[List[Tuple[str, str]], dict]:
+    """[(message, attributed-relpath)] in the legacy wording, plus the
+    call-site map for ``--list``."""
+    calls, quoted = collect_call_sites(catalog, pkg_dir, repo)
+    problems: List[Tuple[str, str]] = []
+
+    for site in sorted(set(calls) - set(catalog)):
+        files = sorted(set(calls[site]))
+        problems.append((
+            f"call site uses unregistered name {site!r} "
+            f"(in {', '.join(files)}) — add it to "
+            f"resilience.faults.CATALOG",
+            files[0].replace(os.sep, "/")))
+
+    for site in sorted(s for s in catalog if not quoted.get(s)):
+        problems.append((
+            f"catalog site {site!r} has NO call-site literal in the "
+            f"package — injection coverage rotted", FAULTS_REL))
+
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        doc = ""
+        problems.append((f"docs/RESILIENCE.md unreadable: {e}",
+                         DOC_REL))
+    for site in sorted(s for s in catalog if s not in doc):
+        problems.append((
+            f"catalog site {site!r} missing from docs/RESILIENCE.md",
+            DOC_REL))
+
+    for site in sorted(set(default_sites) - set(catalog)):
+        problems.append((
+            f"chaos.DEFAULT_SITES entry {site!r} is not a catalog "
+            f"site — the drill would arm a hook nobody calls",
+            "legate_sparse_tpu/resilience/chaos.py"))
+
+    return problems, calls
+
+
+@register
+class FaultSitesRule(Rule):
+    id = "fault-sites"
+    description = ("fault_point literals, resilience.faults.CATALOG "
+                   "and the docs/RESILIENCE.md site table must agree "
+                   "(legacy check_fault_sites)")
+    scope_prefixes = (PKG_PREFIX,)
+    doc_inputs = (DOC_REL,)
+    whole_program = True
+
+    def check(self, ctx: Context, files: Sequence[str],
+              catalog=None, default_sites=None) -> Iterable[Finding]:
+        if catalog is None or default_sites is None:
+            import sys
+            if ctx.repo not in sys.path:
+                sys.path.insert(0, ctx.repo)
+            from legate_sparse_tpu.resilience.chaos import \
+                DEFAULT_SITES as _ds
+            from legate_sparse_tpu.resilience.faults import \
+                CATALOG as _cat
+            catalog = _cat if catalog is None else catalog
+            default_sites = _ds if default_sites is None \
+                else default_sites
+        problems, _calls = problems_for(
+            catalog, default_sites, ctx.abspath(PKG_PREFIX.rstrip("/")),
+            ctx.abspath(DOC_REL), ctx.repo)
+        for msg, rel in problems:
+            yield Finding(rule="fault-sites", path=rel, line=0,
+                          message=msg)
+
+    def falsifiability(self, ctx: Context):
+        # Synthetic rot: an orphaned catalog entry (site with no
+        # call-site literal) — the exact drill test_resilience runs
+        # against the wrapper.
+        from legate_sparse_tpu.resilience.chaos import DEFAULT_SITES
+        from legate_sparse_tpu.resilience.faults import CATALOG
+        catalog = dict(CATALOG)
+        catalog["engine.plan.lint_falsifiability_probe"] = "synthetic"
+        return list(self.check(ctx, [], catalog=catalog,
+                               default_sites=DEFAULT_SITES))

@@ -1,0 +1,147 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""kernel-registry: the autotune candidate registry stays wired.
+
+Migrated from the ad-hoc ``tools/check_kernel_registry.py`` (which
+remains as a thin CLI wrapper with identical exit semantics).  The
+three views of the candidate list — ``autotune/registry.py``, the
+package's dispatch literals, and the ``docs/AUTOTUNER.md`` candidate
+table — must agree; plus the structural invariant that each
+``CANDIDATES`` key equals its entry's label.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from ..core import Context, Finding, PKG_PREFIX, Rule, register
+
+DOC_REL = "docs/AUTOTUNER.md"
+REGISTRY_REL = "legate_sparse_tpu/autotune/registry.py"
+
+
+def collect_literals(candidates, pkg_dir: str, repo: str):
+    """{label: [relpath, ...]} of quoted label occurrences outside the
+    registry module, plus {kernel: True} for files quoting the
+    ``trace.<kernel>`` counter name."""
+    quoted: Dict[str, List[str]] = {}
+    traced: Dict[str, bool] = {}
+    trace_names = {c.kernel: f"trace.{c.kernel}"
+                   for c in candidates.values()}
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                text = f.read()
+            rel = os.path.relpath(path, repo).replace(os.sep, "/")
+            for kernel, tname in trace_names.items():
+                if f'"{tname}"' in text or f"'{tname}'" in text:
+                    traced[kernel] = True
+            if rel == REGISTRY_REL:
+                # The registry quotes every label by definition;
+                # counting it would make orphan detection (rule 2)
+                # unable to fire.
+                continue
+            for label in candidates:
+                if f'"{label}"' in text or f"'{label}'" in text:
+                    quoted.setdefault(label, []).append(rel)
+    return quoted, traced
+
+
+def problems_for(candidates, spmv_module, pkg_dir: str, doc_path: str,
+                 repo: str) -> Tuple[List[Tuple[str, str]], dict]:
+    """[(message, attributed-relpath)] in the legacy wording, plus the
+    quoted-label map for ``--list``."""
+    quoted, traced = collect_literals(candidates, pkg_dir, repo)
+    problems: List[Tuple[str, str]] = []
+
+    for key, cand in sorted(candidates.items()):
+        if key != cand.label:
+            problems.append((
+                f"registry key {key!r} != its entry's label "
+                f"{cand.label!r} — verdicts store labels, a mismatch "
+                f"makes them unroutable", REGISTRY_REL))
+        fn = getattr(spmv_module, cand.kernel, None)
+        if not callable(fn):
+            problems.append((
+                f"candidate {cand.label!r} names kernel "
+                f"{cand.kernel!r}, which is not a callable in "
+                f"legate_sparse_tpu.ops.spmv — registry rotted",
+                REGISTRY_REL))
+        elif not traced.get(cand.kernel):
+            problems.append((
+                f"kernel {cand.kernel!r} has no 'trace.{cand.kernel}' "
+                f"compile counter in the package — the jitted-kernel "
+                f"instrumentation contract is broken",
+                "legate_sparse_tpu/ops/spmv.py"))
+
+    for label in sorted(l for l in candidates if not quoted.get(l)):
+        problems.append((
+            f"candidate label {label!r} has NO quoted literal outside "
+            f"the registry — no dispatch site serves it", REGISTRY_REL))
+
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        doc = ""
+        problems.append((f"docs/AUTOTUNER.md unreadable: {e}",
+                         DOC_REL))
+    for label in sorted(l for l in candidates if l not in doc):
+        problems.append((
+            f"candidate label {label!r} missing from "
+            f"docs/AUTOTUNER.md", DOC_REL))
+
+    return problems, quoted
+
+
+@register
+class KernelRegistryRule(Rule):
+    id = "kernel-registry"
+    description = ("autotune CANDIDATES must name real ops.spmv "
+                   "kernels with wired trace counters, dispatch-site "
+                   "literals and docs rows (legacy "
+                   "check_kernel_registry)")
+    scope_prefixes = (PKG_PREFIX,)
+    doc_inputs = (DOC_REL,)
+    whole_program = True
+
+    def check(self, ctx: Context, files: Sequence[str],
+              candidates=None, spmv_module=None) -> Iterable[Finding]:
+        if candidates is None or spmv_module is None:
+            import sys
+            if ctx.repo not in sys.path:
+                sys.path.insert(0, ctx.repo)
+            from legate_sparse_tpu.autotune.registry import CANDIDATES
+            from legate_sparse_tpu.ops import spmv as _spmv
+            candidates = CANDIDATES if candidates is None \
+                else candidates
+            spmv_module = _spmv if spmv_module is None else spmv_module
+        problems, _ = problems_for(
+            candidates, spmv_module,
+            ctx.abspath(PKG_PREFIX.rstrip("/")), ctx.abspath(DOC_REL),
+            ctx.repo)
+        for msg, rel in problems:
+            yield Finding(rule="kernel-registry", path=rel, line=0,
+                          message=msg)
+
+    def falsifiability(self, ctx: Context):
+        # Synthetic rot: a candidate naming a kernel that does not
+        # exist in ops.spmv.
+        import sys
+        if ctx.repo not in sys.path:
+            sys.path.insert(0, ctx.repo)
+        from legate_sparse_tpu.autotune.registry import (
+            CANDIDATES, Candidate)
+        from legate_sparse_tpu.ops import spmv as _spmv
+        cands = dict(CANDIDATES)
+        probe = "zz-lint-falsifiability-probe"
+        cands[probe] = Candidate(
+            label=probe, kernel="zz_missing_kernel", ops=("spmv",),
+            eligible=lambda A: False, run=lambda A, x, op: None)
+        return list(self.check(ctx, [], candidates=cands,
+                               spmv_module=_spmv))

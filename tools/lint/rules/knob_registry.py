@@ -1,0 +1,109 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""knob-registry: every ``LEGATE_SPARSE*`` env knob has a docs row.
+
+Generalizes ``check_obs_docs`` from obs names to environment knobs:
+each string literal in the package matching ``LEGATE_SPARSE[A-Z0-9_]*``
+must appear in the README env table or a ``docs/*.md`` page.  The env
+surface is the package's operator API — an undocumented knob is a
+feature nobody can discover and a support burden when its spelling is
+guessed wrong.
+
+Matching rules, in order:
+
+- a literal ending in ``_`` is a *prefix* (knob-family builders like
+  ``LEGATE_SPARSE_TPU_RESIL_``): documented when any doc file contains
+  a knob extending it;
+- a full name is documented when it appears verbatim in any doc file;
+- otherwise a backticked shorthand suffix row (the README's
+  ```_PROBE_TIMEOUT` / `_PROBE_RETRIES```-style family rows)
+  covers it when the name ends with that suffix token.
+
+Names built entirely at runtime (no literal) are invisible here — the
+same stated limitation as the obs-docs pass: keep at least a literal
+prefix at knob read sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from ..core import Context, Finding, PKG_PREFIX, Rule, register
+
+KNOB_RE = re.compile(r"LEGATE_SPARSE[A-Z0-9_]*")
+# Backticked shorthand suffix tokens in docs (`_PROBE_TIMEOUT`).
+SHORTHAND_RE = re.compile(r"`(_[A-Z][A-Z0-9_]*)`")
+
+DOC_PATHS = ("README.md", "docs/OBSERVABILITY.md", "docs/ENGINE.md",
+             "docs/RESILIENCE.md", "docs/AUTOTUNER.md", "docs/DIST.md",
+             "docs/MIGRATING.md", "docs/LINT.md")
+
+
+def _doc_text(ctx: Context, doc_paths: Sequence[str]) -> str:
+    parts = []
+    for rel in doc_paths:
+        try:
+            parts.append(ctx.source(rel))
+        except OSError:
+            pass
+    return "\n".join(parts)
+
+
+def collect_knob_literals(ctx: Context, files: Sequence[str]
+                          ) -> Dict[str, List[Tuple[str, int]]]:
+    """{knob: [(relpath, line), ...]} from string literals (f-string
+    literal parts included) in the given files."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for rel in files:
+        tree = ctx.tree(rel)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                for m in KNOB_RE.findall(node.value):
+                    out.setdefault(m, []).append((rel, node.lineno))
+    return out
+
+
+def documented(name: str, doc_text: str, shorthands) -> bool:
+    if name.endswith("_"):
+        # Prefix literal: covered when a documented knob extends it.
+        return bool(re.search(re.escape(name) + r"[A-Z0-9]", doc_text))
+    if name in doc_text:
+        return True
+    return any(name.endswith(sh) for sh in shorthands)
+
+
+@register
+class KnobRegistryRule(Rule):
+    id = "knob-registry"
+    description = ("every LEGATE_SPARSE* env-knob literal in the "
+                   "package must have a README/docs env-table row")
+    scope_prefixes = (PKG_PREFIX,)
+    doc_inputs = DOC_PATHS
+    whole_program = True
+    bad_fixture = "tools/lint/fixtures/knob_registry_bad.py"
+
+    def check(self, ctx: Context, files: Sequence[str],
+              doc_paths: Sequence[str] = DOC_PATHS
+              ) -> Iterable[Finding]:
+        doc_text = _doc_text(ctx, doc_paths)
+        shorthands = set(SHORTHAND_RE.findall(doc_text))
+        knobs = collect_knob_literals(ctx, files)
+        for name in sorted(knobs):
+            if documented(name, doc_text, shorthands):
+                continue
+            # One finding per knob, at its first occurrence; the rest
+            # of the occurrences ride in the message.
+            sites = sorted(set(knobs[name]))
+            rel, line = sites[0]
+            extra = "" if len(sites) == 1 else \
+                f" (+{len(sites) - 1} more site(s))"
+            yield Finding(
+                rule="knob-registry", path=rel, line=line,
+                message=(f"env knob {name!r} has no row in the README "
+                         f"env table or docs/*.md{extra}"))
+
+    def falsifiability(self, ctx: Context):
+        return list(self.check(ctx, [self.bad_fixture]))

@@ -1,0 +1,181 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""lock-discipline: guarded module globals are touched under their lock.
+
+PR 4 hardened ``dist_spgemm``'s module state behind ``_STATE_LOCK``
+after the request executor made concurrent callers a supported
+configuration, and the same pattern now guards singletons, registries
+and telemetry buffers across the package.  The discipline rots the
+usual way: a new helper reads or writes the global without the ``with``
+block, works in every single-threaded test, and tears under load.
+
+``REGISTRY`` below *declares* which lock guards which module globals —
+seeded from every module currently using the ``_STATE_LOCK``-style
+pattern.  The rule then flags any read or write of a registered global
+from inside a function in that module that is not lexically within a
+``with <lock>:`` block.
+
+Module-level statements (the definitions and initializers themselves)
+are exempt: they run at import, before any concurrency exists.  So are
+functions whose name ends in ``_locked`` — the package's existing
+convention for helpers whose contract is "caller holds the lock"
+(``counters._compact_locked``, ``latency._merged_locked``); the naming
+IS the declaration, and the rule enforces that the convention stays
+spelled out.  Deliberate unlocked access — double-checked fast paths,
+GIL-atomic single-reference reads — carries an inline
+``# lint: disable=lock-discipline`` with a one-line justification,
+which doubles as documentation of the memory-model argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Sequence, Set
+
+from ..core import Context, Finding, Rule, register
+
+# {module relpath: {lock name: frozenset(guarded globals)}} — the
+# declared closed registry.  Adding a guarded global to a module means
+# adding it here; the falsifiability fixture proves the rule fires.
+REGISTRY: Dict[str, Dict[str, frozenset]] = {
+    "legate_sparse_tpu/parallel/dist_spgemm.py": {
+        "_STATE_LOCK": frozenset({
+            "_WINDOW_DECLINED", "LAST_B_REALIZATION", "LAST_B_PLAN"}),
+    },
+    "legate_sparse_tpu/obs/trace.py": {
+        "_lock": frozenset({"_records", "_seq_by_name"}),
+    },
+    "legate_sparse_tpu/obs/counters.py": {
+        "_lock": frozenset({"_counters"}),
+    },
+    "legate_sparse_tpu/obs/latency.py": {
+        "_lock": frozenset({"_handles", "_folded"}),
+    },
+    "legate_sparse_tpu/engine/core.py": {
+        "_engine_lock": frozenset({"_engine"}),
+    },
+    "legate_sparse_tpu/engine/gateway.py": {
+        "_gateway_lock": frozenset({"_gateway"}),
+    },
+    "legate_sparse_tpu/engine/plan_cache.py": {
+        "_persist_lock": frozenset({"_persist_enabled"}),
+    },
+    "legate_sparse_tpu/autotune/__init__.py": {
+        "_store_lock": frozenset({"_store"}),
+    },
+    "legate_sparse_tpu/resilience/faults.py": {
+        "_lock": frozenset({"_arms"}),
+    },
+    "legate_sparse_tpu/resilience/policy.py": {
+        "_registry_lock": frozenset({"_breakers", "_budgets"}),
+    },
+}
+
+
+def _inside_function(node: ast.AST) -> bool:
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return True
+        cur = getattr(cur, "_lint_parent", None)
+    return False
+
+
+def _in_locked_helper(node: ast.AST) -> bool:
+    """True inside a ``*_locked``-suffixed function — the declared
+    caller-holds-the-lock convention."""
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and cur.name.endswith("_locked"):
+            return True
+        cur = getattr(cur, "_lint_parent", None)
+    return False
+
+
+def _under_lock(node: ast.AST, lock: str) -> bool:
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Name) and expr.id == lock:
+                    return True
+                # self-style or attribute-qualified lock names
+                if isinstance(expr, ast.Attribute) and \
+                        expr.attr == lock:
+                    return True
+        cur = getattr(cur, "_lint_parent", None)
+    return False
+
+
+def _shadowed(node: ast.AST, name: str) -> bool:
+    """True when ``name`` is a parameter or local of an enclosing
+    function that did NOT declare ``global name`` — then the Name is
+    not the module global at all."""
+    cur = getattr(node, "_lint_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            a = cur.args
+            params = {x.arg for x in
+                      (a.posonlyargs + a.args + a.kwonlyargs)}
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+            if name in params:
+                return True
+            break
+        cur = getattr(cur, "_lint_parent", None)
+    return False
+
+
+def check_module(ctx: Context, rel: str,
+                 guards: Dict[str, frozenset]) -> Iterable[Finding]:
+    tree = ctx.tree(rel)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Name):
+            continue
+        for lock, names in guards.items():
+            if node.id not in names:
+                continue
+            if not _inside_function(node):
+                continue        # import-time definition/initializer
+            if _under_lock(node, lock):
+                continue
+            if _in_locked_helper(node):
+                continue        # "*_locked" = caller holds the lock
+            if _shadowed(node, node.id):
+                continue
+            kind = ("write" if isinstance(node.ctx,
+                                          (ast.Store, ast.Del))
+                    else "read")
+            yield Finding(
+                rule="lock-discipline", path=rel, line=node.lineno,
+                message=(f"{kind} of guarded global {node.id!r} "
+                         f"outside 'with {lock}:'"))
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    description = ("registered module globals accessed outside their "
+                   "declared lock's 'with' block")
+    scope_prefixes = tuple(sorted(REGISTRY))
+    bad_fixture = "tools/lint/fixtures/lock_discipline_bad.py"
+
+    def check(self, ctx: Context, files: Sequence[str],
+              registry: Dict[str, Dict[str, frozenset]] = None
+              ) -> Iterable[Finding]:
+        reg = REGISTRY if registry is None else registry
+        for rel in files:
+            guards = reg.get(rel)
+            if guards:
+                yield from check_module(ctx, rel, guards)
+
+    def falsifiability(self, ctx: Context):
+        fixture = self.bad_fixture
+        synthetic = {fixture: {"_LOCK": frozenset({"_STATE"})}}
+        return list(self.check(ctx, [fixture], registry=synthetic))

@@ -1,0 +1,163 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""obs-docs: every emitted obs name is covered by docs/OBSERVABILITY.md.
+
+Migrated from the ad-hoc ``tools/check_obs_docs.py`` (which remains as
+a thin CLI wrapper with identical exit semantics).  Extracts every
+name literal passed to an obs emission entry point — counters
+(``inc``/``handle``), spans (``span``/``complete_span``), events
+(``event``), latency histograms (``observe``/``handle``/``timer``) —
+and fails unless each appears in docs/OBSERVABILITY.md verbatim or via
+a documented prefix pattern (``resil.*`` / ``mem.<phase>`` tokens).
+f-strings contribute their literal prefix; fully-dynamic names are
+invisible (keep a literal prefix at emission sites).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+from ..core import Context, Finding, PKG_PREFIX, Rule, register
+
+DOC_REL = "docs/OBSERVABILITY.md"
+
+# A quoted (optionally f-string) name as the first argument of an obs
+# emission entry point.  The receiver alternatives cover the package's
+# import aliases (obs / _obs / counters / _counters / trace / _trace /
+# latency / _latency / _lat); the emission methods are the closed set
+# of name-taking APIs.
+EMIT_RE = re.compile(
+    r"(?:\b(?:_?obs|_?counters|_?trace|_?latency|_lat)\.)"
+    r"(?:inc|span|event|handle|observe|timer|complete_span)\(\s*\n?\s*"
+    r"(f?)[\"']([^\"'\n]+)[\"']")
+
+# Backticked tokens in the doc that look like emission names: dotted
+# lowercase (counters/histograms/events) or bare span names.
+DOC_TOKEN_RE = re.compile(r"`([A-Za-z0-9_.<>*/-]+)`")
+
+
+def collect_emissions(pkg_dir: str, repo: str):
+    """{(name_or_prefix, is_prefix): [relpath, ...]} of emitted name
+    literals; f-string names reduce to their literal prefix."""
+    out: Dict[Tuple[str, bool], List[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                text = f.read()
+            rel = os.path.relpath(path, repo)
+            for fprefix, raw in EMIT_RE.findall(text):
+                name = raw
+                is_prefix = False
+                if fprefix:
+                    cut = raw.find("{")
+                    if cut == 0:
+                        continue    # no literal prefix: invisible here
+                    if cut > 0:
+                        name = raw[:cut]
+                        is_prefix = True
+                # Concatenated-literal emissions ("lat.spmv." +
+                # shape_bucket(...)) present as a trailing-dot literal
+                # — treat like an f-string prefix.
+                if name.endswith("."):
+                    is_prefix = True
+                if not re.match(r"^[a-z][a-zA-Z0-9_.]*\.?$", name):
+                    continue    # not an emission name (messages etc.)
+                out.setdefault((name, is_prefix), []).append(rel)
+    return out
+
+
+def doc_patterns(doc_text: str):
+    """(exact_names, prefixes) from the doc's backticked tokens.  A
+    token ending in ``*`` or containing a ``<placeholder>`` segment
+    contributes its literal head as a prefix pattern."""
+    exact = set()
+    prefixes = set()
+    for tok in DOC_TOKEN_RE.findall(doc_text):
+        cut = len(tok)
+        for ch in ("*", "<"):
+            pos = tok.find(ch)
+            if pos != -1:
+                cut = min(cut, pos)
+        if cut < len(tok):
+            head = tok[:cut]
+            if head:
+                prefixes.add(head)
+        else:
+            exact.add(tok)
+    return exact, prefixes
+
+
+def documented(name: str, is_prefix: bool, exact, prefixes) -> bool:
+    if not is_prefix and name in exact:
+        return True
+    for p in prefixes:
+        if name.startswith(p):
+            return True
+    if is_prefix:
+        # An f-string prefix is covered when some documented exact
+        # name or pattern head extends it (the doc names the family).
+        for t in exact:
+            if t.startswith(name):
+                return True
+        for p in prefixes:
+            if p.startswith(name):
+                return True
+    return False
+
+
+def problems_for(pkg_dir: str, doc_path: str, repo: str):
+    """([(message, attributed-relpath)], emissions) in the legacy
+    wording; an unreadable doc is a single problem entry."""
+    emissions = collect_emissions(pkg_dir, repo)
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return ([(f"docs/OBSERVABILITY.md unreadable: {e}", DOC_REL)],
+                emissions)
+    exact, prefixes = doc_patterns(doc)
+
+    problems = []
+    for (name, is_prefix), where in sorted(emissions.items()):
+        if not documented(name, is_prefix, exact, prefixes):
+            kind = "prefix" if is_prefix else "name"
+            files = sorted(set(where))
+            problems.append((
+                f"emitted {kind} {name!r} (in {', '.join(files)}) is "
+                f"not covered by any docs/OBSERVABILITY.md entry",
+                files[0].replace(os.sep, "/")))
+    return problems, emissions
+
+
+@register
+class ObsDocsRule(Rule):
+    id = "obs-docs"
+    description = ("every obs.inc/span/event/observe/timer name "
+                   "literal must be covered by docs/OBSERVABILITY.md "
+                   "(legacy check_obs_docs)")
+    scope_prefixes = (PKG_PREFIX,)
+    doc_inputs = (DOC_REL,)
+    whole_program = True
+
+    def check(self, ctx: Context, files: Sequence[str],
+              pkg_dir: str = None, doc_path: str = None
+              ) -> Iterable[Finding]:
+        pkg = pkg_dir or ctx.abspath(PKG_PREFIX.rstrip("/"))
+        doc = doc_path or ctx.abspath(DOC_REL)
+        problems, _ = problems_for(pkg, doc, ctx.repo)
+        for msg, rel in problems:
+            yield Finding(rule="obs-docs", path=rel, line=0,
+                          message=msg)
+
+    def falsifiability(self, ctx: Context):
+        # The fixture dir stands in for the package: one undocumented
+        # emission literal must fire.
+        fixture_pkg = os.path.join(
+            ctx.repo, "tools", "lint", "fixtures", "obs_docs_bad")
+        return list(self.check(ctx, [], pkg_dir=fixture_pkg))

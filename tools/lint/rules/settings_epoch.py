@@ -1,0 +1,163 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""settings-epoch: every settings attribute is epoch-bumping or
+explicitly epoch-exempt, and nothing bypasses the epoch.
+
+The plan cache (PR 4) keys compiled executables on ``settings.epoch``,
+bumped by ``Settings.__setattr__`` for every post-init value change of
+a lowering-relevant attribute; ``_EPOCH_EXEMPT`` names the attributes
+whose mutation must NOT void ``warmup()`` guarantees.  That contract
+has three rot modes, all checked here:
+
+1. **stale exemption** — a name in ``_EPOCH_EXEMPT`` that no longer
+   exists as a ``Settings`` attribute or property exempts nothing and
+   hides a future re-use of the name from the epoch;
+2. **epoch bypass** — package code writing
+   ``settings.__dict__[...]``, ``vars(settings)[...]`` or
+   ``object.__setattr__(settings, ...)`` skips ``__setattr__``
+   entirely, mutating a knob without invalidating cached plans;
+3. **unknown attribute** — a ``settings.<name>`` (or aliased
+   ``_settings.<name>``) access for a name never assigned in
+   ``Settings.__init__`` nor defined as a property: a typo'd knob read
+   that would surface only as an ``AttributeError`` on a rarely-taken
+   path.
+
+``settings.py`` itself is exempt from (2) — ``__setattr__``'s
+``self.__dict__`` bookkeeping IS the epoch mechanism.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Sequence, Set, Tuple
+
+from ..core import Context, Finding, PKG_PREFIX, Rule, register
+
+SETTINGS_PATH = "legate_sparse_tpu/settings.py"
+# Receiver names treated as the settings singleton across the package.
+RECEIVERS = frozenset({"settings", "_settings"})
+# Internal bookkeeping attrs, always legal.
+INTERNAL = frozenset({"_epoch", "_init_done"})
+
+
+def settings_surface(ctx: Context, settings_rel: str = SETTINGS_PATH
+                     ) -> Tuple[Set[str], Set[str], int]:
+    """(declared attrs+properties, exempt names, exempt lineno) parsed
+    from the Settings class."""
+    tree = ctx.tree(settings_rel)
+    attrs: Set[str] = set()
+    exempt: Set[str] = set()
+    exempt_line = 1
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Settings":
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            attrs.add(t.attr)
+                if isinstance(sub, ast.FunctionDef) and \
+                        sub.decorator_list:
+                    for dec in sub.decorator_list:
+                        if (isinstance(dec, ast.Name) and
+                                dec.id == "property") or \
+                           (isinstance(dec, ast.Attribute) and
+                                dec.attr == "setter"):
+                            attrs.add(sub.name)
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and \
+                                t.id == "_EPOCH_EXEMPT":
+                            exempt_line = stmt.lineno
+                            for e in ast.walk(stmt.value):
+                                if isinstance(e, ast.Constant) and \
+                                        isinstance(e.value, str):
+                                    exempt.add(e.value)
+    attrs.add("epoch")
+    return attrs, exempt, exempt_line
+
+
+def _is_settings_receiver(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id in RECEIVERS
+
+
+@register
+class SettingsEpochRule(Rule):
+    id = "settings-epoch"
+    description = ("settings attributes must be epoch-bumping or in "
+                   "_EPOCH_EXEMPT; no __dict__/object.__setattr__ "
+                   "bypasses; no unknown settings.<attr> accesses")
+    scope_prefixes = (PKG_PREFIX,)
+    whole_program = False
+    bad_fixture = "tools/lint/fixtures/settings_epoch_bad.py"
+
+    def check(self, ctx: Context, files: Sequence[str],
+              settings_rel: str = SETTINGS_PATH) -> Iterable[Finding]:
+        attrs, exempt, exempt_line = settings_surface(ctx, settings_rel)
+
+        # (1) stale exemptions — attributed to settings.py, so only
+        # emitted when it is in the scanned set.
+        if settings_rel in files:
+            for name in sorted(exempt - attrs - INTERNAL):
+                yield Finding(
+                    rule="settings-epoch", path=settings_rel,
+                    line=exempt_line,
+                    message=(f"_EPOCH_EXEMPT entry {name!r} is not a "
+                             f"Settings attribute or property — stale "
+                             f"exemption"))
+
+        for rel in files:
+            tree = ctx.tree(rel)
+            in_settings = rel == settings_rel
+            for node in ast.walk(tree):
+                # (2) epoch bypasses
+                if not in_settings and isinstance(node, ast.Attribute) \
+                        and node.attr == "__dict__" \
+                        and _is_settings_receiver(node.value):
+                    yield Finding(
+                        rule="settings-epoch", path=rel,
+                        line=node.lineno,
+                        message=("settings.__dict__ access bypasses "
+                                 "Settings.__setattr__ — the epoch "
+                                 "never bumps"))
+                    continue
+                if not in_settings and isinstance(node, ast.Call):
+                    callee = node.func
+                    if isinstance(callee, ast.Attribute) and \
+                            callee.attr == "__setattr__" and \
+                            isinstance(callee.value, ast.Name) and \
+                            callee.value.id == "object" and node.args \
+                            and _is_settings_receiver(node.args[0]):
+                        yield Finding(
+                            rule="settings-epoch", path=rel,
+                            line=node.lineno,
+                            message=("object.__setattr__(settings, "
+                                     "...) bypasses the settings "
+                                     "epoch"))
+                        continue
+                    if isinstance(callee, ast.Name) and \
+                            callee.id == "vars" and node.args and \
+                            _is_settings_receiver(node.args[0]):
+                        yield Finding(
+                            rule="settings-epoch", path=rel,
+                            line=node.lineno,
+                            message=("vars(settings) exposes the raw "
+                                     "__dict__ — writes through it "
+                                     "bypass the settings epoch"))
+                        continue
+                # (3) unknown attributes
+                if isinstance(node, ast.Attribute) and \
+                        _is_settings_receiver(node.value) and \
+                        not node.attr.startswith("__") and \
+                        node.attr not in attrs and \
+                        node.attr not in INTERNAL:
+                    yield Finding(
+                        rule="settings-epoch", path=rel,
+                        line=node.lineno,
+                        message=(f"settings.{node.attr} is not a "
+                                 f"declared Settings attribute or "
+                                 f"property (typo'd knob?)"))

@@ -1,0 +1,204 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""trace-purity: host-sync constructs must stay out of traced code.
+
+The repo's performance contract (PR 2's sync-free Krylov, PR 5's
+trace-suppressed fault injection) hinges on traced programs never
+touching the host: a ``.item()``, a ``float()`` coercion of a traced
+value, an ``np.asarray`` materialization, a ``time.*`` read or a
+``print`` inside a jitted/``shard_map``-ped function or a
+``lax.while_loop``/``lax.scan`` body either bakes a transfer into
+every execution or (at best) runs at trace time and silently freezes a
+value into the compiled program.
+
+Detection: a function body counts as **traced** when its ``def`` is
+
+- decorated with ``jit`` (``@jax.jit``, ``@partial(jax.jit, ...)``),
+  or
+- passed by name (or as a lambda) to a call in the closed
+  ``TRACING_ENTRY_POINTS`` set — ``jit`` / ``maybe_jit`` /
+  ``shard_map`` / ``lax.while_loop`` / ``lax.scan`` /
+  ``lax.fori_loop`` / ``lax.cond`` / ``lax.switch``.
+
+Inside traced bodies (nested defs included) the rule flags the closed
+``HOST_SYNC`` construct set below.  ``float()``/``bool()``/``int()``
+coercions are flagged only when the argument is a bare parameter name
+of a function in the traced region — shape arithmetic on static ints
+(``int(np.ceil(...))``) is trace-legal and common in the kernels, and
+flagging it would drown the signal.
+
+The escape hatches are the standard ones: a closed ``ALLOWED_CALLS``
+set for dotted callees that look like violations but are host-legal in
+this codebase, and inline ``# lint: disable=trace-purity`` with a
+justification for deliberate trace-time work (e.g. a static probe that
+runs once at trace time by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence, Set
+
+from ..core import Context, Finding, Rule, register
+
+# Callees whose function-valued arguments are traced.  Matched by the
+# final name segment (``lax.while_loop`` and a bare ``while_loop``
+# import both hit ``while_loop``).
+TRACING_ENTRY_POINTS = frozenset({
+    "jit", "maybe_jit", "shard_map", "while_loop", "scan",
+    "fori_loop", "cond", "switch",
+})
+
+# Dotted callees that pattern-match a violation but are host-legal in
+# this codebase (closed allowlist — extend with a comment saying why).
+ALLOWED_CALLS: frozenset = frozenset()
+
+# module.attr calls flagged inside traced bodies.
+_NP_MATERIALIZERS = frozenset({
+    "asarray", "array", "ascontiguousarray", "copy", "frombuffer",
+    "fromiter", "save", "savez", "load",
+})
+_TIME_CALLS = frozenset({
+    "time", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "sleep",
+})
+# obj.method() calls flagged anywhere in a traced body.
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+def _callee_dotted(func: ast.AST) -> str:
+    """Best-effort dotted name of a call's callee ('' when dynamic)."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    """True for @jit/@jax.jit and @partial(jax.jit, ...) shapes: any
+    name segment 'jit' anywhere in the decorator expression."""
+    for node in ast.walk(dec):
+        if isinstance(node, ast.Name) and node.id == "jit":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "jit":
+            return True
+    return False
+
+
+def _tracing_call_targets(tree: ast.AST):
+    """(names, lambdas): function names / lambda nodes passed to a
+    tracing entry point anywhere in the module."""
+    names: Set[str] = set()
+    lambdas: List[ast.Lambda] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_dotted(node.func)
+        if not callee or callee.split(".")[-1] not in \
+                TRACING_ENTRY_POINTS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Lambda):
+                lambdas.append(arg)
+    return names, lambdas
+
+
+def _traced_regions(tree: ast.AST):
+    """Root nodes (defs / lambdas) whose bodies execute under trace."""
+    names, regions = _tracing_call_targets(tree)
+    regions = list(regions)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in names or any(
+                    _decorator_is_jit(d) for d in node.decorator_list):
+                regions.append(node)
+    return regions
+
+
+def _region_params(region: ast.AST) -> Set[str]:
+    """Parameter names of every def/lambda inside the region."""
+    params: Set[str] = set()
+    for node in ast.walk(region):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                params.add(arg.arg)
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+    return params
+
+
+def _check_region(region: ast.AST, rel: str, owner: str
+                  ) -> Iterable[Finding]:
+    params = _region_params(region)
+    if isinstance(region, ast.Lambda):
+        nodes = list(ast.walk(region.body))
+    else:
+        nodes = []
+        for stmt in region.body:
+            nodes.extend(ast.walk(stmt))
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_dotted(node.func)
+        if callee in ALLOWED_CALLS:
+            continue
+        msg = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS:
+            msg = (f".{node.func.attr}() forces a device->host sync")
+        elif callee.startswith(("np.", "numpy.")) and \
+                callee.split(".")[-1] in _NP_MATERIALIZERS:
+            msg = (f"{callee}() materializes a traced value on the "
+                   f"host (or freezes a trace-time constant)")
+        elif callee.startswith("time.") and \
+                callee.split(".")[-1] in _TIME_CALLS:
+            msg = (f"{callee}() reads the host clock at trace time — "
+                   f"it will not re-run per execution")
+        elif callee in ("jax.device_get", "device_get"):
+            msg = f"{callee}() forces a device->host transfer"
+        elif callee == "print":
+            msg = ("print() inside traced code runs at trace time "
+                   "only (use jax.debug.print)")
+        elif callee in ("float", "bool", "int") and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in params:
+            msg = (f"{callee}({node.args[0].id}) coerces a traced "
+                   f"argument to a host scalar")
+        if msg:
+            yield Finding(
+                rule="trace-purity", path=rel, line=node.lineno,
+                message=f"in traced {owner}: {msg}")
+
+
+@register
+class TracePurityRule(Rule):
+    id = "trace-purity"
+    description = ("host-sync constructs (.item(), float()/bool() "
+                   "coercions, np.* materialization, time.*, print) "
+                   "inside jit/shard_map/while_loop/scan bodies")
+    bad_fixture = "tools/lint/fixtures/trace_purity_bad.py"
+
+    def check(self, ctx: Context, files: Sequence[str]
+              ) -> Iterable[Finding]:
+        for rel in files:
+            tree = ctx.tree(rel)
+            seen = set()
+            for region in _traced_regions(tree):
+                key = id(region)
+                if key in seen:
+                    continue
+                seen.add(key)
+                owner = getattr(region, "name", "<lambda>")
+                yield from _check_region(region, rel, owner)

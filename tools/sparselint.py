@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""sparselint — unified AST static-analysis suite (entry shim).
+
+The framework lives in ``tools/lint/`` (rule registry, inline
+``# lint: disable=<rule>`` suppressions, committed baseline,
+falsifiability fixtures); this file exists so the CLI is invocable the
+same way as the repo's other tools::
+
+    python tools/sparselint.py                 # full scan, exit 0/1
+    python tools/sparselint.py --changed       # only git-touched files
+    python tools/sparselint.py --json          # findings artifact
+    python tools/sparselint.py --list-rules    # rule catalog
+    python tools/sparselint.py --update-baseline
+
+Rule catalog, suppression syntax and the baseline workflow:
+``docs/LINT.md``.
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
