@@ -655,8 +655,12 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # ``dist2d_spgemm_comm_bytes`` / ``dist2d_spgemm_1d_comm_bytes``
 # (the 1-D fields are the equal-device-count baseline the 2-D layout
 # must beat) plus ``dist2d_layout`` / ``dist2d_grid`` /
-# ``dist2d_cg_iters`` and the timing field ``dist2d_spmv_ms``.
-SCHEMA_VERSION = 13
+# ``dist2d_cg_iters`` and the timing field ``dist2d_spmv_ms``.  14 =
+# obs-overhead probe (docs/OBSERVABILITY.md): the SpMV micro-loop
+# re-timed with spans on vs off — ``obs_overhead_pct`` records the
+# toggled tracing tax on the hot path (clamped at 0; the always-on
+# counters/histograms appear in both arms by design).
+SCHEMA_VERSION = 14
 
 
 def main() -> None:
@@ -869,6 +873,38 @@ def main() -> None:
                                 f"bench: roofline items failed: {e!r}\n")
             except Exception as e:
                 sys.stderr.write(f"bench: gflops cap failed: {e!r}\n")
+
+    # Phase: observability overhead (obs v4, schema 14).  The same
+    # SpMV micro-loop timed with spans on vs off — the explicit
+    # ``bench.obs_probe`` span per iteration is the toggled cost being
+    # measured (counters/histograms are always-on by design and appear
+    # in both arms).  Negative deltas are measurement noise, clamped:
+    # the field answers "how much does OBS=1 tax the hot path", not
+    # "which arm won the coin flip".
+    if A is not None and dt_ms is not None:
+        try:
+            from legate_sparse_tpu.bench_timing import loop_ms_per_iter
+
+            def _obs_probe_step(v):
+                with obs.span("bench.obs_probe"):
+                    return A @ v
+
+            was_on = obs.enabled()
+            try:
+                obs.enable()
+                ms_on = loop_ms_per_iter(_obs_probe_step, x,
+                                         k_lo=3, k_hi=15)
+                obs.disable()
+                ms_off = loop_ms_per_iter(_obs_probe_step, x,
+                                          k_lo=3, k_hi=15)
+            finally:
+                (obs.enable if was_on else obs.disable)()
+            if ms_off > 0:
+                result["obs_overhead_pct"] = round(
+                    max(0.0, (ms_on - ms_off) / ms_off * 100.0), 2)
+        except Exception as e:
+            sys.stderr.write(f"bench: obs overhead probe failed: "
+                             f"{e!r}\n")
 
     # Solver evidence in the same JSON line: CG ms/iter on the pde
     # operator (reference examples/pde.py headline).  Two maxiter

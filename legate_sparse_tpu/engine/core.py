@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import context as _context
 from ..resilience import faults as _rfaults
 from ..resilience import outcomes as _routcomes
 from ..resilience import policy as _rpolicy
@@ -235,8 +236,13 @@ class Engine:
         plan, _hit = self._cache.get_or_build(key, BUILDERS["spmv"])
         pack = self._pack_for(A, key)
         x_p = _pad_tail(x.astype(A.dtype), key.cols_b, 0)
-        y_p = plan(pack.data, pack.indices, pack.row_ids, pack.valid,
-                   x_p)
+        # Obs v4: a request-scoped dispatch (gateway/executor set the
+        # trace context) additionally annotates the jax.profiler
+        # timeline as engine.spmv[<trace-id>], joining obs flow arcs
+        # to XLA profile rows; one contextvar read when no context.
+        with _context.profiler_scope("engine.spmv"):
+            y_p = plan(pack.data, pack.indices, pack.row_ids,
+                       pack.valid, x_p)
         return y_p[: A.shape[0]]
 
     def matmat(self, A, X, _checked: bool = False):
@@ -271,8 +277,9 @@ class Engine:
             X_p = jnp.concatenate(
                 [X_p, jnp.zeros((X_p.shape[0], pad_k), dtype=X_p.dtype)],
                 axis=1)
-        Y_p = plan(pack.data, pack.indices, pack.row_ids, pack.valid,
-                   X_p)
+        with _context.profiler_scope("engine.spmm"):
+            Y_p = plan(pack.data, pack.indices, pack.row_ids,
+                       pack.valid, X_p)
         return Y_p[: A.shape[0], :k]
 
     def multi_matvec(self, pairs, _checked: bool = False):

@@ -82,6 +82,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs as _obs
+from ..obs import context as _context
 from ..obs import latency as _latency
 from ..obs import trace as _trace
 from ..resilience import deadline as _rdeadline
@@ -125,13 +126,18 @@ _REQUEST_IDS = itertools.count(1)
 
 class _Request:
     __slots__ = ("A", "x", "future", "rid", "t_ns", "t_popped",
-                 "deadline", "_finished")
+                 "deadline", "tctx", "_finished")
 
     def __init__(self, A, x):
         self.A = A
         self.x = x
         self.future: Future = Future()
         self.rid = next(_REQUEST_IDS)
+        # Causal identity (obs/context.py): joins an active caller
+        # trace (a gateway-routed submit) or mints a fresh one.  Rides
+        # the record because contextvars do not cross into the worker
+        # thread that dispatches this request.
+        self.tctx = _context.mint(rid=self.rid)
         self.t_ns = time.perf_counter_ns()
         # Stamped when the request is popped from the queue into a
         # dispatch group ("batched"); None when it never queued
@@ -174,6 +180,7 @@ class _Request:
         _trace.complete_span(
             "engine.request", self.t_ns, now - self.t_ns,
             rid=self.rid, outcome=outcome,
+            trace_id=self.tctx.trace_id,
             queue_ms=round(queue_ms, 4),
             batch_ms=round(batch_ms, 4),
             dispatch_ms=round(dispatch_ms, 4),
@@ -444,7 +451,8 @@ class RequestExecutor:
         if req.t_popped is None:
             req.t_popped = t0
         try:
-            y = req.A.dot(req.x)
+            with _context.use(req.tctx):
+                y = req.A.dot(req.x)
             req.finish(outcome, t_dispatch=t0)
             req.future.set_result(y)
         except BaseException as e:   # noqa: BLE001 - future contract
@@ -482,14 +490,24 @@ class RequestExecutor:
         _obs.inc("engine.exec.queue_ns", queue_ns)
         _latency.observe("lat.engine.batch_occupancy", k)
         try:
+            # The batch span names every member's trace id (obs v4):
+            # the Chrome-trace flow arcs join each request's
+            # engine.request span to the batch that served it.  A
+            # single-request batch additionally activates that
+            # request's context so downstream spans (spmv, dist
+            # collectives) auto-tag — a multi-request batch has no
+            # single identity to activate.
             with _obs.span("engine.batch", reqs=k, rows=A.shape[0],
-                           nnz=A.nnz) as sp:
+                           nnz=A.nnz,
+                           trace_ids=[r.tctx.trace_id for r in group]
+                           ) as sp:
                 # Eligibility was checked at submit (_checked=True):
                 # re-checking would rebuild structure caches per batch
                 # for nothing; mutation-in-flight is out of contract.
                 if k == 1:
-                    y = self._engine.matvec(A, group[0].x,
-                                            _checked=True)
+                    with _context.use(group[0].tctx):
+                        y = self._engine.matvec(A, group[0].x,
+                                                _checked=True)
                     group[0].finish("resolved", t_dispatch=t_disp,
                                     batch_k=1)
                     group[0].future.set_result(y)

@@ -76,6 +76,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from .. import obs as _obs
+from ..obs import context as _context
 from ..obs import latency as _latency
 from ..resilience import deadline as _rdeadline
 from ..resilience import faults as _rfaults
@@ -142,13 +143,19 @@ class _GwRequest:
     """One gateway request and its exactly-once lifecycle ledger."""
 
     __slots__ = ("A", "x", "future", "rid", "tenant", "qos", "rank",
-                 "vtag", "t_ns", "deadline", "shape_key", "_finished")
+                 "vtag", "t_ns", "deadline", "shape_key", "tctx",
+                 "_finished")
 
     def __init__(self, A, x, tenant: str, qos: str):
         self.A = A
         self.x = x
         self.future: Future = Future()
         self.rid = next(_REQUEST_IDS)
+        # Causal identity (obs/context.py): rides the record across
+        # the drain-worker thread boundary; the admit span, the batch
+        # span's member list, and downstream dispatch spans all carry
+        # this id, rendering one connected flow arc per request.
+        self.tctx = _context.mint(rid=self.rid)
         self.tenant = tenant
         self.qos = qos
         self.rank = _QOS_RANK[qos]
@@ -301,88 +308,99 @@ class Gateway:
                 fut.set_exception(e)
             return fut
         req = _GwRequest(A, x, tenant=str(tenant), qos=qos)
-        _obs.inc("gateway.submitted")
-        _obs.inc(f"gateway.tenant.{req.tenant}.submitted")
-        if _rsettings.resil:
-            # Admission fault site: error kind degrades to inline
-            # service (Future contract holds, queue stays consistent);
-            # latency kind sleeps HERE so admission delay counts
-            # against the request's own deadline.
-            try:
-                _rfaults.fault_point("gateway.admit")
-            except _rfaults.InjectedFault:
-                _obs.inc("gateway.admit_fault_inline")
-                self._serve_inline(req)
-                return req.future
-            if req.deadline is not None and req.deadline.expired():
-                req.shed("gateway.admit", "deadline_shed")
-                return req.future
-            if _rpolicy.breaker("gateway.dispatch").state == "open":
-                # Degraded mode: the dispatch path is tripped — shed
-                # deferrable classes instead of queueing onto a broken
-                # path; interactive traffic is served inline through
-                # the plain dispatch.
-                if req.rank > 0:
-                    req.shed("gateway.admit", "breaker")
-                    return req.future
-                _obs.inc("gateway.breaker_inline")
-                self._serve_inline(req)
-                return req.future
-        if not self._engine._eligible(A, x.dtype):
-            _obs.inc("gateway.inline")
-            self._serve_inline(req)
-            return req.future
-        key = self._engine._key("spmv", A.shape[0], A.shape[1], A.nnz,
-                                A.dtype)
-        req.shape_key = (key.rows_b, key.cols_b, key.nnz_b, key.dtype)
-        to_shed: List = []   # (request, site, reason), shed unlocked
+        # Obs v4: the whole admission decision runs under the
+        # request's trace context, bracketed by one ``gateway.admit``
+        # span — the first anchor of the request's flow arc (admit →
+        # batch → dispatch).  Batch dispatch stays OUTSIDE the
+        # context: a formed batch serves several requests and names
+        # its members via the batch span's ``trace_ids`` list instead.
         batch = None
-        with self._cv:
-            if self._shutdown:
-                raise RuntimeError("gateway is shut down")
-            ten = self._tenants.get(req.tenant)
-            if ten is None:
-                ten = self._tenants[req.tenant] = _Tenant(
-                    req.tenant, self.rate, self.burst)
-            if not ten.bucket.try_take():
-                to_shed.append((req, "gateway.admit", "quota"))
-            elif len(ten.queue) >= self.tenant_quota:
-                to_shed.append((req, "gateway.admit", "queue_full"))
-            else:
-                admitted = True
-                if self._pending >= self.queue_depth:
-                    victim = self._evict_pick_locked()
-                    # Evict only a candidate strictly weaker than the
-                    # incoming request; otherwise the incoming request
-                    # IS the weakest and is the one rejected.
-                    if (victim is not None
-                            and self._evict_key(victim)
-                            > self._evict_key(req)):
-                        self._remove_locked(victim)
-                        _obs.inc("gateway.evicted")
-                        to_shed.append(
-                            (victim, "gateway.admit", "queue_full"))
-                    else:
-                        admitted = False
-                        to_shed.append(
-                            (req, "gateway.admit", "queue_full"))
-                if admitted:
-                    _obs.inc("gateway.admitted")
-                    start = max(self._vtime, ten.vfinish)
-                    weight = QOS_WEIGHTS[req.qos]
-                    req.vtag = ten.vfinish = start + 1.0 / weight
-                    ten.queue.append(req)
-                    self._pending += 1
-                    urgent = req.slack_ms() <= self.slack_ms
-                    if urgent:
-                        batch = self._pop_batch_locked(seed=req)
-                    elif self._pending >= self.max_batch:
-                        batch = self._pop_batch_locked()
-                    elif self.timeout_ms > 0:
-                        self._ensure_worker_locked()
-                        self._cv.notify_all()
-        for victim, site, reason in to_shed:
-            victim.shed(site, reason)
+        with _context.use(req.tctx), \
+                _obs.span("gateway.admit", rid=req.rid,
+                          tenant=req.tenant, qos=req.qos):
+            _obs.inc("gateway.submitted")
+            _obs.inc(f"gateway.tenant.{req.tenant}.submitted")
+            if _rsettings.resil:
+                # Admission fault site: error kind degrades to inline
+                # service (Future contract holds, queue stays
+                # consistent); latency kind sleeps HERE so admission
+                # delay counts against the request's own deadline.
+                try:
+                    _rfaults.fault_point("gateway.admit")
+                except _rfaults.InjectedFault:
+                    _obs.inc("gateway.admit_fault_inline")
+                    self._serve_inline(req)
+                    return req.future
+                if req.deadline is not None and req.deadline.expired():
+                    req.shed("gateway.admit", "deadline_shed")
+                    return req.future
+                if _rpolicy.breaker("gateway.dispatch").state == "open":
+                    # Degraded mode: the dispatch path is tripped —
+                    # shed deferrable classes instead of queueing onto
+                    # a broken path; interactive traffic is served
+                    # inline through the plain dispatch.
+                    if req.rank > 0:
+                        req.shed("gateway.admit", "breaker")
+                        return req.future
+                    _obs.inc("gateway.breaker_inline")
+                    self._serve_inline(req)
+                    return req.future
+            if not self._engine._eligible(A, x.dtype):
+                _obs.inc("gateway.inline")
+                self._serve_inline(req)
+                return req.future
+            key = self._engine._key("spmv", A.shape[0], A.shape[1],
+                                    A.nnz, A.dtype)
+            req.shape_key = (key.rows_b, key.cols_b, key.nnz_b,
+                             key.dtype)
+            to_shed: List = []   # (request, site, reason), shed unlocked
+            with self._cv:
+                if self._shutdown:
+                    raise RuntimeError("gateway is shut down")
+                ten = self._tenants.get(req.tenant)
+                if ten is None:
+                    ten = self._tenants[req.tenant] = _Tenant(
+                        req.tenant, self.rate, self.burst)
+                if not ten.bucket.try_take():
+                    to_shed.append((req, "gateway.admit", "quota"))
+                elif len(ten.queue) >= self.tenant_quota:
+                    to_shed.append((req, "gateway.admit", "queue_full"))
+                else:
+                    admitted = True
+                    if self._pending >= self.queue_depth:
+                        victim = self._evict_pick_locked()
+                        # Evict only a candidate strictly weaker than
+                        # the incoming request; otherwise the incoming
+                        # request IS the weakest and is the one
+                        # rejected.
+                        if (victim is not None
+                                and self._evict_key(victim)
+                                > self._evict_key(req)):
+                            self._remove_locked(victim)
+                            _obs.inc("gateway.evicted")
+                            to_shed.append(
+                                (victim, "gateway.admit", "queue_full"))
+                        else:
+                            admitted = False
+                            to_shed.append(
+                                (req, "gateway.admit", "queue_full"))
+                    if admitted:
+                        _obs.inc("gateway.admitted")
+                        start = max(self._vtime, ten.vfinish)
+                        weight = QOS_WEIGHTS[req.qos]
+                        req.vtag = ten.vfinish = start + 1.0 / weight
+                        ten.queue.append(req)
+                        self._pending += 1
+                        urgent = req.slack_ms() <= self.slack_ms
+                        if urgent:
+                            batch = self._pop_batch_locked(seed=req)
+                        elif self._pending >= self.max_batch:
+                            batch = self._pop_batch_locked()
+                        elif self.timeout_ms > 0:
+                            self._ensure_worker_locked()
+                            self._cv.notify_all()
+            for victim, site, reason in to_shed:
+                victim.shed(site, reason)
         if batch:
             self._dispatch(batch)
         return req.future
@@ -534,7 +552,9 @@ class Gateway:
         (ineligible matrices, fault degradation, fallback) — errors
         resolve THIS request's future only, never a batchmate's."""
         try:
-            req.serve(req.A.dot(req.x))
+            with _context.use(req.tctx):
+                y = req.A.dot(req.x)
+            req.serve(y)
         except BaseException as e:   # noqa: BLE001 - future contract
             req.error(e)
 
@@ -575,7 +595,9 @@ class Gateway:
                     self._serve_inline(r)
                 return
         try:
-            with _obs.span("gateway.batch", reqs=k) as sp:
+            with _obs.span("gateway.batch", reqs=k,
+                           trace_ids=[r.tctx.trace_id for r in live]
+                           ) as sp:
                 self._dispatch_engine(live, sp)
         except Exception:
             # Engine-side failure: the gateway inherits the executor's
@@ -626,8 +648,12 @@ class Gateway:
             g = groups[token]
             A = g[0].A
             if len(g) == 1:
-                g[0].serve(self._engine.matvec(A, g[0].x,
-                                               _checked=True))
+                # Single-member group: activate its trace context so
+                # the downstream dispatch spans (spmv, dist
+                # collectives) auto-tag onto this request's flow arc.
+                with _context.use(g[0].tctx):
+                    y = self._engine.matvec(A, g[0].x, _checked=True)
+                g[0].serve(y)
             else:
                 X = jnp.stack(
                     [jnp.asarray(r.x).astype(A.dtype) for r in g],

@@ -3,7 +3,7 @@
 """legate_sparse_tpu.obs: observability — op-level tracing, counters,
 and structured perf evidence.
 
-Eight pieces (see each module's docstring for the design contract):
+Ten pieces (see each module's docstring for the design contract):
 
 - ``trace``    — near-zero-overhead spans (``with obs.span("spmv",
                  nnz=...)``) recording wall time + first-call vs
@@ -31,6 +31,14 @@ Eight pieces (see each module's docstring for the design contract):
                  device stats, optional tracemalloc peaks).
 - ``regress``  — the bench-trajectory regression gate behind
                  ``tools/bench_compare.py``.
+- ``context``  — causal trace ids minted at ``Gateway.submit`` /
+                 ``Executor.submit``, carried across worker threads on
+                 the request record, auto-tagged onto spans/events and
+                 exported as Chrome-trace flow arcs (obs v4).
+- ``slo``      — declarative per-(op, QoS) latency objectives with
+                 error budgets, evaluated as multi-window burn rates
+                 over the ``lat.*`` histograms; inert without
+                 ``LEGATE_SPARSE_TPU_OBS_SLO`` (obs v4).
 
 Enable tracing with ``LEGATE_SPARSE_TPU_OBS=1`` (read once at import,
 like the other settings) or programmatically::
@@ -46,7 +54,8 @@ null context manager; counters stay live either way.
 """
 
 from . import (  # noqa: F401
-    comm, counters, export, latency, memory, regress, report, trace,
+    comm, context, counters, export, latency, memory, regress, report,
+    slo, trace,
 )
 from .counters import inc, snapshot  # noqa: F401
 from .export import snapshot_openmetrics, write_openmetrics  # noqa: F401
@@ -57,8 +66,8 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
-    "comm", "counters", "export", "latency", "memory", "regress",
-    "report", "trace",
+    "comm", "context", "counters", "export", "latency", "memory",
+    "regress", "report", "slo", "trace",
     "inc", "snapshot", "observe",
     "snapshot_openmetrics", "write_openmetrics",
     "enable", "disable", "enabled", "event", "records", "reset", "span",
@@ -68,7 +77,10 @@ __all__ = [
 
 def reset_all() -> None:
     """Convenience: drop buffered trace records AND zero counters and
-    histograms (test isolation / between bench phases)."""
+    histograms (test isolation / between bench phases); SLO window
+    baselines reset with them (they are snapshots of the zeroed
+    histograms)."""
     trace.reset()
     counters.reset()
     latency.reset()
+    slo.reset()

@@ -1,0 +1,129 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Causal trace context: request-scoped trace ids (obs v4).
+
+The obs stack records *what* happened (spans, counters, histograms)
+but — pre-v4 — not *which request* each record belonged to: a slow
+``gateway.batch`` span could not be joined to the admit that queued it
+or the dist collectives it dispatched.  This module closes the loop
+with the Legion-profiler idea from the source paper (every task carries
+its provenance) mapped onto Python: a tiny immutable
+:class:`TraceContext` (trace id + request id), minted at
+``Gateway.submit`` / ``Executor.submit``, carried *across worker
+threads on the request record itself* (contextvars do not propagate
+into executor threads), and re-activated around each dispatch body via
+:func:`use`.
+
+While a context is active, ``obs.trace`` auto-tags every span/event
+closed on that thread with a ``trace_id`` attr, and the Chrome-trace
+exporter emits flow events (``ph: s/t/f``) binding the tagged slices
+into one connected arc per request — ``gateway.admit`` →
+``gateway.batch`` / ``engine.batch`` → the dist collectives — in
+Perfetto / chrome://tracing.
+
+Overhead contract: minting is one shared-counter ``next()`` plus one
+small object; activation is one contextvar set/reset.  Nothing here
+takes the trace lock, and with tracing disabled the auto-tag read
+never happens (span recording is already a no-op).
+
+``profiler_scope(op)`` additionally opens a ``jax.profiler``
+TraceAnnotation named ``<op>[<trace-id>]`` when a context is active —
+so a future on-TPU ``jax.profiler`` capture joins obs spans to XLA
+profile rows by trace id (the standing ``vs_baseline`` debt).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+from typing import Iterator, Optional
+
+__all__ = [
+    "TraceContext", "mint", "current", "current_trace_id", "use",
+    "profiler_scope", "reset_ids",
+]
+
+# Process-unique mint counter.  ``next()`` on an itertools.count is
+# atomic under the GIL — the same idiom as the executor's request ids.
+_IDS = itertools.count(1)
+
+_var: "contextvars.ContextVar[Optional[TraceContext]]" = \
+    contextvars.ContextVar("legate_sparse_tpu_trace_ctx", default=None)
+
+
+class TraceContext:
+    """Immutable causal identity for one request: ``trace_id`` (the
+    flow key, process-unique) and the originating request ``rid``
+    (when known).  Ride this on the request record to cross threads;
+    activate with :func:`use`."""
+
+    __slots__ = ("trace_id", "rid")
+
+    def __init__(self, trace_id: str, rid: Optional[int] = None):
+        object.__setattr__(self, "trace_id", trace_id)
+        object.__setattr__(self, "rid", rid)
+
+    def __setattr__(self, name, value):  # immutability by contract
+        raise AttributeError("TraceContext is immutable")
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id!r}, rid={self.rid!r})"
+
+
+def mint(rid: Optional[int] = None, kind: str = "req") -> TraceContext:
+    """New process-unique context.  If a context is already active on
+    this thread (e.g. an outer caller minted one), the active context
+    is returned instead — causality attaches to the outermost
+    request, and nested submits join its arc."""
+    cur = _var.get()
+    if cur is not None:
+        return cur
+    return TraceContext(f"{kind}-{next(_IDS):06d}", rid)
+
+
+def current() -> Optional[TraceContext]:
+    """The active context on this thread/task, or None."""
+    return _var.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """The active trace id, or None — the auto-tag fast path."""
+    ctx = _var.get()
+    return None if ctx is None else ctx.trace_id
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """Activate ``ctx`` for the body (tolerates None: no-op).  The
+    dispatch-side bracket: worker threads wrap each request's dispatch
+    body so downstream spans/events auto-tag with the request's id."""
+    if ctx is None:
+        yield None
+        return
+    token = _var.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _var.reset(token)
+
+
+def profiler_scope(op: str):
+    """A ``jax.profiler.TraceAnnotation`` named ``<op>[<trace-id>]``
+    when a context is active, else a null context.  Host-side only —
+    annotates profiler timelines, never the traced program."""
+    ctx = _var.get()
+    if ctx is None:
+        return contextlib.nullcontext()
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # pragma: no cover - profiler API unavailable
+        return contextlib.nullcontext()
+    return TraceAnnotation(f"{op}[{ctx.trace_id}]")
+
+
+def reset_ids() -> None:
+    """Restart the mint counter (test isolation only: concurrent
+    in-flight requests keep their already-minted ids)."""
+    global _IDS
+    _IDS = itertools.count(1)

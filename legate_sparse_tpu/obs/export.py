@@ -30,14 +30,27 @@ API::
 (best effort — a failed write must never mask the process's real
 exit), so any run can leave a scrapeable artifact behind without code
 changes; long-lived servers call ``write_openmetrics`` on their scrape
-path instead.
+path instead.  Containerized runs are *killed*, not exited: the same
+env additionally installs a chaining SIGTERM handler (obs v4) that
+flushes the snapshot, restores the prior disposition and re-raises, so
+the process still dies with the conventional 143 while the artifact
+survives.
+
+Obs v4 also hooks SLO evaluation onto the scrape path — every
+``snapshot_openmetrics()`` runs ``obs.slo.evaluate()`` first (a single
+flag read while ``LEGATE_SPARSE_TPU_OBS_SLO`` is unset), so armed
+processes publish fresh ``slo.*`` counters with every scrape — and
+provides :func:`parse_openmetrics`, the inverse used by the round-trip
+format test and ``tools/doctor.py``.
 """
 
 from __future__ import annotations
 
 import atexit
 import os
-from typing import Dict, Optional
+import re
+import signal
+from typing import Dict, Optional, Tuple
 
 from . import counters as _counters
 from . import latency as _latency
@@ -112,8 +125,75 @@ def render_openmetrics(
 
 def snapshot_openmetrics() -> str:
     """Live snapshot of all counters + histograms as OpenMetrics text
-    (the scrape-path API)."""
+    (the scrape-path API).  Runs SLO evaluation first (obs v4) so the
+    rendered text carries this scrape's ``slo.*`` verdict counters;
+    one inert flag read while ``LEGATE_SPARSE_TPU_OBS_SLO`` is unset."""
+    from . import slo as _slo
+
+    _slo.evaluate()
     return render_openmetrics()
+
+
+# Parsed sample lines of the two families rendered above.
+_COUNTER_LINE_RE = re.compile(
+    rf'^{_PREFIX}_counter_total\{{name="((?:[^"\\]|\\.)*)"\}} (\S+)$')
+_LATENCY_LINE_RE = re.compile(
+    rf'^{_PREFIX}_latency_(bucket|sum|count)'
+    rf'\{{name="((?:[^"\\]|\\.)*)"(?:,le="([^"]*)")?\}} (\S+)$')
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def _unescape_label(value: str) -> str:
+    # One left-to-right pass — sequential str.replace would corrupt
+    # ``\\n`` (escaped backslash + literal n) into a newline.
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), m.group(1)), value)
+
+
+def parse_openmetrics(text: str) -> Tuple[Dict, Dict]:
+    """Parse exposition text produced by :func:`render_openmetrics`
+    back into ``(counters, histograms)`` — counters as ``{name:
+    value}``, histograms as ``{name: {"buckets": [(le, cumulative),
+    ...], "sum": float, "count": int}}``.  The round-trip format test
+    and ``tools/doctor.py`` build on this; unparseable non-comment
+    lines raise (the format is pinned, not advisory)."""
+    counts: Dict[str, float] = {}
+    hists: Dict[str, Dict] = {}
+    saw_eof = False
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            saw_eof = line.strip() == "# EOF"
+            continue
+        m = _COUNTER_LINE_RE.match(line)
+        if m:
+            name = _unescape_label(m.group(1))
+            val = float(m.group(2))
+            counts[name] = int(val) if val.is_integer() else val
+            continue
+        m = _LATENCY_LINE_RE.match(line)
+        if m:
+            kind, raw_name, le, raw = (m.group(1), m.group(2),
+                                       m.group(3), m.group(4))
+            name = _unescape_label(raw_name)
+            h = hists.setdefault(
+                name, {"buckets": [], "sum": 0.0, "count": 0})
+            if kind == "bucket":
+                bound = float("inf") if le == "+Inf" else float(le)
+                h["buckets"].append((bound, int(raw)))
+            elif kind == "sum":
+                h["sum"] = float(raw)
+            else:
+                h["count"] = int(raw)
+            continue
+        raise ValueError(f"unparseable OpenMetrics line: {line!r}")
+    if not saw_eof:
+        raise ValueError("missing # EOF terminator")
+    return counts, hists
 
 
 def write_openmetrics(path: Optional[str] = None) -> str:
@@ -142,5 +222,34 @@ def _atexit_snapshot() -> None:  # pragma: no cover - exercised via env
         pass
 
 
+def _install_sigterm_flush() -> bool:  # pragma: no cover - subprocess
+    """Chain a SIGTERM handler that flushes the snapshot, then defers
+    to the prior disposition (default: restore it and re-kill, so the
+    process still exits 143 and supervisors see a normal TERM death).
+    Containerized runs are killed, not exited — atexit alone leaves no
+    artifact there."""
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+    except (ValueError, OSError):
+        return False            # no signal support here
+
+    def _on_sigterm(signum, frame):
+        _atexit_snapshot()
+        if callable(prev) and prev not in (signal.SIG_IGN,
+                                           signal.SIG_DFL):
+            prev(signum, frame)
+            return
+        signal.signal(signal.SIGTERM,
+                      prev if prev is not None else signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        return False            # e.g. imported off the main thread
+    return True
+
+
 if os.environ.get(ENV_PROM_FILE):
     atexit.register(_atexit_snapshot)
+    _install_sigterm_flush()

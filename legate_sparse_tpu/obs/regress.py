@@ -271,7 +271,8 @@ TRAJECTORY_FIELDS = [
     "dist2d_spmv_ms",
     "engine_warm_ms", "engine_batched_ms_per_req",
     "saturation_p99_ms", "irregular_spmv_ms", "irregular_spmv_speedup",
-    "irregular_spmv_path", "autotune_verdicts", "bench_wall_s",
+    "irregular_spmv_path", "autotune_verdicts", "obs_overhead_pct",
+    "bench_wall_s",
 ]
 
 

@@ -52,6 +52,12 @@ def load_records(path: str) -> List[Dict[str, Any]]:
                 rec["dur_ns"] = float(ev.get("dur", 0.0)) * 1e3
                 rec["seq"] = args.pop("seq", 0)
                 rec["first"] = bool(args.pop("first_call", rec["seq"] == 0))
+            elif ev.get("ph") in ("s", "t", "f"):
+                # Flow-arc anchors (obs v4 causal request flows): kept
+                # as their own record type so the per-op aggregation
+                # never mistakes them for instrumentation events.
+                rec["type"] = "flow"
+                rec["flow_id"] = ev.get("id")
             else:
                 rec["type"] = "event"
             if args:
@@ -70,6 +76,8 @@ def aggregate(records: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
     achieved bandwidth bytes/steady-time (None without bytes attrs)."""
     agg: Dict[str, Dict[str, Any]] = {}
     for r in records:
+        if r.get("type") == "flow":
+            continue            # arc anchors duplicate span timings
         name = r.get("name", "?")
         row = agg.setdefault(name, {
             "calls": 0, "events": 0, "total_ms": 0.0, "first_ms": None,
@@ -461,4 +469,100 @@ def render_gateway_table(counters: Dict[str, Any]) -> str:
             f"{int(counters.get('gateway.dispatch_fallback', 0))} "
             f"dispatch-fallback"
         )
+    return "\n".join(lines)
+
+
+def render_flows_table(records: Iterable[Dict[str, Any]]) -> str:
+    """Per-request causal-flow ledger (``tools/trace_summary.py
+    --flows``): one row per trace id found in span ``trace_id`` /
+    ``trace_ids`` attrs — span count, the span names bracketing the
+    arc, and the end-to-end wall time from first span start to last
+    span end.  Batch spans carry every member's id in ``trace_ids``,
+    so one grouped dispatch legitimately appears in several flows."""
+    flows: Dict[str, List[Dict[str, Any]]] = {}
+    for r in records:
+        if r.get("type") != "span":
+            continue
+        attrs = r.get("attrs") or {}
+        ids = []
+        tid = attrs.get("trace_id")
+        if isinstance(tid, str):
+            ids.append(tid)
+        tids = attrs.get("trace_ids")
+        if isinstance(tids, (list, tuple)):
+            ids.extend(t for t in tids if isinstance(t, str))
+        for t in ids:
+            flows.setdefault(t, []).append(r)
+    if not flows:
+        return ("no trace-tagged spans recorded "
+                "(tracing off, or no gateway/engine requests?)")
+    rows = []
+    for fid in sorted(flows):
+        spans = sorted(flows[fid], key=lambda s: s.get("ts_ns", 0.0))
+        t0 = spans[0].get("ts_ns", 0.0)
+        t1 = max(s.get("ts_ns", 0.0) + s.get("dur_ns", 0.0)
+                 for s in spans)
+        busy_ms = sum(s.get("dur_ns", 0.0) for s in spans) / 1e6
+        rows.append([
+            fid,
+            str(len(spans)),
+            spans[0].get("name", "?"),
+            spans[-1].get("name", "?"),
+            _fmt((t1 - t0) / 1e6),
+            _fmt(busy_ms),
+        ])
+    return format_table(
+        ["flow", "spans", "first", "last", "wall_ms", "busy_ms"],
+        rows, left_cols=4)
+
+
+def render_slo_table(counters: Dict[str, Any],
+                     records: Iterable[Dict[str, Any]] = ()) -> str:
+    """SLO burn ledger (``tools/trace_summary.py --slo``): one row per
+    SLO seen in ``slo.verdict`` events (latest verdict wins) or in the
+    ``slo.breach.*`` counter ledger, plus an evaluation-cadence summary
+    line.  Renders artifacts — no live registry access — so it works
+    on traces from other processes."""
+    latest: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("type") != "event" or r.get("name") != "slo.verdict":
+            continue
+        attrs = r.get("attrs") or {}
+        slo_name = attrs.get("slo")
+        if isinstance(slo_name, str):
+            latest[slo_name] = attrs
+    breaches = {name[len("slo.breach."):]: val
+                for name, val in counters.items()
+                if name.startswith("slo.breach.")}
+    names = sorted(set(latest) | set(breaches))
+    lines = []
+    if names:
+        rows = []
+        for n in names:
+            a = latest.get(n, {})
+            rows.append([
+                n,
+                str(a.get("status", "breach" if breaches.get(n)
+                          else "?")),
+                _fmt(a.get("objective_ms"), "{:.0f}"),
+                (f"{a.get('fast_bad')}/{a.get('fast_total')}"
+                 if a.get("fast_total") is not None else "-"),
+                _fmt(a.get("fast_burn"), "{:.1f}"),
+                _fmt(a.get("slow_burn"), "{:.1f}"),
+                str(int(breaches.get(n, 0))),
+            ])
+        lines.append(format_table(
+            ["slo", "status", "obj_ms", "fast_bad", "fast_burn",
+             "slow_burn", "breaches"], rows, left_cols=2))
+    else:
+        lines.append("no slo.* activity recorded "
+                     "(LEGATE_SPARSE_TPU_OBS_SLO unset, or no "
+                     "evaluations ran?)")
+    evals = counters.get("slo.evaluations", 0)
+    if evals:
+        lines.append(
+            f"evaluations: {int(evals)} "
+            f"({int(counters.get('slo.watchdog.ticks', 0))} from the "
+            f"watchdog), "
+            f"{int(sum(breaches.values()))} total breaches")
     return "\n".join(lines)

@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import context as _context
 from . import counters as _counters
 
 # Span attribute keys that auto-accumulate into the process-wide
@@ -143,6 +144,13 @@ class Span:
             st.pop()
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
+        # Causal auto-tag (obs/context.py): a span closed while a
+        # trace context is active belongs to that request's flow arc.
+        # Explicit trace ids (batch spans tagging their members) win.
+        if "trace_id" not in self.attrs and "trace_ids" not in self.attrs:
+            tid_ctx = _context.current_trace_id()
+            if tid_ctx is not None:
+                self.attrs["trace_id"] = tid_ctx
         with _lock:
             seq = _seq_by_name.get(self.name, 0)
             _seq_by_name[self.name] = seq + 1
@@ -210,6 +218,10 @@ def complete_span(name: str, start_ns: int, dur_ns: int,
     ``depth`` is 0 (cross-thread lifecycles have no nesting stack)."""
     if not _enabled:
         return
+    if "trace_id" not in attrs and "trace_ids" not in attrs:
+        tid_ctx = _context.current_trace_id()
+        if tid_ctx is not None:
+            attrs["trace_id"] = tid_ctx
     with _lock:
         seq = _seq_by_name.get(name, 0)
         _seq_by_name[name] = seq + 1
@@ -236,6 +248,10 @@ def event(name: str, **attrs: Any) -> None:
     accelerator-probe failure, a collective-realization decline."""
     if not _enabled:
         return
+    if "trace_id" not in attrs:
+        tid_ctx = _context.current_trace_id()
+        if tid_ctx is not None:
+            attrs = dict(attrs, trace_id=tid_ctx)
     with _lock:
         if len(_records) >= MAX_RECORDS:
             _counters.inc("obs.dropped_records")
@@ -292,6 +308,9 @@ def to_chrome_trace(extra_metadata: Optional[Dict[str, Any]] = None
     process metadata."""
     pid = os.getpid()
     trace_events: List[Dict[str, Any]] = []
+    # Flow anchors: spans tagged with a trace id (obs/context.py) —
+    # singly via ``trace_id`` or as a batch member list ``trace_ids``.
+    flow_anchors: Dict[str, List[Dict[str, Any]]] = {}
     for r in records():
         ev: Dict[str, Any] = {
             "name": r["name"],
@@ -305,12 +324,45 @@ def to_chrome_trace(extra_metadata: Optional[Dict[str, Any]] = None
             ev["dur"] = r["dur_ns"] / 1e3
             args["seq"] = r["seq"]
             args["first_call"] = r["first"]
+            ids = []
+            tid_one = args.get("trace_id")
+            if isinstance(tid_one, str):
+                ids.append(tid_one)
+            for t in (args.get("trace_ids") or ()):
+                if isinstance(t, str):
+                    ids.append(t)
+            for t in ids:
+                flow_anchors.setdefault(t, []).append(ev)
         else:
             ev["ph"] = "i"
             ev["s"] = "p"
         if args:
             ev["args"] = args
         trace_events.append(ev)
+    # One flow arc per trace id: Chrome flow events ("s" start / "t"
+    # step / "f" finish) bound to the tagged slices render the request
+    # as a connected arc (gateway.admit → engine.batch → dist
+    # collectives) in Perfetto.  The binding point is the slice
+    # enclosing (pid, tid, ts), so each flow record reuses its anchor
+    # span's coordinates.
+    for trace_id, anchors in sorted(flow_anchors.items()):
+        if len(anchors) < 2:
+            continue
+        anchors.sort(key=lambda ev: ev["ts"])
+        last = len(anchors) - 1
+        for i, anchor in enumerate(anchors):
+            flow: Dict[str, Any] = {
+                "name": "request",
+                "cat": "flow",
+                "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                "id": trace_id,
+                "pid": pid,
+                "tid": anchor["tid"],
+                "ts": anchor["ts"],
+            }
+            if i == last:
+                flow["bp"] = "e"
+            trace_events.append(flow)
     from . import latency as _latency
 
     meta: Dict[str, Any] = {

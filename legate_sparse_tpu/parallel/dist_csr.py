@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..obs import context as _tctx
 from ..obs import latency as _lat
 from ..engine import engine_enabled as _engine_enabled
 from ..engine import get_engine as _get_engine
@@ -1278,8 +1279,14 @@ def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
     )
     comm_bytes = _comm.record("dist_spmv", vols, layout=A.layout)
 
-    with _lat.timer("lat.dist_spmv."
-                    + _lat.shape_bucket(A.shape[0])), \
+    # Obs v4: a request-scoped dispatch (the trace context set by the
+    # gateway/executor) auto-tags this span with its trace id AND
+    # annotates the jax.profiler timeline (dist_spmv[<trace-id>]), so
+    # a future on-TPU profiler capture joins obs flow arcs to XLA
+    # rows.  Without a context both are no-ops.
+    with _tctx.profiler_scope("dist_spmv"), \
+            _lat.timer("lat.dist_spmv."
+                       + _lat.shape_bucket(A.shape[0])), \
             _obs.span("dist_spmv", shards=A.num_shards, halo=halo,
                       comm_bytes=comm_bytes,
                       comm_calls=sum(1 for b in vols.values() if b > 0)
@@ -1717,8 +1724,9 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
         cb = callback   # scalar iterates: nothing to truncate
     restart_eff = min(int(restart) if restart else 20,
                       int(b_sh.shape[0]))
-    with _obs.span("dist_gmres", n=rows, shards=A.num_shards,
-                   restart=restart_eff) as sp:
+    with _tctx.profiler_scope("dist_gmres"), \
+            _obs.span("dist_gmres", n=rows, shards=A.num_shards,
+                      restart=restart_eff) as sp:
         x, info = _gmres(
             _padded_operator(A), b_sh, x0=x0_sh, tol=tol,
             restart=restart, maxiter=maxiter, M=_padded_precond(M, A),
@@ -2006,7 +2014,8 @@ def dist_cg(
 
     item = jnp.dtype(b_sh.dtype).itemsize
     if callback is None:
-        with _lat.timer("lat.dist_cg.solve." + _lat.shape_bucket(rows)), \
+        with _tctx.profiler_scope("dist_cg"), \
+                _lat.timer("lat.dist_cg.solve." + _lat.shape_bucket(rows)), \
                 _obs.span("dist_cg", n=rows, shards=A.num_shards,
                           maxiter=int(maxiter),
                           preconditioned=M is not None) as sp, \

@@ -128,6 +128,17 @@ Settings
     - ``gateway_timeout_ms`` (``_TIMEOUT_MS``, 2.0): background drain
       cadence; ``<= 0`` = deterministic flush-only mode (tests).
 
+``obs_slo`` (``LEGATE_SPARSE_TPU_OBS_SLO``)
+    Declarative SLO burn-rate evaluation (``legate_sparse_tpu.obs.slo``,
+    ``docs/OBSERVABILITY.md``): per-(op, QoS) latency objectives with
+    error budgets, evaluated as multi-window burn rates over rebased
+    snapshots of the always-on ``lat.*`` histograms.  Off by default —
+    ``slo.evaluate()`` is then a single flag read returning ``[]``,
+    and no ``slo.*`` counter ever moves (inertness pinned by test).
+    ``obs_slo_watchdog_ms`` (``LEGATE_SPARSE_TPU_OBS_SLO_WATCHDOG_MS``,
+    0 = off) arms a daemon watchdog thread evaluating on a
+    monotonic-clock cadence.
+
 ``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
     Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
     ``docs/AUTOTUNER.md``): measured kernel selection for the
@@ -383,6 +394,13 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_GATEWAY_TIMEOUT_MS",
                            "2.0")
         )
+        # ---- SLO burn-rate evaluation (legate_sparse_tpu.obs.slo) ----
+        self.obs_slo: bool = _env_bool("LEGATE_SPARSE_TPU_OBS_SLO",
+                                       False)
+        self.obs_slo_watchdog_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_OBS_SLO_WATCHDOG_MS",
+                           "0")
+        )
         # ---- autotuner (legate_sparse_tpu.autotune) ----
         self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
                                         False)
@@ -431,6 +449,9 @@ class Settings:
         "gateway", "gateway_max_batch", "gateway_queue_depth",
         "gateway_tenant_quota", "gateway_rate", "gateway_burst",
         "gateway_slack_ms", "gateway_timeout_ms",
+        # SLO evaluation only *reads* the always-on latency
+        # histograms — pure telemetry, like ``obs``.
+        "obs_slo", "obs_slo_watchdog_ms",
         # Autotune knobs pick *which already-compiled kernel* serves a
         # dispatch (routing) or shape the measurement budget — never
         # what any kernel lowers to.  Verdict keys carry the epoch

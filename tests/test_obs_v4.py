@@ -1,0 +1,639 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Obs v4 drills: causal request flows, the SLO burn-rate evaluator,
+the OpenMetrics round-trip + SIGTERM flush, and the performance
+doctor (docs/OBSERVABILITY.md).
+
+The load-bearing contracts, each pinned here:
+
+- **causal flows**: one gateway request under OBS=1 yields a
+  Chrome-trace flow arc (``ph s/t/f``, shared ``id``) connecting
+  ``gateway.admit`` through ``gateway.batch`` to the dispatch — a
+  single trace id across every hop;
+- **SLO burn**: a latency-fault drill drives the evaluator to a
+  deterministic breach verdict and the ``slo.breach.<slo>`` counter is
+  EXACT (one evaluation, one increment); with
+  ``LEGATE_SPARSE_TPU_OBS_SLO`` unset the evaluator is bit-for-bit
+  inert — no verdicts, zero ``slo.*`` counter movement;
+- **format pins**: ``parse_openmetrics`` round-trips
+  ``render_openmetrics`` exactly (names, escaping, bucket counts), the
+  scrape stays parseable and monotone under concurrent writers, and a
+  SIGTERM'd process still leaves a parseable snapshot behind;
+- **doctor**: the committed golden smoke artifact diagnoses to a
+  deterministic finding set, and ``--check`` exit codes are a usable
+  CI verdict.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+from legate_sparse_tpu import obs, resilience
+from legate_sparse_tpu.engine import Engine, Gateway
+from legate_sparse_tpu.obs import (
+    context, counters, export, latency, report, slo, trace,
+)
+from legate_sparse_tpu.resilience import faults as rfaults
+from legate_sparse_tpu.settings import settings
+
+from utils_test.tools import load_tool as _tool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
+
+_ENG = Engine()
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    context.reset_ids()
+    yield
+    obs.reset_all()
+    context.reset_ids()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+@pytest.fixture
+def gw_on():
+    saved = settings.gateway
+    settings.gateway = True
+    yield settings
+    settings.gateway = saved
+
+
+@pytest.fixture
+def slo_on():
+    saved = (settings.obs_slo, settings.obs_slo_watchdog_ms)
+    settings.obs_slo = True
+    settings.obs_slo_watchdog_ms = 0.0
+    yield settings
+    settings.obs_slo, settings.obs_slo_watchdog_ms = saved
+
+
+def _random_csr(n=400, density=0.03, seed=0):
+    S = sp.random(n, n, density=density, format="csr",
+                  random_state=np.random.default_rng(seed),
+                  dtype=np.float32)
+    return lst.csr_array(S)
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+
+def _gateway(**kw):
+    base = dict(max_batch=64, queue_depth=128, tenant_quota=64,
+                rate=0.0, burst=16.0, slack_ms=1.0, timeout_ms=0.0)
+    base.update(kw)
+    return Gateway(_ENG, **base)
+
+
+# ------------------------------------------------------ trace context --
+def test_mint_is_unique_and_joins_active_context():
+    a = context.mint(rid=1)
+    b = context.mint(rid=2)
+    assert a.trace_id != b.trace_id
+    with context.use(a):
+        # A nested mint JOINS the active flow instead of forking it —
+        # the executor request minted under a gateway context must
+        # carry the gateway's id.
+        assert context.mint(rid=3) is a
+        assert context.current_trace_id() == a.trace_id
+    assert context.current() is None
+
+
+def test_trace_context_immutable_and_use_none_noop():
+    c = context.mint()
+    with pytest.raises(AttributeError):
+        c.trace_id = "forged"
+    with context.use(None):
+        assert context.current() is None
+
+
+def test_profiler_scope_nullcontext_without_active_context():
+    import contextlib
+    assert isinstance(context.profiler_scope("op"),
+                      contextlib.nullcontext)
+
+
+def test_spans_and_events_auto_tag_active_trace_id():
+    obs.enable()
+    c = context.mint()
+    with context.use(c):
+        with obs.span("tagme"):
+            pass
+        obs.event("tagme.event")
+    with obs.span("untagged"):
+        pass
+    recs = {r["name"]: r for r in obs.records()}
+    assert recs["tagme"]["attrs"]["trace_id"] == c.trace_id
+    assert recs["tagme.event"]["attrs"]["trace_id"] == c.trace_id
+    assert "trace_id" not in (recs["untagged"].get("attrs") or {})
+
+
+def test_explicit_trace_ids_attr_wins_over_context():
+    obs.enable()
+    with context.use(context.mint()):
+        with obs.span("batchlike", trace_ids=["a", "b"]):
+            pass
+    (rec,) = [r for r in obs.records() if r["name"] == "batchlike"]
+    assert rec["attrs"]["trace_ids"] == ["a", "b"]
+    assert "trace_id" not in rec["attrs"]
+
+
+# -------------------------------------------------------- causal flows --
+def test_causal_flow_arc_end_to_end(gw_on):
+    """One gateway request under OBS=1 renders as a connected flow
+    arc: ``ph "s"`` then ``"f"`` records sharing one id, and the
+    ``gateway.admit`` / ``gateway.batch`` spans both carry that id."""
+    obs.enable()
+    gw = _gateway()
+    A, x = _random_csr(), _x(400)
+    fut = gw.submit(A, x, tenant="t0", qos="interactive")
+    gw.flush()
+    y = fut.result()
+    np.testing.assert_allclose(np.asarray(y), np.asarray(A.dot(x)),
+                               rtol=1e-5)
+
+    doc = obs.to_chrome_trace()
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    assert flows, "no flow records exported"
+    ids = {e["id"] for e in flows}
+    assert len(ids) == 1
+    (tid,) = ids
+    phases = [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])]
+    assert phases[0] == "s" and phases[-1] == "f"
+    assert all(p == "t" for p in phases[1:-1])
+    assert flows[-1].get("bp") == "e"  # bind to enclosing slice
+
+    spans = {e["name"]: e for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["gateway.admit"]["args"]["trace_id"] == tid
+    assert tid in spans["gateway.batch"]["args"]["trace_ids"]
+
+
+def test_flow_requires_two_anchors():
+    """A trace id seen in only one span must NOT produce a dangling
+    one-record arc."""
+    obs.enable()
+    with context.use(context.mint()):
+        with obs.span("solo"):
+            pass
+    doc = obs.to_chrome_trace()
+    assert not [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+
+
+def test_distinct_requests_get_distinct_arcs(gw_on):
+    obs.enable()
+    gw = _gateway()
+    A = _random_csr()
+    futs = [gw.submit(A, _x(400, seed=s), tenant=f"t{s}",
+                      qos="interactive") for s in range(2)]
+    gw.flush()
+    for f in futs:
+        f.result()
+    doc = obs.to_chrome_trace()
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+    assert len({e["id"] for e in flows}) == 2
+
+
+# ------------------------------------------------------------ SLO burn --
+def test_slo_breach_exact_counter(slo_on):
+    """Deterministic drill: every observation above the objective →
+    fast burn far past the page threshold → exactly one breach
+    increment per evaluation that saw fresh bad events."""
+    slo.register(slo.Slo(
+        "drill", "drill.op", None, "lat.drill.", objective_ms=1.0,
+        target=0.99))
+    for _ in range(8):
+        latency.observe("lat.drill.op", 50.0)
+    verdicts = {v.slo: v for v in slo.evaluate()}
+    v = verdicts["drill"]
+    assert v.status == "breach"
+    assert v.fast_bad == v.fast_total == 8
+    assert v.fast_burn == pytest.approx((8 / 8) / 0.01)
+    assert counters.get("slo.breach.drill") == 1
+
+    # No fresh observations: the fast window is empty, no new breach,
+    # the counter stays EXACT (slow window keeps it at watch).
+    verdicts = {v.slo: v for v in slo.evaluate()}
+    assert verdicts["drill"].status == "watch"
+    assert verdicts["drill"].fast_total == 0
+    assert counters.get("slo.breach.drill") == 1
+    assert counters.get("slo.evaluations") == 2
+
+
+def test_slo_ok_below_objective(slo_on):
+    slo.register(slo.Slo(
+        "calm", "calm.op", None, "lat.calm.", objective_ms=1000.0))
+    for _ in range(10):
+        latency.observe("lat.calm.op", 0.5)
+    (v,) = [v for v in slo.evaluate() if v.slo == "calm"]
+    assert v.status == "ok" and v.fast_bad == 0
+    assert counters.get("slo.breach.calm") == 0
+
+
+def test_slo_latency_fault_drill_breaches_gateway_objective(gw_on,
+                                                            slo_on):
+    """The resilience latency injector drives real ``lat.gateway.
+    request.interactive`` observations past a tightened objective —
+    the full pipeline (fault → histogram → burn → verdict → counter),
+    not a hand-fed histogram."""
+    saved_resil = settings.resil
+    settings.resil = True
+    resilience.reset()
+    try:
+        slo.register(slo.Slo(
+            "gateway.interactive", "gateway.request", "interactive",
+            "lat.gateway.request.interactive", objective_ms=1e-3,
+            target=0.99))
+        rfaults.inject("gateway.admit", kind="latency", count=3,
+                       latency_ms=5.0)
+        gw = _gateway()
+        A = _random_csr()
+        futs = [gw.submit(A, _x(400, seed=s), tenant="t0",
+                          qos="interactive") for s in range(3)]
+        gw.flush()
+        for f in futs:
+            f.result()
+        verdicts = {v.slo: v for v in slo.evaluate()}
+        v = verdicts["gateway.interactive"]
+        assert v.status == "breach"
+        assert v.fast_bad == v.fast_total >= 3
+        assert counters.get("slo.breach.gateway.interactive") == 1
+    finally:
+        settings.resil = saved_resil
+        resilience.reset()
+
+
+def test_slo_inert_by_default(gw_on):
+    """LEGATE_SPARSE_TPU_OBS_SLO unset: the evaluator returns [] and
+    no ``slo.*`` counter exists, while the gateway result stays
+    bit-for-bit the plain dot — v4 costs nothing when off."""
+    assert settings.obs_slo is False
+    for _ in range(5):
+        latency.observe("lat.gateway.request.interactive", 1e6)
+    assert slo.evaluate() == []
+    assert slo.verdicts() == []
+    assert slo.start_watchdog(10.0) is False
+    gw = _gateway()
+    A, x = _random_csr(), _x(400)
+    fut = gw.submit(A, x, tenant="t", qos="interactive")
+    gw.flush()
+    y_off = np.asarray(fut.result())
+    snap = counters.snapshot()
+    assert not [k for k in snap if k.startswith("slo.")]
+    # The scrape path calls evaluate() unconditionally — still inert.
+    text = export.snapshot_openmetrics()
+    assert "slo." not in text
+    # Arming the evaluator changes nothing numerically: the identical
+    # submit under OBS_SLO=1 (with a scrape-triggered evaluation in
+    # between) is bit-for-bit the unarmed result.
+    settings.obs_slo = True
+    try:
+        export.snapshot_openmetrics()
+        fut = gw.submit(A, x, tenant="t", qos="interactive")
+        gw.flush()
+        y_on = np.asarray(fut.result())
+    finally:
+        settings.obs_slo = False
+    assert np.array_equal(y_off, y_on)
+
+
+def test_slo_watchdog_ticks_and_stops(slo_on):
+    slo.register(slo.Slo(
+        "wd", "wd.op", None, "lat.wd.", objective_ms=1000.0))
+    latency.observe("lat.wd.op", 0.1)
+    assert slo.start_watchdog(5.0) is True
+    assert slo.start_watchdog(5.0) is True  # idempotent while alive
+    deadline = time.monotonic() + 5.0
+    while (counters.get("slo.watchdog.ticks") < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    slo.stop_watchdog()
+    ticks = counters.get("slo.watchdog.ticks")
+    assert ticks >= 2
+    assert counters.get("slo.evaluations") >= ticks
+    time.sleep(0.05)
+    assert counters.get("slo.watchdog.ticks") == ticks  # really dead
+
+
+def test_slo_register_replaces_and_resets():
+    tightened = slo.Slo("gateway.interactive", "gateway.request",
+                        "interactive",
+                        "lat.gateway.request.interactive",
+                        objective_ms=1.0)
+    slo.register(tightened)
+    byname = {s.name: s for s in slo.registered()}
+    assert byname["gateway.interactive"].objective_ms == 1.0
+    slo.reset()
+    byname = {s.name: s for s in slo.registered()}
+    assert byname["gateway.interactive"].objective_ms == 50.0
+
+
+# ------------------------------------------------- OpenMetrics format --
+def test_openmetrics_round_trip_exact():
+    counters.inc("rt.plain", 3)
+    counters.inc('rt.wei"rd\\name', 2)
+    for ms in (0.5, 1.5, 200.0):
+        latency.observe("lat.rt.op", ms)
+    text = export.render_openmetrics()
+    parsed_counters, parsed_hists = export.parse_openmetrics(text)
+    snap = counters.snapshot()
+    for name, val in snap.items():
+        assert parsed_counters[name] == val
+    h = parsed_hists["lat.rt.op"]
+    assert h["count"] == 3
+    assert h["sum"] == pytest.approx(202.0)
+    # Cumulative bucket counts ascend and end at +Inf == count.
+    bounds = [b for b, _ in h["buckets"]]
+    cums = [c for _, c in h["buckets"]]
+    assert bounds == sorted(bounds) and bounds[-1] == float("inf")
+    assert cums == sorted(cums) and cums[-1] == 3
+
+
+def test_parse_openmetrics_rejects_garbage_and_missing_eof():
+    with pytest.raises(ValueError, match="unparseable"):
+        export.parse_openmetrics("not a metric line\n# EOF\n")
+    with pytest.raises(ValueError, match="EOF"):
+        export.parse_openmetrics(
+            'legate_sparse_tpu_counter_total{name="x"} 1\n')
+
+
+def test_openmetrics_type_help_lines_pinned():
+    text = export.render_openmetrics()
+    lines = text.splitlines()
+    assert "# TYPE legate_sparse_tpu_counter counter" in lines
+    assert "# TYPE legate_sparse_tpu_latency histogram" in lines
+    assert any(ln.startswith("# HELP legate_sparse_tpu_counter ")
+               for ln in lines)
+    assert any(ln.startswith("# HELP legate_sparse_tpu_latency ")
+               for ln in lines)
+    assert lines[-1] == "# EOF"
+
+
+def test_concurrent_scrape_always_parses_and_is_monotone():
+    """Writers hammer counters + histograms while the main thread
+    scrapes repeatedly: every scrape parses, and every counter /
+    histogram total is nondecreasing across consecutive scrapes."""
+    N, M = 4, 800
+    stop = threading.Event()
+
+    def writer(k):
+        i = 0
+        while not stop.is_set() and i < M:
+            counters.inc("scr.events")
+            counters.inc(f"scr.w{k}")
+            latency.observe("lat.scr.op", 0.25 * (1 + (i % 7)))
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(N)]
+    for t in threads:
+        t.start()
+    try:
+        prev_counters, prev_count = {}, 0
+        for _ in range(25):
+            parsed_c, parsed_h = export.parse_openmetrics(
+                export.snapshot_openmetrics())
+            for name, val in prev_counters.items():
+                assert parsed_c.get(name, 0) >= val, name
+            prev_counters = {k: v for k, v in parsed_c.items()
+                             if k.startswith("scr.")}
+            cnt = parsed_h.get("lat.scr.op", {}).get("count", 0)
+            assert cnt >= prev_count
+            prev_count = cnt
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    parsed_c, parsed_h = export.parse_openmetrics(
+        export.snapshot_openmetrics())
+    assert parsed_c["scr.events"] == N * M
+    assert parsed_h["lat.scr.op"]["count"] == N * M
+
+
+def test_sigterm_flushes_openmetrics_snapshot(tmp_path):
+    """Containerized runs die by SIGTERM, not sys.exit: the chained
+    handler must flush the snapshot AND still die by the signal."""
+    prom = tmp_path / "term.prom"
+    child = (
+        "import os, signal\n"
+        "from legate_sparse_tpu.obs import counters\n"
+        "counters.inc('sig.test', 7)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "raise SystemExit('survived SIGTERM')\n"
+    )
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               LEGATE_SPARSE_TPU_OBS_PROM=str(prom))
+    r = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == -signal.SIGTERM, (r.returncode, r.stderr)
+    assert prom.exists(), "SIGTERM left no snapshot behind"
+    parsed_c, _ = export.parse_openmetrics(prom.read_text())
+    assert parsed_c["sig.test"] == 7
+
+
+# ----------------------------------------------------- flow/slo tables --
+def test_load_records_maps_flow_phases():
+    obs.enable()
+    c = context.mint()
+    with context.use(c):
+        with obs.span("a"):
+            pass
+        with obs.span("b"):
+            pass
+    doc = obs.to_chrome_trace()
+    path = "/tmp/does-not-matter"
+    # Exercise load_records via its parsing body, not the file system.
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(doc, f)
+        path = f.name
+    try:
+        recs = report.load_records(path)
+    finally:
+        os.unlink(path)
+    kinds = {r["type"] for r in recs}
+    assert "flow" in kinds and "span" in kinds
+    flows = [r for r in recs if r["type"] == "flow"]
+    assert all(r["flow_id"] == c.trace_id for r in flows)
+    # Flow anchors must not leak into the per-op aggregation.
+    agg = report.aggregate(recs)
+    assert "request" not in agg
+    assert agg["a"]["calls"] == 1
+
+
+def test_render_flows_table_groups_by_trace_id():
+    records = [
+        {"type": "span", "name": "gateway.admit", "ts_ns": 0,
+         "dur_ns": 2e6, "attrs": {"trace_id": "req-1"}},
+        {"type": "span", "name": "gateway.batch", "ts_ns": 3e6,
+         "dur_ns": 4e6, "attrs": {"trace_ids": ["req-1", "req-2"]}},
+        {"type": "span", "name": "gateway.admit", "ts_ns": 1e6,
+         "dur_ns": 1e6, "attrs": {"trace_id": "req-2"}},
+    ]
+    out = report.render_flows_table(records)
+    lines = out.splitlines()
+    assert lines[0].split()[:4] == ["flow", "spans", "first", "last"]
+    row1 = next(ln for ln in lines if ln.startswith("req-1"))
+    assert row1.split()[1] == "2"
+    assert "gateway.admit" in row1 and "gateway.batch" in row1
+    # req-1: wall = (3ms + 4ms) - 0 = 7ms
+    assert "7.000" in row1
+    assert report.render_flows_table([]).startswith(
+        "no trace-tagged spans")
+
+
+def test_render_slo_table_from_events_and_counters():
+    records = [
+        {"type": "event", "name": "slo.verdict",
+         "attrs": {"slo": "gateway.interactive", "status": "breach",
+                   "objective_ms": 50.0, "fast_bad": 6,
+                   "fast_total": 6, "fast_burn": 1000.0,
+                   "slow_burn": 900.0}},
+    ]
+    table = report.render_slo_table(
+        {"slo.breach.gateway.interactive": 2, "slo.evaluations": 4},
+        records)
+    assert "gateway.interactive" in table
+    assert "breach" in table
+    assert "evaluations: 4" in table
+    empty = report.render_slo_table({}, [])
+    assert empty.startswith("no slo.* activity")
+
+
+def test_trace_summary_flows_and_slo_flags(gw_on, slo_on, tmp_path,
+                                           capsys):
+    obs.enable()
+    slo.register(slo.Slo(
+        "gateway.interactive", "gateway.request", "interactive",
+        "lat.gateway.request.interactive", objective_ms=1e-6))
+    gw = _gateway()
+    A, x = _random_csr(), _x(400)
+    fut = gw.submit(A, x, tenant="t0", qos="interactive")
+    gw.flush()
+    fut.result()
+    slo.evaluate()
+    path = str(tmp_path / "run.trace.json")
+    obs.write_chrome_trace(path)
+    ts = _tool("trace_summary")
+    rc = ts.main([path, "--flows", "--slo"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "causal flows:" in out and "req-" in out
+    assert "slo ledger:" in out and "gateway.interactive" in out
+
+
+def test_obs_overhead_rides_trajectory_ungated():
+    """``obs_overhead_pct`` is an informational trajectory column
+    (bench schema 14), never a regression gate — a noisy micro-probe
+    must not fail CI."""
+    from legate_sparse_tpu.obs import regress
+    assert "obs_overhead_pct" in regress.TRAJECTORY_FIELDS
+    assert regress._gated("obs_overhead_pct", 11.0) is None
+
+
+# -------------------------------------------------------------- doctor --
+def test_doctor_golden_smoke_findings_deterministic(capsys):
+    """The committed golden artifact must diagnose to a stable finding
+    set — this is the tier-1 CI hook the ISSUE asks for."""
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    assert doctor.load_artifact(GOLDEN, ev) == "bench"
+    findings = doctor.diagnose(ev)
+    codes = [f["code"] for f in findings]
+    assert codes == ["breaker-trips", "gateway-rejections"]
+    assert all(f["severity"] == "warn" for f in findings)
+    # CI verdict: warns alone don't fail the default --check.
+    assert doctor.main(["--check", GOLDEN]) == 0
+    assert doctor.main(["--check", "--fail-on", "warn", GOLDEN]) == 1
+    capsys.readouterr()
+
+
+def test_doctor_flags_slo_breach_as_critical(tmp_path, capsys):
+    counters.inc("slo.breach.gateway.interactive", 3)
+    prom = tmp_path / "m.prom"
+    export.write_openmetrics(str(prom))
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    assert doctor.load_artifact(str(prom), ev) == "openmetrics"
+    findings = doctor.diagnose(ev)
+    assert findings[0]["code"] == "slo-breach"
+    assert findings[0]["severity"] == "critical"
+    assert doctor.main(["--check", str(prom)]) == 1
+    capsys.readouterr()
+
+
+def test_doctor_reads_trace_artifacts_and_ranks(tmp_path, capsys):
+    obs.enable()
+    counters.inc("resil.breaker.trips", 2)
+    counters.inc("slo.breach.engine.request", 1)
+    with obs.span("op.spmv"):
+        pass
+    path = str(tmp_path / "t.trace.json")
+    obs.write_chrome_trace(path)
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    assert doctor.load_artifact(path, ev) == "trace"
+    findings = doctor.diagnose(ev)
+    codes = [f["code"] for f in findings]
+    # Ranked: critical first.
+    assert codes[0] == "slo-breach" and "breaker-trips" in codes
+    capsys.readouterr()
+
+
+def test_doctor_healthy_artifact_no_findings(tmp_path, capsys):
+    bench = {"schema_version": 14, "metric": "x", "value": 1.0,
+             "engine_plan_hits": 9, "engine_plan_misses": 1}
+    p = tmp_path / "ok.json"
+    p.write_text(json.dumps(bench))
+    doctor = _tool("doctor")
+    assert doctor.main(["--check", "--fail-on", "info", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "no findings" in out
+
+
+def test_doctor_unreadable_artifacts_exit_2(tmp_path, capsys):
+    p = tmp_path / "junk.bin"
+    p.write_text("not json, not openmetrics")
+    doctor = _tool("doctor")
+    assert doctor.main([str(p)]) == 2
+    capsys.readouterr()
+
+
+def test_doctor_obs_overhead_and_roofline_rules():
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.bench.update({
+        "obs_overhead_pct": 12.5,
+        "cpu_roofline_ratio": 0.4,
+        "cpu_roofline_items": {"mask_ms": 0.5, "pad_ms": 1.5},
+    })
+    codes = {f["code"]: f for f in doctor.diagnose(ev)}
+    assert "obs-overhead" in codes
+    roof = codes["roofline-shortfall"]
+    # Loss terms ranked largest-first in the message.
+    assert roof["message"].index("pad_ms") < roof["message"].index(
+        "mask_ms")

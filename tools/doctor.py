@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Automated performance doctor: offline diagnosis over obs artifacts.
+
+``trace_summary.py`` renders ledgers; the doctor *reads* them.  Point
+it at any mix of the three artifact kinds the package emits —
+
+- Chrome-trace JSON (``bench.py`` / ``obs.write_chrome_trace``; the
+  ``otherData`` blob carries counters, histograms and the bench
+  result),
+- OpenMetrics text (``LEGATE_SPARSE_TPU_OBS_PROM`` snapshots,
+  ``obs.export.write_openmetrics``),
+- bench result JSON (``bench.py`` output, driver wrappers, log tails)
+
+— and it cross-references them into a ranked findings table: breaker
+trips, plan-cache thrash, batch occupancy collapse, comm-bytes
+actual-vs-predicted drift, CPU roofline shortfall (with the measured
+loss terms ranked), gateway rejection pressure, SLO budget burns, and
+observability overhead.  Every finding carries a remediation hint —
+the docs section or knob to reach for next.
+
+Artifact kind is auto-detected from content, never from the filename.
+
+Usage::
+
+    python tools/doctor.py BENCH_x.json run.trace.json metrics.prom
+    python tools/doctor.py --check evidence/BENCH_golden_smoke.json
+    python tools/doctor.py --check --fail-on warn artifacts/*.json
+
+``--check`` makes the exit status a CI verdict: 1 when any finding at
+or above ``--fail-on`` severity (default ``critical``) is present,
+0 otherwise, 2 when no artifact could be read.  Without ``--check``
+the exit status is always 0 (report, don't judge).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from legate_sparse_tpu.obs import export, regress, report  # noqa: E402
+
+SEVERITIES = ("info", "warn", "critical")
+
+# Thresholds (module constants so tests can reference them).
+PLAN_HIT_RATE_FLOOR = 0.5
+BATCH_OCCUPANCY_FLOOR = 2.0
+COMM_DELTA_TOL = 0.01
+ROOFLINE_FLOOR = 0.7
+GATEWAY_REJECT_CEIL = 0.10
+OBS_OVERHEAD_CEIL_PCT = 5.0
+
+
+def _severity_rank(sev: str) -> int:
+    return SEVERITIES.index(sev)
+
+
+class Evidence:
+    """Merged view over every artifact read: counters (summed across
+    artifacts — each is a monotone ledger of its own process), the
+    latest histograms, the latest bench result, and all trace
+    records."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, float] = {}
+        self.histograms: Dict[str, Any] = {}
+        self.bench: Dict[str, Any] = {}
+        self.records: List[Dict[str, Any]] = []
+        self.sources: List[str] = []
+
+    def add_counters(self, counters: Dict[str, Any]) -> None:
+        for name, val in counters.items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self.counters[name] = self.counters.get(name, 0) + val
+
+    def counter(self, name: str, default: float = 0.0) -> float:
+        return self.counters.get(name, default)
+
+    def field(self, name: str, default=None):
+        """Bench-result field lookup."""
+        val = self.bench.get(name, default)
+        return default if val is None else val
+
+
+def load_artifact(path: str, ev: Evidence) -> str:
+    """Read one artifact into the evidence, returning the detected
+    kind (``openmetrics`` / ``trace`` / ``bench``).  Raises ValueError
+    when the content matches none of them."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.strip()
+    if stripped.startswith(f"# TYPE {export._PREFIX}") or \
+            f"{export._PREFIX}_counter_total" in stripped.split("\n", 3)[0]:
+        counters, hists = export.parse_openmetrics(text)
+        ev.add_counters(counters)
+        ev.histograms.update(hists)
+        ev.sources.append(f"{path} (openmetrics)")
+        return "openmetrics"
+    try:
+        doc = json.loads(stripped)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        ev.records.extend(report.load_records(path))
+        meta = doc.get("otherData") or {}
+        ev.add_counters(meta.get("counters") or {})
+        ev.histograms.update(meta.get("histograms") or {})
+        bench = meta.get("bench_result")
+        if isinstance(bench, dict):
+            ev.bench.update(bench)
+        ev.sources.append(f"{path} (trace)")
+        return "trace"
+    bench = regress.load_bench(path)      # raises ValueError if not one
+    ev.bench.update(bench)
+    ev.sources.append(f"{path} (bench)")
+    return "bench"
+
+
+def _finding(sev: str, code: str, message: str, hint: str,
+             value: Optional[str] = None) -> Dict[str, str]:
+    return {"severity": sev, "code": code, "message": message,
+            "hint": hint, "value": value or "-"}
+
+
+def diagnose(ev: Evidence) -> List[Dict[str, str]]:
+    """Run every rule over the merged evidence; findings ranked
+    critical-first, stable within severity (rule order)."""
+    out: List[Dict[str, str]] = []
+
+    # -- SLO budget burns: the one signal that is a page, not a smell.
+    breaches = {name[len("slo.breach."):]: val
+                for name, val in ev.counters.items()
+                if name.startswith("slo.breach.") and val}
+    for slo_name in sorted(breaches):
+        out.append(_finding(
+            "critical", "slo-breach",
+            f"SLO '{slo_name}' burned its error budget "
+            f"{int(breaches[slo_name])}x (fast-window burn >= page "
+            f"threshold)",
+            "docs/OBSERVABILITY.md 'SLO registry': inspect "
+            "trace_summary --slo, then the lat.* histograms behind "
+            "the objective",
+            str(int(breaches[slo_name]))))
+
+    # -- Breaker trips: capacity was protected by failing fast.
+    trips = ev.counter("resil.breaker.trips") or ev.field(
+        "resil_breaker_trips", 0)
+    if trips:
+        out.append(_finding(
+            "warn", "breaker-trips",
+            f"circuit breaker tripped {int(trips)}x — downstream "
+            f"failures crossed the trip threshold",
+            "docs/RESILIENCE.md: check resil.breaker.*.trips sites "
+            "via trace_summary --resil; raise capacity or fix the "
+            "failing dependency before tuning thresholds",
+            str(int(trips))))
+
+    # -- Plan-cache thrash: every miss is an XLA recompile.
+    hits = ev.counter("engine.plan.hits") or ev.field(
+        "engine_plan_hits", 0)
+    misses = ev.counter("engine.plan.misses") or ev.field(
+        "engine_plan_misses", 0)
+    if hits + misses:
+        rate = hits / (hits + misses)
+        if rate < PLAN_HIT_RATE_FLOOR:
+            out.append(_finding(
+                "warn", "plan-thrash",
+                f"engine plan-cache hit rate {rate:.0%} (< "
+                f"{PLAN_HIT_RATE_FLOOR:.0%}) — shape churn is forcing "
+                f"recompiles",
+                "docs/ENGINE.md: widen pad buckets "
+                "(LEGATE_SPARSE_TPU_ENGINE knobs) or raise the plan "
+                "cache capacity",
+                f"{rate:.2f}"))
+
+    # -- Autotune decline ladder: measurements that never pay off.
+    at_declines = ev.counter("autotune.route.declined")
+    at_hits = ev.counter("autotune.route.hit")
+    if at_declines and at_declines > at_hits:
+        out.append(_finding(
+            "warn", "autotune-declines",
+            f"autotuner declined routing {int(at_declines)}x vs "
+            f"{int(at_hits)} routed hits — measured verdicts are not "
+            f"being reused",
+            "docs/AUTOTUNER.md: check the decline ladder "
+            "(autotune.route.* counters); stale store? "
+            "LEGATE_SPARSE_TPU_AUTOTUNE_STORE path writable?",
+            str(int(at_declines))))
+
+    # -- Batch occupancy: a batching engine running solo requests.
+    for label, breq, batches in (
+            ("executor", ev.counter("engine.exec.batched_requests"),
+             ev.counter("engine.exec.batches")),
+            ("gateway", ev.counter("gateway.dispatched_requests"),
+             ev.counter("gateway.dispatches"))):
+        if batches >= 4 and breq / batches < BATCH_OCCUPANCY_FLOOR:
+            out.append(_finding(
+                "info", "batch-occupancy",
+                f"{label} batch occupancy {breq / batches:.1f} "
+                f"reqs/batch over {int(batches)} batches (< "
+                f"{BATCH_OCCUPANCY_FLOOR:.0f}) — batching overhead "
+                f"without batching wins",
+                "docs/ENGINE.md: raise the batch window "
+                "(_ENGINE_WINDOW_US) or submit concurrently; solo "
+                "streams may prefer inline dispatch",
+                f"{breq / batches:.1f}"))
+
+    # -- Comm bytes, counted vs bench-recorded: drift means the
+    #    predictive model and the dist kernels disagree.
+    counted = ev.counter("comm.total_bytes")
+    recorded = ev.field("comm_total_bytes")
+    if counted and isinstance(recorded, (int, float)) and recorded:
+        delta = abs(counted - recorded) / recorded
+        if delta > COMM_DELTA_TOL:
+            out.append(_finding(
+                "warn", "comm-drift",
+                f"comm.total_bytes counter ({int(counted)}) vs bench "
+                f"comm_total_bytes ({int(recorded)}) differ "
+                f"{delta:.1%} (> {COMM_DELTA_TOL:.0%})",
+                "docs/DIST.md accounting contract: a dist kernel "
+                "changed its collective pattern without updating "
+                "obs/comm.py predictions (or vice versa)",
+                f"{delta:.3f}"))
+
+    # -- CPU roofline shortfall, with the measured loss terms ranked.
+    ratio = ev.field("cpu_roofline_ratio")
+    if isinstance(ratio, (int, float)) and ratio < ROOFLINE_FLOOR:
+        items = ev.field("cpu_roofline_items") or {}
+        ranked = sorted(
+            ((k, v) for k, v in items.items()
+             if isinstance(v, (int, float))),
+            key=lambda kv: -kv[1])
+        detail = ", ".join(f"{k}={v:.2f}" for k, v in ranked[:3])
+        out.append(_finding(
+            "warn", "roofline-shortfall",
+            f"cpu_roofline_ratio {ratio:.2f} (< {ROOFLINE_FLOOR}) — "
+            f"SpMV is leaving measured bandwidth on the table"
+            + (f"; top losses: {detail}" if detail else ""),
+            "bench.py itemizes the loss terms "
+            "(cpu_roofline_items); attack the largest first "
+            "(mask/pad losses -> layout, segment-sum -> kernel)",
+            f"{ratio:.2f}"))
+
+    # -- Gateway rejection pressure.
+    submitted = ev.counter("gateway.submitted") or ev.field(
+        "gateway_requests", 0)
+    rejected = sum(v for name, v in ev.counters.items()
+                   if name.startswith("gateway.rejected."))
+    if not rejected:
+        rejected = sum(
+            v for k, v in ev.bench.items()
+            if k.startswith("gateway_rejected_")
+            and isinstance(v, (int, float)))
+    if submitted and rejected / submitted > GATEWAY_REJECT_CEIL:
+        out.append(_finding(
+            "warn", "gateway-rejections",
+            f"gateway rejected {int(rejected)}/{int(submitted)} "
+            f"submissions ({rejected / submitted:.0%} > "
+            f"{GATEWAY_REJECT_CEIL:.0%}) — admission pressure exceeds "
+            f"capacity",
+            "docs/OBSERVABILITY.md gateway ledger: split by reason "
+            "(trace_summary --gateway); queue_full -> raise "
+            "queue/quota knobs, breaker -> see breaker-trips",
+            f"{rejected / submitted:.2f}"))
+
+    # -- Observability overhead.  Smoke-lane artifacts are excluded:
+    #    the CI toy matrix runs SpMV in microseconds, so the relative
+    #    span tax there is dominated by the probe itself and would
+    #    flap the otherwise-deterministic finding set.
+    overhead = ev.field("obs_overhead_pct")
+    if isinstance(overhead, (int, float)) and \
+            not ev.field("smoke", False) and \
+            overhead > OBS_OVERHEAD_CEIL_PCT:
+        out.append(_finding(
+            "warn", "obs-overhead",
+            f"obs_overhead_pct {overhead:.1f}% (> "
+            f"{OBS_OVERHEAD_CEIL_PCT:.0f}%) — tracing is taxing the "
+            f"hot path",
+            "run with LEGATE_SPARSE_TPU_OBS unset in production; "
+            "spans are the only toggled cost (counters/histograms "
+            "are always-on by design)",
+            f"{overhead:.1f}"))
+
+    # -- Dropped records: the trace itself is lying by omission.
+    dropped = ev.counter("obs.dropped_records")
+    if dropped:
+        out.append(_finding(
+            "info", "trace-dropped",
+            f"{int(dropped)} trace records dropped at the MAX_RECORDS "
+            f"cap — per-op tables undercount",
+            "docs/OBSERVABILITY.md: reset/export the trace "
+            "periodically, or trace a shorter window",
+            str(int(dropped))))
+
+    out.sort(key=lambda f: -_severity_rank(f["severity"]))
+    return out
+
+
+def render_findings(findings: List[Dict[str, str]],
+                    verbose_hints: bool = True) -> str:
+    if not findings:
+        return "doctor: no findings — all ledgers within thresholds"
+    rows = [[f["severity"].upper(), f["code"], f["value"], f["message"]]
+            for f in findings]
+    out = [report.format_table(
+        ["severity", "finding", "value", "detail"], rows, left_cols=4)]
+    if verbose_hints:
+        out.append("")
+        for f in findings:
+            out.append(f"[{f['code']}] hint: {f['hint']}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Ranked diagnosis over obs artifacts (Chrome "
+                    "trace / OpenMetrics / bench JSON).")
+    ap.add_argument("artifacts", nargs="+",
+                    help="artifact files; kind auto-detected from "
+                         "content")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: exit 1 when any finding reaches "
+                         "--fail-on severity")
+    ap.add_argument("--fail-on", choices=SEVERITIES, default="critical",
+                    help="minimum severity that fails --check "
+                         "(default: critical)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array instead of "
+                         "the table")
+    ap.add_argument("--no-hints", action="store_true",
+                    help="omit the remediation-hint lines")
+    args = ap.parse_args(argv)
+
+    ev = Evidence()
+    for path in args.artifacts:
+        try:
+            kind = load_artifact(path, ev)
+        except (OSError, ValueError) as exc:
+            print(f"doctor: cannot read {path}: {exc}", file=sys.stderr)
+            continue
+        print(f"doctor: read {path} ({kind})", file=sys.stderr)
+    if not ev.sources:
+        print("doctor: no readable artifacts", file=sys.stderr)
+        return 2
+
+    findings = diagnose(ev)
+    if args.json:
+        print(json.dumps(findings, indent=2))
+    else:
+        print(render_findings(findings,
+                              verbose_hints=not args.no_hints))
+
+    if args.check:
+        floor = _severity_rank(args.fail_on)
+        if any(_severity_rank(f["severity"]) >= floor
+               for f in findings):
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --resil
     python tools/trace_summary.py run.trace.json --gateway
     python tools/trace_summary.py run.trace.json --autotune
+    python tools/trace_summary.py run.trace.json --flows --slo
 
 ``--stream-gbs`` defaults to the ``stream_gbs`` recorded in the trace
 file's bench metadata when present (bench.py embeds its result blob).
@@ -146,6 +147,15 @@ def main(argv=None) -> int:
                          "store activity, route hit/miss/decline "
                          "funnel, per-kernel routed dispatches from "
                          "the autotune.* counters)")
+    ap.add_argument("--flows", action="store_true",
+                    help="also render the causal-flow ledger (one row "
+                         "per request trace id: span count, bracketing "
+                         "span names, end-to-end wall time — obs v4 "
+                         "flow arcs)")
+    ap.add_argument("--slo", action="store_true",
+                    help="also render the SLO burn ledger (latest "
+                         "verdict per objective from slo.verdict "
+                         "events + the exact slo.breach.* counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -211,6 +221,15 @@ def main(argv=None) -> int:
     if args.autotune:
         print("\nautotune ledger:")
         print(render_autotune_table(meta.get("counters") or {}))
+
+    if args.flows:
+        print("\ncausal flows:")
+        print(report.render_flows_table(records))
+
+    if args.slo:
+        print("\nslo ledger:")
+        print(report.render_slo_table(meta.get("counters") or {},
+                                      records))
 
     if args.latency:
         print("\nlatency histograms:")
