@@ -273,6 +273,67 @@ def maybe_enable_persistent_cache() -> bool:
 # ---------------------------------------------------------------- builders
 
 
+def plan_program(key: PlanKey):
+    """The (jitted fn, arg_specs, static kwargs, kernel name) quadruple
+    a plan for ``key`` lowers — the single spec source shared by the
+    compiling builders below and the lower-only verifier hook
+    (``lower_plan``), so a contract is checked against EXACTLY the
+    program production would compile."""
+    import jax
+
+    from ..ops import spmv as spmv_ops
+    from ..types import coord_dtype_for
+
+    dt = np.dtype(key.dtype)
+    cdt = coord_dtype_for(max(key.cols_b, 1))
+    sds = jax.ShapeDtypeStruct
+    if key.op == "spmv":
+        specs = (
+            sds((key.nnz_b,), dt),            # data
+            sds((key.nnz_b,), cdt),           # indices
+            sds((key.nnz_b,), np.int32),      # row_ids
+            sds((), np.int32),                # valid_nnz
+            sds((key.cols_b,), dt),           # x
+        )
+        return (spmv_ops.csr_spmv_rowids_masked, specs,
+                {"rows": key.rows_b}, "csr_spmv_rowids_masked")
+    if key.op == "spmm":
+        specs = (
+            sds((key.nnz_b,), dt),
+            sds((key.nnz_b,), cdt),
+            sds((key.nnz_b,), np.int32),
+            sds((), np.int32),
+            sds((key.cols_b, key.k_b), dt),
+        )
+        return (spmv_ops.csr_spmm_rowids_masked, specs,
+                {"rows": key.rows_b}, "csr_spmm_rowids_masked")
+    if key.op == "spmv_multi":
+        b = key.k_b
+        specs = (
+            sds((b, key.nnz_b), dt),          # stacked data
+            sds((b, key.nnz_b), cdt),         # stacked indices
+            sds((b, key.nnz_b), np.int32),    # stacked row_ids
+            sds((b,), np.int32),              # per-matrix valid_nnz
+            sds((b, key.cols_b), dt),         # per-matrix x
+        )
+        return (spmv_ops.csr_multi_spmv_rowids_masked, specs,
+                {"rows": key.rows_b, "b": b},
+                "csr_multi_spmv_rowids_masked")
+    raise KeyError(f"no plan program for op {key.op!r}")
+
+
+def lower_plan(key: PlanKey):
+    """Lower — WITHOUT compiling — the kernel program
+    ``BUILDERS[key.op]`` would AOT-compile for ``key``, against the
+    same ``ShapeDtypeStruct`` operands.  Returns the ``jax.stages``
+    ``Lowered`` (``.as_text()`` is its StableHLO; ``.jaxpr`` via
+    ``jax.make_jaxpr`` on the traced form is the caller's affair).
+    This is planverify's entry point: contract checks read the lowered
+    IR and never pay (or trigger) an XLA compile."""
+    fn, specs, static, _kernel = plan_program(key)
+    return fn.lower(*specs, **static)
+
+
 def _aot(fn, key: PlanKey, arg_specs, **static) -> Callable:
     """Lower + compile ``fn`` (a jitted function) against
     ``ShapeDtypeStruct`` operands — no example arrays materialized."""
@@ -289,59 +350,33 @@ def build_spmv_plan(key: PlanKey) -> Plan:
     padded slots entirely (documented jax semantics) and the valid
     prefix reduces in exactly the unpadded order: bit-for-bit equality
     with ``csr_spmv_rowids``."""
-    import jax
-
     from ..ops import spmv as spmv_ops
-    from ..types import coord_dtype_for
 
-    dt = np.dtype(key.dtype)
-    cdt = coord_dtype_for(max(key.cols_b, 1))
-    sds = jax.ShapeDtypeStruct
-    specs = (
-        sds((key.nnz_b,), dt),            # data
-        sds((key.nnz_b,), cdt),           # indices
-        sds((key.nnz_b,), np.int32),      # row_ids
-        sds((), np.int32),                # valid_nnz
-        sds((key.cols_b,), dt),           # x
-    )
-    compiled = _aot(spmv_ops.csr_spmv_rowids_masked, key, specs,
-                    rows=key.rows_b)
+    fn, specs, static, kernel = plan_program(key)
+    compiled = _aot(fn, key, specs, **static)
 
     def traced(data, indices, row_ids, valid, x):
         return spmv_ops.csr_spmv_rowids_masked(
             data, indices, row_ids, valid, x, rows=key.rows_b)
 
     return Plan(key, compiled=compiled, traced=traced,
-                meta={"kernel": "csr_spmv_rowids_masked"})
+                meta={"kernel": kernel})
 
 
 def build_spmm_plan(key: PlanKey) -> Plan:
     """Bucketed CSR SpMM plan (also the executor's stacked-batch
     kernel; same padding contract as the SpMV plan, ``k_b`` wide)."""
-    import jax
-
     from ..ops import spmv as spmv_ops
-    from ..types import coord_dtype_for
 
-    dt = np.dtype(key.dtype)
-    cdt = coord_dtype_for(max(key.cols_b, 1))
-    sds = jax.ShapeDtypeStruct
-    specs = (
-        sds((key.nnz_b,), dt),
-        sds((key.nnz_b,), cdt),
-        sds((key.nnz_b,), np.int32),
-        sds((), np.int32),
-        sds((key.cols_b, key.k_b), dt),
-    )
-    compiled = _aot(spmv_ops.csr_spmm_rowids_masked, key, specs,
-                    rows=key.rows_b)
+    fn, specs, static, kernel = plan_program(key)
+    compiled = _aot(fn, key, specs, **static)
 
     def traced(data, indices, row_ids, valid, X):
         return spmv_ops.csr_spmm_rowids_masked(
             data, indices, row_ids, valid, X, rows=key.rows_b)
 
     return Plan(key, compiled=compiled, traced=traced,
-                meta={"kernel": "csr_spmm_rowids_masked"})
+                meta={"kernel": kernel})
 
 
 def build_spmv_multi_plan(key: PlanKey) -> Plan:
@@ -355,31 +390,18 @@ def build_spmv_multi_plan(key: PlanKey) -> Plan:
     ids are offset per slot by ``rows_b + 1`` so every pack's
     out-of-range padding row id stays in its own discarded segment
     (bit-for-bit contract, see ``csr_multi_spmv_rowids_masked``)."""
-    import jax
-
     from ..ops import spmv as spmv_ops
-    from ..types import coord_dtype_for
 
-    dt = np.dtype(key.dtype)
-    cdt = coord_dtype_for(max(key.cols_b, 1))
-    sds = jax.ShapeDtypeStruct
+    fn, specs, static, kernel = plan_program(key)
+    compiled = _aot(fn, key, specs, **static)
     b = key.k_b
-    specs = (
-        sds((b, key.nnz_b), dt),          # stacked data
-        sds((b, key.nnz_b), cdt),         # stacked indices
-        sds((b, key.nnz_b), np.int32),    # stacked row_ids
-        sds((b,), np.int32),              # per-matrix valid_nnz
-        sds((b, key.cols_b), dt),         # per-matrix x
-    )
-    compiled = _aot(spmv_ops.csr_multi_spmv_rowids_masked, key, specs,
-                    rows=key.rows_b, b=b)
 
     def traced(data, indices, row_ids, valid, X):
         return spmv_ops.csr_multi_spmv_rowids_masked(
             data, indices, row_ids, valid, X, rows=key.rows_b, b=b)
 
     return Plan(key, compiled=compiled, traced=traced,
-                meta={"kernel": "csr_multi_spmv_rowids_masked"})
+                meta={"kernel": kernel})
 
 
 BUILDERS: Dict[str, Callable[[PlanKey], Plan]] = {

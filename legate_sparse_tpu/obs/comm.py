@@ -123,6 +123,42 @@ def reduce_scatter_bytes(input_elems: int, itemsize: int,
     return (shards - 1) * int(input_elems) * int(itemsize)
 
 
+def lowered_op_bytes(kind: str, operand_bytes: int, *,
+                     group_sizes=(), moved_pairs: int = 0) -> int:
+    """Interconnect bytes of ONE lowered collective op, from its IR
+    attributes, under the same total-at-receivers convention as the
+    model formulas above — the bridge ``tools/verify`` uses to
+    cross-check StableHLO operand shapes against this ledger:
+
+    - ``collective_permute``: ``moved_pairs`` non-identity
+      source-target pairs each deliver the per-device operand once
+      (matches both the halo rounds — R pairs — and the 2-d chunk
+      transpose, whose identity pairs move nothing);
+    - ``all_gather``: each replica group of size g has every member
+      receive the other g-1 operand blocks  ->  sum g*(g-1)*operand;
+    - ``all_reduce`` (psum): ring all-reduce per group  ->
+      sum 2*(g-1)*operand;
+    - ``reduce_scatter``: each member receives g-1 partial chunks of
+      operand/g  ->  sum (g-1)*operand;
+    - ``all_to_all``: the operand IS the (g, row) send buffer; own row
+      stays local  ->  sum (g-1)*operand.
+
+    ``operand_bytes`` is the per-device operand size read from the IR
+    tensor type; ``group_sizes`` the replica-group sizes."""
+    ob = int(operand_bytes)
+    if kind == "collective_permute":
+        return int(moved_pairs) * ob
+    per_group = {
+        "all_gather": lambda g: g * (g - 1) * ob,
+        "all_reduce": lambda g: 2 * (g - 1) * ob,
+        "reduce_scatter": lambda g: (g - 1) * ob,
+        "all_to_all": lambda g: (g - 1) * ob,
+    }
+    if kind not in per_group:
+        raise KeyError(f"unknown lowered collective kind {kind!r}")
+    return sum(per_group[kind](int(g)) for g in group_sizes)
+
+
 def transpose_moved_chunks(grid_rows: int, grid_cols: int) -> int:
     """Number of vector chunks the 2-d-block input fixup ``ppermute``
     actually moves: chunk k's destination under the row-major ->

@@ -1185,6 +1185,31 @@ def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
     ))
 
 
+# The distributed plan shapes this module can lower, as static
+# (entry point, layout, realization) triples — enumerable WITHOUT
+# devices or meshes, so the contract gates (``tools/verify`` and the
+# sparselint ``plan-contract`` rule) can walk the catalog at
+# import/AST time.  Every triple names one distinct lowered program
+# family: the realization axis is the collective structure the
+# dispatch branches on (``_dist_spmv_impl``), not a tuning knob.
+# ``dist_cg``/``dist_gmres`` cover the solver iteration/cycle bodies
+# over the corresponding SpMV realization ("1d-col" is the (1, R)
+# degenerate grid of the 2-d panel program and adds no distinct
+# solver body).  Grow this tuple when a new dispatch branch lands —
+# the plan-contract rule fails until its contract is committed.
+DIST_PLAN_SHAPES: Tuple[Tuple[str, str, str], ...] = (
+    ("dist_spmv", "1d-row", "halo"),
+    ("dist_spmv", "1d-row", "all_gather"),
+    ("dist_spmv", "1d-row", "precise"),
+    ("dist_spmv", "1d-col", "panel"),
+    ("dist_spmv", "2d-block", "panel"),
+    ("dist_spmm", "1d-row", "halo"),
+    ("dist_cg", "1d-row", "halo"),
+    ("dist_cg", "2d-block", "panel"),
+    ("dist_gmres", "1d-row", "halo"),
+)
+
+
 def spmv_comm_volumes(A: DistCSR, x_local_elems: int, itemsize: int,
                       cols: int = 1):
     """Per-call collective interconnect volumes of one ``dist_spmv``

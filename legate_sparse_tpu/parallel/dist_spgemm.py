@@ -1031,6 +1031,20 @@ def _dist_spgemm_2d(A: DistCSR, B: DistCSR) -> DistCSR:
     )
 
 
+# Static (entry point, layout, realization) catalog of this module's
+# contract-bearing lowered program families — the SpGEMM counterpart
+# of ``dist_csr.DIST_PLAN_SHAPES`` (same consumers: ``tools/verify``
+# and the sparselint plan-contract rule; same rule: a new dispatch
+# branch grows this tuple and must commit a contract).  The contracted
+# program per triple is the phase-1 product-count shard_map — the
+# phase whose collective realization choice (window ppermute vs B
+# all_gather vs 2-d panel staging) the later phases inherit.
+SPGEMM_PLAN_SHAPES = (
+    ("dist_spgemm", "1d-row", "all_gather"),
+    ("dist_spgemm", "2d-block", "panel"),
+)
+
+
 def dist_spgemm(A: DistCSR, B: DistCSR) -> DistCSR:
     """C = A @ B, both row-block distributed; returns a row-block C.
 

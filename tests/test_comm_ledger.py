@@ -278,28 +278,40 @@ def test_gmg_hierarchy_prices_its_cycle():
 
 @needs_mesh
 def test_model_matches_lowered_collectives():
-    """Anti-circularity check: the ledger's collective KINDS and
-    multiplicities must match the program XLA actually lowers, not
-    just the model that produced the counters.  Counts the collective
-    ops in the jitted dist_spmv's StableHLO for both realizations."""
+    """Anti-circularity check: the ledger's collective KINDS,
+    multiplicities AND bytes must match the program XLA actually
+    lowers, not just the model that produced the counters.  Goes
+    through planverify's schedule checker (tools/verify) — the same
+    parser and byte convention the contract gate enforces — instead of
+    ad-hoc substring counting."""
+    from tools.verify.catalog import Built
+    from tools.verify.rules import lowered_volumes, schedule_of
+
     mesh = make_row_mesh()
     n = 32 * R
-    x_np = np.ones(n, np.float32)
 
-    def hlo_of(dA):
-        x = shard_vector(x_np, mesh, dA.rows_padded)
-        return jax.jit(lambda v: dist_spmv(dA, v)).lower(x).as_text()
+    def built_of(dA):
+        x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+        hlo = jax.jit(lambda v: dist_spmv(dA, v)).lower(x).as_text()
+        return Built(hlo=hlo, jaxpr=None, predicted=None)
 
-    halo_hlo = hlo_of(shard_csr(_banded(n), mesh=mesh))
+    def model_of(dA):
+        vols = spmv_comm_volumes(dA, dA.rows_padded // dA.num_shards, 4)
+        return {k: v for k, v in vols.items() if v > 0}
+
+    dA = shard_csr(_banded(n), mesh=mesh)
+    built = built_of(dA)
     # Two-sided halo exchange: exactly the two ppermutes the model
     # prices as one exchange of 2*R*halo*itemsize bytes; no gather.
-    assert halo_hlo.count("collective_permute") == 2, halo_hlo[:200]
-    assert "all_gather" not in halo_hlo
+    assert [e["kind"] for e in schedule_of(built)] == \
+        ["collective_permute", "collective_permute"]
+    assert lowered_volumes(built) == model_of(dA)
 
-    ag_hlo = hlo_of(shard_csr(_banded(n), mesh=mesh,
-                              force_all_gather=True))
-    assert ag_hlo.count("all_gather") >= 1
-    assert "collective_permute" not in ag_hlo
+    dA = shard_csr(_banded(n), mesh=mesh, force_all_gather=True)
+    built = built_of(dA)
+    kinds = [e["kind"] for e in schedule_of(built)]
+    assert kinds and set(kinds) == {"all_gather"}
+    assert lowered_volumes(built) == model_of(dA)
 
 
 # ------------------------------------- sparsity-aware window declines --
@@ -416,10 +428,14 @@ def test_2d_spmv_counters_match_static_prediction():
 
 @needs_grid
 def test_2d_model_matches_lowered_collectives():
-    """Anti-circularity for the 2-d-block program: the lowered HLO
-    carries exactly the collectives the ledger prices — one input
-    fixup permute, one x-panel all-gather, one reduce-scatter."""
+    """Anti-circularity for the 2-d-block program, through
+    planverify's schedule checker: the lowered HLO carries exactly the
+    collectives the ledger prices — one input fixup permute, one
+    x-panel all-gather, one reduce-scatter — and their byte volumes
+    (ledger convention) match the static model exactly, here at f64."""
     from legate_sparse_tpu.parallel import make_grid_mesh
+    from tools.verify.catalog import Built
+    from tools.verify.rules import lowered_volumes, schedule_of
 
     mesh = make_grid_mesh(2, 4)
     n = 96
@@ -427,9 +443,12 @@ def test_2d_model_matches_lowered_collectives():
     x = shard_vector(np.ones(n, np.float64), mesh, dA.rows_padded,
                      layout=dA.layout)
     hlo = jax.jit(lambda v: dist_spmv(dA, v)).lower(x).as_text()
-    assert hlo.count('"stablehlo.collective_permute"') == 1, hlo[:200]
-    assert hlo.count('"stablehlo.all_gather"') == 1
-    assert hlo.count('"stablehlo.reduce_scatter"') == 1
+    built = Built(hlo=hlo, jaxpr=None, predicted=None)
+    assert [e["kind"] for e in schedule_of(built)] == [
+        "collective_permute", "all_gather", "reduce_scatter"]
+    vols = spmv_comm_volumes(dA, dA.rows_padded // 8, 8)
+    assert lowered_volumes(built) == {
+        k: v for k, v in vols.items() if v > 0}
 
 
 @needs_grid

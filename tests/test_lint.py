@@ -28,8 +28,8 @@ from tools.lint.core import (  # noqa: E402
 
 EXPECTED_RULES = {
     "fault-sites", "kernel-registry", "knob-registry",
-    "lock-discipline", "monotonic-clock", "obs-docs", "settings-epoch",
-    "trace-purity",
+    "lock-discipline", "monotonic-clock", "obs-docs", "plan-contract",
+    "settings-epoch", "trace-purity",
 }
 
 

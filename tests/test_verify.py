@@ -1,0 +1,435 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""planverify (tools/verify/): the StableHLO/jaxpr contract gate.
+
+Tier-1 wiring for the verifier itself: rule registry completeness, the
+falsifiability drill (every rule must fire on a seeded known-bad
+lowered program), the StableHLO-syntax assumptions the parser encodes
+revalidated against the live jax, contract coverage of every
+registered kernel and plan shape, solver-cycle transfer freedom, the
+CLI surface, and — the gate — a full-catalog verify with ZERO
+findings against the committed contracts."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from utils_test.tools import load_tool  # noqa: E402
+
+from tools.common.findings import write_baseline  # noqa: E402
+from tools.verify import catalog, contracts  # noqa: E402
+from tools.verify import hlo as vhlo  # noqa: E402
+from tools.verify import rules as vrules  # noqa: E402
+from tools.verify.cli import main as cli_main  # noqa: E402
+from tools.verify.runner import (  # noqa: E402
+    run_verify, select_programs, update_contracts,
+)
+
+R = len(jax.devices())
+needs_mesh = pytest.mark.skipif(
+    R < catalog.MESH_DEVICES,
+    reason=f"catalog fixtures lower against the "
+           f"{catalog.MESH_DEVICES}-device mesh")
+
+EXPECTED_RULES = {
+    "collective-schedule", "comm-bytes", "transfer-freedom",
+    "dtype-discipline",
+}
+
+# Cheapest program to build: single-shard kernel, no mesh collectives.
+CHEAP_PID = "kernel/csr-rowids/spmv/f32"
+
+
+def _cheap_prog():
+    return [catalog.get_program(CHEAP_PID)]
+
+
+# ------------------------------------------------------------------ #
+# registry
+# ------------------------------------------------------------------ #
+
+def test_registry_is_complete():
+    rules = vrules.all_rules()
+    assert set(rules) == EXPECTED_RULES
+    for rid, rule in rules.items():
+        assert rule.id == rid
+        assert rule.description, f"rule {rid} has no description"
+
+
+def test_duplicate_rule_id_rejected():
+    class Dup(vrules.VerifyRule):
+        id = "comm-bytes"
+
+    with pytest.raises(ValueError, match="duplicate"):
+        vrules.register(Dup)
+
+
+def test_catalog_ids_unique_and_sourced():
+    progs = catalog.all_programs()
+    pids = [p.pid for p in progs]
+    assert len(pids) == len(set(pids))
+    for p in progs:
+        assert p.sources, p.pid
+        assert "legate_sparse_tpu/obs/comm.py" in p.sources, \
+            f"{p.pid}: every program depends on the byte model"
+
+
+# ------------------------------------------------------------------ #
+# the StableHLO parser, on synthetic text (no devices needed)
+# ------------------------------------------------------------------ #
+
+_SYNTHETIC = """
+  %1 = "stablehlo.collective_permute"(%0) <{channel_handle = \
+#stablehlo.channel_handle<handle = 1, type = 1>, source_target_pairs \
+= dense<[[0, 1], [1, 2], [2, 2]]> : tensor<3x2xi64>}> : \
+(tensor<4xf32>) -> tensor<4xf32>
+  %2 = "stablehlo.all_reduce"(%1) <{replica_groups = \
+dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>}> ({
+  ^bb0(%arg0: tensor<f32>, %arg1: tensor<f32>):
+    %3 = stablehlo.add %arg0, %arg1 : tensor<f32>
+    stablehlo.return %3 : tensor<f32>
+  }) : (tensor<8xf32>) -> tensor<8xf32>
+"""
+
+
+def test_parser_permute_counts_moved_pairs_only():
+    permute, reduce = vhlo.parse_collectives(_SYNTHETIC)
+    assert permute.kind == "collective_permute"
+    assert permute.operand_bytes == 16
+    assert permute.n_pairs == 3
+    assert permute.moved_pairs == 2      # [2, 2] is a self-pair
+    assert reduce.kind == "all_reduce"   # order = program order
+
+
+def test_parser_skips_reduction_regions():
+    # The all_reduce body contains ops and a type signature of its
+    # own; the parser must read the OUTER (tensor<8xf32>) operand.
+    _, reduce = vhlo.parse_collectives(_SYNTHETIC)
+    assert reduce.operand_bytes == 32
+    assert reduce.groups == (1, 4)       # 1 group of 4
+    assert reduce.model_kind == "psum"
+
+
+def test_tensor_bytes():
+    assert vhlo.tensor_bytes("tensor<2x3xf64>") == 48
+    assert vhlo.tensor_bytes("tensor<f32>") == 4
+    assert vhlo.tensor_bytes("tensor<8xbf16>") == 16
+    with pytest.raises(ValueError):
+        vhlo.tensor_bytes("not a tensor")
+
+
+def test_parser_custom_calls_and_feeds():
+    text = ('%0 = stablehlo.custom_call @Sharding(%a) : x\n'
+            '"stablehlo.custom_call"(%b) <{call_target_name = '
+            '"xla_python_cpu_callback"}> : y\n'
+            '%1 = "stablehlo.outfeed"(%c) : z\n')
+    assert vhlo.parse_custom_calls(text) == [
+        "Sharding", "xla_python_cpu_callback"]
+    assert vhlo.parse_feeds(text) == ["outfeed"]
+
+
+@needs_mesh
+def test_stablehlo_syntax_assumptions_hold():
+    """Revalidate the quoted-generic-form assumption against the live
+    jax: a real lowered psum must parse into exactly the collective
+    the ledger prices, byte-exactly."""
+    from legate_sparse_tpu.obs import comm
+
+    built = vrules._psum_built()
+    ops = vhlo.parse_collectives(built.hlo)
+    assert [o.kind for o in ops] == ["all_reduce"]
+    assert ops[0].groups == (1, R)
+    assert vrules.lowered_volumes(built) == {
+        "psum": comm.psum_bytes(1, 4, R)}
+    assert vhlo.host_callbacks(built.jaxpr) == []
+
+
+# ------------------------------------------------------------------ #
+# falsifiability drill: every rule must fire on its known-bad program
+# ------------------------------------------------------------------ #
+
+@needs_mesh
+@pytest.mark.parametrize("rule_id", sorted(EXPECTED_RULES))
+def test_rule_is_falsifiable(rule_id):
+    findings = vrules.get_rule(rule_id).falsifiability()
+    assert findings, f"rule {rule_id} produced no finding on its " \
+                     f"known-bad program — it checks nothing"
+    assert all(f.rule == rule_id for f in findings)
+    assert all(f.message for f in findings)
+
+
+# ------------------------------------------------------------------ #
+# contract coverage: registry kernels + plan shapes -> committed files
+# ------------------------------------------------------------------ #
+
+def test_contract_filename_scheme():
+    assert contracts.contract_name("dist/spmv/1d-row/halo/f32") == \
+        "dist-spmv-1d-row-halo-f32.json"
+    assert contracts.kernel_prefix("csr-rowids") == "kernel-csr-rowids-"
+    assert contracts.dist_prefix(("dist_spmv", "1d-row", "halo")) == \
+        "dist-spmv-1d-row-halo"
+
+
+def test_every_catalog_program_has_committed_contract():
+    for p in catalog.all_programs():
+        c = contracts.load_contract(p.pid)
+        assert c is not None, f"{p.pid}: no committed contract"
+        assert c["version"] == contracts.CONTRACT_VERSION
+        assert c["program"] == p.pid
+        assert c["reason"].strip()
+
+
+def test_every_kernel_label_and_plan_shape_is_contracted():
+    from legate_sparse_tpu.parallel.dist_csr import DIST_PLAN_SHAPES
+    from legate_sparse_tpu.parallel.dist_spgemm import (
+        SPGEMM_PLAN_SHAPES,
+    )
+    from tools.lint.core import Context
+    from tools.lint.rules.plan_contract import registry_labels
+
+    names = contracts.list_contracts()
+    labels = registry_labels(Context())
+    assert labels
+    for label in labels:
+        prefix = contracts.kernel_prefix(label)
+        assert any(n.startswith(prefix) for n in names), label
+    for triple in tuple(DIST_PLAN_SHAPES) + tuple(SPGEMM_PLAN_SHAPES):
+        prefix = contracts.dist_prefix(triple) + "-"
+        assert any(n.startswith(prefix) for n in names), triple
+    # The acceptance floor, spelled out: spmv/cg/spgemm contracted on
+    # BOTH 1-d-row and 2-d-block.
+    for req in ("dist-spmv-1d-row-halo", "dist-spmv-2d-block-panel",
+                "dist-cg-1d-row-halo", "dist-cg-2d-block-panel",
+                "dist-spgemm-1d-row-all-gather",
+                "dist-spgemm-2d-block-panel"):
+        assert any(n.startswith(req) for n in names), req
+
+
+# ------------------------------------------------------------------ #
+# solver cycles: lowered loop bodies are host-transfer-free
+# ------------------------------------------------------------------ #
+
+@needs_mesh
+@pytest.mark.parametrize("pid", ["dist/cg/1d-row/halo/f32",
+                                 "dist/cg/2d-block/panel/f32"])
+def test_cg_body_is_transfer_free(pid):
+    built = catalog.build(pid)
+    assert vrules.transfer_violations(built) == []
+    assert vhlo.host_callbacks(built.jaxpr) == []
+    c = contracts.load_contract(pid)
+    assert c["transfer_free"] is True
+    # The body's scalar psums are partitioner-inserted, priced as
+    # deferred volumes — never as host round-trips.
+    assert c["deferred_volumes"].get("psum", 0) > 0
+
+
+@needs_mesh
+def test_gmres_cycle_loops_without_host_transfers():
+    pid = "dist/gmres/1d-row/halo/f32"
+    built = catalog.build(pid)
+    # The Arnoldi loop is really in the traced program (so the
+    # transfer-freedom claim is about a genuine per-iteration body)...
+    prims = {e.primitive.name for e, _ in vhlo.iter_eqns(built.jaxpr)}
+    assert prims & vhlo.LOOP_PRIMS
+    # ...and nothing in or around it round-trips to the host.
+    assert vrules.transfer_violations(built) == []
+    c = contracts.load_contract(pid)
+    assert c["transfer_free"] is True
+    # Loop-replayed collectives: per-dispatch bytes are not a
+    # lower-time quantity, so the contract records no prediction.
+    assert c["predicted_volumes"] is None
+    assert c["notes"].get("loops") is True
+
+
+# ------------------------------------------------------------------ #
+# drift detection + baseline lifecycle (temp contract dirs)
+# ------------------------------------------------------------------ #
+
+@needs_mesh
+def test_missing_contract_is_a_finding(tmp_path):
+    res = run_verify(programs=_cheap_prog(), baseline_path=None,
+                     contracts_dir=str(tmp_path / "empty"))
+    assert res.exit_code == 1
+    assert [f.rule for f in res.active] == ["collective-schedule"]
+    assert "no committed contract" in res.active[0].message
+
+
+@needs_mesh
+def test_bytes_drift_fires_then_baselines_then_goes_stale(tmp_path):
+    payload = contracts.load_contract(CHEAP_PID)
+    drifted = dict(payload, lowered_volumes={"psum": 12345})
+    cdir = str(tmp_path / "contracts")
+    contracts.write_contract(CHEAP_PID, drifted, cdir)
+
+    res = run_verify(programs=_cheap_prog(), baseline_path=None,
+                     contracts_dir=cdir)
+    assert res.exit_code == 1
+    assert {f.rule for f in res.active} == {"comm-bytes"}
+
+    bl = str(tmp_path / "baseline.json")
+    write_baseline(bl, res.active)
+    res2 = run_verify(programs=_cheap_prog(), baseline_path=bl,
+                      contracts_dir=cdir)
+    assert res2.exit_code == 0
+    assert res2.baselined and not res2.active
+    assert res2.stale_baseline == []
+
+    # Against the healthy committed contract the grandfathered entry
+    # matches nothing — reported stale so the baseline shrinks.
+    res3 = run_verify(programs=_cheap_prog(), baseline_path=bl)
+    assert res3.exit_code == 0
+    assert res3.stale_baseline
+
+
+@needs_mesh
+def test_schedule_drift_reports_first_divergence(tmp_path):
+    payload = contracts.load_contract(CHEAP_PID)
+    phantom = {"kind": "all_gather", "operand_bytes": 64,
+               "moved_pairs": None, "groups": [1, 8], "bytes": 448}
+    cdir = str(tmp_path / "contracts")
+    contracts.write_contract(
+        CHEAP_PID, dict(payload, schedule=[phantom]), cdir)
+    res = run_verify(programs=_cheap_prog(), baseline_path=None,
+                     contracts_dir=cdir)
+    scheds = [f for f in res.active if f.rule == "collective-schedule"]
+    assert len(scheds) == 1
+    assert "missing op: all_gather" in scheds[0].message
+
+
+@needs_mesh
+def test_update_contracts_is_deterministic(tmp_path):
+    p1 = update_contracts("probe", programs=_cheap_prog(),
+                          contracts_dir=str(tmp_path / "a"))
+    p2 = update_contracts("probe", programs=_cheap_prog(),
+                          contracts_dir=str(tmp_path / "b"))
+    with open(p1[0]) as f1, open(p2[0]) as f2:
+        assert f1.read() == f2.read()
+    with open(p1[0]) as f:
+        fresh = json.load(f)
+    committed = contracts.load_contract(CHEAP_PID)
+    strip = lambda d: {k: v for k, v in d.items() if k != "reason"}
+    assert strip(fresh) == strip(committed)
+
+
+def test_update_contracts_requires_reason():
+    with pytest.raises(ValueError, match="reason"):
+        update_contracts("  ", programs=[])
+
+
+# ------------------------------------------------------------------ #
+# --changed selection
+# ------------------------------------------------------------------ #
+
+def test_changed_selection_maps_files_to_programs():
+    all_ids = {p.pid for p in catalog.all_programs()}
+    # Verifier / shared-model edits re-verify everything.
+    got = select_programs(selection=["tools/verify/hlo.py"])
+    assert {p.pid for p in got} == all_ids
+    got = select_programs(selection=["legate_sparse_tpu/obs/comm.py"])
+    assert {p.pid for p in got} == all_ids
+    # Unrelated files select nothing.
+    assert select_programs(selection=["README.md"]) == []
+    # A solver-only module re-verifies exactly the solver programs.
+    got = {p.pid for p in select_programs(
+        selection=["legate_sparse_tpu/linalg.py"])}
+    assert got
+    assert all(i.startswith(("dist/cg/", "dist/gmres/")) for i in got)
+
+
+def test_unknown_program_id_raises():
+    with pytest.raises(KeyError, match="no-such-program"):
+        select_programs(program_ids=["no-such-program"])
+
+
+# ------------------------------------------------------------------ #
+# CLI surface
+# ------------------------------------------------------------------ #
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in EXPECTED_RULES:
+        assert rid in out
+
+
+def test_cli_list_programs(capsys):
+    assert cli_main(["--list-programs"]) == 0
+    out = capsys.readouterr().out
+    assert CHEAP_PID in out
+    assert "dist/cg/2d-block/panel/f32" in out
+
+
+def test_cli_usage_errors(capsys):
+    assert cli_main(["--rules", "no-such-rule"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+    assert cli_main(["no/such/program"]) == 2
+    assert "unknown program" in capsys.readouterr().err
+    assert cli_main(["--update-contracts", CHEAP_PID]) == 2
+    assert "--reason" in capsys.readouterr().err
+
+
+@needs_mesh
+def test_cli_json_artifact_single_program(capsys):
+    rc = cli_main([CHEAP_PID, "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data["tool"] == "planverify"
+    assert data["findings"] == []
+    assert data["exit_code"] == 0
+    assert data["programs_checked"] == [CHEAP_PID]
+    assert set(data["rules_run"]) == EXPECTED_RULES
+
+
+# ------------------------------------------------------------------ #
+# doctor ingestion: planverify --json is the fourth artifact kind
+# ------------------------------------------------------------------ #
+
+@needs_mesh
+def test_doctor_ingests_planverify_artifact(tmp_path):
+    doctor = load_tool("doctor")
+    art = run_verify(programs=_cheap_prog()).to_json()
+    path = tmp_path / "pv.json"
+    path.write_text(json.dumps(art))
+    ev = doctor.Evidence()
+    assert doctor.load_artifact(str(path), ev) == "planverify"
+    assert doctor.diagnose(ev) == []          # clean run: no findings
+
+    art["findings"] = [{
+        "rule": "comm-bytes", "path": "tools/verify/contracts/x.json",
+        "line": 0, "message": "lowered volumes diverge",
+        "severity": "error"}]
+    path.write_text(json.dumps(art))
+    ev = doctor.Evidence()
+    doctor.load_artifact(str(path), ev)
+    findings = doctor.diagnose(ev)
+    drift = [f for f in findings if f["code"] == "plan-contract-drift"]
+    assert len(drift) == 1
+    assert drift[0]["severity"] == "critical"
+    assert "--update-contracts" in drift[0]["hint"]
+    assert doctor.main([str(path), "--check"]) == 1
+
+
+# ------------------------------------------------------------------ #
+# tier-1 gate: the whole catalog verifies clean against the committed
+# contracts — collective schedules, byte volumes (exact), transfer
+# freedom and dtype discipline, for every kernel and dist plan shape
+# ------------------------------------------------------------------ #
+
+@needs_mesh
+def test_full_catalog_verify_is_clean():
+    res = run_verify()
+    assert res.active == [], "findings:\n" + "\n".join(
+        f.render() for f in res.active)
+    assert res.stale_baseline == []
+    assert set(res.rules_run) == EXPECTED_RULES
+    assert sorted(res.programs_checked) == sorted(
+        p.pid for p in catalog.all_programs())

@@ -1,0 +1,17 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Shared static-analysis plumbing for the repo's gate tools.
+
+``sparselint`` (``tools/lint/``, AST-level source invariants) and
+``planverify`` (``tools/verify/``, lowered-program contracts) present
+the same operator surface — ``path:line: severity: [rule-id] message``
+findings, a committed line-number-free baseline with stale-entry
+detection, deterministic 0/1/2 exit codes, a ``--json`` artifact — so
+the finding/baseline core lives here once and both frameworks import
+it.  Anything rule-model-specific (AST contexts, inline suppressions,
+lowering catalogs) stays in the owning tool.
+"""
+
+from .findings import (  # noqa: F401
+    Finding, load_baseline, write_baseline,
+)

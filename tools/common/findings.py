@@ -1,0 +1,63 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Findings and baselines, shared by sparselint and planverify.
+
+- A **finding** is one rule violation at a source location (or, for
+  planverify, at a lowered program — ``path`` then names the program's
+  defining module and ``message`` carries the program id).
+- The **baseline** grandfathers findings in a committed JSON file
+  keyed ``(rule, path, message)`` — deliberately line-number-free so
+  unrelated edits above a grandfathered site don't resurrect it.
+  Entries are a multiset (two identical findings need two entries);
+  entries that match nothing are reported by the runners as *stale*
+  so the baseline shrinks instead of rotting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str           # repo-relative, "/"-separated
+    line: int           # 1-based; 0 = whole-file/whole-program
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.severity}: "
+                f"[{self.rule}] {self.message}")
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline entries as a multiset of (rule, path, message)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "message": f.message}
+         for f in findings),
+        key=lambda e: (e["rule"], e["path"], e["message"]))
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")

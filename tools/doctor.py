@@ -4,20 +4,22 @@
 """Automated performance doctor: offline diagnosis over obs artifacts.
 
 ``trace_summary.py`` renders ledgers; the doctor *reads* them.  Point
-it at any mix of the three artifact kinds the package emits —
+it at any mix of the four artifact kinds the package emits —
 
 - Chrome-trace JSON (``bench.py`` / ``obs.write_chrome_trace``; the
   ``otherData`` blob carries counters, histograms and the bench
   result),
 - OpenMetrics text (``LEGATE_SPARSE_TPU_OBS_PROM`` snapshots,
   ``obs.export.write_openmetrics``),
-- bench result JSON (``bench.py`` output, driver wrappers, log tails)
+- bench result JSON (``bench.py`` output, driver wrappers, log tails),
+- planverify JSON (``python tools/planverify.py --json``; detected by
+  its ``"tool": "planverify"`` key)
 
 — and it cross-references them into a ranked findings table: breaker
 trips, plan-cache thrash, batch occupancy collapse, comm-bytes
-actual-vs-predicted drift, CPU roofline shortfall (with the measured
-loss terms ranked), gateway rejection pressure, SLO budget burns, and
-observability overhead.  Every finding carries a remediation hint —
+actual-vs-predicted drift, compiled-plan contract drift, CPU roofline
+shortfall (with the measured loss terms ranked), gateway rejection
+pressure, SLO budget burns, and observability overhead.  Every finding carries a remediation hint —
 the docs section or knob to reach for next.
 
 Artifact kind is auto-detected from content, never from the filename.
@@ -73,6 +75,9 @@ class Evidence:
         self.histograms: Dict[str, Any] = {}
         self.bench: Dict[str, Any] = {}
         self.records: List[Dict[str, Any]] = []
+        self.verify_findings: List[Dict[str, Any]] = []
+        self.verify_stale: List[Dict[str, Any]] = []
+        self.verify_programs: List[str] = []
         self.sources: List[str] = []
 
     def add_counters(self, counters: Dict[str, Any]) -> None:
@@ -91,7 +96,8 @@ class Evidence:
 
 def load_artifact(path: str, ev: Evidence) -> str:
     """Read one artifact into the evidence, returning the detected
-    kind (``openmetrics`` / ``trace`` / ``bench``).  Raises ValueError
+    kind (``openmetrics`` / ``trace`` / ``planverify`` / ``bench``).
+    Raises ValueError
     when the content matches none of them."""
     with open(path) as f:
         text = f.read()
@@ -117,6 +123,12 @@ def load_artifact(path: str, ev: Evidence) -> str:
             ev.bench.update(bench)
         ev.sources.append(f"{path} (trace)")
         return "trace"
+    if isinstance(doc, dict) and doc.get("tool") == "planverify":
+        ev.verify_findings.extend(doc.get("findings") or [])
+        ev.verify_stale.extend(doc.get("stale_baseline") or [])
+        ev.verify_programs.extend(doc.get("programs_checked") or [])
+        ev.sources.append(f"{path} (planverify)")
+        return "planverify"
     bench = regress.load_bench(path)      # raises ValueError if not one
     ev.bench.update(bench)
     ev.sources.append(f"{path} (bench)")
@@ -148,6 +160,29 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
             "trace_summary --slo, then the lat.* histograms behind "
             "the objective",
             str(int(breaches[slo_name]))))
+
+    # -- Compiled-plan contract drift: the lowered IR no longer
+    #    matches the committed planverify contract.  Critical, not a
+    #    smell: either a dist kernel silently changed its collective
+    #    pattern/byte volume, or an intended change shipped without
+    #    regenerating its contract.
+    for vf in ev.verify_findings:
+        out.append(_finding(
+            "critical", "plan-contract-drift",
+            f"planverify [{vf.get('rule', '?')}] {vf.get('path', '?')}"
+            f": {vf.get('message', '')}",
+            "re-run `python tools/planverify.py` after reverting the "
+            "drift; if the new lowering is intended, regenerate via "
+            "`--update-contracts --reason '...'` (docs/VERIFY.md)",
+            vf.get("rule", "-")))
+    for entry in ev.verify_stale:
+        out.append(_finding(
+            "info", "verify-stale-baseline",
+            f"planverify baseline entry [{entry.get('rule', '?')}] "
+            f"{entry.get('path', '?')} matches no current finding",
+            "delete the stale entry from tools/verify/baseline.json "
+            "so the grandfather list shrinks instead of rotting",
+            entry.get("rule", "-")))
 
     # -- Breaker trips: capacity was protected by failing fast.
     trips = ev.counter("resil.breaker.trips") or ev.field(

@@ -39,11 +39,18 @@ Design notes
 from __future__ import annotations
 
 import ast
-import json
 import os
 import re
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Shared finding/baseline core (tools/common): one definition serves
+# both sparselint and planverify, so the two gates render findings and
+# grandfather baselines identically.  Re-exported here because every
+# rule module and tests/test_lint.py import them from this module.
+from ..common.findings import (  # noqa: F401
+    Finding, load_baseline, write_baseline,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -61,24 +68,6 @@ SEVERITIES = ("error", "warning")
 # mtimes are wall-clock``.
 _SUPPRESS_RE = re.compile(
     r"#\s*lint:\s*disable=([A-Za-z0-9_,\-]+)")
-
-
-@dataclass(frozen=True)
-class Finding:
-    """One rule violation at a source location."""
-
-    rule: str
-    path: str           # repo-relative, "/"-separated
-    line: int           # 1-based; 0 = whole-file/whole-program
-    message: str
-    severity: str = "error"
-
-    def render(self) -> str:
-        return (f"{self.path}:{self.line}: {self.severity}: "
-                f"[{self.rule}] {self.message}")
-
-    def baseline_key(self) -> Tuple[str, str, str]:
-        return (self.rule, self.path, self.message)
 
 
 class Context:
@@ -196,7 +185,7 @@ def get_rule(rule_id: str) -> Rule:
 
 
 # ------------------------------------------------------------------ #
-# suppression + baseline
+# suppression
 # ------------------------------------------------------------------ #
 
 def suppressed_by_line(ctx: Context, finding: Finding) -> bool:
@@ -215,30 +204,6 @@ def suppressed_by_line(ctx: Context, finding: Finding) -> bool:
         return False
     names = {tok.strip() for tok in m.group(1).split(",")}
     return finding.rule in names or "all" in names
-
-
-def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
-    """Baseline entries as a multiset of (rule, path, message)."""
-    if not os.path.exists(path):
-        return {}
-    with open(path) as f:
-        data = json.load(f)
-    out: Dict[Tuple[str, str, str], int] = {}
-    for e in data.get("entries", []):
-        key = (e["rule"], e["path"], e["message"])
-        out[key] = out.get(key, 0) + 1
-    return out
-
-
-def write_baseline(path: str, findings: Sequence[Finding]) -> None:
-    entries = sorted(
-        ({"rule": f.rule, "path": f.path, "message": f.message}
-         for f in findings),
-        key=lambda e: (e["rule"], e["path"], e["message"]))
-    with open(path, "w") as f:
-        json.dump({"version": 1, "entries": entries}, f, indent=1,
-                  sort_keys=True)
-        f.write("\n")
 
 
 # ------------------------------------------------------------------ #

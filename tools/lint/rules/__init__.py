@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     lock_discipline,
     monotonic_clock,
     obs_docs,
+    plan_contract,
     settings_epoch,
     trace_purity,
 )

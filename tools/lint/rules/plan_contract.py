@@ -1,0 +1,154 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""plan-contract: every dispatchable program has a committed
+planverify contract.
+
+The planverify gate (tools/verify/, docs/VERIFY.md) can only hold the
+line on programs it knows about.  This rule closes the coverage loop
+statically — no jax, no lowering: every kernel label in
+``autotune/registry.py`` and every plan-shape triple in
+``parallel/dist_csr.py::DIST_PLAN_SHAPES`` /
+``parallel/dist_spgemm.py::SPGEMM_PLAN_SHAPES`` must map (via the
+shared mechanical filename scheme in ``tools.verify.contracts``) to at
+least one committed contract file, and no committed contract may be an
+orphan that matches neither — a stale file asserts invariants about a
+program that no longer exists.
+
+The plan-shape tuples are read with ``ast.literal_eval`` from the
+module source (they are declared as pure literals precisely so this
+rule and planverify's catalog can enumerate them without devices).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from ...verify.contracts import (
+    dist_prefix, kernel_prefix, list_contracts,
+)
+from ..core import Context, Finding, Rule, register
+
+REGISTRY_REL = "legate_sparse_tpu/autotune/registry.py"
+DIST_REL = "legate_sparse_tpu/parallel/dist_csr.py"
+SPGEMM_REL = "legate_sparse_tpu/parallel/dist_spgemm.py"
+CONTRACTS_REL = "tools/verify/contracts/"
+
+_UPDATE_HINT = ("run `python tools/planverify.py --update-contracts "
+                "--reason '...'` after adding the program to "
+                "tools/verify/catalog.py")
+
+
+def registry_labels(ctx: Context) -> List[str]:
+    """Kernel labels from the registry source: every ``label="..."``
+    keyword (the kernel-registry rule separately enforces that keys
+    and labels agree, so the keyword set IS the label set)."""
+    tree = ctx.tree(REGISTRY_REL)
+    labels = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.keyword) and node.arg == "label" and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            labels.append(node.value.value)
+    return sorted(set(labels))
+
+
+def plan_shape_literals(ctx: Context, rel: str, name: str
+                        ) -> Optional[Tuple]:
+    """``ast.literal_eval`` of module-level ``name = (...)`` in
+    ``rel``; None when the assignment is missing or not a literal."""
+    tree = ctx.tree(rel)
+    for node in tree.body if hasattr(tree, "body") else []:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        if name in targets and node.value is not None:
+            try:
+                return tuple(ast.literal_eval(node.value))
+            except (ValueError, SyntaxError):
+                return None
+    return None
+
+
+@register
+class PlanContractRule(Rule):
+    id = "plan-contract"
+    description = ("every autotune kernel label and dist plan-shape "
+                   "triple must have a committed planverify contract "
+                   "(and no contract may be an orphan)")
+    scope_prefixes = (REGISTRY_REL, DIST_REL, SPGEMM_REL)
+    doc_inputs = (CONTRACTS_REL,)
+    whole_program = True
+
+    def triggers(self, rel: str) -> bool:
+        return rel.startswith(CONTRACTS_REL) or super().triggers(rel)
+
+    def check(self, ctx: Context, files: Sequence[str],
+              kernel_labels=None, plan_shapes=None,
+              contract_names=None) -> Iterable[Finding]:
+        if kernel_labels is None:
+            kernel_labels = registry_labels(ctx)
+        if plan_shapes is None:
+            plan_shapes = []
+            for rel, name in ((DIST_REL, "DIST_PLAN_SHAPES"),
+                              (SPGEMM_REL, "SPGEMM_PLAN_SHAPES")):
+                shapes = plan_shape_literals(ctx, rel, name)
+                if shapes is None:
+                    yield Finding(
+                        rule=self.id, path=rel, line=0,
+                        message=f"{name} is missing or not a pure "
+                                f"literal tuple in {rel} — planverify "
+                                f"and this rule enumerate plan shapes "
+                                f"from it")
+                else:
+                    plan_shapes.extend(shapes)
+        if contract_names is None:
+            contract_names = list_contracts()
+
+        names = list(contract_names)
+        claimed = set()
+
+        for label in kernel_labels:
+            prefix = kernel_prefix(label)
+            hits = [n for n in names if n.startswith(prefix)]
+            claimed.update(hits)
+            if not hits:
+                yield Finding(
+                    rule=self.id, path=REGISTRY_REL, line=0,
+                    message=f"kernel label {label!r} has no committed "
+                            f"planverify contract "
+                            f"({CONTRACTS_REL}{prefix}*.json) — "
+                            f"{_UPDATE_HINT}")
+
+        for triple in plan_shapes:
+            prefix = dist_prefix(triple) + "-"
+            hits = [n for n in names if n.startswith(prefix)]
+            claimed.update(hits)
+            if not hits:
+                src = SPGEMM_REL if triple[0] == "dist_spgemm" \
+                    else DIST_REL
+                yield Finding(
+                    rule=self.id, path=src, line=0,
+                    message=f"plan shape {tuple(triple)!r} has no "
+                            f"committed planverify contract "
+                            f"({CONTRACTS_REL}{prefix}*.json) — "
+                            f"{_UPDATE_HINT}")
+
+        for name in sorted(set(names) - claimed):
+            yield Finding(
+                rule=self.id, path=CONTRACTS_REL + name, line=0,
+                message=f"contract {name} matches no registry kernel "
+                        f"label and no plan-shape triple — the "
+                        f"program it contracted no longer exists; "
+                        f"delete the file (or restore the plan shape)")
+
+    def falsifiability(self, ctx: Context) -> List[Finding]:
+        # Synthetic rot: a registered label with no contract file.
+        probe = "zz-lint-falsifiability-probe"
+        return list(self.check(
+            ctx, [], kernel_labels=registry_labels(ctx) + [probe]))

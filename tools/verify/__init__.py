@@ -1,0 +1,20 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""planverify: StableHLO/jaxpr contract verifier for compiled kernels
+and dist plans (docs/VERIFY.md).
+
+Lowers — never executes — every registered kernel and dist-plan shape
+and checks the IR against committed per-program contracts: collective
+schedule, exact comm bytes vs obs/comm, transfer freedom, and dtype
+discipline.  Import surface:
+
+- ``tools.verify.contracts`` — jax-free contract store (safe for the
+  sparselint ``plan-contract`` rule);
+- ``tools.verify.catalog`` — the program catalog (imports jax lazily
+  at build time);
+- ``tools.verify.runner`` / ``tools.verify.cli`` — the verify
+  pipeline and CLI (``python tools/planverify.py``).
+
+This ``__init__`` intentionally imports none of them: listing
+contracts must never initialize a jax backend.
+"""

@@ -1,0 +1,492 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""The verified-program catalog: every kernel the autotune registry
+dispatches and every dist-plan shape, lowered — never executed.
+
+Each entry builds a ``Built``: the program's StableHLO text (via
+``jax.jit(...).lower()``), its jaxpr, and the ``obs/comm`` byte
+prediction the comm-bytes rule cross-checks.  Builders go through the
+SAME code paths production compiles:
+
+- registry kernels through ``engine.plan_cache.plan_program`` /
+  ``lower_plan`` (single spec source — the contract is checked against
+  exactly what a plan cache miss would compile);
+- dist plans through the public dispatchers (``dist_spmv`` /
+  ``dist_spmm``) on small fixture operands over the 8-device virtual
+  CPU mesh, one program per ``DIST_PLAN_SHAPES`` /
+  ``SPGEMM_PLAN_SHAPES`` triple;
+- solver cycle bodies through the exact loop-body builders the solvers
+  dispatch (``linalg._cg_builders`` lowered against sharded
+  ``ShapeDtypeStruct`` state, ``linalg._gmres_cycle``), so
+  transfer-freedom is proven for the code that runs *inside* the
+  while_loop, where a host round-trip would sync every iteration.
+
+Two prediction scopes, and why (docs/VERIFY.md):
+
+- ``predicted``: collectives the program emits explicitly (shard_map
+  bodies).  These are visible at lower time and the comm-bytes rule
+  requires EXACT byte equality against ``obs/comm``.  ``None`` marks a
+  program whose collectives sit inside a traced-once loop body that
+  re-executes (GMRES Arnoldi), where per-dispatch totals are not a
+  lower-time quantity — schedule/transfer/dtype checks still apply.
+- ``deferred``: collectives the model prices that the SPMD partitioner
+  only materializes at COMPILE time (scalar ``jnp.vdot`` psums on
+  sharded vectors outside shard_map).  They are absent from lowered
+  IR by construction, so they are recorded in the contract as modeled
+  volumes rather than IR-checked ones.
+
+jax (and the package) import lazily inside builders: listing the
+catalog or resolving contract names must not initialize a backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Sources shared by every program: the bytes bridge and the model.
+_COMM = ("legate_sparse_tpu/obs/comm.py",)
+_KERNEL_SRC = ("legate_sparse_tpu/ops/spmv.py",
+               "legate_sparse_tpu/engine/plan_cache.py",
+               "legate_sparse_tpu/autotune/registry.py") + _COMM
+_DIST_SRC = ("legate_sparse_tpu/parallel/dist_csr.py",
+             "legate_sparse_tpu/parallel/mesh.py") + _COMM
+_SOLVER_SRC = _DIST_SRC + ("legate_sparse_tpu/linalg.py",)
+_SPGEMM_SRC = ("legate_sparse_tpu/parallel/dist_spgemm.py",
+               "legate_sparse_tpu/parallel/mesh.py") + _COMM
+
+MESH_DEVICES = 8          # virtual CPU mesh every dist fixture uses
+GRID = (2, 4)             # 2-d-block fixture grid
+N_1D = 64                 # 1-d fixture order (rows_per_shard = 8)
+N_2D = 96                 # 2-d fixture order (matches tests' grid size)
+CG_CONV_TEST_ITERS = 25   # dist_cg's default — part of the body program
+GMRES_RESTART = 4
+
+
+@dataclass(frozen=True)
+class Program:
+    """One contracted program: identity + provenance, no jax."""
+
+    pid: str                      # e.g. "dist/spmv/1d-row/halo/f32"
+    kind: str                     # "kernel" | "dist"
+    sources: Tuple[str, ...]      # repo-relative files that define it
+
+
+@dataclass
+class Built:
+    """One program's lowered artifacts + model prediction."""
+
+    hlo: str
+    jaxpr: Any
+    # Exact model volumes for explicitly-lowered collectives, keyed by
+    # ledger kind; None = loop-replayed collectives, bytes not a
+    # lower-time quantity (schedule is still contracted).
+    predicted: Optional[Dict[str, int]]
+    # Modeled volumes the partitioner inserts post-lowering.
+    deferred: Dict[str, int] = field(default_factory=dict)
+    # Declared accumulator widenings ("bf16->f32") the dtype rule
+    # permits for this program.
+    widening_allowed: Tuple[str, ...] = ()
+    notes: Dict[str, Any] = field(default_factory=dict)
+
+
+_PROGRAMS: List[Program] = []
+_BUILDERS: Dict[str, Callable[[], Built]] = {}
+_BUILT: Dict[str, Built] = {}
+
+
+def _program(pid: str, kind: str, sources: Tuple[str, ...]):
+    def deco(fn):
+        _PROGRAMS.append(Program(pid=pid, kind=kind, sources=sources))
+        _BUILDERS[pid] = fn
+        return fn
+    return deco
+
+
+def all_programs() -> List[Program]:
+    return list(_PROGRAMS)
+
+
+def get_program(pid: str) -> Program:
+    for p in _PROGRAMS:
+        if p.pid == pid:
+            return p
+    raise KeyError(pid)
+
+
+def build(pid: str) -> Built:
+    """Build (and memoize) one program's lowered artifacts."""
+    if pid not in _BUILT:
+        _BUILT[pid] = _BUILDERS[pid]()
+    return _BUILT[pid]
+
+
+def _require_devices():
+    import jax
+
+    n = len(jax.devices())
+    if n < MESH_DEVICES:
+        raise RuntimeError(
+            f"planverify needs a {MESH_DEVICES}-device virtual mesh "
+            f"(got {n}); run via tools/planverify.py, which pins "
+            f"XLA_FLAGS before jax initializes")
+
+
+# ------------------------------------------------------------------ #
+# shared fixtures (memoized; device_put of tiny arrays only —
+# contracted programs themselves are lowered, never run)
+# ------------------------------------------------------------------ #
+
+_FIX: Dict[str, Any] = {}
+
+
+def _banded_np(n: int, dtype="float32"):
+    import legate_sparse_tpu as sparse
+    import numpy as np
+
+    return sparse.diags(
+        [np.ones(n - 1), np.full(n, 4.0), np.ones(n - 1)], [-1, 0, 1],
+        shape=(n, n), format="csr", dtype=np.dtype(dtype))
+
+
+def _fix(key: str, make: Callable[[], Any]) -> Any:
+    if key not in _FIX:
+        _FIX[key] = make()
+    return _FIX[key]
+
+
+def _row_mesh():
+    from legate_sparse_tpu.parallel import make_row_mesh
+
+    _require_devices()
+    import jax
+
+    return _fix("row_mesh", lambda: make_row_mesh(
+        jax.devices()[:MESH_DEVICES]))
+
+
+def _grid_mesh():
+    from legate_sparse_tpu.parallel import make_grid_mesh
+
+    _require_devices()
+    return _fix("grid_mesh", lambda: make_grid_mesh(*GRID))
+
+
+def _dist_A(key: str, **shard_kwargs):
+    from legate_sparse_tpu.parallel import shard_csr
+
+    def make():
+        if shard_kwargs.get("layout") in ("2d-block", "1d-col"):
+            from legate_sparse_tpu.parallel import make_grid_mesh
+
+            mesh = (_grid_mesh() if shard_kwargs["layout"] == "2d-block"
+                    else _fix("col_mesh",
+                              lambda: make_grid_mesh(1, MESH_DEVICES)))
+            n = N_2D if shard_kwargs["layout"] == "2d-block" else N_1D
+            return shard_csr(_banded_np(n), mesh=mesh, **shard_kwargs)
+        return shard_csr(_banded_np(N_1D), mesh=_row_mesh(),
+                         **shard_kwargs)
+
+    return _fix(key, make)
+
+
+def _spmv_predicted(dA, itemsize: int = 4, cols: int = 1):
+    from legate_sparse_tpu.parallel.dist_csr import spmv_comm_volumes
+
+    xl = (dA.rows_padded // dA.num_shards) * cols
+    vols = spmv_comm_volumes(dA, xl, itemsize, cols=cols)
+    return {k: v for k, v in vols.items() if v > 0}
+
+
+def _lower_dist_spmv(dA, cols: int = 1):
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmm, dist_spmv, shard_dense, shard_vector,
+    )
+
+    n = dA.shape[0]
+    if cols == 1:
+        x = shard_vector(np.ones(n, np.float32), dA.mesh,
+                         dA.rows_padded, layout=dA.layout)
+        fn = lambda v: dist_spmv(dA, v)            # noqa: E731
+    else:
+        x = shard_dense(np.ones((n, cols), np.float32), dA.mesh,
+                        dA.rows_padded)
+        fn = lambda v: dist_spmm(dA, v)            # noqa: E731
+    hlo = jax.jit(fn).lower(x).as_text()
+    jaxpr = jax.make_jaxpr(fn)(x)
+    return hlo, jaxpr
+
+
+def _spmv_program(pid: str, fixture_key: str, **shard_kwargs):
+    @_program(pid, "dist", _DIST_SRC)
+    def _build():
+        dA = _dist_A(fixture_key, **shard_kwargs)
+        hlo, jaxpr = _lower_dist_spmv(dA)
+        return Built(hlo=hlo, jaxpr=jaxpr,
+                     predicted=_spmv_predicted(dA),
+                     notes={"layout": dA.layout,
+                            "shards": dA.num_shards})
+
+
+# ------------------------------------------------------------------ #
+# kernel programs (autotune registry labels x dtype class)
+# ------------------------------------------------------------------ #
+
+def _kernel_build(op: str, dtype: str, k_b: int = 1):
+    import jax
+
+    from legate_sparse_tpu.engine.plan_cache import (
+        PlanKey, lower_plan, plan_program,
+    )
+
+    key = PlanKey(op, dtype, N_1D, N_1D, 4 * N_1D, k_b=k_b)
+    hlo = lower_plan(key).as_text()
+    fn, specs, static, _name = plan_program(key)
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **static))(*specs)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 notes={"plan_id": key.plan_id})
+
+
+for _op, _pid_op, _dt, _pid_dt, _k in (
+        ("spmv", "spmv", "float32", "f32", 1),
+        ("spmv", "spmv", "bfloat16", "bf16", 1),
+        ("spmm", "spmm", "float32", "f32", 4),
+        ("spmv_multi", "spmv-multi", "float32", "f32", 3),
+):
+    _program(f"kernel/csr-rowids/{_pid_op}/{_pid_dt}", "kernel",
+             _KERNEL_SRC)(
+        lambda op=_op, dt=_dt, k=_k: _kernel_build(op, dt, k_b=k))
+
+
+def _ell_build(op: str, dtype: str, k: int = 4):
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.ops.spmv import ell_spmm, ell_spmv
+
+    sds = jax.ShapeDtypeStruct
+    dt, W = np.dtype(dtype), 3
+    specs = (sds((N_1D, W), dt), sds((N_1D, W), np.int32),
+             sds((N_1D,), np.int32))
+    if op == "spmv":
+        fn, specs = ell_spmv, specs + (sds((N_1D,), dt),)
+    else:
+        fn, specs = ell_spmm, specs + (sds((N_1D, k), dt),)
+    hlo = jax.jit(fn).lower(*specs).as_text()
+    jaxpr = jax.make_jaxpr(fn)(*specs)
+    # jnp.sum's row reduction deliberately accumulates bf16 in f32
+    # (upcast -> reduce -> cast back): the declared-accumulator case.
+    allowed = ("bf16->f32",) if dtype == "bfloat16" else ()
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 widening_allowed=allowed)
+
+
+for _op, _dt, _pid_dt in (("spmv", "float32", "f32"),
+                          ("spmv", "bfloat16", "bf16"),
+                          ("spmm", "float32", "f32")):
+    _program(f"kernel/ell/{_op}/{_pid_dt}", "kernel", _KERNEL_SRC)(
+        lambda op=_op, dt=_dt: _ell_build(op, dt))
+
+
+@_program("kernel/sliced-ell/spmv/f32", "kernel", _KERNEL_SRC)
+def _build_sliced_ell():
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.ops.spmv import (
+        sliced_ell_pack, sliced_ell_spmv,
+    )
+
+    import jax.numpy as jnp
+
+    A = _banded_np(N_1D)
+    bins = sliced_ell_pack(jnp.asarray(A.data),
+                           jnp.asarray(A.indices), A.indptr, N_1D)
+    x = jax.ShapeDtypeStruct((N_1D,), np.float32)
+    hlo = sliced_ell_spmv.lower(bins, x, rows=N_1D).as_text()
+    jaxpr = jax.make_jaxpr(
+        lambda b, v: sliced_ell_spmv(b, v, rows=N_1D))(bins, x)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 notes={"bins": len(bins)})
+
+
+# ------------------------------------------------------------------ #
+# dist_spmv / dist_spmm plan shapes
+# ------------------------------------------------------------------ #
+
+_spmv_program("dist/spmv/1d-row/halo/f32", "dA_halo")
+_spmv_program("dist/spmv/1d-row/all-gather/f32", "dA_ag",
+              force_all_gather=True)
+_spmv_program("dist/spmv/1d-row/precise/f32", "dA_precise",
+              precise=True)
+_spmv_program("dist/spmv/1d-col/panel/f32", "dA_1dcol",
+              layout="1d-col")
+_spmv_program("dist/spmv/2d-block/panel/f32", "dA_2d",
+              layout="2d-block")
+
+
+@_program("dist/spmm/1d-row/halo/f32", "dist", _DIST_SRC)
+def _build_spmm_halo():
+    k = 4
+    dA = _dist_A("dA_halo")
+    hlo, jaxpr = _lower_dist_spmv(dA, cols=k)
+    return Built(hlo=hlo, jaxpr=jaxpr,
+                 predicted=_spmv_predicted(dA, cols=k),
+                 notes={"k": k})
+
+
+# ------------------------------------------------------------------ #
+# solver cycle bodies (transfer-freedom inside the loop)
+# ------------------------------------------------------------------ #
+
+def _cg_state_specs(dA):
+    """ShapeDtypeStructs of ``linalg._cg_state0``'s tuple, with the
+    sharded-vector layout ``dist_cg`` solves over — so the body lowers
+    as the SPMD program the solver while_loop runs."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from legate_sparse_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+    from legate_sparse_tpu.types import index_dtype
+
+    spec = (P((ROW_AXIS, COL_AXIS)) if dA.grid is not None
+            else P(ROW_AXIS))
+    sh = NamedSharding(dA.mesh, spec)
+    vec = lambda: jax.ShapeDtypeStruct(                 # noqa: E731
+        (dA.rows_padded,), np.float32, sharding=sh)
+    scal = lambda dt: jax.ShapeDtypeStruct((), dt)      # noqa: E731
+    idx = index_dtype()
+    return (vec(), vec(), vec(), scal(np.float32), scal(idx),
+            scal(np.bool_), scal(np.float32), scal(idx))
+
+
+def _cg_body_build(fixture_key: str, **shard_kwargs):
+    import jax
+
+    from legate_sparse_tpu.linalg import _cg_builders
+    from legate_sparse_tpu.obs import comm as _comm
+
+    dA = _dist_A(fixture_key, **shard_kwargs)
+    _cond, body = _cg_builders(dA.matvec_fn(), lambda r: r,
+                               CG_CONV_TEST_ITERS)
+    state = _cg_state_specs(dA)
+    hlo = jax.jit(body).lower(state).as_text()
+    jaxpr = jax.make_jaxpr(body)(state)
+    # The body's three scalar vdots (rho, pq, ||r||^2) psum at COMPILE
+    # time (partitioner-inserted): modeled, deferred, not in the IR.
+    deferred = {"psum": 3 * _comm.psum_bytes(1, 4, dA.num_shards)}
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted=_spmv_predicted(dA),
+                 deferred=deferred,
+                 notes={"conv_test_iters": CG_CONV_TEST_ITERS})
+
+
+_program("dist/cg/1d-row/halo/f32", "dist", _SOLVER_SRC)(
+    lambda: _cg_body_build("dA_halo"))
+_program("dist/cg/2d-block/panel/f32", "dist", _SOLVER_SRC)(
+    lambda: _cg_body_build("dA_2d", layout="2d-block"))
+
+
+@_program("dist/gmres/1d-row/halo/f32", "dist", _SOLVER_SRC)
+def _build_gmres_cycle():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from legate_sparse_tpu.linalg import _gmres_cycle
+    from legate_sparse_tpu.parallel.mesh import ROW_AXIS
+
+    dA = _dist_A("dA_halo")
+    sh = NamedSharding(dA.mesh, P(ROW_AXIS))
+    vec = jax.ShapeDtypeStruct((dA.rows_padded,), np.float32,
+                               sharding=sh)
+    A_mv, M_mv = dA.matvec_fn(), lambda r: r
+    fn = lambda x, b: _gmres_cycle(A_mv, M_mv, x, b,   # noqa: E731
+                                   GMRES_RESTART)
+    hlo = jax.jit(fn).lower(vec, vec).as_text()
+    jaxpr = jax.make_jaxpr(fn)(vec, vec)
+    # The Arnoldi fori_loop body traces ONCE but runs restart times:
+    # per-dispatch byte totals are not a lower-time quantity, so the
+    # bytes rule is scoped out (predicted=None) while the schedule,
+    # transfer and dtype contracts still bind.
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted=None,
+                 notes={"restart": GMRES_RESTART, "loops": True})
+
+
+# ------------------------------------------------------------------ #
+# dist_spgemm phase-1 (product count) programs
+# ------------------------------------------------------------------ #
+
+@_program("dist/spgemm/1d-row/all-gather/f32", "dist", _SPGEMM_SRC)
+def _build_spgemm_1d():
+    import jax
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.obs import comm as _comm
+    from legate_sparse_tpu.parallel.dist_spgemm import (
+        _esc_t_fn, _layout_of,
+    )
+
+    dA = _dist_A("dA_spgemm", force_all_gather=True)
+    la = lb = _layout_of(dA)
+    R = dA.num_shards
+    placeholder = jnp.zeros((R, 1), dtype=jnp.int32)
+
+    def arrays_of(M):
+        return (
+            M.data, M.cols,
+            M.counts if M.counts is not None else placeholder,
+            M.row_ids if M.row_ids is not None else placeholder,
+            M.gather_globals if M.gather_globals is not None
+            else placeholder,
+        )
+
+    args = arrays_of(dA) + arrays_of(dA)
+    fn = _esc_t_fn(dA.mesh, la, lb, None)
+    hlo = fn.lower(*args).as_text()
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    # Phase 1 all_gathers B's structural arrays (counts; plus row_ids
+    # for non-ELL layouts) along the row axis — price each gathered
+    # block by its per-shard element count.
+    gathered = [arrays_of(dA)[2]] if lb.ell else [
+        arrays_of(dA)[2], arrays_of(dA)[3]]
+    ag = sum(
+        _comm.all_gather_bytes(
+            int(a.size) // R, a.dtype.itemsize, R)
+        for a in gathered)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={"all_gather": ag},
+                 notes={"ell": bool(lb.ell), "phase": "count"})
+
+
+@_program("dist/spgemm/2d-block/panel/f32", "dist", _SPGEMM_SRC)
+def _build_spgemm_2d():
+    import jax
+
+    from legate_sparse_tpu.obs import comm as _comm
+    from legate_sparse_tpu.parallel.dist_spgemm import _esc2d_t_fn
+
+    dA = _dist_A("dA_2d", layout="2d-block")
+    Rr, Rc = dA.grid
+    fn = _esc2d_t_fn(dA.mesh, dA.cols_per_shard, dA.rows_per_shard)
+    args = (dA.cols, dA.counts, dA.row_ids, dA.counts)
+    hlo = fn.lower(*args).as_text()
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    # Four structural gathers: A cols + counts along mesh cols (Rr
+    # groups of Rc), B row_ids + counts along mesh rows (Rc groups of
+    # Rr) — the SUMMA phase-1 terms of obs/comm, op by op.
+    capA = int(dA.cols.shape[-1])
+    capB = int(dA.row_ids.shape[-1])
+    ag = (
+        Rr * _comm.all_gather_bytes(capA, dA.cols.dtype.itemsize, Rc)
+        + Rr * _comm.all_gather_bytes(1, dA.counts.dtype.itemsize, Rc)
+        + Rc * _comm.all_gather_bytes(capB,
+                                      dA.row_ids.dtype.itemsize, Rr)
+        + Rc * _comm.all_gather_bytes(1, dA.counts.dtype.itemsize, Rr)
+    )
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={"all_gather": ag},
+                 notes={"grid": [Rr, Rc], "phase": "count"})

@@ -1,0 +1,166 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""planverify CLI (see ``tools/planverify.py`` for the entry shim,
+which pins the virtual CPU mesh before jax initializes).
+
+Exit codes match sparselint: 0 = no active findings; 1 = findings;
+2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+from . import catalog, rules
+from .runner import (
+    DEFAULT_BASELINE, run_verify, select_programs, update_contracts,
+    write_baseline,
+)
+
+
+def changed_files(repo: str):
+    """Repo-relative paths touched vs HEAD (unstaged + staged +
+    untracked) — same selection as sparselint --changed."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            text = subprocess.run(
+                args, cwd=repo, capture_output=True, text=True,
+                check=True).stdout
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise RuntimeError(f"--changed needs git: {e}") from e
+        out.update(l.strip() for l in text.splitlines() if l.strip())
+    return sorted(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="planverify",
+        description="StableHLO/jaxpr contract verifier for compiled "
+                    "kernels and dist plans: lowers every registered "
+                    "program (never executes) and checks collective "
+                    "schedule, comm bytes, transfer freedom and dtype "
+                    "discipline against committed contracts "
+                    "(docs/VERIFY.md).")
+    ap.add_argument("programs", nargs="*",
+                    help="program ids to verify (default: the full "
+                         "catalog; see --list-programs)")
+    ap.add_argument("--changed", action="store_true",
+                    help="verify only programs whose source modules "
+                         "or contract files differ from git HEAD")
+    ap.add_argument("--rules",
+                    help="comma-separated rule ids to run (default: "
+                         "all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings artifact on "
+                         "stdout (tools/doctor.py ingests this)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: "
+                         "tools/verify/baseline.json); 'none' "
+                         "disables")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "active findings and exit 0")
+    ap.add_argument("--update-contracts", action="store_true",
+                    help="regenerate the committed contract files "
+                         "from the current lowered IR (requires "
+                         "--reason)")
+    ap.add_argument("--reason",
+                    help="justification committed into regenerated "
+                         "contracts (required with "
+                         "--update-contracts)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--list-programs", action="store_true",
+                    help="print the program catalog and exit")
+    args = ap.parse_args(argv)
+
+    registry = rules.all_rules()
+    if args.list_rules:
+        width = max(len(r) for r in registry)
+        for rid in sorted(registry):
+            print(f"{rid.ljust(width)}  {registry[rid].description}")
+        return 0
+    if args.list_programs:
+        for p in catalog.all_programs():
+            print(p.pid)
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",")
+                    if r.strip()]
+        unknown = sorted(set(rule_ids) - set(registry))
+        if unknown:
+            print(f"planverify: unknown rule(s): "
+                  f"{', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        if args.changed:
+            progs = select_programs(selection=changed_files(repo))
+        elif args.programs:
+            progs = select_programs(program_ids=args.programs)
+        else:
+            progs = select_programs()
+    except (RuntimeError, KeyError) as e:
+        print(f"planverify: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_contracts:
+        if not args.reason or not args.reason.strip():
+            print("planverify: --update-contracts requires a "
+                  "non-empty --reason", file=sys.stderr)
+            return 2
+        paths = update_contracts(args.reason, programs=progs)
+        for p in paths:
+            print(f"planverify: wrote {os.path.relpath(p, repo)}")
+        return 0
+
+    if not progs:
+        print("planverify: nothing to verify for this selection")
+        return 0
+
+    baseline = None if args.baseline == "none" else args.baseline
+    if args.update_baseline:
+        res = run_verify(programs=progs, rule_ids=rule_ids,
+                         baseline_path=None)
+        write_baseline(baseline or DEFAULT_BASELINE, res.active)
+        print(f"planverify: baseline rewritten with "
+              f"{len(res.active)} entry(ies) -> "
+              f"{baseline or DEFAULT_BASELINE}")
+        return 0
+
+    res = run_verify(programs=progs, rule_ids=rule_ids,
+                     baseline_path=baseline)
+
+    if args.as_json:
+        print(json.dumps(res.to_json(), indent=1, sort_keys=True))
+        return res.exit_code
+
+    for f in res.active:
+        print(f.render())
+    for key in res.stale_baseline:
+        print(f"planverify: stale baseline entry {key!r} matched "
+              f"nothing — remove it", file=sys.stderr)
+    n_base = len(res.baselined)
+    extra = f" ({n_base} baselined)" if n_base else ""
+    if res.active:
+        print(f"planverify: FAILED — {len(res.active)} finding(s) "
+              f"across {len(res.rules_run)} rule(s), "
+              f"{len(res.programs_checked)} program(s){extra}",
+              file=sys.stderr)
+        return 1
+    print(f"planverify: OK — 0 findings across "
+          f"{len(res.rules_run)} rule(s), "
+          f"{len(res.programs_checked)} program(s){extra}")
+    return 0

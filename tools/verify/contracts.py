@@ -1,0 +1,92 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Contract storage for planverify — deliberately jax-free.
+
+A *contract* is one committed JSON file per verified program under
+``tools/verify/contracts/``, recording the collective schedule, byte
+volumes, custom-call allowlist, transfer-freedom bit and dtype
+allowances the lowered IR exhibited when the contract was last
+(re)generated with ``--update-contracts --reason "..."``.  Program ids
+are hierarchical (``dist/spmv/1d-row/halo/f32``); filenames are the
+mechanical kebab-case flattening so the sparselint ``plan-contract``
+rule can map registry labels and plan-shape triples to expected files
+without importing jax (this module is its only planverify import).
+
+Contracts are committed artifacts: no timestamps or machine-local
+paths, sorted keys, one canonical rendering — regenerating without an
+IR change must produce a byte-identical file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+CONTRACT_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "contracts")
+
+CONTRACT_VERSION = 1
+
+
+def slug(part: str) -> str:
+    """Kebab-case one program-id path segment (``dist_spmv`` and
+    ``dist/spmv`` flatten identically — ids are mechanical)."""
+    return part.replace("_", "-").replace("/", "-").lower()
+
+
+def contract_name(program_id: str) -> str:
+    return slug(program_id) + ".json"
+
+
+def contract_path(program_id: str,
+                  contracts_dir: Optional[str] = None) -> str:
+    return os.path.join(contracts_dir or CONTRACT_DIR,
+                        contract_name(program_id))
+
+
+def kernel_prefix(label: str) -> str:
+    """Expected contract-filename prefix for one autotune registry
+    kernel label (``csr-rowids`` -> ``kernel-csr-rowids-``)."""
+    return "kernel-" + slug(label) + "-"
+
+
+def dist_prefix(shape_triple) -> str:
+    """Expected contract-filename prefix for one dist plan-shape
+    triple (``("dist_spmv", "1d-row", "halo")`` ->
+    ``dist-spmv-1d-row-halo``)."""
+    op, layout, realization = shape_triple
+    return "-".join(slug(p) for p in (op, layout, realization))
+
+
+def list_contracts(contracts_dir: Optional[str] = None) -> List[str]:
+    """Committed contract filenames, sorted."""
+    d = contracts_dir or CONTRACT_DIR
+    if not os.path.isdir(d):
+        return []
+    return sorted(f for f in os.listdir(d) if f.endswith(".json"))
+
+
+def load_contract(program_id: str,
+                  contracts_dir: Optional[str] = None
+                  ) -> Optional[Dict]:
+    p = contract_path(program_id, contracts_dir)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def write_contract(program_id: str, payload: Dict,
+                   contracts_dir: Optional[str] = None) -> str:
+    d = contracts_dir or CONTRACT_DIR
+    os.makedirs(d, exist_ok=True)
+    p = contract_path(program_id, contracts_dir)
+    if payload.get("version") != CONTRACT_VERSION:
+        raise ValueError(
+            f"contract payload for {program_id} has version "
+            f"{payload.get('version')!r}, expected {CONTRACT_VERSION}")
+    with open(p, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return p

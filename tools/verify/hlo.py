@@ -1,0 +1,306 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""StableHLO-text and jaxpr readers for planverify.
+
+Everything here is a *reader*: pure functions from lowered-IR text (or
+a traced jaxpr object) to small structured summaries the rules compare
+against contracts.  No jax import at module level — the jaxpr walkers
+duck-type on ``.eqns``/``.jaxpr`` so this module stays importable by
+jax-free callers (the sparselint plan-contract rule imports the
+package's contract helpers, which must not drag a backend in).
+
+StableHLO syntax assumptions (validated against jax 0.4.x CPU
+lowerings of shard_map programs — see tests/test_verify.py, which
+re-validates them on every run so a jax upgrade that changes the
+printing breaks loudly here, not silently in a contract):
+
+- collectives print in the quoted generic form::
+
+    %2 = "stablehlo.collective_permute"(%0) <{channel_handle = ...,
+        source_target_pairs = dense<[[0, 1], [1, 2]]> :
+        tensor<8x2xi64>}> : (tensor<1xf32>) -> tensor<1xf32>
+
+- ``all_gather``/``all_reduce``/``reduce_scatter``/``all_to_all``
+  carry ``replica_groups = dense<...> : tensor<GxSxi64>`` (G groups of
+  S participants); ``reduce_scatter``/``all_reduce`` interpose a
+  reduction region ``({ ... })`` before the type signature, so the
+  operand type is read *after* the balanced region close.
+- host round-trips surface as ``stablehlo.custom_call`` with an
+  ``@target`` (pretty form) or ``call_target_name = "..."`` (generic
+  form), or as ``stablehlo.infeed``/``outfeed``/``send``/``recv``.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+COLLECTIVE_KINDS = (
+    "collective_permute", "all_gather", "all_reduce",
+    "reduce_scatter", "all_to_all",
+)
+
+# IR op name -> the comm-ledger kind obs/comm.py prices.  Both
+# all_reduce and reduce_scatter settle into the ledger's "psum" bucket:
+# the model prices the *reduction*, the partitioner picks the op.
+MODEL_KIND = {
+    "collective_permute": "ppermute",
+    "all_gather": "all_gather",
+    "all_reduce": "psum",
+    "reduce_scatter": "psum",
+    "all_to_all": "all_to_all",
+}
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4,
+    "i16": 2, "ui16": 2, "i8": 1, "ui8": 1, "i1": 1,
+    "complex<f32>": 8, "complex<f64>": 16,
+}
+
+# Float widths for the widening check (HLO side).
+_FLOAT_WIDTH = {"f8E4M3FN": 1, "f8E5M2": 1, "bf16": 2, "f16": 2,
+                "f32": 4, "f64": 8}
+
+_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([^>]+(?:<[^>]*>)?)>")
+_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<.*?>\s*:\s*tensor<(\d+)x(\d+)xi64>",
+    re.S)
+_PAIRS_SHAPE_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<(.*?)>\s*:\s*tensor<(\d+)x2xi64>",
+    re.S)
+_PAIR_RE = re.compile(r"\[\s*(-?\d+)\s*,\s*(-?\d+)\s*\]")
+_SIG_RE = re.compile(r":\s*\(([^)]*)\)\s*->")
+_CUSTOM_CALL_AT_RE = re.compile(
+    r"stablehlo\.custom_call\s*@([A-Za-z_][\w.\-]*)")
+_CUSTOM_CALL_NAME_RE = re.compile(r'call_target_name\s*=\s*"([^"]+)"')
+_CONVERT_RE = re.compile(
+    r"stablehlo\.convert[^\n]*:\s*\(tensor<((?:\d+x)*)(\w+)>\)\s*->\s*"
+    r"tensor<(?:\d+x)*(\w+)>")
+_INOUT_FEED_RE = re.compile(
+    r'"?stablehlo\.(infeed|outfeed|send|recv)"?[ ("]')
+
+
+def tensor_bytes(type_str: str) -> int:
+    """Byte size of one ``tensor<...>`` type (scalar tensors = one
+    element)."""
+    m = _TENSOR_RE.search(type_str)
+    if not m:
+        raise ValueError(f"not a tensor type: {type_str!r}")
+    dims, dtype = m.group(1), m.group(2).strip()
+    n = 1
+    for d in dims.split("x"):
+        if d:
+            n *= int(d)
+    try:
+        return n * _DTYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown element type {dtype!r} in "
+                         f"{type_str!r}") from None
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One lowered collective, in program order."""
+
+    kind: str                 # IR op name (COLLECTIVE_KINDS)
+    operand_bytes: int        # first-operand payload size
+    n_pairs: int = 0          # collective_permute: total pairs
+    moved_pairs: int = 0      # collective_permute: non-self pairs
+    # replica_groups shape (n_groups, group_size); None for permutes.
+    groups: Optional[Tuple[int, int]] = None
+
+    @property
+    def model_kind(self) -> str:
+        return MODEL_KIND[self.kind]
+
+    def signature(self) -> dict:
+        """JSON-stable schedule entry (what contracts commit)."""
+        return {
+            "kind": self.kind,
+            "operand_bytes": self.operand_bytes,
+            "moved_pairs": self.moved_pairs if
+            self.kind == "collective_permute" else None,
+            "groups": list(self.groups) if self.groups else None,
+        }
+
+
+def _region_end(text: str, start: int) -> int:
+    """Index just past the balanced ``{...}`` region opening at
+    ``text[start]`` (which must be '{')."""
+    depth = 0
+    for i in range(start, len(text)):
+        c = text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    raise ValueError("unbalanced region in StableHLO text")
+
+
+def parse_collectives(text: str) -> List[CollectiveOp]:
+    """All collective ops in ``text``, in textual (= program) order."""
+    ops: List[CollectiveOp] = []
+    for m in re.finditer(
+            r'"stablehlo\.(%s)"' % "|".join(COLLECTIVE_KINDS), text):
+        kind = m.group(1)
+        # Attribute block <{...}> directly after the operand list.
+        am = re.compile(r"<\{(.*?)\}>", re.S).search(text, m.end())
+        if am is None:
+            raise ValueError(f"collective {kind} without attributes "
+                             f"near offset {m.start()}")
+        attrs = am.group(1)
+        pos = am.end()
+        # Skip an optional reduction region "({ ... })" before the
+        # type signature (all_reduce / reduce_scatter).
+        rm = re.compile(r"\s*\(\s*\{").match(text, pos)
+        if rm:
+            pos = _region_end(text, text.index("{", pos))
+            # past the region's closing ')'
+            pos = text.index(")", pos) + 1
+        sm = _SIG_RE.search(text, pos)
+        if sm is None:
+            raise ValueError(f"collective {kind} without a type "
+                             f"signature near offset {m.start()}")
+        first_operand = sm.group(1).split(",")[0]
+        ob = tensor_bytes(first_operand)
+
+        n_pairs = moved = 0
+        groups = None
+        pm = _PAIRS_SHAPE_RE.search(attrs)
+        if pm:
+            n_pairs = int(pm.group(2))
+            pairs = _PAIR_RE.findall(pm.group(1))
+            if pairs:
+                moved = sum(1 for s, t in pairs if s != t)
+            # splat form dense<v> means every pair is (v, v): moved 0
+        gm = _GROUPS_RE.search(attrs)
+        if gm:
+            groups = (int(gm.group(1)), int(gm.group(2)))
+        ops.append(CollectiveOp(kind=kind, operand_bytes=ob,
+                                n_pairs=n_pairs, moved_pairs=moved,
+                                groups=groups))
+    return ops
+
+
+def parse_custom_calls(text: str) -> List[str]:
+    """Custom-call targets in textual order (pretty ``@target`` and
+    generic ``call_target_name`` forms)."""
+    hits = [(m.start(), m.group(1))
+            for m in _CUSTOM_CALL_AT_RE.finditer(text)]
+    hits += [(m.start(), m.group(1))
+             for m in _CUSTOM_CALL_NAME_RE.finditer(text)]
+    return [t for _, t in sorted(hits)]
+
+
+def parse_feeds(text: str) -> List[str]:
+    """infeed/outfeed/send/recv op names present in the text."""
+    return sorted({m.group(1) for m in _INOUT_FEED_RE.finditer(text)})
+
+
+def hlo_widening_converts(text: str) -> List[str]:
+    """``"src->dst"`` strings for every float-widening
+    ``stablehlo.convert`` in the text."""
+    out = []
+    for m in _CONVERT_RE.finditer(text):
+        src, dst = m.group(2), m.group(3)
+        if (src in _FLOAT_WIDTH and dst in _FLOAT_WIDTH
+                and _FLOAT_WIDTH[dst] > _FLOAT_WIDTH[src]):
+            out.append(f"{src}->{dst}")
+    return out
+
+
+# ------------------------------------------------------------------ #
+# jaxpr walking (duck-typed: no jax import)
+# ------------------------------------------------------------------ #
+
+# Primitives that round-trip through the host.  ``debug_callback`` is
+# included deliberately: a debug print inside a solver loop body is a
+# per-iteration host sync on real hardware.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+# Loop-carrying primitives: a callback under one of these runs every
+# iteration, the worst case the transfer rule calls out specially.
+LOOP_PRIMS = frozenset({"while", "scan"})
+
+
+def _param_jaxprs(value: Any) -> Iterator[Any]:
+    """Yield jaxpr-like objects inside one eqn param value."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _param_jaxprs(v)
+
+
+def iter_eqns(jaxpr: Any, ancestors: Tuple[str, ...] = ()
+              ) -> Iterator[Tuple[Any, Tuple[str, ...]]]:
+    """Depth-first ``(eqn, ancestor-primitive-names)`` over a (closed)
+    jaxpr, recursing into while/scan/cond/pjit/shard_map bodies."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn, ancestors
+        for v in eqn.params.values():
+            for sub in _param_jaxprs(v):
+                yield from iter_eqns(
+                    sub, ancestors + (eqn.primitive.name,))
+
+
+def host_callbacks(jaxpr: Any) -> List[Tuple[str, bool]]:
+    """``(primitive, inside_loop_body)`` for every host-round-trip
+    primitive anywhere in the jaxpr."""
+    out = []
+    for eqn, anc in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMS:
+            out.append((name, any(a in LOOP_PRIMS for a in anc)))
+    return out
+
+
+_SHORT = {
+    "float64": "f64", "float32": "f32", "float16": "f16",
+    "bfloat16": "bf16", "complex64": "c64", "complex128": "c128",
+    "float8_e4m3fn": "f8E4M3FN", "float8_e5m2": "f8E5M2",
+}
+
+
+def _short(dtype: Any) -> str:
+    name = getattr(dtype, "name", str(dtype))
+    return _SHORT.get(name, name)
+
+
+def jaxpr_widening_converts(jaxpr: Any) -> List[Tuple[str, bool]]:
+    """``("src->dst", inside_loop_body)`` for every float-widening
+    ``convert_element_type`` in the jaxpr (ints/bools are exempt —
+    dtype discipline is about silent precision inflation of values,
+    not index bookkeeping)."""
+    import numpy as np
+
+    # jax is necessarily importable here (the caller holds a jaxpr);
+    # its dtype lattice knows the ml_dtypes floats (bf16/f8*) whose
+    # raw numpy kind is 'V', not 'f'.
+    from jax.dtypes import issubdtype as _issub
+
+    def _floatish(dt):
+        return _issub(dt, np.floating) or _issub(dt, np.complexfloating)
+
+    out = []
+    for eqn, anc in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = np.dtype(eqn.invars[0].aval.dtype)
+        dst = np.dtype(eqn.params["new_dtype"])
+        if (_floatish(src) and _floatish(dst)
+                and dst.itemsize > src.itemsize):
+            out.append((f"{_short(src)}->{_short(dst)}",
+                        any(a in LOOP_PRIMS for a in anc)))
+    return out

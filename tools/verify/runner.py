@@ -1,0 +1,148 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""planverify runner: build programs, apply rules, classify findings.
+
+Same disposition pipeline as sparselint (tools/lint/core.py) minus
+inline suppression — findings attach to lowered programs, not source
+lines, so there is no line to annotate; exemptions go through the
+committed contract (``widening_allowed``, regenerated schedules) or,
+as a last resort, the baseline.  Baseline keys are the shared
+line-free ``(rule, path, message)`` triple from tools/common, with
+the same stale-entry reporting so grandfathered drift shrinks instead
+of rotting.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common.findings import (  # noqa: F401  (re-export for CLI)
+    Finding, load_baseline, write_baseline,
+)
+from . import catalog, rules
+from .contracts import contract_name, load_contract, write_contract
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+# Edits under these prefixes re-verify EVERY program: the verifier or
+# the shared byte model itself changed.
+_GLOBAL_PREFIXES = ("tools/verify/", "tools/common/",
+                    "legate_sparse_tpu/obs/comm.py")
+
+
+@dataclass
+class Result:
+    """One verify run's outcome, pre-split by disposition."""
+
+    active: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[Tuple[str, str, str]] = field(
+        default_factory=list)
+    rules_run: List[str] = field(default_factory=list)
+    programs_checked: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "tool": "planverify",
+            "findings": [asdict(f) for f in self.active],
+            "baselined": [asdict(f) for f in self.baselined],
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m}
+                for (r, p, m) in self.stale_baseline],
+            "rules_run": self.rules_run,
+            "programs_checked": self.programs_checked,
+            "exit_code": self.exit_code,
+        }
+
+
+def select_programs(selection: Optional[Sequence[str]] = None,
+                    program_ids: Optional[Sequence[str]] = None
+                    ) -> List[catalog.Program]:
+    """Catalog programs to verify.  ``selection`` is a changed-file
+    list (``--changed``): a program re-verifies when one of its source
+    modules, its contract file, or the verifier itself changed."""
+    progs = catalog.all_programs()
+    if program_ids is not None:
+        wanted = set(program_ids)
+        progs = [p for p in progs if p.pid in wanted]
+        missing = wanted - {p.pid for p in progs}
+        if missing:
+            raise KeyError(
+                f"unknown program id(s): {', '.join(sorted(missing))}")
+    if selection is None:
+        return progs
+    sel = {s.replace(os.sep, "/") for s in selection}
+    if any(s.startswith(_GLOBAL_PREFIXES) for s in sel):
+        return progs
+    out = []
+    for p in progs:
+        cpath = "tools/verify/contracts/" + contract_name(p.pid)
+        if cpath in sel or any(s in p.sources for s in sel):
+            out.append(p)
+    return out
+
+
+def run_verify(programs: Optional[Sequence[catalog.Program]] = None,
+               rule_ids: Optional[Sequence[str]] = None,
+               baseline_path: Optional[str] = DEFAULT_BASELINE,
+               contracts_dir: Optional[str] = None) -> Result:
+    """Lower every selected program and run the rule set.
+
+    ``baseline_path=None`` disables baselining; ``contracts_dir``
+    overrides the committed contract directory (tests)."""
+    registry = rules.all_rules()
+    rule_list = ([registry[r] for r in rule_ids] if rule_ids
+                 else [registry[k] for k in sorted(registry)])
+    progs = (list(programs) if programs is not None
+             else catalog.all_programs())
+
+    res = Result(rules_run=[r.id for r in rule_list])
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    consumed: Dict[Tuple[str, str, str], int] = {}
+
+    for prog in progs:
+        res.programs_checked.append(prog.pid)
+        built = catalog.build(prog.pid)
+        contract = load_contract(prog.pid, contracts_dir)
+        for rule in rule_list:
+            for f in sorted(rule.check(prog, built, contract),
+                            key=lambda f: (f.path, f.rule, f.message)):
+                key = f.baseline_key()
+                if baseline.get(key, 0) > consumed.get(key, 0):
+                    consumed[key] = consumed.get(key, 0) + 1
+                    res.baselined.append(f)
+                else:
+                    res.active.append(f)
+
+    for key, n in sorted(baseline.items()):
+        if consumed.get(key, 0) < n:
+            res.stale_baseline.append(key)
+    return res
+
+
+def update_contracts(reason: str,
+                     programs: Optional[
+                         Sequence[catalog.Program]] = None,
+                     contracts_dir: Optional[str] = None) -> List[str]:
+    """Regenerate contract files from the current lowered IR.  The
+    mandatory ``reason`` is committed into each file — contract churn
+    must carry its justification through review."""
+    if not reason or not reason.strip():
+        raise ValueError("--update-contracts requires a non-empty "
+                         "--reason")
+    progs = (list(programs) if programs is not None
+             else catalog.all_programs())
+    paths = []
+    for prog in progs:
+        built = catalog.build(prog.pid)
+        payload = rules.contract_payload(prog, built, reason.strip())
+        paths.append(write_contract(prog.pid, payload, contracts_dir))
+    return paths
