@@ -659,8 +659,16 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # obs-overhead probe (docs/OBSERVABILITY.md): the SpMV micro-loop
 # re-timed with spans on vs off — ``obs_overhead_pct`` records the
 # toggled tracing tax on the hot path (clamped at 0; the always-on
-# counters/histograms appear in both arms by design).
-SCHEMA_VERSION = 14
+# counters/histograms appear in both arms by design).  15 =
+# compressed-storage byte columns (``csr_array.compress``): the
+# deterministic per-nnz traffic models ``spmv_bytes_per_nnz`` /
+# ``spmv_bytes_per_nnz_bf16`` (golden-pinned exactly), the
+# compressed pde anchor ``pde_bytes_per_iter_bf16`` /
+# ``pde_ms_per_iter_bf16`` / ``pde_bytes_ratio`` (full lane), and
+# the 2-D dist panel field ``dist2d_spmv_comm_bytes_bf16`` — bf16
+# panels + int16 block-local indices, exactly half the f32 panel
+# bytes, golden-gated through the 1% ``*_comm_bytes`` band.
+SCHEMA_VERSION = 15
 
 
 def main() -> None:
@@ -833,6 +841,22 @@ def main() -> None:
             "dia" if A._get_dia() is not None
             else "ell" if A._get_ell() is not None else "csr"
         )
+        # Storage-traffic trajectory columns (schema 15): the byte
+        # model per nonzero, canonical f32 vs compressed storage
+        # (``csr_array.compress``: bf16 values + narrowed indices)
+        # against the same f32 operand.  Deterministic — the model
+        # reads actual storage itemsizes — so the smoke golden pins
+        # both exactly.
+        result["spmv_bytes_per_nnz"] = round(
+            _spmv_bytes(A, x) / A.nnz, 4)
+        try:
+            C_s = A.compress()
+            result["spmv_bytes_per_nnz_bf16"] = round(
+                _spmv_bytes(C_s, x) / C_s.nnz, 4)
+            del C_s
+        except Exception as e:
+            sys.stderr.write(
+                f"bench: compressed spmv bytes failed: {e!r}\n")
         if stream:
             frac = round(bw / stream, 4)
             # The contract metric must not be satisfiable by the CPU
@@ -1282,6 +1306,29 @@ def main() -> None:
                 except RuntimeError as e:
                     sys.stderr.write(
                         f"bench: dist2d spmv timing: {e}\n")
+                # Compressed panels (schema 15): the same matrix
+                # through ``compress()`` — bf16 panel values, int16
+                # block-local indices — with a bf16 x, priced by the
+                # SAME ledger formulas as the f32 field (itemsize 2):
+                # the all_gather panel bytes exactly halve, and the
+                # golden pins the halved total through the 1%
+                # ``*_comm_bytes`` gate.  One dispatch exercises the
+                # low-precision 2-D kernel for real.
+                try:
+                    dC2 = shard_csr(A_2.compress(), mesh=mesh_g,
+                                    layout=dA2.layout)
+                    volsb = spmv_comm_volumes(
+                        dC2, dC2.rows_padded // dC2.num_shards, 2)
+                    result["dist2d_spmv_comm_bytes_bf16"] = sum(
+                        volsb.values())
+                    xb_2 = shard_vector(
+                        jnp.ones(n_2, jnp.bfloat16), mesh_g,
+                        dC2.rows_padded, layout=dC2.layout)
+                    _ = float(jnp.sum(dist_spmv(dC2, xb_2)))
+                    del dC2, xb_2
+                except Exception as e:
+                    sys.stderr.write(
+                        f"bench: dist2d compressed failed: {e!r}\n")
                 # Fixed-iteration CG, as in the 1-D dist phase: the
                 # iteration count and so the comm volume are
                 # deterministic across machines.
@@ -1923,6 +1970,31 @@ def main() -> None:
             result["pde_grid"] = f"{grid_p}x{grid_p}"
             result["pde_ms_per_iter"] = round(ms_p, 3)
             result["pde_bytes_per_iter"] = by_p
+            # Compressed-pipeline anchor (schema 15): the same
+            # explicit update with bf16 operator AND state — the
+            # magnitude-stable chain tolerates rounded state, and
+            # compressed banded storage drops the DIA hole mask
+            # (zero-filled band, ``compress()`` docstring), so the
+            # iteration streams 16 bytes/row against f32's 37:
+            # the recorded ratio is the tentpole's byte win.
+            try:
+                C_p = A_p.compress()
+                vb_p = x_p.astype(jnp.bfloat16)
+                bb_p = b_p.astype(jnp.bfloat16)
+
+                def pde_step_bf16(v):
+                    return v - 0.25 * (C_p @ v) + bb_p
+
+                ms_pb = loop_ms_per_iter(pde_step_bf16, vb_p,
+                                         k_lo=2, k_hi=8)
+                by_pb = _spmv_bytes(C_p, vb_p) + 2 * np2
+                result["pde_ms_per_iter_bf16"] = round(ms_pb, 3)
+                result["pde_bytes_per_iter_bf16"] = by_pb
+                result["pde_bytes_ratio"] = round(by_p / by_pb, 4)
+                del C_p, vb_p, bb_p
+            except Exception as e:
+                sys.stderr.write(
+                    f"bench: compressed pde failed: {e!r}\n")
             if stream:
                 bound_p = by_p / (stream * 1e9) * 1e3
                 result["pde_stream_bound_ms"] = round(bound_p, 3)

@@ -19,8 +19,10 @@ ranking depends on structure the thresholds can't see:
 Routing (``route_matvec`` / ``route_matmat``, consulted by
 ``csr_array.dot`` right after the engine rung) serves a stored verdict
 or silently declines — tuning off (``LEGATE_SPARSE_TPU_AUTOTUNE``
-unset, the default), tracer contexts, dtype promotion, DIA/BSR
-structure, or a store miss all fall through to today's heuristics.
+unset, the default), tracer contexts, dtype promotion (save the
+declared bf16/f16 -> f32 widening, which the ``*-bf16`` candidates
+serve), DIA/BSR structure, or a store miss all fall through to
+today's heuristics.
 The engine consults :func:`plan_preference` in its eligibility check
 and defers to any verdict naming a non-CSR kernel.
 
@@ -101,9 +103,19 @@ def _route(A, operand, op: str):
                                       operand):
         _obs.inc("autotune.route.decline")
         return None  # ambient trace / tracer operands: caches would leak
+    widening = False
     if np.result_type(A.dtype, operand.dtype) != A.dtype:
-        _obs.inc("autotune.route.decline")
-        return None  # promotion: verdicts are keyed on the matrix dtype
+        # Promotion: verdicts are keyed on the matrix dtype.  The one
+        # declared exception is the low-precision-storage widening
+        # (bf16/f16 matrix x f32 operand -> f32): the ``*-bf16``
+        # candidates accumulate in f32 anyway, so their routed output
+        # is bit-for-bit the direct dispatch under promotion.
+        widening = (str(A.dtype) in ("bfloat16", "float16")
+                    and np.result_type(A.dtype, operand.dtype)
+                    == np.float32)
+        if not widening:
+            _obs.inc("autotune.route.decline")
+            return None
     if A._get_dia() is not None or A._get_bsr() is not None:
         _obs.inc("autotune.route.decline")
         return None  # structure-specialized paths keep priority
@@ -125,6 +137,13 @@ def _route(A, operand, op: str):
     if cand is None or op not in cand.ops or not cand.eligible(A):
         # A stale/foreign verdict naming a kernel this matrix can't
         # run (e.g. flat ELL over budget) must not error the dispatch.
+        _obs.inc("autotune.route.decline")
+        return None
+    if widening and not verdict.label.endswith("-bf16"):
+        # Under the declared widening only the f32-accumulation family
+        # may serve: its out dtype is result_type(A, x) by
+        # construction, so routed == direct dispatch stays bit-for-bit
+        # regardless of the operand dtype the verdict was raced with.
         _obs.inc("autotune.route.decline")
         return None
     y = cand.run(A, operand, op)

@@ -53,6 +53,33 @@ def _run_sliced_ell(A, operand, op: str):
     return _sp.sliced_ell_spmv(A._get_sliced_ell(), operand, A.shape[0])
 
 
+# Low-precision-storage family: bf16/f16 values with f32 accumulation
+# (ops/spmv.py ``*_f32acc`` kernels).  Eligible only when the matrix
+# already stores narrow values — the race must never silently round an
+# f32 matrix down to win on bytes.
+def _low_precision(A) -> bool:
+    return str(A.dtype) in ("bfloat16", "float16")
+
+
+def _run_csr_rowids_bf16(A, operand, op: str):
+    rid = A._get_row_ids()
+    if op == "spmv":
+        return _sp.csr_spmv_rowids_f32acc(
+            A.data, A.indices, rid, operand, A.shape[0])
+    return _sp.csr_spmm_rowids_f32acc(
+        A.data, A.indices, rid, operand, A.shape[0])
+
+
+def _run_ell_bf16(A, operand, op: str):
+    ell = A._get_ell()
+    return _sp.ell_spmv_f32acc(ell[0], ell[1], ell[2], operand)
+
+
+def _run_sliced_ell_bf16(A, operand, op: str):
+    return _sp.sliced_ell_spmv_f32acc(
+        A._get_sliced_ell(), operand, A.shape[0])
+
+
 @dataclass(frozen=True)
 class Candidate:
     """One routable kernel family (see module docstring)."""
@@ -82,5 +109,25 @@ CANDIDATES = {
         ops=("spmv",),
         eligible=lambda A: A._get_sliced_ell() is not None,
         run=_run_sliced_ell,
+    ),
+    "csr-rowids-bf16": Candidate(
+        label="csr-rowids-bf16", kernel="csr_spmv_rowids_f32acc",
+        ops=("spmv", "spmm"),
+        eligible=_low_precision,
+        run=_run_csr_rowids_bf16,
+    ),
+    "ell-bf16": Candidate(
+        label="ell-bf16", kernel="ell_spmv_f32acc",
+        ops=("spmv",),
+        eligible=lambda A: _low_precision(A)
+        and A._get_ell() is not None,
+        run=_run_ell_bf16,
+    ),
+    "sliced-ell-bf16": Candidate(
+        label="sliced-ell-bf16", kernel="sliced_ell_spmv_f32acc",
+        ops=("spmv",),
+        eligible=lambda A: _low_precision(A)
+        and A._get_sliced_ell() is not None,
+        run=_run_sliced_ell_bf16,
     ),
 }

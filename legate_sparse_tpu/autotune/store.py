@@ -11,9 +11,14 @@ engine's plan cache, with the same thread-safe move-to-end LRU shape
 Key and invalidation contract
 -----------------------------
 :class:`VerdictKey` carries ``(op, dtype, fingerprint class, rows
-bucket, nnz bucket, k bucket, platform fingerprint, settings.epoch)``.
-Shape terms reuse the engine's bucket policy, so one verdict covers a
-bucket, not an exact shape.  Two terms invalidate without eviction:
+bucket, nnz bucket, k bucket, platform fingerprint, settings.epoch,
+storage)``.  Shape terms reuse the engine's bucket policy, so one
+verdict covers a bucket, not an exact shape.  The ``dtype`` term is
+the *storage* value dtype (``csr_array.compress`` keeps ``.dtype``
+honest), and ``storage`` tags the index representation — so a verdict
+measured over bf16 values or int16 indices can never replay against
+f32/int32 storage of the same logical matrix.  Two terms invalidate
+without eviction:
 
 - ``epoch`` — any post-import mutation of a lowering-relevant setting
   bumps ``settings.epoch`` (settings.py contract), so stale verdicts
@@ -70,13 +75,20 @@ class VerdictKey:
     k_b: int
     platform: str
     epoch: int
+    # Storage-representation tag beyond the value dtype (which the
+    # ``dtype`` term already keys): "" for canonical int32 column
+    # indices, "i16" for compressed indices.  A verdict measured over
+    # one byte layout never replays against another — the index width
+    # changes the gather traffic the race actually measured.
+    storage: str = ""
 
     @property
     def key_id(self) -> str:
         """Compact display/serialization id (obs events, --autotune
         table, the on-disk JSON)."""
+        storage = f"/s{self.storage}" if self.storage else ""
         return (f"{self.op}/{self.dtype}/{self.fp_class}"
-                f"/r{self.rows_b}/z{self.nnz_b}/k{self.k_b}"
+                f"/r{self.rows_b}/z{self.nnz_b}/k{self.k_b}{storage}"
                 f"@{self.platform}/e{self.epoch}")
 
 
@@ -96,6 +108,9 @@ def key_for(A, op: str = "spmv", k: int = 1) -> Optional[VerdictKey]:
     fp = A._get_fingerprint()
     if fp is None:
         return None
+    storage = ""
+    if np.dtype(A.indices.dtype).itemsize < 4:
+        storage = f"i{np.dtype(A.indices.dtype).itemsize * 8}"
     return VerdictKey(
         op=op,
         dtype=np.dtype(A.dtype).name,
@@ -105,6 +120,7 @@ def key_for(A, op: str = "spmv", k: int = 1) -> Optional[VerdictKey]:
         k_b=_buckets.k_bucket(k),
         platform=platform_fingerprint(),
         epoch=_settings.epoch,
+        storage=storage,
     )
 
 
@@ -211,6 +227,7 @@ class VerdictStore:
                     k_b=int(entry["k_b"]),
                     platform=entry["platform"],
                     epoch=int(entry["epoch"]),
+                    storage=str(entry.get("storage", "")),
                 )
             except (KeyError, TypeError, ValueError):
                 dropped += 1

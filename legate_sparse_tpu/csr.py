@@ -376,6 +376,78 @@ class csr_array(CompressedBase, DenseSparseBase):
         out.sum_duplicates()
         return out
 
+    # ---------------- storage compression ----------------
+    def compress(self, values="bfloat16", indices="auto",
+                 copy: bool = False) -> "csr_array":
+        """Narrow the storage representation (structure shared).
+
+        Every hot path here is bandwidth-bound, so shrinking the
+        dominant byte streams — nnz values + nnz column indices — is
+        speed.  ``values`` names the target value dtype (default
+        ``"bfloat16"``; ``None`` keeps the current values; any
+        supported dtype is accepted, so :meth:`astype_storage` can
+        widen back).  ``indices`` is ``"auto"`` (int16 whenever the
+        column extent fits ``int16``, else unchanged), ``None``
+        (keep), or an explicit integer dtype — which raises when the
+        column extent overflows it.
+
+        ``.dtype`` stays honest (it reports the storage dtype) while
+        ``.dot`` keeps f32-grade semantics: low-precision storage
+        against an f32 operand dispatches the ``ops/spmv.py``
+        ``*_f32acc`` kernels (f32 accumulation, f32 output) — or the
+        DIA shifted-add lowerings, whose products promote to f32 per
+        element — without ever materializing a widened copy of the
+        matrix.
+
+        Declared IEEE trade for banded matrices: compressed storage
+        drops the DIA hole mask (the band data is zero-filled, so
+        hole products are exact zeros for every *finite* operand, and
+        the mask stream is a full quarter of a bf16 band's bytes).  A
+        non-finite operand entry aligned with a band hole therefore
+        propagates NaN where canonical f32 storage masks it — values
+        are already rounded; compression is opt-in lossy.
+        """
+        data = self._data
+        if values is not None:
+            vdt = np.dtype(values)
+            require_supported_dtype(vdt)
+            if vdt != data.dtype:
+                data = data.astype(vdt)
+        idx = self._indices
+        if indices is not None:
+            if isinstance(indices, str) and indices == "auto":
+                idt = (np.dtype(np.int16)
+                       if self.shape[1] - 1 <= np.iinfo(np.int16).max
+                       else None)
+            else:
+                idt = np.dtype(indices)
+                if idt.kind != "i":
+                    raise ValueError(
+                        f"index storage must be a signed integer "
+                        f"dtype, got {idt}")
+                if self.shape[1] - 1 > np.iinfo(idt).max:
+                    raise ValueError(
+                        f"column extent {self.shape[1]} overflows "
+                        f"index dtype {idt}")
+            if idt is not None and idt != np.dtype(idx.dtype):
+                idx = idx.astype(idt)
+            elif copy:
+                idx = jnp.array(idx)
+        # _with_data shares the index-dtype-independent structure
+        # caches (row ids, ELL width, DIA offsets, fingerprint); the
+        # value/format packs rebuild lazily at the new storage dtypes.
+        out = self._with_data(data, copy=copy and data is self._data)
+        out._indices = idx
+        return out
+
+    def astype_storage(self, values=None, indices=None,
+                       copy: bool = False) -> "csr_array":
+        """Explicit storage-representation cast: :meth:`compress` with
+        keep-by-default arguments (``astype`` changes the *logical*
+        dtype and upcasts operands to match; this changes only how the
+        bytes are stored)."""
+        return self.compress(values=values, indices=indices, copy=copy)
+
     @property
     def T(self):
         return self.transpose()
@@ -528,6 +600,15 @@ class csr_array(CompressedBase, DenseSparseBase):
         offsets = self._dia_offsets
         # Exact band (every in-bounds slot explicit): no mask needed.
         exact = _dia_ops.band_cover(offsets, self.shape, cols) == nnz
+        # Compressed-value storage (``compress()``) declares the hole
+        # trade: ``dia_from_csr`` zero-fills, so hole products are
+        # exact zeros for finite x and the mask stream — 1 byte/slot,
+        # a full quarter of a bf16 band's traffic — is dropped.  The
+        # cost is that a non-finite x entry at a hole propagates
+        # (0*inf) where canonical storage masks it; values are already
+        # rounded, and the compress() docstring documents both.
+        if str(self.dtype) in ("bfloat16", "float16"):
+            exact = True
         if exact:
             dia_data = _dia_ops.dia_from_csr(
                 self._data, self._indices, self._get_row_ids(),
@@ -1250,8 +1331,22 @@ class csr_array(CompressedBase, DenseSparseBase):
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
             _obs.inc("op.spmv")
-            A, x = cast_to_common_type(self, other_arr)
-            src = self if A is self else None
+            # Low-precision-storage widening (bf16/f16 matrix, f32
+            # operand): keep the compressed operand — the generic cast
+            # below would materialize an f32 copy of the value stream,
+            # undoing the whole byte win — and dispatch the
+            # f32-accumulation kernels, whose output is
+            # result_type(A, x) exactly as promotion demands.
+            lowp = (str(self.dtype) in ("bfloat16", "float16")
+                    and np.result_type(self.dtype, other_arr.dtype)
+                    == np.float32
+                    and other_arr.dtype != self.dtype)
+            if lowp:
+                A, x = self, other_arr
+                src = self
+            else:
+                A, x = cast_to_common_type(self, other_arr)
+                src = self if A is self else None
             # Always-on dispatch-latency histogram, keyed by the pow2
             # shape bucket (obs/latency.py): the distribution the
             # autotuner/serving arc consult — spans only exist while
@@ -1293,8 +1388,16 @@ class csr_array(CompressedBase, DenseSparseBase):
                         if squeeze:
                             y = y[:, None]
                         return fill_out(y, out)
+                # Under the declared widening DIA keeps serving: its
+                # XLA lowerings are shifted multiply-adds whose bf16 x
+                # f32 products promote to f32 before the reduction —
+                # f32-grade accumulation for free, band bytes halved.
+                # BSR stands down (the Mosaic kernel is compiled
+                # same-dtype); the gather-class f32acc kernels cover
+                # the rest.
                 dia = src._get_dia() if src is not None else None
-                bsr = (src._get_bsr() if src is not None and dia is None
+                bsr = (src._get_bsr()
+                       if src is not None and not lowp and dia is None
                        else None)
                 ell = (src._get_ell()
                        if src is not None and dia is None and bsr is None
@@ -1305,7 +1408,7 @@ class csr_array(CompressedBase, DenseSparseBase):
                     )
 
                     y = (dia_spmv_maybe_pallas(src._get_dia_pack(), x)
-                         if pallas_dia_active() else None)
+                         if pallas_dia_active() and not lowp else None)
                     path = "dia-pallas"
                     if y is None:
                         offs = dia[1]
@@ -1323,9 +1426,19 @@ class csr_array(CompressedBase, DenseSparseBase):
                         x, interpret=jax.devices()[0].platform != "tpu"
                     )
                     path = "bsr"
+                elif ell is not None and lowp:
+                    y = _spmv_ops.ell_spmv_f32acc(
+                        ell[0], ell[1], ell[2], x)
+                    path = "ell-bf16"
                 elif ell is not None:
                     y = _spmv_ops.ell_spmv(ell[0], ell[1], ell[2], x)
                     path = "ell"
+                elif src is not None and lowp:
+                    y = _spmv_ops.csr_spmv_rowids_f32acc(
+                        A.data, A.indices, src._get_row_ids(), x,
+                        self.shape[0]
+                    )
+                    path = "csr-rowids-bf16"
                 elif src is not None:
                     y = _spmv_ops.csr_spmv_rowids(
                         A.data, A.indices, src._get_row_ids(), x,
@@ -1350,8 +1463,18 @@ class csr_array(CompressedBase, DenseSparseBase):
                     f"dimension mismatch: {self.shape} @ {other_arr.shape}"
                 )
             _obs.inc("op.spmm")
-            A, X = cast_to_common_type(self, other_arr)
-            src = self if A is self else None
+            # Same declared widening as the SpMV branch: compressed
+            # storage stays compressed, f32 accumulation serves.
+            lowp = (str(self.dtype) in ("bfloat16", "float16")
+                    and np.result_type(self.dtype, other_arr.dtype)
+                    == np.float32
+                    and other_arr.dtype != self.dtype)
+            if lowp:
+                A, X = self, other_arr
+                src = self
+            else:
+                A, X = cast_to_common_type(self, other_arr)
+                src = self if A is self else None
             with _lat.timer("lat.spmm."
                             + _lat.shape_bucket(self.shape[0])), \
                     _obs.span("spmm") as sp:
@@ -1378,15 +1501,19 @@ class csr_array(CompressedBase, DenseSparseBase):
                                    bytes=A.spmv_traffic_bytes(
                                        X, path=path))
                         return fill_out(Y, out)
+                # DIA serves under the widening (same promotion logic
+                # as the SpMV branch); BSR/flat-ELL stand down — no
+                # f32-accumulation spmm variants for those families.
                 dia = src._get_dia() if src is not None else None
                 from .ops.bsr import SPMM_MAX_K as _BSR_MAX_K
 
                 bsr = (src._get_bsr()
-                       if src is not None and dia is None
+                       if src is not None and not lowp and dia is None
                        and 0 < X.shape[1] <= _BSR_MAX_K
                        else None)
                 ell = (src._get_ell()
-                       if src is not None and dia is None and bsr is None
+                       if src is not None and not lowp
+                       and dia is None and bsr is None
                        else None)
                 if dia is not None:
                     from .ops.pallas_dia import (
@@ -1400,7 +1527,7 @@ class csr_array(CompressedBase, DenseSparseBase):
                     Y = (
                         dia_spmm_maybe_pallas(src._get_dia_pack(), X)
                         if 0 < X.shape[1] <= SPMM_MAX_K
-                        and pallas_dia_active()
+                        and pallas_dia_active() and not lowp
                         else None
                     )
                     path = "dia-pallas"
@@ -1418,6 +1545,12 @@ class csr_array(CompressedBase, DenseSparseBase):
                 elif ell is not None:
                     Y = _spmv_ops.ell_spmm(ell[0], ell[1], ell[2], X)
                     path = "ell"
+                elif src is not None and lowp:
+                    Y = _spmv_ops.csr_spmm_rowids_f32acc(
+                        A.data, A.indices, src._get_row_ids(), X,
+                        self.shape[0]
+                    )
+                    path = "csr-rowids-bf16"
                 elif src is not None:
                     Y = _spmv_ops.csr_spmm_rowids(
                         A.data, A.indices, src._get_row_ids(), X,
@@ -1449,8 +1582,15 @@ class csr_array(CompressedBase, DenseSparseBase):
         gather model.
         """
         n = self.shape[0]
+        if path in ("csr-rowids-bf16", "ell-bf16", "sliced-ell-bf16"):
+            # The f32-accumulation variants stream the same blocks as
+            # their full-precision families — the models below read
+            # the actual storage itemsizes, so the narrowing is
+            # already priced.
+            path = path[: -len("-bf16")]
         x_bytes = int(x.size) * x.dtype.itemsize
-        out_bytes = n * self.dtype.itemsize
+        out_bytes = n * jnp.dtype(
+            jnp.result_type(self.dtype, x.dtype)).itemsize
         if x.ndim == 2:
             out_bytes *= int(x.shape[1])
         # Caches use the False sentinel for "tried, not applicable".

@@ -143,10 +143,6 @@ def save_npz(file, matrix, compressed: bool = True) -> None:
 
     matrix = _as_csr(matrix)
     data = _np.asarray(matrix.data)
-    if data.dtype.kind == "V" or str(data.dtype) == "bfloat16":
-        # npz has no portable bfloat16 encoding (numpy stores it as raw
-        # void, unreadable by scipy and np.load alike): widen to f32.
-        data = data.astype(_np.float32)
     arrays = dict(
         format=_np.array(b"csr"),
         shape=_np.asarray(matrix.shape, dtype=_np.int64),
@@ -154,6 +150,16 @@ def save_npz(file, matrix, compressed: bool = True) -> None:
         indices=_np.asarray(matrix.indices),
         indptr=_np.asarray(matrix.indptr),
     )
+    if data.dtype.kind == "V" or str(data.dtype) == "bfloat16":
+        # npz has no portable bfloat16 encoding (numpy stores the
+        # ml_dtypes registration as raw void, unreadable by scipy and
+        # np.load alike): persist the raw 16-bit patterns plus a dtype
+        # marker — bit-exact through load_npz, and compressed storage
+        # (``csr_array.compress``) checkpoints at its true byte size.
+        # scipy cannot read a bf16 container; widen before saving when
+        # scipy interchange matters.
+        arrays["data_dtype"] = _np.array(str(data.dtype).encode())
+        arrays["data"] = data.view(_np.uint16)
     if compressed:
         _np.savez_compressed(file, **arrays)
     else:
@@ -169,10 +175,25 @@ def load_npz(file) -> csr_array:
         if isinstance(fmt, bytes):
             fmt = fmt.decode()
         if fmt == "csr":
-            return csr_array(
-                (f["data"], f["indices"], f["indptr"]),
+            data = f["data"]
+            if "data_dtype" in f:
+                # Compressed-value container (save_npz above): the raw
+                # 16-bit patterns reinterpret to the marked dtype —
+                # bit-exact, no widening round trip.
+                data = data.view(_np.dtype(
+                    f["data_dtype"].item().decode()))
+            out = csr_array(
+                (data, f["indices"], f["indptr"]),
                 shape=tuple(int(s) for s in f["shape"]),
             )
+            idx_dt = _np.dtype(f["indices"].dtype)
+            if (idx_dt.kind == "i" and idx_dt.itemsize
+                    < _np.dtype(out.indices.dtype).itemsize):
+                # The triple constructor canonicalizes indices to the
+                # coord dtype; restore the container's compressed
+                # width so storage round-trips exactly.
+                out = out.astype_storage(indices=idx_dt)
+            return out
     # Non-csr containers (csc/coo/dia/bsr/...): scipy decodes the
     # layout (file-like sources are rewound; np.load consumed them).
     if hasattr(file, "seek"):

@@ -325,6 +325,99 @@ def _get_atol_rtol(b_norm, tol=None, atol=0.0, rtol=1e-5):
 
 
 # --------------------------------------------------------------------------
+# Mixed-precision iterative refinement (compressed-storage inner solves)
+# --------------------------------------------------------------------------
+
+_REFINE_AUTO_CYCLES = 12   # "auto": outer-correction cycle budget
+_REFINE_INNER_RTOL = 1e-2  # per-cycle inner residual-reduction target
+
+
+def _refine_inner_operator(A) -> csr_array:
+    """The compressed-storage inner operator behind ``refine=``: one
+    precision rung below the system dtype — f64 values drop to f32,
+    f32 values to bf16 — with int16 column indices whenever the width
+    fits, built through :meth:`csr_array.compress`.  The inner Krylov
+    sweep streams the narrow bytes (that is the roofline win); the
+    outer full-precision residual correction restores the grade.
+    Raises for operands refinement cannot serve: the knob is an
+    explicit request, and silently solving unrefined would lie."""
+    if is_sparse_matrix(A) and not isinstance(A, csr_array):
+        A = A.tocsr()
+    if not isinstance(A, csr_array):
+        raise ValueError(
+            "refine= needs a sparse-matrix operand (the inner solve "
+            "runs over compressed csr_array storage); got "
+            f"{type(A).__name__}")
+    dt = np.dtype(A.dtype)
+    if dt == np.float64:
+        return A.compress(values="float32")
+    if dt == np.float32:
+        return A.compress()
+    raise ValueError(
+        f"refine= serves float32/float64 systems (got {dt.name}: "
+        "storage is already low-precision — solve it directly)")
+
+
+def _refine_cycles(refine) -> int:
+    if refine == "auto":
+        return _REFINE_AUTO_CYCLES
+    cycles = int(refine)
+    if cycles <= 0:
+        raise ValueError(
+            f"refine= must be 'auto' or a positive cycle count, "
+            f"got {refine!r}")
+    return cycles
+
+
+def _refined_solve(solver: str, inner_solve: Callable, A_op, A_in,
+                   b, x, atol: float, maxiter: int, cycles: int):
+    """The shared iterative-refinement driver behind ``cg``/``gmres``
+    ``refine=``.
+
+    Classic mixed-precision IR: a full-precision residual
+    ``r = b - A x`` against the matrix the caller handed us, an inner
+    Krylov correction solve over the compressed-storage operator
+    ``A_in`` in f32 vectors (to :data:`_REFINE_INNER_RTOL` relative —
+    the grade low-precision storage can actually deliver), then a
+    full-precision update ``x += d``.  Convergence is judged on the
+    TRUE residual, so the refined solve meets the same ``atol`` the
+    unrefined f32/f64 solve would.
+
+    Host-sync cadence contract: ONE stacked fetch per refinement
+    cycle — the residual-norm convergence decision (counted as
+    ``transfer.host_sync.<solver>_refine``), matching the solvers'
+    existing one-fetch-per-cycle discipline; the opt-in health monitor
+    (docs/RESILIENCE.md) rides that same fetch.
+    """
+    site = f"solver.{solver}.refine"
+    monitor = _rhealth.Monitor(site) if _rsettings.resil else None
+    inner_dt = (jnp.float32 if np.dtype(b.dtype) == np.float64
+                else b.dtype)
+    total = 0
+    rn = None
+    with _obs.span(solver + ".refine", n=int(b.shape[0]),
+                   cycles=cycles,
+                   inner_dtype=np.dtype(A_in.dtype).name) as sp:
+        for cycle in range(cycles):
+            r = b - A_op.matvec(x)
+            rn = float(jnp.linalg.norm(r))     # the per-cycle fetch
+            _obs.inc(f"transfer.host_sync.{solver}_refine")
+            if monitor is not None:
+                monitor.observe(rn, total, partial=x)
+            if rn < atol or total >= maxiter:
+                break
+            d, it = inner_solve(
+                A_in, r.astype(inner_dt),
+                max(atol, _REFINE_INNER_RTOL * rn), maxiter - total,
+            )
+            total += max(int(it), 1)
+            x = x + d.astype(b.dtype)
+        if sp is not None:
+            sp.set(iters=total, resid=rn)
+    return x, total
+
+
+# --------------------------------------------------------------------------
 # CG (reference ``linalg.py:465-535``)
 # --------------------------------------------------------------------------
 def _cg_builders(A_mv: Callable, M_mv: Callable, conv_test_iters: int):
@@ -489,6 +582,7 @@ def cg(
     atol=0.0,
     rtol=1e-5,
     conv_test_iters: int = 25,
+    refine=None,
 ):
     """Conjugate Gradient solve of ``A x = b`` (scipy-shaped signature,
     reference ``linalg.py:465-535``).  Returns ``(x, iters)``.
@@ -496,6 +590,13 @@ def cg(
     Without a callback the solve is a single jitted while_loop (no host
     sync per iteration).  With a callback, a Python-level loop mirrors
     the reference's structure so user code observes every iterate.
+
+    ``refine="auto"`` (or a positive cycle count) switches to
+    mixed-precision iterative refinement: inner CG sweeps run over the
+    compressed-storage operator (``A.compress()`` — bf16 values under
+    f32 systems, f32 under f64, int16 indices where they fit) while
+    full-precision residual corrections keep the final residual at the
+    same ``atol`` the unrefined solve meets (``_refined_solve``).
     """
     b = jnp.asarray(b)
     if b.ndim == 2 and b.shape[1] == 1:
@@ -518,6 +619,22 @@ def cg(
     )
     x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
          else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+
+    if refine is not None:
+        if M is not None or callback is not None:
+            raise ValueError(
+                "cg: refine= composes with neither M= nor callback= — "
+                "inner sweeps run over the compressed operator without "
+                "the outer preconditioner/observer")
+        _obs.inc("op.cg")
+
+        def _inner(A_in, r, inner_atol, budget):
+            return cg(A_in, r, atol=inner_atol, rtol=0.0,
+                      maxiter=budget, conv_test_iters=conv_test_iters)
+
+        return _refined_solve(
+            "cg", _inner, A_op, _refine_inner_operator(A), b, x,
+            atol, int(maxiter), _refine_cycles(refine))
 
     _obs.inc("op.cg")
     if callback is None:
@@ -686,9 +803,15 @@ def gmres(
     atol=0.0,
     callback_type=None,
     rtol=1e-5,
+    refine=None,
 ):
     """Restarted GMRES (scipy/cupy-shaped signature, reference
     ``linalg.py:540-668``).  Returns ``(x, iters)``.
+
+    ``refine="auto"`` (or a positive cycle count) runs mixed-precision
+    iterative refinement: inner restarted-GMRES solves over the
+    compressed-storage operator, full-precision residual corrections
+    between them — same contract as :func:`cg`'s ``refine=``.
 
     Each restart cycle — Arnoldi, progressive Givens QR of the
     Hessenberg, triangular solve, solution update — runs as ONE traced
@@ -727,6 +850,22 @@ def gmres(
     )
     x = (jnp.zeros(n, dtype=b.dtype) if x0 is None
          else jnp.asarray(x0, dtype=b.dtype).reshape(-1))
+
+    if refine is not None:
+        if M is not None or callback is not None:
+            raise ValueError(
+                "gmres: refine= composes with neither M= nor "
+                "callback= — inner cycles run over the compressed "
+                "operator without the outer preconditioner/observer")
+        _obs.inc("op.gmres")
+
+        def _inner(A_in, r, inner_atol, budget):
+            return gmres(A_in, r, atol=inner_atol, rtol=0.0,
+                         restart=restart, maxiter=budget)
+
+        return _refined_solve(
+            "gmres", _inner, A_op, _refine_inner_operator(A), b, x,
+            atol, int(maxiter), _refine_cycles(refine))
 
     cycle = maybe_jit(
         partial(_gmres_cycle, A_op.matvec, M_op.matvec, restart=restart)

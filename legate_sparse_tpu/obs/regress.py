@@ -262,10 +262,15 @@ def render_findings(findings: List[Dict[str, Any]],
 # only runs forward).
 TRAJECTORY_FIELDS = [
     "platform", "stream_gbs", "value", "spmv_ms",
-    "cpu_roofline_ratio", "cg_ms_per_iter", "spgemm_ms",
+    "cpu_roofline_ratio",
+    "spmv_bytes_per_nnz", "spmv_bytes_per_nnz_bf16",
+    "cg_ms_per_iter", "spgemm_ms",
     "gmg_cycle_ms", "pde_ms_per_iter", "pde_roofline_ratio",
+    "pde_bytes_per_iter", "pde_bytes_per_iter_bf16",
+    "pde_bytes_ratio",
     "dist_spmv_comm_bytes", "comm_total_bytes",
     "dist2d_layout", "dist2d_spmv_comm_bytes",
+    "dist2d_spmv_comm_bytes_bf16",
     "dist2d_spmv_1d_comm_bytes", "dist2d_cg_comm_bytes",
     "dist2d_spgemm_comm_bytes", "dist2d_spgemm_1d_comm_bytes",
     "dist2d_spmv_ms",

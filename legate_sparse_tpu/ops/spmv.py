@@ -230,6 +230,107 @@ def sliced_ell_spmv(bins, x, rows: int):
     return y
 
 
+# --- Low-precision-storage variants (f32 accumulation) ------------------
+#
+# SpMV is bandwidth-bound on every lane this repo targets, so bf16/f16
+# value storage halves the dominant byte stream.  Each variant widens
+# the gathered *product* to f32 BEFORE the reduction (the paper's
+# "narrow storage, wide accumulate" contract), then narrows the result
+# to ``result_type(data, x)`` — bf16 in/bf16 out, while an f32 x
+# promotes the output to f32 with no intermediate copy of the matrix.
+# The IEEE masking contract is unchanged: padded slots mask the
+# product, never the operand.
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def csr_spmv_rowids_f32acc(data, indices, row_ids, x, rows: int):
+    """Low-byte-storage SpMV (precomputed row ids): bf16/f16 values,
+    f32 ``segment_sum`` accumulation, ``result_type(data, x)`` out."""
+    _obs.inc("trace.csr_spmv_rowids_f32acc")
+    out_dtype = jnp.result_type(data.dtype, x.dtype)
+    prod = data.astype(jnp.float32) * x[indices].astype(jnp.float32)
+    y = jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+    return y.astype(out_dtype)
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def csr_spmv_rowids_masked_f32acc(data, indices, row_ids, valid_nnz, x,
+                                  rows: int):
+    """Masked low-byte SpMV (zero-padded nonzero suffix): the 2-D
+    block-sharded panel kernel for bf16 panels.  Same masked-product
+    IEEE contract as :func:`csr_spmv_rowids_masked`, accumulated in
+    f32."""
+    _obs.inc("trace.csr_spmv_rowids_masked_f32acc")
+    out_dtype = jnp.result_type(data.dtype, x.dtype)
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = jnp.where(
+        slot < valid_nnz,
+        data.astype(jnp.float32) * x[indices].astype(jnp.float32),
+        jnp.zeros((1,), dtype=jnp.float32),
+    )
+    y = jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+    return y.astype(out_dtype)
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def csr_spmm_rowids_f32acc(data, indices, row_ids, X, rows: int):
+    """Low-byte-storage SpMM: bf16/f16 values, f32 accumulation."""
+    _obs.inc("trace.csr_spmm_rowids_f32acc")
+    out_dtype = jnp.result_type(data.dtype, X.dtype)
+    prod = data.astype(jnp.float32)[:, None] \
+        * X[indices, :].astype(jnp.float32)
+    Y = jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+    return Y.astype(out_dtype)
+
+
+@jax.jit
+def ell_spmv_f32acc(ell_data, ell_cols, ell_counts, x):
+    """Low-byte-storage ELL SpMV: masked f32 products, f32 row
+    reduction, ``result_type(ell_data, x)`` out."""
+    _obs.inc("trace.ell_spmv_f32acc")
+    out_dtype = jnp.result_type(ell_data.dtype, x.dtype)
+    W = ell_data.shape[1]
+    slot = jnp.arange(W, dtype=ell_counts.dtype)
+    valid = slot[None, :] < ell_counts[:, None]
+    prod = jnp.where(
+        valid,
+        ell_data.astype(jnp.float32) * x[ell_cols].astype(jnp.float32),
+        jnp.zeros((1, 1), dtype=jnp.float32),
+    )
+    return jnp.sum(prod, axis=1).astype(out_dtype)
+
+
+@partial(jax.jit, static_argnames=("rows",))
+def sliced_ell_spmv_f32acc(bins, x, rows: int):
+    """Low-byte-storage sliced-ELL SpMV: per-bin masked f32 products
+    and f32 row reductions, scattered back in original row order
+    (same unique-sorted ``.at[].set`` as :func:`sliced_ell_spmv`)."""
+    _obs.inc("trace.sliced_ell_spmv_f32acc")
+    out_dtype = jnp.result_type(bins[0][0].dtype, x.dtype)
+    y = jnp.zeros((rows,), dtype=out_dtype)
+    for ell_data, ell_cols, cnt, row_idx in bins:
+        W = ell_data.shape[1]
+        slot = jnp.arange(W, dtype=cnt.dtype)
+        valid = slot[None, :] < cnt[:, None]
+        prod = jnp.where(
+            valid,
+            ell_data.astype(jnp.float32)
+            * x[ell_cols].astype(jnp.float32),
+            jnp.zeros((1, 1), dtype=jnp.float32),
+        )
+        y = y.at[row_idx].set(
+            jnp.sum(prod, axis=1).astype(out_dtype),
+            indices_are_sorted=True, unique_indices=True)
+    return y
+
+
 # Above this many intermediate elements (rows*W*k), ell_spmm switches to
 # a W-slice accumulation loop instead of materializing the full
 # (rows, W, k) product tensor (~512 MB of f32 at the default cap).

@@ -598,7 +598,13 @@ def _shard_csr_2d(A: csr_array, mesh: Optional[Mesh],
             else np.zeros(n_blocks, np.int64)
         cap = max(int(per.max()), 1) if nnz else 1
         d_b = np.zeros((n_blocks, cap), dtype=data.dtype)
-        c_b = np.zeros((n_blocks, cap), dtype=np.int32)
+        # Block-local column values live in [0, cps): whenever the
+        # block width fits, the static index payload ships as int16 —
+        # the panel gather upcasts in-register, so the narrow width is
+        # pure HBM/interconnect savings (docs/DIST.md storage note).
+        col_dt = (np.int16 if cps - 1 <= np.iinfo(np.int16).max
+                  else np.int32)
+        c_b = np.zeros((n_blocks, cap), dtype=col_dt)
         r_b = np.full((n_blocks, cap), rid_pad, dtype=np.int32)
         for g in range(n_blocks):
             m = bid == g
@@ -1139,7 +1145,8 @@ def _transpose_perm(grid: Tuple[int, int]) -> Tuple[Tuple[int, int], ...]:
 
 
 @lru_cache(maxsize=256)
-def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
+def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int,
+                      lowp: bool = False):
     """Cached shard_map callable for the 2-d-block dist SpMV: the
     communication-avoiding program the layout exists for —
 
@@ -1152,6 +1159,13 @@ def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
     4. tiled ``psum_scatter`` along MESH COLUMNS — partial row-block
        products reduced and scattered straight into the row-major
        output chunks, half the bytes of a full ``psum``.
+
+    ``lowp`` (bf16/f16 block values) swaps step 3 for the
+    f32-accumulation kernel: partial products sum in f32 and narrow
+    back to ``result_type(A, x)`` BEFORE the psum_scatter, so a bf16
+    x panel moves half the bytes on every collective while the
+    per-block reduction keeps f32 grade.  The flag is part of the
+    lru_cache key — one compiled program per storage class.
     """
     _obs.inc("jit_miss.dist_csr.block_spmv_2d_fn")
     from ._compat import shard_map
@@ -1161,6 +1175,8 @@ def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
     Rr, Rc = grid
     perm = _transpose_perm(grid)
     skip_perm = all(s == d for s, d in perm)
+    local_spmv = (_spmv_ops.csr_spmv_rowids_masked_f32acc if lowp
+                  else _spmv_ops.csr_spmv_rowids_masked)
 
     def kernel(data, cols, row_ids, counts, x_local):
         if not skip_perm:
@@ -1168,7 +1184,7 @@ def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int):
                 x_local, (ROW_AXIS, COL_AXIS), perm
             )
         x_panel = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
-        y_part = _spmv_ops.csr_spmv_rowids_masked(
+        y_part = local_spmv(
             data[0, 0], cols[0, 0], row_ids[0, 0], counts[0, 0],
             x_panel, rps,
         )
@@ -1317,9 +1333,12 @@ def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
                       comm_calls=sum(1 for b in vols.values() if b > 0)
                       ) as sp:
         if A.grid is not None:
-            fn = _block_spmv_2d_fn(A.mesh, A.grid, A.rows_per_shard)
+            lowp = str(A.dtype) in ("bfloat16", "float16")
+            fn = _block_spmv_2d_fn(A.mesh, A.grid, A.rows_per_shard,
+                                   lowp)
             if sp is not None:
-                sp.set(path="2d-block", layout=A.layout)
+                sp.set(path="2d-block-bf16" if lowp else "2d-block",
+                       layout=A.layout)
             return fn(A.data, A.cols, A.row_ids, A.counts, x)
 
         if A.dia_data is not None and halo >= 0 and not precise:

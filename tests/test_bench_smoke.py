@@ -31,6 +31,7 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "schema_version,"
+                 "spmv_bytes_per_nnz,spmv_bytes_per_nnz_bf16,"
                  "engine_plan_hits,engine_plan_misses,"
                  "engine_batch_requests,"
                  "resil_retries,resil_shed,resil_breaker_trips,"

@@ -1,0 +1,464 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Compressed storage (``csr_array.compress`` / ``astype_storage``):
+bf16 values + int16 column indices with f32-grade ``.dot`` semantics.
+
+The load-bearing contracts this file pins:
+
+- **representation**: ``compress()`` narrows values to bf16 and
+  indices to int16 (when the column extent fits), shares structure,
+  keeps ``.dtype`` honest, and ``astype_storage`` widens back
+  losslessly (bf16 -> f32 is exact);
+- **accuracy, scipy-differential**: every routed precision variant —
+  the gather-class ``*_f32acc`` kernels and the DIA shifted-add
+  promotion — lands within f32-accumulation distance of float64
+  scipy over the *rounded* values (the bf16 rounding is the declared
+  loss; the accumulation must not add to it);
+- **routed == direct**: an autotune ``*-bf16`` verdict dispatches the
+  f32-accumulation kernel bit-for-bit identically to calling it
+  directly, and only ``*-bf16`` labels may serve the declared
+  bf16/f16 x f32 -> f32 widening;
+- **verdict-key separation**: bf16-storage and compressed-index
+  verdicts can never replay against f32/int32 storage of the same
+  logical matrix;
+- **DIA hole-mask trade**: compressed storage drops the hole mask
+  (documented IEEE trade — a non-finite operand entry at a band hole
+  propagates where canonical f32 storage masks it), f32 storage keeps
+  it;
+- **npz round-trip**: a compressed matrix checkpoints at its true
+  byte size and loads back bit-exact (ISSUE satellite);
+- **dist parity**: a sharded compressed matrix against an f32 vector
+  honors the same promotion contract as the local ``.dot`` on every
+  layout — 1d-row, 1d-col, 2d-block (ISSUE satellite);
+- **refine=**: cg/gmres mixed-precision iterative refinement meets
+  the unrefined full-precision tolerance, one host fetch per cycle.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+from legate_sparse_tpu import autotune, linalg, obs
+from legate_sparse_tpu.autotune import key_for
+from legate_sparse_tpu.io import load_npz, save_npz
+from legate_sparse_tpu.obs import counters, trace
+from legate_sparse_tpu.ops import spmv as spmv_ops
+from legate_sparse_tpu.parallel import (
+    dist_spmv, make_grid_mesh, make_row_mesh, shard_csr,
+)
+from legate_sparse_tpu.parallel.dist_csr import shard_vector
+from legate_sparse_tpu.settings import settings
+
+R = len(jax.devices())
+needs_grid = pytest.mark.skipif(R < 8, reason="needs the 8-device mesh")
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """Fresh obs state and a clean autotune store around every test;
+    autotune off unless the test flips it."""
+    saved = settings.autotune
+    obs.reset_all()
+    trace.disable()
+    autotune.reset()
+    yield
+    settings.autotune = saved
+    autotune.reset()
+    obs.reset_all()
+
+
+def _random_csr(n, m=None, density=0.08, seed=0, spd=False):
+    m = n if m is None else m
+    rng = np.random.default_rng(seed)
+    A_sp = sp.random(n, m, density=density, random_state=rng,
+                     format="csr", dtype=np.float64)
+    if spd:
+        A_sp = (A_sp + A_sp.T + 10.0 * sp.eye(n)).tocsr()
+    return A_sp.astype(np.float32)
+
+
+def _holey_tridiag(n=64, hole=10):
+    """Tridiagonal with the (hole, hole) main-diagonal slot absent
+    from the structure — a holey band (``_get_dia`` builds a mask)."""
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        for j in (i - 1, i, i + 1):
+            if 0 <= j < n and not (i == j == hole):
+                rows.append(i)
+                cols.append(j)
+                vals.append(1.0 + 0.01 * i + 0.5 * (i == j))
+    A_sp = sp.coo_matrix(
+        (np.asarray(vals, np.float32), (rows, cols)),
+        shape=(n, n)).tocsr()
+    return lst.csr_array(A_sp)
+
+
+def _scipy_ref(C, x):
+    """float64 scipy product over C's *stored* (rounded) values — the
+    accuracy referee: the bf16 rounding is the only loss allowed."""
+    ref = sp.csr_matrix(
+        (np.asarray(C.data).astype(np.float64),
+         np.asarray(C.indices).astype(np.int64),
+         np.asarray(C.indptr).astype(np.int64)),
+        shape=C.shape)
+    return ref @ np.asarray(x).astype(np.float64)
+
+
+# ------------------------------------------------- representation --
+def test_compress_defaults_bf16_int16():
+    A = lst.csr_array(_random_csr(256, seed=1))
+    C = A.compress()
+    assert str(C.dtype) == "bfloat16"
+    assert np.dtype(C.indices.dtype) == np.int16
+    assert C.shape == A.shape and C.nnz == A.nnz
+    # The original is untouched (compress returns a new view).
+    assert np.dtype(A.dtype) == np.float32
+    assert np.dtype(A.indices.dtype) == np.int32
+    # Values are exactly the bf16 rounding, indices identical.
+    want = np.asarray(jnp.asarray(A.data).astype(jnp.bfloat16))
+    assert np.array_equal(np.asarray(C.data).view(np.uint16),
+                          want.view(np.uint16))
+    np.testing.assert_array_equal(np.asarray(C.indices),
+                                  np.asarray(A.indices))
+    np.testing.assert_array_equal(np.asarray(C.indptr),
+                                  np.asarray(A.indptr))
+
+
+def test_compress_auto_keeps_int32_when_columns_overflow_int16():
+    n_cols = (1 << 15) + 8            # 32776 > int16 max
+    A = lst.csr_array(_random_csr(8, n_cols, density=0.01, seed=2))
+    C = A.compress()
+    assert str(C.dtype) == "bfloat16"
+    assert np.dtype(C.indices.dtype) == np.int32
+
+
+def test_compress_rejects_bad_storage_dtypes():
+    A = lst.csr_array(_random_csr(64))
+    with pytest.raises(ValueError, match="overflows"):
+        lst.csr_array(_random_csr(8, (1 << 15) + 8, density=0.01)
+                      ).compress(indices="int16")
+    with pytest.raises(ValueError, match="signed integer"):
+        A.compress(indices="float32")
+    with pytest.raises(NotImplementedError, match="not supported"):
+        A.compress(values="float16")
+
+
+def test_astype_storage_widens_back_exactly():
+    A = lst.csr_array(_random_csr(128, seed=3))
+    C = A.compress()
+    W = C.astype_storage(values="float32", indices="int32")
+    assert np.dtype(W.dtype) == np.float32
+    assert np.dtype(W.indices.dtype) == np.int32
+    # bf16 -> f32 is exact: widening restores the rounded values
+    # bit-for-bit as f32.
+    want = np.asarray(jnp.asarray(C.data).astype(jnp.float32))
+    assert np.array_equal(np.asarray(W.data), want)
+    # Keep-by-default: no arguments is a representation no-op.
+    K = C.astype_storage()
+    assert str(K.dtype) == "bfloat16"
+    assert np.dtype(K.indices.dtype) == np.int16
+
+
+# ------------------------------------- accuracy, scipy-differential --
+@pytest.mark.parametrize("structure", ["uniform", "powerlaw", "banded"])
+def test_lowp_spmv_scipy_differential(structure):
+    if structure == "banded":
+        A_sp = sp.diags(
+            [np.linspace(0.5, 1.5, 255), np.linspace(2.0, 3.0, 256),
+             np.linspace(-1.0, 1.0, 255)],
+            [-1, 0, 1]).tocsr().astype(np.float32)
+        A = lst.csr_array(A_sp)
+    elif structure == "powerlaw":
+        from legate_sparse_tpu import gallery
+        A = gallery.powerlaw(256, nnz_per_row=4, rng=5,
+                             dtype=np.float32)
+        A.sum_duplicates()
+    else:
+        A = lst.csr_array(_random_csr(256, density=0.05, seed=4))
+    C = A.compress()
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 256), jnp.float32)
+    y = C @ x
+    # Promotion contract: bf16 storage x f32 operand -> f32 out.
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y), _scipy_ref(C, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lowp_spmm_scipy_differential():
+    A = lst.csr_array(_random_csr(192, density=0.06, seed=6))
+    C = A.compress()
+    X = jnp.asarray(
+        np.linspace(-1.0, 1.0, 192 * 3).reshape(192, 3), jnp.float32)
+    Y = C @ X
+    assert Y.dtype == jnp.float32 and Y.shape == (192, 3)
+    ref = np.stack([_scipy_ref(C, X[:, j]) for j in range(3)], axis=1)
+    np.testing.assert_allclose(np.asarray(Y), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_same_dtype_bf16_spmv_stays_bf16():
+    A = lst.csr_array(_random_csr(128, density=0.08, seed=7))
+    C = A.compress()
+    x = jnp.asarray(np.linspace(0.1, 1.0, 128), jnp.bfloat16)
+    y = C @ x
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y).astype(np.float64),
+        _scipy_ref(C, np.asarray(x).astype(np.float32)),
+        rtol=0.05, atol=0.05)
+
+
+# ------------------------------------------------ DIA mask trade --
+def test_compressed_dia_drops_mask_f32_keeps_it():
+    A = _holey_tridiag()
+    dia_f32 = A._get_dia()
+    assert dia_f32 is not None and dia_f32[2] is not None
+    C = A.compress()
+    dia_c = C._get_dia()
+    assert dia_c is not None and dia_c[2] is None
+    # f32 values + compressed indices alone keep the mask: the trade
+    # is declared by the *value* narrowing only.
+    N = A.astype_storage(indices="int16")
+    dia_n = N._get_dia()
+    assert dia_n is not None and dia_n[2] is not None
+
+
+def test_compressed_dia_nonfinite_hole_trade():
+    hole = 10
+    A = _holey_tridiag(hole=hole)
+    n = A.shape[0]
+    x = np.linspace(0.5, 1.5, n).astype(np.float32)
+    x[hole] = np.inf
+    xj = jnp.asarray(x)
+    # Canonical f32 storage: the mask guards the hole — row `hole`
+    # (whose only structural entries are off-diagonal) stays finite.
+    y_f32 = np.asarray(A @ xj)
+    assert np.isfinite(y_f32[hole])
+    # Compressed storage: the zero-filled hole multiplies inf -> NaN.
+    # This is the documented opt-in IEEE trade.
+    y_c = np.asarray(A.compress() @ xj)
+    assert np.isnan(y_c[hole])
+
+
+def test_compressed_dia_finite_parity():
+    A = _holey_tridiag()
+    C = A.compress()
+    x = jnp.asarray(np.linspace(-2.0, 2.0, A.shape[0]), jnp.float32)
+    y = C @ x
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y), _scipy_ref(C, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------ autotune routing --
+def test_verdict_key_separates_storage():
+    A = lst.csr_array(_random_csr(256, seed=8))
+    C = A.compress()
+    kf = key_for(A, "spmv")
+    kc = key_for(C, "spmv")
+    assert kf is not None and kc is not None
+    assert kf.dtype == "float32" and kf.storage == ""
+    assert kc.dtype == "bfloat16" and kc.storage == "i16"
+    assert "/si16@" in kc.key_id and "/si16@" not in kf.key_id
+    assert kf != kc
+    store = autotune.get_store()
+    store.record(kc, "csr-rowids-bf16", timings_ms={}, trials=1)
+    assert store.lookup(kc) is not None
+    # A bf16-storage verdict never replays against f32 storage.
+    assert store.lookup(kf) is None
+
+
+@pytest.mark.parametrize("label,structure", [
+    ("csr-rowids-bf16", "uniform"),
+    ("ell-bf16", "uniform"),
+    ("sliced-ell-bf16", "powerlaw"),
+])
+def test_routed_bf16_verdict_is_bitwise_direct(label, structure):
+    if structure == "powerlaw":
+        from legate_sparse_tpu import gallery
+        A = gallery.powerlaw(256, nnz_per_row=4, rng=9,
+                             dtype=np.float32)
+        A.sum_duplicates()
+    else:
+        A = lst.csr_array(_random_csr(256, density=0.05, seed=9))
+    C = A.compress()
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 256), jnp.float32)
+    key = key_for(C, "spmv")
+    settings.autotune = True
+    autotune.get_store().record(key, label, timings_ms={}, trials=1)
+    hits0 = counters.get("autotune.route.hits")
+    y = C @ x
+    assert counters.get("autotune.route.hits") == hits0 + 1
+    assert counters.get("autotune.route." + label) >= 1
+    if label == "csr-rowids-bf16":
+        y_direct = spmv_ops.csr_spmv_rowids_f32acc(
+            C.data, C.indices, C._get_row_ids(), x, C.shape[0])
+    elif label == "ell-bf16":
+        ell = C._get_ell()
+        assert ell is not None
+        y_direct = spmv_ops.ell_spmv_f32acc(ell[0], ell[1], ell[2], x)
+    else:
+        bins = C._get_sliced_ell()
+        assert bins is not None
+        y_direct = spmv_ops.sliced_ell_spmv_f32acc(bins, x, C.shape[0])
+    # Routed == direct: same jitted entry point, bit-for-bit.
+    assert y.dtype == y_direct.dtype == jnp.float32
+    assert np.array_equal(np.asarray(y), np.asarray(y_direct))
+
+
+def test_widening_declines_non_bf16_verdicts():
+    A = lst.csr_array(_random_csr(256, density=0.05, seed=10))
+    C = A.compress()
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 256), jnp.float32)
+    settings.autotune = True
+    # A plain-family verdict must not serve the widening: its output
+    # dtype under promotion is not pinned by construction.
+    autotune.get_store().record(
+        key_for(C, "spmv"), "csr-rowids", timings_ms={}, trials=1)
+    declines0 = counters.get("autotune.route.decline")
+    hits0 = counters.get("autotune.route.hits")
+    y = C @ x
+    assert counters.get("autotune.route.decline") > declines0
+    assert counters.get("autotune.route.hits") == hits0
+    # The heuristic lowp chain still serves correctly.
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y), _scipy_ref(C, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_routed_spmm_bf16_bitwise_direct():
+    A = lst.csr_array(_random_csr(192, density=0.06, seed=11))
+    C = A.compress()
+    X = jnp.asarray(
+        np.linspace(-1.0, 1.0, 192 * 4).reshape(192, 4), jnp.float32)
+    settings.autotune = True
+    autotune.get_store().record(
+        key_for(C, "spmm", k=4), "csr-rowids-bf16",
+        timings_ms={}, trials=1)
+    Y = C @ X
+    Y_direct = spmv_ops.csr_spmm_rowids_f32acc(
+        C.data, C.indices, C._get_row_ids(), X, C.shape[0])
+    assert Y.dtype == Y_direct.dtype == jnp.float32
+    assert np.array_equal(np.asarray(Y), np.asarray(Y_direct))
+
+
+# ------------------------------------------------- npz round-trip --
+def test_npz_roundtrip_bf16_int16_bit_exact(tmp_path):
+    A = lst.csr_array(_random_csr(200, seed=12))
+    C = A.compress()
+    path = str(tmp_path / "compressed.npz")
+    save_npz(path, C)
+    L = load_npz(path)
+    # Storage dtypes survive the container.
+    assert str(L.dtype) == "bfloat16"
+    assert np.dtype(L.indices.dtype) == np.int16
+    assert L.shape == C.shape and L.nnz == C.nnz
+    # Bit-exact values: compare the raw 16-bit patterns.
+    assert np.array_equal(np.asarray(L.data).view(np.uint16),
+                          np.asarray(C.data).view(np.uint16))
+    np.testing.assert_array_equal(np.asarray(L.indices),
+                                  np.asarray(C.indices))
+    np.testing.assert_array_equal(np.asarray(L.indptr),
+                                  np.asarray(C.indptr))
+    # The loaded matrix dispatches the same lowp kernels bit-for-bit.
+    x = jnp.asarray(np.linspace(-1.0, 1.0, 200), jnp.float32)
+    assert np.array_equal(np.asarray(L @ x), np.asarray(C @ x))
+
+
+# ------------------------------------------------------ dist parity --
+@needs_grid
+@pytest.mark.parametrize("layout", ["1d-row", "1d-col", "2d-block"])
+def test_dist_lowp_parity_matches_local_dot(layout):
+    n = 96
+    A = lst.csr_array(_random_csr(n, density=0.08, seed=13))
+    C = A.compress()
+    x = jnp.asarray(np.linspace(-1.0, 1.0, n), jnp.float32)
+    y_local = np.asarray(C @ x)
+    mesh = (make_grid_mesh(2, 4) if layout == "2d-block"
+            else make_row_mesh())
+    dC = shard_csr(C, mesh=mesh, layout=layout)
+    xs = shard_vector(x, dC.mesh, dC.rows_padded, layout=dC.layout)
+    y = dist_spmv(dC, xs)
+    # Same promotion contract as the local dot: f32 out.
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y)[:n], y_local,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y)[:n], _scipy_ref(C, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+@needs_grid
+def test_dist_2d_block_carries_int16_cols():
+    A = lst.csr_array(_random_csr(96, density=0.08, seed=14))
+    dC = shard_csr(A.compress(), mesh=make_grid_mesh(2, 4),
+                   layout="2d-block")
+    # Block-local columns live in [0, cps): int16 end-to-end.
+    assert np.dtype(dC.cols.dtype) == np.int16
+    assert str(np.dtype(dC.data.dtype)) == "bfloat16"
+
+
+# -------------------------------------------------------- refine= --
+def test_cg_refine_auto_meets_f32_tolerance():
+    n = 120
+    A = lst.csr_array(_random_csr(n, density=0.05, seed=15, spd=True))
+    b = jnp.asarray(np.linspace(0.5, 1.5, n), jnp.float32)
+    rtol = 1e-6
+    atol = rtol * float(jnp.linalg.norm(b))
+    x, iters = linalg.cg(A, b, rtol=rtol, atol=0.0, refine="auto")
+    resid = float(jnp.linalg.norm(b - A @ x))
+    assert resid <= atol * 1.05
+    assert iters > 0
+    # One stacked host fetch per refinement cycle, counted.
+    assert counters.get("transfer.host_sync.cg_refine") >= 1
+
+
+def test_cg_refine_f64_system_uses_f32_inner():
+    n = 120
+    A_sp = _random_csr(n, density=0.05, seed=16, spd=True).astype(
+        np.float64)
+    A = lst.csr_array(A_sp)
+    b = jnp.asarray(np.linspace(0.5, 1.5, n), jnp.float64)
+    rtol = 1e-10
+    x, _ = linalg.cg(A, b, rtol=rtol, atol=0.0, refine="auto")
+    resid = float(jnp.linalg.norm(b - A @ x))
+    assert resid <= rtol * float(jnp.linalg.norm(b)) * 1.05
+    # The inner rung for f64 is f32 storage, one precision down.
+    inner = linalg._refine_inner_operator(A)
+    assert np.dtype(inner.dtype) == np.float32
+
+
+def test_gmres_refine_auto_meets_tolerance():
+    n = 80
+    rng = np.random.default_rng(17)
+    A_sp = sp.random(n, n, density=0.08, random_state=rng,
+                     format="csr", dtype=np.float64)
+    A_sp = (A_sp + 12.0 * sp.eye(n)).tocsr().astype(np.float32)
+    A = lst.csr_array(A_sp)
+    b = jnp.asarray(np.linspace(0.5, 1.5, n), jnp.float32)
+    rtol = 1e-6
+    x, _ = linalg.gmres(A, b, rtol=rtol, atol=0.0, refine="auto")
+    resid = float(jnp.linalg.norm(b - A @ x))
+    assert resid <= rtol * float(jnp.linalg.norm(b)) * 1.05
+    assert counters.get("transfer.host_sync.gmres_refine") >= 1
+
+
+def test_refine_rejects_bad_compositions():
+    n = 32
+    A = lst.csr_array(_random_csr(n, density=0.2, seed=18, spd=True))
+    b = np.ones(n, np.float32)
+    with pytest.raises(ValueError, match="composes with neither"):
+        linalg.cg(A, b, refine="auto", M=sp.eye(n).tocsr())
+    with pytest.raises(ValueError, match="composes with neither"):
+        linalg.gmres(A, b, refine="auto", callback=lambda x: None)
+    with pytest.raises(ValueError, match="positive cycle count"):
+        linalg.cg(A, b, refine=0)
+    # Already-low-precision storage has no rung below it.
+    with pytest.raises(ValueError, match="float32/float64"):
+        linalg.cg(A.compress(), b, refine="auto")
+    # Dense operands have no compressed inner representation.
+    with pytest.raises(ValueError, match="sparse-matrix operand"):
+        linalg.cg(np.eye(n, dtype=np.float32), b, refine="auto")

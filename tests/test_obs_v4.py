@@ -637,3 +637,54 @@ def test_doctor_obs_overhead_and_roofline_rules():
     # Loss terms ranked largest-first in the message.
     assert roof["message"].index("pad_ms") < roof["message"].index(
         "mask_ms")
+
+
+def _verdict_rec(key, label):
+    return {"type": "event", "name": "autotune.verdict", "ts_ns": 0,
+            "tid": 0, "attrs": {"key": key, "label": label}}
+
+
+def test_doctor_storage_wider_than_verdict():
+    """An f32-storage verdict for a fingerprint class that also holds
+    a bf16-storage verdict is the compressed-storage win sitting idle
+    — one warn finding per class, hint pointing at compress()."""
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.records = [
+        _verdict_rec(
+            "spmv/bfloat16/banded/w8/r64/z256/k1/si16@cpu:cpu:8/e0",
+            "csr-rowids-bf16"),
+        _verdict_rec(
+            "spmv/float32/banded/w8/r64/z256/k1@cpu:cpu:8/e0",
+            "csr-rowids"),
+        # Different fingerprint class: silent.
+        _verdict_rec(
+            "spmv/float32/powerlaw/w64/r262144/z2097152/k1@cpu:cpu:8/e0",
+            "sliced-ell"),
+        # Unparseable key: skipped, never crashes.
+        _verdict_rec("garbage", "x"),
+    ]
+    found = [f for f in doctor.diagnose(ev)
+             if f["code"] == "storage-wider-than-verdict"]
+    assert len(found) == 1
+    f = found[0]
+    assert f["severity"] == "warn"
+    assert "banded/w8" in f["message"]
+    assert "csr-rowids-bf16" in f["message"]
+    assert "compress()" in f["hint"]
+    # The storage tag and platform/epoch are structural no-ops: keys
+    # differing only there land in the same class.
+    assert doctor._parse_verdict_key(
+        "spmv/bfloat16/banded/w8/r64/z256/k1/si16@cpu:cpu:8/e0"
+    ) == doctor._parse_verdict_key(
+        "spmv/bfloat16/banded/w8/r64/z256/k1@tpu:v5p:64/e3")
+
+
+def test_doctor_storage_rule_quiet_without_f32_twin():
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.records = [_verdict_rec(
+        "spmv/bfloat16/banded/w8/r64/z256/k1/si16@cpu:cpu:8/e0",
+        "csr-rowids-bf16")]
+    assert not [f for f in doctor.diagnose(ev)
+                if f["code"] == "storage-wider-than-verdict"]

@@ -141,6 +141,26 @@ def _finding(sev: str, code: str, message: str, hint: str,
             "hint": hint, "value": value or "-"}
 
 
+def _parse_verdict_key(key_id: str):
+    """Split a ``VerdictKey.key_id`` —
+    ``op/dtype/fp_class/rN/zN/kN[/sTAG]@platform/eN`` — into
+    ``(op, dtype, fp_class, shape-bucket tuple)``, or None when the id
+    doesn't parse.  The optional ``/s`` storage tag and the
+    platform/epoch suffix are deliberately excluded: the
+    storage-wider-than-verdict rule compares *structural* identity
+    across storage representations."""
+    head = key_id.split("@", 1)[0]
+    parts = head.split("/")
+    if parts and parts[-1][:1] == "s":
+        parts = parts[:-1]
+    if len(parts) < 6:
+        return None
+    shape = tuple(parts[-3:])
+    if [t[:1] for t in shape] != ["r", "z", "k"]:
+        return None
+    return parts[0], parts[1], "/".join(parts[2:-3]), shape
+
+
 def diagnose(ev: Evidence) -> List[Dict[str, str]]:
     """Run every rule over the merged evidence; findings ranked
     critical-first, stable within severity (rule order)."""
@@ -228,6 +248,40 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
             "(autotune.route.* counters); stale store? "
             "LEGATE_SPARSE_TPU_AUTOTUNE_STORE path writable?",
             str(int(at_declines))))
+
+    # -- Storage wider than verdict: the autotuner measured a
+    #    bf16-storage winner for a fingerprint class, yet f32 storage
+    #    of the same class is still being tuned/dispatched — the
+    #    compressed-storage byte win is sitting idle.
+    bf16_classes: Dict[tuple, str] = {}
+    f32_classes: Dict[tuple, str] = {}
+    for rec in ev.records:
+        if rec.get("name") != "autotune.verdict":
+            continue
+        attrs = rec.get("attrs") or {}
+        parsed = _parse_verdict_key(str(attrs.get("key", "")))
+        if parsed is None:
+            continue
+        op, dtype, klass, shape = parsed
+        if dtype in ("bfloat16", "float16"):
+            bf16_classes[(op, klass, shape)] = str(
+                attrs.get("label", "?"))
+        elif dtype == "float32":
+            f32_classes[(op, klass, shape)] = str(attrs.get("key"))
+    for group in sorted(set(bf16_classes) & set(f32_classes)):
+        op, klass, shape = group
+        out.append(_finding(
+            "warn", "storage-wider-than-verdict",
+            f"f32 storage is being dispatched for {op}/{klass}/"
+            f"{'/'.join(shape)} although a compressed-storage verdict "
+            f"({bf16_classes[group]!r}) exists for the same "
+            f"fingerprint class — the measured byte win is sitting "
+            f"idle",
+            "csr_array.compress() the operand (bf16 values + int16 "
+            "indices) so the *-bf16 verdict serves the dispatch "
+            "(docs/AUTOTUNER.md 'Candidates'); keep f32 storage only "
+            "where the rounding is unacceptable",
+            f32_classes[group]))
 
     # -- Batch occupancy: a batching engine running solo requests.
     for label, breq, batches in (

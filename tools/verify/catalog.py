@@ -313,6 +313,72 @@ def _build_sliced_ell():
                  notes={"bins": len(bins)})
 
 
+def _f32acc_build(op: str):
+    """Low-byte-storage kernel programs (the autotune labels
+    ``csr-rowids-bf16`` / ``ell-bf16``): bf16 values with int16
+    column indices — the representation ``csr_array.compress``
+    produces — against an f32 operand.  Lowered at the jitted entry
+    points directly: the engine plan cache declines promotion, so the
+    autotune registry is the only dispatcher of these variants."""
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.ops import spmv as _ops
+
+    sds = jax.ShapeDtypeStruct
+    bf16, f32 = np.dtype("bfloat16"), np.dtype(np.float32)
+    if op in ("spmv", "spmm"):
+        nnz = 4 * N_1D
+        fn = (_ops.csr_spmv_rowids_f32acc if op == "spmv"
+              else _ops.csr_spmm_rowids_f32acc)
+        specs = (sds((nnz,), bf16), sds((nnz,), np.int16),
+                 sds((nnz,), np.int32),
+                 sds((N_1D,), f32) if op == "spmv"
+                 else sds((N_1D, 4), f32))
+    else:                                   # flat ELL
+        W = 3
+        fn = _ops.ell_spmv_f32acc
+        specs = (sds((N_1D, W), bf16), sds((N_1D, W), np.int16),
+                 sds((N_1D,), np.int32), sds((N_1D,), f32))
+    kw = {"rows": N_1D} if op in ("spmv", "spmm") else {}
+    hlo = fn.lower(*specs, **kw).as_text()
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kw))(*specs)
+    # The declared accumulator: products and segment/row reductions
+    # run in f32, the out narrows to result_type(data, x) == f32.
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 widening_allowed=("bf16->f32",))
+
+
+for _op, _pid in (("spmv", "kernel/csr-rowids-bf16/spmv"),
+                  ("spmm", "kernel/csr-rowids-bf16/spmm"),
+                  ("ell", "kernel/ell-bf16/spmv")):
+    _program(_pid, "kernel", _KERNEL_SRC)(
+        lambda op=_op: _f32acc_build(op))
+
+
+@_program("kernel/sliced-ell-bf16/spmv", "kernel", _KERNEL_SRC)
+def _build_sliced_ell_bf16():
+    import jax
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.ops.spmv import (
+        sliced_ell_pack, sliced_ell_spmv_f32acc,
+    )
+
+    C = _banded_np(N_1D).compress()         # bf16 values, int16 cols
+    bins = sliced_ell_pack(jnp.asarray(C.data),
+                           jnp.asarray(C.indices), C.indptr, N_1D)
+    x = jax.ShapeDtypeStruct((N_1D,), np.float32)
+    hlo = sliced_ell_spmv_f32acc.lower(bins, x, rows=N_1D).as_text()
+    jaxpr = jax.make_jaxpr(
+        lambda b, v: sliced_ell_spmv_f32acc(b, v, rows=N_1D))(bins, x)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 widening_allowed=("bf16->f32",),
+                 notes={"bins": len(bins)})
+
+
 # ------------------------------------------------------------------ #
 # dist_spmv / dist_spmm plan shapes
 # ------------------------------------------------------------------ #
@@ -326,6 +392,37 @@ _spmv_program("dist/spmv/1d-col/panel/f32", "dA_1dcol",
               layout="1d-col")
 _spmv_program("dist/spmv/2d-block/panel/f32", "dA_2d",
               layout="2d-block")
+
+
+@_program("dist/spmv/2d-block/panel/bf16", "dist", _DIST_SRC)
+def _build_spmv_2d_bf16():
+    """Compressed-panel realization of the SAME ("dist_spmv",
+    "2d-block", "panel") plan shape: bf16 panel values + int16
+    block-local column indices (``compress()`` upstream of
+    ``shard_csr``), bf16 x — every collective moves exactly half the
+    f32 program's bytes, priced by the same ledger formulas at
+    itemsize 2."""
+    import jax
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.parallel import shard_csr
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmv, shard_vector,
+    )
+
+    dA = _fix("dA_2d_bf16", lambda: shard_csr(
+        _banded_np(N_2D).compress(), mesh=_grid_mesh(),
+        layout="2d-block"))
+    x = shard_vector(jnp.ones(dA.shape[0], jnp.bfloat16), dA.mesh,
+                     dA.rows_padded, layout=dA.layout)
+    fn = lambda v: dist_spmv(dA, v)                 # noqa: E731
+    hlo = jax.jit(fn).lower(x).as_text()
+    jaxpr = jax.make_jaxpr(fn)(x)
+    return Built(hlo=hlo, jaxpr=jaxpr,
+                 predicted=_spmv_predicted(dA, itemsize=2),
+                 widening_allowed=("bf16->f32",),
+                 notes={"layout": dA.layout, "shards": dA.num_shards,
+                        "cols_dtype": str(dA.cols.dtype)})
 
 
 @_program("dist/spmm/1d-row/halo/f32", "dist", _DIST_SRC)
