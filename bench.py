@@ -667,8 +667,15 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # ``pde_ms_per_iter_bf16`` / ``pde_bytes_ratio`` (full lane), and
 # the 2-D dist panel field ``dist2d_spmv_comm_bytes_bf16`` — bf16
 # panels + int16 block-local indices, exactly half the f32 panel
-# bytes, golden-gated through the 1% ``*_comm_bytes`` band.
-SCHEMA_VERSION = 15
+# bytes, golden-gated through the 1% ``*_comm_bytes`` band.  16 =
+# recovery phase (docs/RESILIENCE.md): a deterministic device-loss
+# drill mid-``dist_cg`` on the all-device mesh — checkpoint saves at
+# the conv-fetch cadence, one seeded loss, shrink -> reshard ->
+# restore -> resume — recording the golden-pinned exact
+# ``resil_ckpt_saves`` / ``resil_recoveries`` / ``resil_restored``
+# plus the measured ``resil_reshard_bytes`` and the timing pair
+# ``recovery_clean_ms`` / ``recovery_recovered_ms``.
+SCHEMA_VERSION = 16
 
 
 def main() -> None:
@@ -1533,6 +1540,96 @@ def main() -> None:
                     _resil.reset()
         except Exception as e:
             sys.stderr.write(f"bench: resil phase failed: {e!r}\n")
+
+    # Recovery phase (schema_version 16, docs/RESILIENCE.md): a
+    # deterministic device-loss drill mid-``dist_cg`` on the
+    # all-device mesh.  Checkpoints ride the conv-fetch cadence
+    # (every 10 iterations), a seeded loss fires at the second fetch,
+    # and the ladder shrinks the mesh, reshards the operands, restores
+    # the it=20 snapshot and resumes the remaining budget.  With
+    # rtol=0 the iteration plan is fixed, so the counter deltas are
+    # exact and the smoke golden pins them: 4 checkpoint saves
+    # (two pre-loss + two post-restore), 1 recovery restoring 20
+    # iterations, and the measured survivor-repartition bytes.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_RECOVERY",
+                           "0") != "1")
+            and not past_deadline(result, "recovery")):
+        try:
+            import time as _time
+
+            from legate_sparse_tpu import resilience as _resil
+            from legate_sparse_tpu.parallel import (
+                dist_cg, make_row_mesh, shard_csr,
+            )
+            from legate_sparse_tpu.settings import settings as _rst
+
+            mesh_rec = make_row_mesh()
+            if int(mesh_rec.shape["rows"]) >= 2:
+                n_rec = 1 << (12 if smoke else 16)
+                maxit_rec, cti_rec = 40, 10
+                saved = (_rst.resil, _rst.resil_ckpt_iters,
+                         _rst.resil_backoff_ms)
+                with obs.span("bench.recovery") as _sp:
+                    try:
+                        _rst.resil = True
+                        _rst.resil_ckpt_iters = cti_rec
+                        _rst.resil_backoff_ms = 0.0
+                        _resil.reset()
+                        A_rec = _banded_config(sparse, n_rec,
+                                               nnz_per_row)
+                        dA_rec = shard_csr(A_rec, mesh=mesh_rec)
+                        b_rec = np.ones(n_rec, np.float32)
+                        _ = dist_cg(dA_rec, b_rec, rtol=0.0,
+                                    maxiter=maxit_rec,
+                                    conv_test_iters=cti_rec)  # compile
+                        t0 = _time.perf_counter()
+                        _ = dist_cg(dA_rec, b_rec, rtol=0.0,
+                                    maxiter=maxit_rec,
+                                    conv_test_iters=cti_rec)
+                        clean_ms = (_time.perf_counter() - t0) * 1e3
+                        c0 = {k: obs.counters.get(k) for k in (
+                            "resil.ckpt.saves",
+                            "resil.recovery.attempts",
+                            "resil.recovery.restored_iters",
+                            "resil.recovery.reshard_bytes")}
+                        _resil.inject("solver.cg.conv", "device_loss",
+                                      after=2, device=1)
+                        t0 = _time.perf_counter()
+                        _x, it_rec = dist_cg(dA_rec, b_rec, rtol=0.0,
+                                             maxiter=maxit_rec,
+                                             conv_test_iters=cti_rec)
+                        recovered_ms = (_time.perf_counter() - t0) * 1e3
+                        result["recovery_clean_ms"] = round(clean_ms, 4)
+                        result["recovery_recovered_ms"] = round(
+                            recovered_ms, 4)
+                        result["resil_ckpt_saves"] = int(
+                            obs.counters.get("resil.ckpt.saves")
+                            - c0["resil.ckpt.saves"])
+                        result["resil_recoveries"] = int(
+                            obs.counters.get("resil.recovery.attempts")
+                            - c0["resil.recovery.attempts"])
+                        result["resil_restored"] = int(
+                            obs.counters.get(
+                                "resil.recovery.restored_iters")
+                            - c0["resil.recovery.restored_iters"])
+                        result["resil_reshard_bytes"] = int(
+                            obs.counters.get(
+                                "resil.recovery.reshard_bytes")
+                            - c0["resil.recovery.reshard_bytes"])
+                        if _sp is not None:
+                            _sp.set(
+                                saves=result["resil_ckpt_saves"],
+                                recoveries=result["resil_recoveries"],
+                                reshard_bytes=result[
+                                    "resil_reshard_bytes"],
+                                iters=int(it_rec))
+                    finally:
+                        (_rst.resil, _rst.resil_ckpt_iters,
+                         _rst.resil_backoff_ms) = saved
+                        _resil.reset()
+        except Exception as e:
+            sys.stderr.write(f"bench: recovery phase failed: {e!r}\n")
 
     # Saturation phase (schema_version 10, obs v3): offered load vs
     # the request executor — the p50/p99-vs-load curve ROADMAP item 1

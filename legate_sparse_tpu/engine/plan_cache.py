@@ -58,7 +58,10 @@ class PlanKey:
     cols_b: int             # bucketed x/operand length
     nnz_b: int              # bucketed stored-entry count
     k_b: int = 1            # bucketed dense-operand width (SpMM/batch)
-    mesh_fp: str = ""       # "" = single-device
+    mesh_fp: str = ""       # "" = single-device; folds layout+grid, so
+                            # a resharded matrix (parallel.reshard:
+                            # new mesh/layout) never aliases its
+                            # source's cached plans
     epoch: int = 0          # settings epoch at build time
 
     @property

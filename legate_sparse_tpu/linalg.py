@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from . import obs as _obs
 from .obs import latency as _lat
+from .resilience import checkpoint as _rckpt
 from .resilience import deadline as _rdeadline
 from .resilience import faults as _rfaults
 from .resilience import health as _rhealth
@@ -404,6 +405,12 @@ def _refined_solve(solver: str, inner_solve: Callable, A_op, A_in,
             _obs.inc(f"transfer.host_sync.{solver}_refine")
             if monitor is not None:
                 monitor.observe(rn, total, partial=x)
+            if _rsettings.resil:
+                # The refinement fetch IS a cadence point: enforce the
+                # request deadline here too, or a refined solve could
+                # outlive its budget unnoticed (regression-tested).
+                _rdeadline.raise_if_expired(site, iterations=total,
+                                            residual=rn, partial=x)
             if rn < atol or total >= maxiter:
                 break
             d, it = inner_solve(
@@ -495,11 +502,13 @@ def _cg_loop(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
 def _resil_solver_active() -> bool:
     """Route a solve through the chunked resilience driver?  Requires
     the master switch AND something that needs per-cycle host
-    decisions (an active deadline scope, or health detection opted
-    in) — so ``LEGATE_SPARSE_TPU_RESIL=1`` alone leaves the one-shot
+    decisions (an active deadline scope, health detection opted in,
+    or a checkpoint scope that wants the fetch cadence) — so
+    ``LEGATE_SPARSE_TPU_RESIL=1`` alone leaves the one-shot
     while_loop path untouched."""
     return _rsettings.resil and (
-        _rdeadline.current() is not None or _rhealth.active())
+        _rdeadline.current() is not None or _rhealth.active()
+        or _rckpt.active())
 
 
 def _cg_loop_resil(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
@@ -541,6 +550,7 @@ def _cg_loop_resil(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
     state = _cg_state0(A_mv, b, x0, atol, maxiter)
     step = max(int(conv_test_iters), 1)
     monitor = _rhealth.Monitor(site)
+    ckpt = _rckpt.current()
     it = 0
     resid = None
     while it < maxiter:
@@ -566,6 +576,10 @@ def _cg_loop_resil(A_mv: Callable, M_mv: Callable, b, x0, atol: float,
         done = bool(arr[1])
         resid = float(np.sqrt(arr[2]))
         monitor.observe(resid, it, partial=state[0])
+        if ckpt is not None and not done:
+            # Checkpoint cadence rides the chunk fetch: snapshot the
+            # restartable Krylov state (x, r, p) into host buffers.
+            ckpt.maybe_save(it, (state[0], state[1], state[2]))
         if done:
             break
     return state[0], state[4]
@@ -879,6 +893,7 @@ def gmres(
     # all riding the one existing host sync per cycle.
     resil = _rsettings.resil
     monitor = _rhealth.Monitor("solver.gmres.conv") if resil else None
+    ckpt = _rckpt.current() if resil else None
     resid_f = None
     iters = 0
     while iters < maxiter:
@@ -916,6 +931,10 @@ def gmres(
                 break          # converged at cycle start: keep x
             x = x_new
         iters += restart
+        if ckpt is not None:
+            # GMRES restarts from its iterate alone — the Arnoldi seed
+            # x is the whole restartable state.
+            ckpt.maybe_save(iters, (x,))
         if callback is not None:
             if callback_type == "pr_norm":
                 callback(float(jnp.linalg.norm(b - A_op.matvec(x))) / bnrm2)

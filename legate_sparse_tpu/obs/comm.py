@@ -293,6 +293,22 @@ def cg_iteration_volumes(spmv_vols: Volumes, itemsize: int,
     return merge(spmv_vols, {"psum": 3 * psum_bytes(1, itemsize, shards)})
 
 
+def reshard_volumes(*, moved_chunks: int, chunk_elems: int,
+                    itemsize: int, shards: int) -> Volumes:
+    """One cached chunk-permute reshard program
+    (``parallel/reshard.py``): a single ``ppermute`` over the flat
+    device order moving ``moved_chunks`` per-device chunks of
+    ``chunk_elems`` elements each — chunks whose source and
+    destination device coincide are identity pairs and move nothing
+    (the same fixed-point discount as ``transpose_moved_chunks``).
+    Zero volumes (single shard, or an identity placement) mean the
+    lowered program contains no collective at all."""
+    if shards <= 1 or moved_chunks <= 0:
+        return {}
+    b = int(moved_chunks) * int(chunk_elems) * int(itemsize)
+    return {"ppermute": b} if b else {}
+
+
 def gmres_cycle_volumes(spmv_vols: Volumes, restart: int, itemsize: int,
                         shards: int) -> Volumes:
     """One sync-free GMRES restart cycle: ``restart + 1`` SpMV

@@ -393,6 +393,33 @@ def render_resil_table(counters: Dict[str, Any]) -> str:
             f"{int(counters.get('resil.fault.trace_skipped', 0))} "
             f"trace-suppressed"
         )
+    saves = counters.get("resil.ckpt.saves", 0)
+    if saves or counters.get("resil.ckpt.restores", 0):
+        lines.append(
+            f"checkpoints: {int(saves)} saved "
+            f"({int(counters.get('resil.ckpt.bytes', 0))} host bytes, "
+            f"{counters.get('resil.ckpt.ms', 0):.1f} ms), "
+            f"{int(counters.get('resil.ckpt.restores', 0))} restored"
+        )
+    rec = counters.get("resil.recovery.attempts", 0)
+    if rec:
+        lines.append(
+            f"recoveries: {int(rec)} device losses, "
+            f"{int(counters.get('resil.recovery.mesh_shrink', 0))} "
+            f"mesh shrinks moving "
+            f"{int(counters.get('resil.recovery.reshard_bytes', 0))} "
+            f"reshard bytes, "
+            f"{int(counters.get('resil.recovery.restored_iters', 0))} "
+            f"iterations restored, "
+            f"{int(counters.get('resil.recovery.succeeded', 0))} "
+            f"solves completed"
+        )
+    abft = counters.get("resil.abft.checks", 0)
+    if abft:
+        lines.append(
+            f"abft: {int(abft)} checksummed SpMVs, "
+            f"{int(counters.get('resil.abft.mismatch', 0))} mismatches"
+        )
     return "\n".join(lines)
 
 

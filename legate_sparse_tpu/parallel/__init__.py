@@ -28,6 +28,7 @@ from .mesh import (  # noqa: F401
     make_row_mesh,
     resolve_layout,
     row_spec,
+    survivor_mesh,
 )
 from .dist_csr import (  # noqa: F401
     DistCSR,
@@ -43,6 +44,7 @@ from .dist_csr import (  # noqa: F401
     dist_plan_fingerprint,
     mesh_fingerprint,
 )
+from .reshard import chunk_permute_plan, reshard, reshard_vector  # noqa: F401
 from .dist_spgemm import dist_spgemm  # noqa: F401
 from .dist_csr import dist_diagonal  # noqa: F401
 from .dist_build import dist_diags, dist_poisson2d  # noqa: F401

@@ -35,6 +35,7 @@ last local row with value 0.
 
 from __future__ import annotations
 
+import contextlib
 import math
 from dataclasses import dataclass
 from functools import lru_cache, partial
@@ -50,7 +51,10 @@ from ..obs import context as _tctx
 from ..obs import latency as _lat
 from ..engine import engine_enabled as _engine_enabled
 from ..engine import get_engine as _get_engine
+from ..resilience import checkpoint as _rckpt
+from ..resilience import faults as _rfaults
 from ..resilience import guarded_call as _resil_guarded
+from ..resilience.outcomes import ChecksumError, DeviceLost
 from ..settings import settings as _rsettings
 from ..types import index_dtype
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -59,7 +63,7 @@ from ..csr import csr_array
 from .mesh import (
     COL_AXIS, LAYOUT_1D_COL, LAYOUT_1D_ROW, LAYOUT_2D_BLOCK,
     LAYOUT_AUTO, ROW_AXIS, factor_grid, make_grid_mesh, make_row_mesh,
-    resolve_layout,
+    resolve_layout, survivor_mesh,
 )
 
 
@@ -690,7 +694,9 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
                 f"shard_csr: precise images are a 1d-row realization; "
                 f"not supported with layout={lay!r}"
             )
-        return _shard_csr_2d(A, mesh, lay)
+        dist = _shard_csr_2d(A, mesh, lay)
+        dist._src_csr = A
+        return dist
     if ell_max_expand is None:
         ell_max_expand = settings.ell_max_expand
     if precise is None:
@@ -844,6 +850,10 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
                       if dia_mask_blocks is not None else None),
             nnz_hint=nnz,
         ))
+        # Retain the host source for parallel/reshard.py's repartition
+        # path (recovery ladder: survivor-mesh re-shard after a device
+        # loss) — a host reference, not a device copy.
+        dist._src_csr = A
         return dist
 
     # Padded-CSR fallback: (R, nnz_max) + static row ids.
@@ -872,7 +882,7 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
     _obs.event("shard_csr.layout", layout="padded-csr", halo=halo,
                precise=bool(precise), shards=R, rows=rows, nnz=nnz,
                banded=dia_offs is not None)
-    return attach_dia_prepack(DistCSR(
+    dist = attach_dia_prepack(DistCSR(
         data=put(data_b), cols=put(idx_b),
         counts=put(local_nnz.astype(np.int32)), row_ids=put(rid_b),
         shape=(rows, cols), rows_per_shard=rps, halo=halo, ell=False,
@@ -886,6 +896,8 @@ def shard_csr(A: csr_array, mesh: Optional[Mesh] = None,
                   if dia_mask_blocks is not None else None),
         nnz_hint=nnz,
     ))
+    dist._src_csr = A
+    return dist
 
 
 def shard_vector(x, mesh: Mesh, rows_padded: int,
@@ -1223,6 +1235,7 @@ DIST_PLAN_SHAPES: Tuple[Tuple[str, str, str], ...] = (
     ("dist_cg", "1d-row", "halo"),
     ("dist_cg", "2d-block", "panel"),
     ("dist_gmres", "1d-row", "halo"),
+    ("dist_reshard", "1d-row", "chunk-permute"),
 )
 
 
@@ -1293,9 +1306,69 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     ``solver.*.conv``) own recovery for those.
     """
     if _rsettings.resil and csr_array._can_build_cache(x):
+        if _rsettings.resil_abft:
+            return _resil_guarded("dist.spmv",
+                                  lambda: _dist_spmv_abft(A, x))
         return _resil_guarded("dist.spmv",
                               lambda: _dist_spmv_impl(A, x))
     return _dist_spmv_impl(A, x)
+
+
+def _abft_checksum_vector(A: DistCSR, xlen: int):
+    """The sharded column-checksum vector w (w_j = sum_i A_ij) an
+    ABFT-verified SpMV dots against x, built once per matrix from the
+    retained host source and cached on ``A``.  None when the matrix
+    cannot carry one (no retained source, or non-square — the padded
+    x and y lengths then differ and the identity sum(y) = <w, x> has
+    no shared sharding)."""
+    cached = getattr(A, "_abft_w", None)
+    if cached is not None and cached[0] == xlen:
+        return cached[1]
+    src = getattr(A, "_src_csr", None)
+    rows, cols = A.shape
+    if src is None or rows != cols:
+        return None
+    wv = np.zeros(cols, dtype=np.float64)
+    np.add.at(wv, np.asarray(src.indices),
+              np.asarray(src.data, dtype=np.float64))
+    w = shard_vector(jnp.asarray(wv, dtype=A.dtype), A.mesh, xlen,
+                     layout=A.layout)
+    A._abft_w = (xlen, w)
+    return w
+
+
+def _dist_spmv_abft(A: DistCSR, x: jax.Array) -> jax.Array:
+    """Opt-in ABFT-checksummed eager SpMV (``settings.resil_abft``):
+    carry the column checksum w through the dispatch and verify
+    sum(y) = <w, x> at the fetch.  The comparison tolerance scales
+    with <|w|, |x|> (the condition of the checksum sum), and the
+    NaN-safe ``not (diff <= tol)`` form turns a poisoned y into a
+    detection rather than a silent pass.  A mismatch raises the
+    retryable :class:`~..resilience.outcomes.ChecksumError` — the
+    ``dist.spmv`` policy site re-dispatches from the intact operands,
+    turning a corrupted collective into a typed, counted retry.
+    Matrices without a checksum vector run unverified (documented in
+    docs/RESILIENCE.md; traced solver loops are covered by the
+    conv-fetch health monitors instead)."""
+    w = _abft_checksum_vector(A, int(x.shape[0]))
+    y = _dist_spmv_impl(A, x)
+    if w is None:
+        return y
+    # Value-carrying drill site: a nonfinite arm poisons y exactly as
+    # a corrupted collective would.
+    y = _rfaults.fault_point("dist.spmv.abft", y)
+    stats = jnp.stack([jnp.sum(y), jnp.vdot(w, x),
+                       jnp.vdot(jnp.abs(w), jnp.abs(x))])
+    observed, expected, scale = (float(v) for v in np.asarray(stats))
+    eps = float(jnp.finfo(jnp.result_type(A.dtype, x.dtype)).eps)
+    tol = 64.0 * eps * (abs(scale) + 1.0)
+    _obs.inc("resil.abft.checks")
+    if not (abs(observed - expected) <= tol):
+        _obs.inc("resil.abft.mismatch")
+        _obs.event("resil.abft.mismatch", observed=observed,
+                   expected=expected, tol=tol)
+        raise ChecksumError("dist.spmv.abft", observed, expected)
+    return y
 
 
 def _dist_spmv_impl(A: DistCSR, x: jax.Array) -> jax.Array:
@@ -1739,6 +1812,111 @@ def _shard_system(A: DistCSR, b, x0, maxiter, callback):
     return rows, b_sh, x0_sh, maxiter, cb
 
 
+@contextlib.contextmanager
+def _maybe_ckpt_scope(site: str):
+    """Open a checkpoint scope for a distributed solve when the knob
+    asks for one (``settings.resil_ckpt_iters > 0``) and no caller
+    scope is already bound — the caller's scope always wins (scopes do
+    not compose; see resilience/checkpoint.py)."""
+    if (_rsettings.resil and _rckpt.current() is None
+            and _rsettings.resil_ckpt_iters > 0):
+        with _rckpt.scope(site) as ck:
+            yield ck
+    else:
+        yield _rckpt.current()
+
+
+def _solve_with_recovery(site: str, A: "DistCSR", b, b_sh, x0_sh,
+                         maxiter: int, solve_fn, guard: bool = True):
+    """The device-loss recovery ladder (docs/RESILIENCE.md) around a
+    distributed solve: **detect** (a ``DeviceLost`` escapes the retry
+    policy un-retried, surfacing at the conv-fetch cadence) ->
+    **shrink** (``survivor_mesh`` drops the lost flat ordinal) ->
+    **reshard** (retained-source repartition of ``A`` onto the
+    survivor grid) -> **restore** (the last checkpoint's iterate —
+    else the original ``x0``) -> **resume** with the remaining
+    iteration budget.  Converges to the same tolerance instead of
+    raising.
+
+    ``solve_fn(A_cur, b_sh_cur, x0_sh_cur, miter) -> (x, iters)``
+    runs the solve over operands sharded for ``A_cur``; the ladder
+    owns re-sharding ``b`` / the restart iterate after each shrink
+    (from HOST state — the old mesh's arrays may be unreadable after
+    a real loss, which is why the checkpoint path snapshots to host
+    buffers).  ``guard=True`` wraps each attempt as the ``site``
+    fault/retry site; gmres passes False (its cycle loop already owns
+    the ``solver.gmres.conv`` site).  Recoveries are bounded by the
+    shard count: each loss removes one device, and a single-shard
+    solve has nothing to shrink to, so the ``DeviceLost`` re-raises.
+
+    Accounting (pinned by tests): per recovery, one each of
+    ``resil.recovery.attempts`` / ``.device_loss`` / ``.mesh_shrink``,
+    ``resil.recovery.restored_iters`` by the checkpoint's credited
+    iterations, ``resil.recovery.reshard_bytes`` by the measured
+    ``transfer.shard_upload_bytes`` delta of the repartition, and one
+    ``resil.recovery`` event; ``resil.recovery.succeeded`` once when
+    a recovered solve completes.  Returns ``(x, total_iters, A_fin)``
+    — iterations credited from restores count toward the total, and
+    the comm ledger prices the final mesh.
+    """
+    from .reshard import reshard
+
+    rows = A.shape[0]
+    ck = _rckpt.current()
+    A_cur, b_cur, x0_cur = A, b_sh, x0_sh
+    miter = int(maxiter)
+    base = 0          # iterations credited from restored checkpoints
+    recovered = 0
+    while True:
+        try:
+            if guard:
+                x, iters = _resil_guarded(
+                    site, partial(solve_fn, A_cur, b_cur, x0_cur,
+                                  miter))
+            else:
+                x, iters = solve_fn(A_cur, b_cur, x0_cur, miter)
+            if recovered:
+                _obs.inc("resil.recovery.succeeded")
+            return x, base + int(iters), A_cur
+        except DeviceLost as e:
+            if A_cur.num_shards <= 1:
+                raise
+            recovered += 1
+            _obs.inc("resil.recovery.attempts")
+            _obs.inc("resil.recovery.device_loss")
+            survivors = survivor_mesh(A_cur.mesh, int(e.device))
+            before = int(A_cur.num_shards)
+            up0 = _obs.snapshot().get("transfer.shard_upload_bytes", 0)
+            A_cur = reshard(A_cur, mesh=survivors, layout=A_cur.layout)
+            moved = (_obs.snapshot().get("transfer.shard_upload_bytes",
+                                         0) - up0)
+            _obs.inc("resil.recovery.mesh_shrink")
+            _obs.inc("resil.recovery.reshard_bytes", int(moved))
+            b_cur = shard_vector(jnp.asarray(b), A_cur.mesh,
+                                 A_cur.rows_padded, layout=A_cur.layout)
+            snap = ck.restore() if ck is not None else None
+            if snap is not None:
+                it0, arrays = snap
+                # Plain restart from the checkpointed x: r and p
+                # re-derive from scratch, preserving convergence to
+                # tolerance (not the exact iterate sequence).
+                x_host = np.asarray(arrays[0])[:rows]
+                base += int(it0)
+                _obs.inc("resil.recovery.restored_iters", int(it0))
+                ck.rebase()
+            else:
+                x_host = np.asarray(x0_sh)[:rows]
+            x0_cur = shard_vector(jnp.asarray(x_host, dtype=b_cur.dtype),
+                                  A_cur.mesh, A_cur.rows_padded,
+                                  layout=A_cur.layout)
+            miter = max(int(maxiter) - base, 1)
+            _obs.event("resil.recovery", site=site,
+                       device=int(e.device), shards_before=before,
+                       shards_after=int(A_cur.num_shards),
+                       restored_iters=(int(snap[0]) if snap else 0),
+                       reshard_bytes=int(moved))
+
+
 def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
                maxiter=None, M=None, callback=None, atol: float = 0.0,
                callback_type=None, rtol: float = 1e-5):
@@ -1771,12 +1949,28 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
     with _tctx.profiler_scope("dist_gmres"), \
             _obs.span("dist_gmres", n=rows, shards=A.num_shards,
                       restart=restart_eff) as sp:
-        x, info = _gmres(
-            _padded_operator(A), b_sh, x0=x0_sh, tol=tol,
-            restart=restart, maxiter=maxiter, M=_padded_precond(M, A),
-            callback=cb, atol=atol, callback_type=callback_type,
-            rtol=rtol,
-        )
+        # Resilience: the cycle loop inside ``_gmres`` owns the
+        # ``solver.gmres.conv`` fault/retry site and the checkpoint
+        # cadence (the Arnoldi seed x per cycle); a ``DeviceLost``
+        # escaping it routes through the recovery ladder, which
+        # re-seeds the restarted Arnoldi from the last snapshot on
+        # the survivor mesh (guard=False: no second policy wrap).
+        def _solve(A_cur, b_cur, x0_cur, miter):
+            return _gmres(
+                _padded_operator(A_cur), b_cur, x0=x0_cur, tol=tol,
+                restart=restart, maxiter=miter,
+                M=_padded_precond(M, A_cur), callback=cb, atol=atol,
+                callback_type=callback_type, rtol=rtol,
+            )
+
+        if _rsettings.resil:
+            with _maybe_ckpt_scope("dist.gmres"):
+                x, info, A_fin = _solve_with_recovery(
+                    "dist.gmres", A, b, b_sh, x0_sh, int(maxiter),
+                    _solve, guard=False)
+        else:
+            x, info = _solve(A, b_sh, x0_sh, maxiter)
+            A_fin = A
         # Comm ledger: the driver returns iterations as a host int, so
         # the cycle count is free (approximated as ceil(iters/restart);
         # a run converging at cycle start reports one cycle fewer than
@@ -1784,11 +1978,11 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
         # realizations + the Arnoldi/MGS scalar psums.
         cycles = max(1, -(-int(info) // restart_eff))
         item = jnp.dtype(b_sh.dtype).itemsize
-        spmv = spmv_comm_volumes(A, A.rows_padded // A.num_shards,
-                                 item)
+        spmv = spmv_comm_volumes(
+            A_fin, A_fin.rows_padded // A_fin.num_shards, item)
         vols = _comm.scale(
             _comm.gmres_cycle_volumes(spmv, restart_eff, item,
-                                      A.num_shards),
+                                      A_fin.num_shards),
             cycles,
         )
         n_psum = cycles * (restart_eff * (restart_eff + 1) // 2
@@ -1798,7 +1992,7 @@ def dist_gmres(A: DistCSR, b, x0=None, tol=None, restart=None,
         # "psum" call count (its psum_scatter output reduction).
         calls["psum"] = calls.get("psum", 0) + n_psum
         comm_bytes = _comm.record("dist_gmres", vols, calls,
-                                  layout=A.layout)
+                                  layout=A_fin.layout)
         if sp is not None:
             sp.set(iters=int(info), cycles=cycles,
                    comm_bytes=comm_bytes,
@@ -2068,21 +2262,30 @@ def dist_cg(
             # site — an injected (or real) collective failure retries
             # the solve from x0, which re-converges to the identical
             # answer instead of corrupting the Krylov state.  An
-            # active deadline scope / health opt-in swaps in the
-            # chunked driver (one fetch per conv_test_iters cycle —
-            # the existing cadence).
-            def _solve():
+            # active deadline scope / health opt-in / checkpoint
+            # scope swaps in the chunked driver (one fetch per
+            # conv_test_iters cycle — the existing cadence), and a
+            # ``DeviceLost`` routes through the recovery ladder
+            # (shrink -> reshard -> restore -> resume).  NOTE: after
+            # a shrink, ``M`` is applied to survivor-mesh vectors —
+            # a mesh-agnostic jittable callable recovers; a
+            # mesh-pinned preconditioner will not.
+            def _solve(A_cur, b_cur, x0_cur, miter):
                 loop = (_cg_loop_resil if _resil_solver_active()
                         else _cg_loop)
                 return loop(
-                    A.matvec_fn(), M_mv, b_sh, x0_sh, atol,
-                    int(maxiter), int(conv_test_iters),
+                    A_cur.matvec_fn(), M_mv, b_cur, x0_cur, atol,
+                    int(miter), int(conv_test_iters),
                 )
 
             if _rsettings.resil:
-                x, iters = _resil_guarded("dist.cg", _solve)
+                with _maybe_ckpt_scope("dist.cg"):
+                    x, iters, A_fin = _solve_with_recovery(
+                        "dist.cg", A, b, b_sh, x0_sh, int(maxiter),
+                        _solve)
             else:
-                x, iters = _solve()
+                x, iters = _solve(A, b_sh, x0_sh, maxiter)
+                A_fin = A
             if sp is not None:
                 # One host sync for honest timing + the true iteration
                 # count (tracing mode only; see linalg.cg).  The same
@@ -2090,11 +2293,11 @@ def dist_cg(
                 # once, so the per-iteration volumes are multiplied out
                 # here rather than at the (trace-time) dispatch.
                 it = int(iters)
-                vols, calls = cg_comm_volumes(A, item, it)
+                vols, calls = cg_comm_volumes(A_fin, item, it)
                 sp.set(iters=it,
                        comm_bytes=_comm.record("dist_cg", vols,
                                                calls,
-                                               layout=A.layout),
+                                               layout=A_fin.layout),
                        comm_calls=sum(
                            calls[k] for k, b in vols.items()
                            if b > 0))

@@ -128,6 +128,36 @@ def make_row_mesh(devices: Optional[Sequence | int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (ROW_AXIS,))
 
 
+def survivor_mesh(mesh: Mesh, lost: int | Sequence[int]) -> Mesh:
+    """The shrunken mesh after losing device(s) at flat ordinal(s)
+    ``lost`` — the recovery ladder's mesh-shrink step
+    (docs/RESILIENCE.md).
+
+    Survivors keep the source mesh's flat device order (minus the
+    lost ordinals), so row blocks stay contiguous per host after the
+    reshard.  A 1-D ``rows`` mesh shrinks to a 1-D ``rows`` mesh; a
+    2-D (rows, cols) grid re-factors the survivor count through
+    ``factor_grid`` (a lost device rarely leaves the original grid
+    shape intact).  Errors rather than returning an empty mesh when
+    every device is lost.
+    """
+    flat = list(np.asarray(mesh.devices).reshape(-1))
+    lost_set = {int(lost)} if isinstance(lost, int) else {
+        int(i) for i in lost}
+    bad = [i for i in lost_set if not 0 <= i < len(flat)]
+    if bad:
+        raise ValueError(
+            f"survivor_mesh: lost ordinal(s) {sorted(bad)} outside "
+            f"flat mesh of {len(flat)} devices")
+    survivors = [d for i, d in enumerate(flat) if i not in lost_set]
+    if not survivors:
+        raise ValueError("survivor_mesh: no devices survive")
+    if len(mesh.axis_names) == 1:
+        return Mesh(np.asarray(survivors), mesh.axis_names)
+    r, c = factor_grid(len(survivors))
+    return Mesh(np.asarray(survivors).reshape(r, c), mesh.axis_names)
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:

@@ -34,6 +34,12 @@ observable** (``docs/RESILIENCE.md``):
                  closed catalog under live multi-tenant gateway load,
                  with exactly-once / exact-accounting / bitwise-parity
                  invariant checks (``docs/RESILIENCE.md``).
+- ``checkpoint`` — restartable solver snapshots at the same
+                 one-fetch-per-cycle cadence (host buffers, overhead
+                 ledgered in ``resil.ckpt.*``); the recovery ladder in
+                 ``dist_cg``/``dist_gmres`` restores the last snapshot
+                 after a ``DeviceLost`` and resumes on the shrunken
+                 survivor mesh (``parallel/reshard.py``).
 
 Inert by default: with ``LEGATE_SPARSE_TPU_RESIL`` unset every hook is
 one flag read, no site adds a host sync, and behavior is bit-for-bit
@@ -44,21 +50,27 @@ events; ``tools/trace_summary.py --resil`` renders the ledger.
 
 from __future__ import annotations
 
-from . import chaos, deadline, faults, health, outcomes, policy  # noqa: F401
+from . import (  # noqa: F401
+    chaos, checkpoint, deadline, faults, health, outcomes, policy,
+)
+from .checkpoint import SolverCheckpoint  # noqa: F401
 from .faults import CATALOG, InjectedFault, fault_point, inject  # noqa: F401
 from .health import Monitor, SolverHealthError  # noqa: F401
 from .outcomes import (  # noqa: F401
-    DeadlineExceeded, FinalOutcomeError, HealthReport, Rejected,
-    ResilienceError,
+    ChecksumError, DeadlineExceeded, DeviceLost, FinalOutcomeError,
+    HealthReport, Rejected, ResilienceError,
 )
 from .policy import CircuitOpenError, breaker, run  # noqa: F401
 from ..settings import settings as _settings
 
 __all__ = [
-    "chaos", "deadline", "faults", "health", "outcomes", "policy",
+    "chaos", "checkpoint", "deadline", "faults", "health", "outcomes",
+    "policy",
+    "SolverCheckpoint",
     "CATALOG", "InjectedFault", "fault_point", "inject",
     "Monitor", "SolverHealthError",
-    "DeadlineExceeded", "FinalOutcomeError", "HealthReport", "Rejected",
+    "ChecksumError", "DeadlineExceeded", "DeviceLost",
+    "FinalOutcomeError", "HealthReport", "Rejected",
     "ResilienceError",
     "CircuitOpenError", "breaker", "run",
     "active", "guarded_call", "reset",

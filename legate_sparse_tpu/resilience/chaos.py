@@ -88,6 +88,7 @@ class ChaosReport:
     errors: int = 0
     faults_armed: int = 0
     faults_fired: int = 0
+    recoveries: int = 0
     per_tenant: Dict[str, Dict[str, int]] = field(default_factory=dict)
     violations: List[str] = field(default_factory=list)
 
@@ -108,11 +109,89 @@ def _arm_random_faults(rng: random.Random, sites: Sequence[str],
         report.faults_armed += 1
 
 
+def _run_device_loss_scenario(rng: random.Random, spec: dict,
+                              report: ChaosReport) -> None:
+    """One seeded device-loss recovery solve under the in-flight
+    gateway load (docs/RESILIENCE.md): arm a ``device_loss`` at the CG
+    conv-fetch cadence (the lost ordinal drawn from the drill RNG),
+    run a checkpointed ``dist_cg``, and hold the scenario to three
+    invariants:
+
+    1. **Exactly-once resolution** — the solve returns one value and
+       never raises (the recovery ladder absorbs the loss).
+    2. **Exact accounting** — the ``resil.recovery.*`` /
+       ``resil.ckpt.restores`` deltas are exactly one recovery's
+       worth, and the reshard moved a nonzero byte count.
+    3. **Scipy-differential parity** — the recovered solution matches
+       ``scipy.sparse.linalg.spsolve`` on the same system within the
+       drill tolerance (a recovery may change the iterate path, never
+       the answer).
+
+    The spec's matrix must need more than ``2 * conv_test_iters``
+    iterations, so a checkpoint lands before the loss fires."""
+    import scipy.sparse as _sp
+    import scipy.sparse.linalg as _spla
+
+    from ..parallel.dist_csr import dist_cg
+    from . import checkpoint as _ckpt
+
+    A = spec["A"]
+    b = np.asarray(spec["b"])
+    rtol = float(spec.get("rtol", 1e-8))
+    cti = int(spec.get("conv_test_iters", 5))
+    every = int(spec.get("ckpt_iters", cti))
+    device = rng.randrange(int(A.num_shards))
+    c0 = _obs.counters.snapshot("resil.")
+    _faults.inject("solver.cg.conv", "device_loss",
+                   after=int(spec.get("after", 2)), device=device)
+    try:
+        with _ckpt.scope("chaos.device_loss", every=every):
+            x, _iters = dist_cg(A, b, rtol=rtol, conv_test_iters=cti)
+    except BaseException as e:  # noqa: BLE001 - ledger
+        report.violations.append(
+            f"device_loss solve raised instead of recovering: {e!r}")
+        return
+    report.recoveries += 1
+    c1 = _obs.counters.snapshot("resil.")
+
+    def delta(name: str) -> int:
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+    for name, want in (("resil.recovery.attempts", 1),
+                       ("resil.recovery.device_loss", 1),
+                       ("resil.recovery.mesh_shrink", 1),
+                       ("resil.recovery.succeeded", 1),
+                       ("resil.ckpt.restores", 1)):
+        if delta(name) != want:
+            report.violations.append(
+                f"device_loss accounting: {name} moved {delta(name)} "
+                f"!= {want}")
+    if delta("resil.recovery.reshard_bytes") <= 0:
+        report.violations.append(
+            "device_loss: survivor reshard ledgered zero bytes")
+    src = getattr(A, "_src_csr", None)
+    if src is None:
+        report.violations.append(
+            "device_loss: matrix retains no source for the parity "
+            "reference (shard via shard_csr)")
+        return
+    S = _sp.csr_matrix(
+        (np.asarray(src.data), np.asarray(src.indices),
+         np.asarray(src.indptr)), shape=src.shape)
+    ref = _spla.spsolve(S.tocsc(), b)
+    if not np.allclose(np.asarray(x), ref, rtol=1e-5,
+                       atol=float(spec.get("parity_atol", 1e-6))):
+        report.violations.append(
+            "device_loss: recovered solution diverged from the scipy "
+            "reference")
+
+
 def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
               seed: int = 0,
               sites: Sequence[str] = DEFAULT_SITES,
               kinds: Sequence[str] = DEFAULT_KINDS,
-              result_timeout_s: float = 30.0) -> ChaosReport:
+              result_timeout_s: float = 30.0,
+              device_loss: Optional[dict] = None) -> ChaosReport:
     """Run ``rounds`` of composed-fault multi-tenant load through
     ``gateway`` and verify the isolation invariants (module
     docstring).
@@ -121,7 +200,16 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
     tenant's matrix), ``xs`` (operand vectors submitted each round),
     and optional ``deadline_ms`` — when set, that tenant's submissions
     run inside ``deadline.scope(deadline_ms)`` (``0.0`` = a deadline
-    storm: every one of its requests arrives already expired)."""
+    storm: every one of its requests arrives already expired).
+
+    ``device_loss`` opts a recovery scenario into every round: while
+    the round's gateway submissions are in flight, a seeded
+    ``device_loss`` drill solve runs through the full recovery ladder
+    and is held to exactly-once / exact-accounting / scipy-parity
+    invariants (:func:`_run_device_loss_scenario`).  The spec dict:
+    ``A`` (a ``shard_csr`` matrix), ``b``, and optional ``rtol`` /
+    ``conv_test_iters`` / ``ckpt_iters`` / ``after`` /
+    ``parity_atol``."""
     if not (_settings.gateway and _settings.resil):
         raise RuntimeError(
             "chaos.run_drill needs settings.gateway and settings.resil "
@@ -149,6 +237,10 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
                             qos=spec.get("qos", "batch"))
                     report.submitted += 1
                     inflight.append((spec, x, fut))
+            if device_loss is not None:
+                # The recovery solve runs while this round's gateway
+                # submissions are still queued — live load.
+                _run_device_loss_scenario(rng, device_loss, report)
             gateway.flush()
             report.faults_fired += sum(
                 a["fired"] for a in _faults.armed().values())

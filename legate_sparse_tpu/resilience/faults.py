@@ -35,6 +35,12 @@ Kinds
                 (last element set to NaN: the residual slot of the
                 solver convergence fetches) — the health-detection
                 drill; sites without a value treat it as a no-op fire.
+- ``device_loss`` raise :class:`~.outcomes.DeviceLost` carrying the
+                armed device ordinal — the recovery-ladder drill
+                (``inject(site, "device_loss", device=N)``): the
+                solver observes the loss at its conv-fetch, shrinks
+                the mesh to the survivors, reshards, and resumes from
+                the last checkpoint.
 
 Trace safety: injection is suppressed inside an ambient jax trace
 (``resil.fault.trace_skipped``) — a fault fired at trace time would be
@@ -52,7 +58,7 @@ from typing import Any, Dict, Optional
 
 from .. import obs as _obs
 from ..settings import settings as _settings
-from .outcomes import ResilienceError
+from .outcomes import DeviceLost, ResilienceError
 
 #: The closed site catalog: every ``fault_point`` in the package names
 #: one of these.  Keep in sync with docs/RESILIENCE.md (enforced by
@@ -69,6 +75,10 @@ CATALOG: Dict[str, str] = {
         "csr.py: csr_array.dot SpMV/SpMM/SpGEMM dispatch",
     "dist.spmv":
         "parallel/dist_csr.py: distributed SpMV collective dispatch",
+    "dist.spmv.abft":
+        "parallel/dist_csr.py: ABFT y-checksum verification of an "
+        "eager distributed SpMV (value site carrying y — arm "
+        "nonfinite to drill a corrupted collective)",
     "dist.cg":
         "parallel/dist_csr.py: dist_cg solve dispatch (collective "
         "loop)",
@@ -88,7 +98,7 @@ CATALOG: Dict[str, str] = {
 }
 
 #: Fault kinds a site can be armed with.
-KINDS = ("error", "latency", "nonfinite")
+KINDS = ("error", "latency", "nonfinite", "device_loss")
 
 
 class InjectedFault(ResilienceError):
@@ -120,12 +130,13 @@ _arms: Dict[str, _Arm] = {}
 
 def inject(site: str, kind: str = "error", count: int = 1,
            after: int = 0, latency_ms: float = 5.0, p: float = 1.0,
-           seed: int = 0) -> None:
+           seed: int = 0, device: int = 0) -> None:
     """Arm ``site`` to fire ``kind`` on its next ``count`` eligible
     calls (skipping the first ``after``).  ``p < 1`` makes each
     eligible call fire with probability ``p`` drawn from a
     deterministic per-call LCG over ``seed`` — same seed, same
-    schedule, every run."""
+    schedule, every run.  ``device`` names the flat mesh ordinal a
+    ``device_loss`` fire reports as lost (ignored by other kinds)."""
     if site not in CATALOG:
         raise ValueError(
             f"unknown fault site {site!r}; catalog: {sorted(CATALOG)}")
@@ -135,7 +146,8 @@ def inject(site: str, kind: str = "error", count: int = 1,
         _arms[site] = _Arm(site=site, kind=kind, count=int(count),
                            after=int(after),
                            latency_ms=float(latency_ms), p=float(p),
-                           seed=int(seed))
+                           seed=int(seed),
+                           meta={"device": int(device)})
 
 
 def clear(site: Optional[str] = None) -> None:
@@ -251,6 +263,7 @@ def fault_point(site: str, value: Any = None) -> Any:
             ordinal = arm.fired
             kind = arm.kind
             latency_ms = arm.latency_ms
+            device = int(arm.meta.get("device", 0))
     if not fire:
         return value
     _obs.inc("resil.fault.injected")
@@ -258,6 +271,8 @@ def fault_point(site: str, value: Any = None) -> Any:
     _obs.event("resil.fault", site=site, kind=kind, ordinal=ordinal)
     if kind == "error":
         raise InjectedFault(site, ordinal)
+    if kind == "device_loss":
+        raise DeviceLost(site, ordinal, device)
     if kind == "latency":
         if latency_ms > 0:
             time.sleep(latency_ms / 1e3)

@@ -104,6 +104,45 @@ class DeadlineExceeded(FinalOutcomeError):
                if isinstance(residual, float) else ""))
 
 
+class DeviceLost(FinalOutcomeError):
+    """A mesh device vanished mid-solve (detected at a host-sync
+    point — the conv-fetch cadence is the only place a distributed
+    solve touches the host, so it is also where loss is observed).
+
+    A final outcome, not a retryable fault: retrying the same dispatch
+    on the same (now smaller) device set would fail identically, and
+    feeding the breaker would poison the site for the *recovered*
+    mesh.  ``policy.run`` re-raises immediately; the recovery ladder
+    in ``dist_cg`` / ``dist_gmres`` catches it, shrinks the mesh to
+    the survivor grid, reshards, restores the last checkpoint, and
+    resumes (docs/RESILIENCE.md, "Recovery ladder")."""
+
+    def __init__(self, site: str, ordinal: int = 0,
+                 device: int = 0):
+        self.site = site
+        self.ordinal = int(ordinal)
+        self.device = int(device)
+        super().__init__(
+            f"device {device} lost at {site} (ordinal {ordinal})")
+
+
+class ChecksumError(ResilienceError):
+    """An ABFT checksum mismatch: the y-checksum of a distributed SpMV
+    disagreed with the column-checksum prediction, i.e. a collective
+    (or the kernel feeding it) corrupted data in flight.  Retryable —
+    ``policy.run`` at the ``dist.spmv`` site re-dispatches the SpMV,
+    which recomputes from the (intact) operands — unlike the final
+    verdicts above."""
+
+    def __init__(self, site: str, observed: float, expected: float):
+        self.site = site
+        self.observed = float(observed)
+        self.expected = float(expected)
+        super().__init__(
+            f"ABFT checksum mismatch at {site}: observed "
+            f"{observed!r}, expected {expected!r}")
+
+
 @dataclass(frozen=True)
 class HealthReport:
     """Structured description of an unhealthy solve (see

@@ -102,6 +102,13 @@ Settings
       outcomes); ``resil_stagnation_cycles`` (``_STAGNATION_CYCLES``,
       0 = off) and ``resil_divergence_mult`` (``_DIVERGENCE_MULT``)
       tune it.
+    - ``resil_ckpt_iters`` (``_CKPT_ITERS``, 0 = off): default
+      solver checkpoint cadence — snapshot the solve state every k
+      convergence fetches (``resilience.checkpoint``); the recovery
+      ladder restores the last snapshot after a device loss.
+    - ``resil_abft`` (``_ABFT``): opt-in ABFT-checksummed eager
+      distributed SpMV (column-checksum verification of y; mismatch
+      raises a retryable ``ChecksumError``).
 
 ``gateway`` (``LEGATE_SPARSE_TPU_GATEWAY``)
     Multi-tenant admission gateway (``legate_sparse_tpu.engine.gateway``,
@@ -368,6 +375,12 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_RESIL_DIVERGENCE_MULT",
                            "1e8")
         )
+        self.resil_ckpt_iters: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_RESIL_CKPT_ITERS", "0")
+        )
+        self.resil_abft: bool = _env_bool(
+            "LEGATE_SPARSE_TPU_RESIL_ABFT", False
+        )
         # ---- multi-tenant gateway (legate_sparse_tpu.engine.gateway) ----
         self.gateway: bool = _env_bool("LEGATE_SPARSE_TPU_GATEWAY",
                                        False)
@@ -442,6 +455,7 @@ class Settings:
         "resil_retry_budget", "resil_breaker_k",
         "resil_breaker_cooldown_ms", "resil_health",
         "resil_stagnation_cycles", "resil_divergence_mult",
+        "resil_ckpt_iters", "resil_abft",
         # Gateway knobs shape admission, fairness and queueing in
         # front of the engine — pure request-lifecycle policy, never
         # what a plan lowers to (the stacked multi-matrix plan is

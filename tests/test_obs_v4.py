@@ -639,6 +639,23 @@ def test_doctor_obs_overhead_and_roofline_rules():
         "mask_ms")
 
 
+def test_doctor_recovery_without_checkpoint_advance():
+    """Device-loss recoveries with zero checkpoint saves mean every
+    recovery replayed the whole solve from x0 — one warn finding
+    pointing at the cadence knob; quiet once snapshots advance."""
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.bench.update({"resil_recoveries": 2, "resil_ckpt_saves": 0})
+    codes = {f["code"]: f for f in doctor.diagnose(ev)}
+    f = codes["recovery-without-checkpoint-advance"]
+    assert f["severity"] == "warn"
+    assert "CKPT_ITERS" in f["hint"]
+    ev2 = doctor.Evidence()
+    ev2.bench.update({"resil_recoveries": 2, "resil_ckpt_saves": 4})
+    assert "recovery-without-checkpoint-advance" not in {
+        f["code"] for f in doctor.diagnose(ev2)}
+
+
 def _verdict_rec(key, label):
     return {"type": "event", "name": "autotune.verdict", "ts_ns": 0,
             "tid": 0, "attrs": {"key": key, "label": label}}

@@ -1,0 +1,378 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Fault-tolerant distributed solves (ISSUE 15, docs/RESILIENCE.md):
+checkpoint/restore at the fetch cadence, the device-loss recovery
+ladder (detect -> shrink -> reshard -> restore -> resume), opt-in
+ABFT-checksummed dist SpMV, the ``refine=`` deadline-cadence bugfix,
+and the off-by-default inertness pins for all of it."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import obs, resilience
+from legate_sparse_tpu.parallel import (
+    dist_cg, dist_gmres, dist_spmv, make_row_mesh, shard_csr,
+)
+from legate_sparse_tpu.parallel.dist_csr import shard_vector
+from legate_sparse_tpu.resilience import checkpoint as rckpt
+from legate_sparse_tpu.resilience import deadline as rdeadline
+from legate_sparse_tpu.resilience import faults as rfaults
+from legate_sparse_tpu.settings import settings
+
+_RESIL_KNOBS = (
+    "resil", "resil_retries", "resil_backoff_ms", "resil_retry_budget",
+    "resil_breaker_k", "resil_breaker_cooldown_ms", "resil_health",
+    "resil_ckpt_iters", "resil_abft",
+)
+
+
+@pytest.fixture
+def resil():
+    saved = {k: getattr(settings, k) for k in _RESIL_KNOBS}
+    settings.resil = True
+    settings.resil_backoff_ms = 0.0
+    resilience.reset()
+    obs.counters.reset("resil.")
+    yield settings
+    for k, v in saved.items():
+        setattr(settings, k, v)
+    resilience.reset()
+
+
+def _tridiag(n, dtype=np.float32):
+    return sparse.diags(
+        [np.full(n, 4.0, dtype), np.full(n - 1, -1.0, dtype),
+         np.full(n - 1, -1.0, dtype)],
+        [0, 1, -1], format="csr", dtype=dtype)
+
+
+def _delta(c0, c1, name):
+    return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+
+def _ref_solve(A, b):
+    import scipy.sparse as sp
+    import scipy.sparse.linalg as spla
+
+    S = sp.csr_matrix(
+        (np.asarray(A.data), np.asarray(A.indices),
+         np.asarray(A.indptr)), shape=A.shape)
+    return spla.spsolve(S.tocsc(), b)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: cadence, host buffers, ledger
+# ---------------------------------------------------------------------------
+def test_checkpoint_rides_cg_fetch_cadence(resil):
+    """A checkpoint scope routes the solve through the chunked driver
+    and snapshots (x, r, p) every ``every`` iterations at the existing
+    conv fetches — no extra host syncs beyond the chunk cadence."""
+    A = _tridiag(256)
+    b = np.ones(256, np.float32)
+    c0 = obs.counters.snapshot()
+    with rckpt.scope("t.cg", every=10) as ck:
+        x, it = sparse.linalg.cg(A, b, rtol=0.0, maxiter=40,
+                                 conv_test_iters=10)
+    c1 = obs.counters.snapshot()
+    assert int(it) == 40
+    assert ck.saves == 4                    # fetches at 10/20/30/40
+    assert ck.iterations == 40
+    assert len(ck.arrays) == 3              # (x, r, p)
+    assert all(isinstance(a, np.ndarray) for a in ck.arrays)
+    assert _delta(c0, c1, "resil.ckpt.saves") == 4
+    assert _delta(c0, c1, "resil.ckpt.bytes") == 4 * 3 * 256 * 4
+    # Snapshots piggyback the chunk fetches (4 chunks = 4 syncs).
+    assert _delta(c0, c1, "transfer.host_sync.cg_conv") == 4
+    it0, arrays = ck.restore()
+    assert it0 == 40
+    assert np.array_equal(arrays[0], np.asarray(x))
+    assert _delta(c0, obs.counters.snapshot(),
+                  "resil.ckpt.restores") == 1
+
+
+def test_checkpoint_rides_gmres_cycle_cadence(resil):
+    A = _tridiag(256)
+    b = np.ones(256, np.float32)
+    with rckpt.scope("t.gmres", every=10) as ck:
+        x, it = sparse.linalg.gmres(A, b, restart=10, rtol=0.0,
+                                    maxiter=30)
+    assert int(it) == 30
+    assert ck.saves == 3                    # one per restart cycle
+    assert len(ck.arrays) == 1              # the Arnoldi seed x
+    assert np.array_equal(ck.arrays[0], np.asarray(x))
+
+
+def test_checkpoint_zero_cadence_never_snapshots(resil):
+    A = _tridiag(128)
+    b = np.ones(128, np.float32)
+    with rckpt.scope("t.cg", every=0) as ck:
+        sparse.linalg.cg(A, b, maxiter=50)
+    assert ck.saves == 0
+    assert ck.restore() is None
+
+
+# ---------------------------------------------------------------------------
+# the recovery ladder: detect -> shrink -> reshard -> restore -> resume
+# ---------------------------------------------------------------------------
+def test_device_loss_recovery_ladder_exact_accounting(resil):
+    """The acceptance drill: a seeded device loss mid-``dist_cg`` on
+    the 8-virtual-device mesh recovers via mesh-shrink + reshard +
+    checkpoint-restore, converging to the same tolerance — with exact
+    ``resil.recovery.*`` / ``resil.ckpt.*`` accounting.  Fixed
+    iteration plan (rtol=0): fetches at 10/20/30..., snapshot at
+    10 and 20, loss at the third fetch, restore from 20, resume the
+    remaining 40-iteration budget."""
+    n = 256
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    if dA.num_shards < 2:
+        pytest.skip("needs >= 2 devices")
+    shards0 = int(dA.num_shards)
+    b = np.ones(n, np.float32)
+    c0 = obs.counters.snapshot()
+    rfaults.inject("solver.cg.conv", "device_loss", after=2, device=1)
+    with rckpt.scope("dist.cg", every=10):
+        x, it = dist_cg(dA, b, rtol=0.0, maxiter=60,
+                        conv_test_iters=10)
+    c1 = obs.counters.snapshot()
+    assert int(it) == 60                    # 20 banked + 40 resumed
+    for name, want in (("resil.recovery.attempts", 1),
+                       ("resil.recovery.device_loss", 1),
+                       ("resil.recovery.mesh_shrink", 1),
+                       ("resil.recovery.succeeded", 1),
+                       ("resil.recovery.restored_iters", 20),
+                       ("resil.ckpt.restores", 1)):
+        assert _delta(c0, c1, name) == want, name
+    # saves: 2 pre-loss + 4 on the resumed 40-iteration lineage
+    assert _delta(c0, c1, "resil.ckpt.saves") == 6
+    assert _delta(c0, c1, "resil.recovery.reshard_bytes") > 0
+    # Same tolerance as a clean solve of this budget.
+    assert np.allclose(np.asarray(x), _ref_solve(A, b),
+                       rtol=1e-5, atol=1e-6)
+    # The caller's matrix is untouched (the ladder reshards a copy).
+    assert int(dA.num_shards) == shards0
+    assert rfaults.fired("solver.cg.conv") == 1   # exactly-once
+    rfaults.clear()
+
+
+def test_device_loss_without_snapshot_restarts_from_x0(resil):
+    """No snapshot banked yet (cadence off): the ladder restarts from
+    the original x0 at iteration 0 — the doctor's
+    recovery-without-checkpoint-advance scenario — and still solves."""
+    n = 256
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    if dA.num_shards < 2:
+        pytest.skip("needs >= 2 devices")
+    b = np.ones(n, np.float32)
+    c0 = obs.counters.snapshot()
+    rfaults.inject("solver.cg.conv", "device_loss", after=0, device=0)
+    with rckpt.scope("dist.cg", every=0):
+        x, it = dist_cg(dA, b, rtol=0.0, maxiter=40,
+                        conv_test_iters=10)
+    c1 = obs.counters.snapshot()
+    assert int(it) == 40                    # full budget replayed
+    assert _delta(c0, c1, "resil.recovery.attempts") == 1
+    assert _delta(c0, c1, "resil.ckpt.saves") == 0
+    assert _delta(c0, c1, "resil.ckpt.restores") == 0
+    assert _delta(c0, c1, "resil.recovery.restored_iters") == 0
+    assert np.allclose(np.asarray(x), _ref_solve(A, b),
+                       rtol=1e-5, atol=1e-6)
+
+
+def test_device_loss_recovery_dist_gmres(resil):
+    n = 256
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    if dA.num_shards < 2:
+        pytest.skip("needs >= 2 devices")
+    b = np.ones(n, np.float32)
+    c0 = obs.counters.snapshot()
+    rfaults.inject("solver.gmres.conv", "device_loss", after=1,
+                   device=2)
+    with rckpt.scope("dist.gmres", every=10):
+        x, it = dist_gmres(dA, b, restart=10, rtol=1e-8, maxiter=100)
+    c1 = obs.counters.snapshot()
+    assert _delta(c0, c1, "resil.recovery.attempts") == 1
+    assert _delta(c0, c1, "resil.ckpt.restores") == 1
+    assert _delta(c0, c1, "resil.recovery.restored_iters") == 10
+    assert np.allclose(np.asarray(x), _ref_solve(A, b),
+                       rtol=1e-4, atol=1e-5)
+
+
+def test_default_ckpt_cadence_knob_opens_scope(resil):
+    """Without an explicit scope, ``settings.resil_ckpt_iters`` > 0
+    makes ``dist_cg`` open its own checkpoint scope — the env-knob
+    path (LEGATE_SPARSE_TPU_RESIL_CKPT_ITERS) the bench drill uses."""
+    n = 256
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    if dA.num_shards < 2:
+        pytest.skip("needs >= 2 devices")
+    settings.resil_ckpt_iters = 10
+    b = np.ones(n, np.float32)
+    c0 = obs.counters.snapshot()
+    rfaults.inject("solver.cg.conv", "device_loss", after=2, device=1)
+    x, it = dist_cg(dA, b, rtol=0.0, maxiter=60, conv_test_iters=10)
+    c1 = obs.counters.snapshot()
+    assert int(it) == 60
+    assert _delta(c0, c1, "resil.ckpt.restores") == 1
+    assert _delta(c0, c1, "resil.recovery.restored_iters") == 20
+
+
+def test_device_loss_on_last_shard_reraises(resil):
+    """The ladder is bounded: with no survivor to shrink onto, the
+    typed DeviceLost escapes instead of looping."""
+    import jax
+
+    A = _tridiag(128)
+    dA = shard_csr(A, mesh=make_row_mesh(jax.devices()[:1]))
+    b = np.ones(128, np.float32)
+    rfaults.inject("solver.cg.conv", "device_loss", after=0, device=0)
+    with pytest.raises(resilience.DeviceLost):
+        with rckpt.scope("dist.cg", every=10):
+            dist_cg(dA, b, rtol=0.0, maxiter=40, conv_test_iters=10)
+    assert obs.counters.get("resil.recovery.attempts") == 0
+    rfaults.clear()
+
+
+# ---------------------------------------------------------------------------
+# ABFT-checksummed dist SpMV
+# ---------------------------------------------------------------------------
+def test_abft_clean_pass_counts_checks_only(resil):
+    settings.resil_abft = True
+    A = _tridiag(256)
+    dA = shard_csr(A)
+    xv = shard_vector(np.ones(256, np.float32), dA.mesh,
+                      dA.rows_padded)
+    c0 = obs.counters.snapshot()
+    y = np.asarray(dist_spmv(dA, xv))
+    c1 = obs.counters.snapshot()
+    assert _delta(c0, c1, "resil.abft.checks") == 1
+    assert _delta(c0, c1, "resil.abft.mismatch") == 0
+    assert np.allclose(y[:256], np.asarray(A @ jnp.ones(256)),
+                       rtol=1e-5, atol=1e-6)
+
+
+def test_abft_mismatch_is_typed_counted_retry(resil):
+    """A poisoned collective turns into a ChecksumError the dist.spmv
+    retry ladder absorbs: one mismatch, one retry, correct bits."""
+    settings.resil_abft = True
+    A = _tridiag(256)
+    dA = shard_csr(A)
+    xv = shard_vector(np.ones(256, np.float32), dA.mesh,
+                      dA.rows_padded)
+    clean = np.asarray(dist_spmv(dA, xv))
+    c0 = obs.counters.snapshot()
+    rfaults.inject("dist.spmv.abft", kind="nonfinite", count=1)
+    y = np.asarray(dist_spmv(dA, xv))
+    c1 = obs.counters.snapshot()
+    assert _delta(c0, c1, "resil.abft.mismatch") == 1
+    assert _delta(c0, c1, "resil.retry.dist.spmv") == 1
+    assert np.array_equal(y, clean)
+    rfaults.clear()
+
+
+def test_abft_exhausted_retries_surface_checksum_error(resil):
+    settings.resil_abft = True
+    settings.resil_retries = 1
+    A = _tridiag(256)
+    dA = shard_csr(A)
+    xv = shard_vector(np.ones(256, np.float32), dA.mesh,
+                      dA.rows_padded)
+    rfaults.inject("dist.spmv.abft", kind="nonfinite", count=5)
+    with pytest.raises(resilience.ChecksumError):
+        dist_spmv(dA, xv)
+    rfaults.clear()
+
+
+def test_abft_off_is_counter_inert(resil):
+    assert settings.resil_abft is False
+    A = _tridiag(256)
+    dA = shard_csr(A)
+    xv = shard_vector(np.ones(256, np.float32), dA.mesh,
+                      dA.rows_padded)
+    c0 = obs.counters.snapshot()
+    np.asarray(dist_spmv(dA, xv))
+    c1 = obs.counters.snapshot()
+    assert _delta(c0, c1, "resil.abft.checks") == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite bugfix: refine= cycles honor the request deadline
+# ---------------------------------------------------------------------------
+def test_refine_fetch_enforces_deadline_cg(resil):
+    """Regression: ``refine=`` cycles bypassed the deadline cadence —
+    an expired budget must surface at the refine fetch as a typed
+    DeadlineExceeded on the refine site, not run to completion."""
+    A = _tridiag(512)
+    b = np.ones(512, np.float32)
+    with pytest.raises(resilience.DeadlineExceeded) as ei:
+        with rdeadline.scope(0.0):
+            sparse.linalg.cg(A, b, refine=3, maxiter=500)
+    assert ei.value.site == "solver.cg.refine"
+    assert ei.value.partial is not None
+
+
+def test_refine_fetch_enforces_deadline_gmres(resil):
+    A = _tridiag(512)
+    b = np.ones(512, np.float32)
+    with pytest.raises(resilience.DeadlineExceeded) as ei:
+        with rdeadline.scope(0.0):
+            sparse.linalg.gmres(A, b, refine=3, restart=10,
+                                maxiter=500)
+    assert ei.value.site == "solver.gmres.refine"
+
+
+def test_refine_completes_under_generous_deadline(resil):
+    A = _tridiag(256)
+    b = np.ones(256, np.float32)
+    with rdeadline.scope(60_000.0):
+        x, it = sparse.linalg.cg(A, b, refine=3, maxiter=500)
+    assert np.allclose(np.asarray(x), _ref_solve(A, b),
+                       rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# inertness: resil off => bit-for-bit, counter-inert
+# ---------------------------------------------------------------------------
+def test_resil_off_checkpoint_scope_inert():
+    """With LEGATE_SPARSE_TPU_RESIL unset an open checkpoint scope
+    changes nothing: no chunked driver, no snapshots, no counters."""
+    assert settings.resil is False, "suite must run with RESIL unset"
+    A = _tridiag(256)
+    b = np.ones(256, np.float32)
+    x_plain, it_plain = sparse.linalg.cg(A, b, maxiter=50)
+    c0 = obs.counters.snapshot()
+    with rckpt.scope("off", every=5) as ck:
+        x, it = sparse.linalg.cg(A, b, maxiter=50)
+    c1 = obs.counters.snapshot()
+    assert ck.saves == 0
+    assert int(it) == int(it_plain)
+    assert np.array_equal(np.asarray(x), np.asarray(x_plain))
+    assert _delta(c0, c1, "resil.ckpt.saves") == 0
+    assert _delta(c0, c1, "transfer.host_sync.cg_conv") == 0
+
+
+def test_resil_off_dist_solves_counter_inert():
+    assert settings.resil is False
+    n = 256
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    b = np.ones(n, np.float32)
+    xv = shard_vector(np.ones(n, np.float32), dA.mesh, dA.rows_padded)
+    np.asarray(dist_spmv(dA, xv))          # warm
+    c0 = obs.counters.snapshot()
+    np.asarray(dist_spmv(dA, xv))
+    dist_cg(dA, b, maxiter=50)
+    c1 = obs.counters.snapshot()
+    moved = {k for k, v in c1.items()
+             if v != c0.get(k, 0)
+             and (k.startswith("resil.ckpt")
+                  or k.startswith("resil.recovery")
+                  or k.startswith("resil.abft")
+                  or k == "op.reshard")}
+    assert not moved, moved

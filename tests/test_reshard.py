@@ -1,0 +1,257 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Resharding (ISSUE 15, docs/DIST.md): the chunk-permute vector
+program and the matrix repartition path.
+
+- every (src, dst) layout pair over {1d-row, 1d-col, 2d-block}
+  round-trips through ``reshard`` value-identical to a fresh
+  ``shard_csr`` of the source matrix;
+- ``reshard_vector`` is ONE cached ppermute whose recorded comm bytes
+  match the static ``reshard_volumes`` prediction (1% band — they are
+  the same arithmetic, the band guards itemsize/rounding drift);
+- the placement fast path and identity pairs ledger zero bytes;
+- plan-cache non-aliasing: a resharded matrix's
+  ``dist_plan_fingerprint`` never collides with its source's, so the
+  engine can never serve a pre-reshard compiled program for it.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import obs
+from legate_sparse_tpu.obs import comm as obs_comm
+from legate_sparse_tpu.parallel import (
+    chunk_permute_plan, dist_cg, dist_plan_fingerprint, dist_spmv,
+    make_row_mesh, reshard, reshard_vector, shard_csr,
+)
+from legate_sparse_tpu.parallel.reshard import (
+    _PERMUTE_PROGRAMS,
+)
+from legate_sparse_tpu.parallel.dist_csr import (
+    mesh_fingerprint, shard_vector,
+)
+
+LAYOUTS = ("1d-row", "1d-col", "2d-block")
+
+
+def _tridiag(n, dtype=np.float32):
+    return sparse.diags(
+        [np.full(n, 4.0, dtype), np.full(n - 1, -1.0, dtype),
+         np.full(n - 1, -1.0, dtype)],
+        [0, 1, -1], format="csr", dtype=dtype)
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(n).astype(np.float32)
+
+
+def _spmv_ref(A, x):
+    return np.asarray(A @ jnp.asarray(x))
+
+
+def _dist_y(dA, x):
+    xv = shard_vector(x, dA.mesh, dA.rows_padded, layout=dA.layout)
+    return np.asarray(dist_spmv(dA, xv))[: dA.shape[0]]
+
+
+def _rotated(mesh: Mesh) -> Mesh:
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    return Mesh(np.asarray(devs[1:] + devs[:1]), mesh.axis_names)
+
+
+# ---------------------------------------------------------------------------
+# matrix repartition: the full (src, dst) layout-pair matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("src_layout", LAYOUTS)
+@pytest.mark.parametrize("dst_layout", LAYOUTS)
+def test_matrix_reshard_pair_matches_fresh_shard(src_layout,
+                                                 dst_layout):
+    """``reshard(A, layout=dst)`` must be indistinguishable (SpMV
+    values, plan fingerprint) from sharding the retained source matrix
+    fresh over the destination — for every ordered layout pair."""
+    n = 96
+    A = _tridiag(n)
+    x = _x(n, seed=7)
+    ref = _spmv_ref(A, x)
+    dA = shard_csr(A, layout=src_layout)
+    B = reshard(dA, layout=dst_layout)
+    if src_layout == dst_layout:
+        assert B is dA, "same-fingerprint reshard must be the fast path"
+    fresh = shard_csr(A, mesh=B.mesh, layout=B.layout)
+    assert dist_plan_fingerprint(B) == dist_plan_fingerprint(fresh)
+    assert np.allclose(_dist_y(B, x), ref, rtol=1e-5, atol=1e-6)
+    assert np.allclose(_dist_y(fresh, x), ref, rtol=1e-5, atol=1e-6)
+    # And back: the round trip lands on the source fingerprint again.
+    C = reshard(B, mesh=dA.mesh, layout=src_layout)
+    assert dist_plan_fingerprint(C) == dist_plan_fingerprint(dA)
+    assert np.allclose(_dist_y(C, x), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_matrix_reshard_requires_retained_source():
+    A = _tridiag(64)
+    dA = shard_csr(A)
+    dA2 = shard_csr(A)
+    dA2._src_csr = None
+    with pytest.raises(ValueError, match="_src_csr"):
+        reshard(dA2, layout="2d-block")
+    # the retained-source path still serves the sibling
+    assert reshard(dA, layout="1d-row") is dA
+
+
+def test_matrix_reshard_shrink_is_a_repartition():
+    """A smaller destination mesh (the recovery ladder's shrink rung)
+    repartitions through the retained source and still solves."""
+    n = 128
+    A = _tridiag(n)
+    dA = shard_csr(A)
+    if dA.num_shards < 2:
+        pytest.skip("needs >= 2 devices")
+    devs = list(np.asarray(dA.mesh.devices).reshape(-1))
+    small = make_row_mesh(devs[:-1])
+    B = reshard(dA, mesh=small)
+    assert B.num_shards == dA.num_shards - 1
+    b = np.ones(n, np.float32)
+    x, _it = dist_cg(B, b, rtol=1e-8, maxiter=300)
+    assert np.allclose(_spmv_ref(A, np.asarray(x)[:n]), b,
+                       rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# vector chunk-permute program
+# ---------------------------------------------------------------------------
+def test_vector_chunk_permute_roundtrip_bitwise():
+    mesh = make_row_mesh()
+    G = int(np.asarray(mesh.devices).size)
+    if G < 2:
+        pytest.skip("needs >= 2 devices")
+    dst = _rotated(mesh)
+    n = 64 * G
+    v = shard_vector(np.arange(n, dtype=np.float32), mesh, n)
+    w = reshard_vector(v, dst)
+    # Same global vector, destination placement: chunk c now lives on
+    # the device that owns chunk c under the destination mesh.
+    assert np.array_equal(np.asarray(w), np.asarray(v))
+    dst_devs = list(np.asarray(dst.devices).reshape(-1))
+    for s in w.addressable_shards:
+        c = int(np.asarray(s.data)[0]) // (n // G)
+        assert s.device == dst_devs[c]
+    # Round trip back is bitwise the original.
+    v2 = reshard_vector(w, mesh)
+    assert np.array_equal(np.asarray(v2), np.asarray(v))
+    for a, b in zip(v.addressable_shards, v2.addressable_shards):
+        assert a.device == b.device
+
+
+def test_vector_comm_counters_match_static_prediction():
+    mesh = make_row_mesh()
+    G = int(np.asarray(mesh.devices).size)
+    if G < 2:
+        pytest.skip("needs >= 2 devices")
+    dst = _rotated(mesh)
+    n = 64 * G
+    v = shard_vector(np.ones(n, np.float32), mesh, n)
+    c0 = obs.counters.snapshot("comm.")
+    reshard_vector(v, dst)
+    c1 = obs.counters.snapshot("comm.")
+    predicted = obs_comm.reshard_volumes(
+        moved_chunks=G, chunk_elems=n // G, itemsize=4,
+        shards=G)["ppermute"]
+    recorded = (c1.get("comm.dist_reshard.ppermute_bytes", 0)
+                - c0.get("comm.dist_reshard.ppermute_bytes", 0))
+    assert recorded > 0
+    assert abs(recorded - predicted) <= 0.01 * predicted, (
+        recorded, predicted)
+    assert (c1.get("comm.dist_reshard.ppermute", 0)
+            - c0.get("comm.dist_reshard.ppermute", 0)) == 1
+    # The by-layout aggregate slices the same bytes.
+    assert (c1.get("comm.layout.1d-row.dist_reshard_bytes", 0)
+            - c0.get("comm.layout.1d-row.dist_reshard_bytes", 0)
+            ) == recorded
+
+
+def test_vector_identity_placement_ledgers_zero():
+    mesh = make_row_mesh()
+    n = 64 * int(np.asarray(mesh.devices).size)
+    v = shard_vector(np.ones(n, np.float32), mesh, n)
+    c0 = obs.counters.snapshot("comm.")
+    w = reshard_vector(v, mesh)
+    c1 = obs.counters.snapshot("comm.")
+    assert np.array_equal(np.asarray(w), np.asarray(v))
+    assert (c1.get("comm.dist_reshard.ppermute_bytes", 0)
+            == c0.get("comm.dist_reshard.ppermute_bytes", 0))
+
+
+def test_vector_program_cached_per_mesh_pair():
+    """Equal (src, dst) fingerprint pairs share ONE compiled program
+    — including meshes rebuilt from the same devices."""
+    mesh = make_row_mesh()
+    G = int(np.asarray(mesh.devices).size)
+    if G < 2:
+        pytest.skip("needs >= 2 devices")
+    dst = _rotated(mesh)
+    n = 64 * G
+    v = shard_vector(np.ones(n, np.float32), mesh, n)
+    reshard_vector(v, dst)
+    n_programs = len(_PERMUTE_PROGRAMS)
+    # Fresh-but-equal mesh objects: cache hit, no new entry.
+    mesh2 = make_row_mesh()
+    v2 = shard_vector(np.ones(n, np.float32), mesh2, n)
+    reshard_vector(v2, _rotated(mesh2))
+    assert len(_PERMUTE_PROGRAMS) == n_programs
+
+
+def test_vector_shrink_rejected_typed():
+    mesh = make_row_mesh()
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    n = 64 * len(devs)
+    v = shard_vector(np.ones(n, np.float32), mesh, n)
+    with pytest.raises(ValueError, match="repartition"):
+        reshard_vector(v, make_row_mesh(devs[:-1]))
+    with pytest.raises(ValueError, match="same device set"):
+        chunk_permute_plan(mesh, make_row_mesh(devs[:-1]))
+
+
+def test_chunk_permute_plan_pairs():
+    mesh = make_row_mesh()
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    G = len(devs)
+    pairs, moved = chunk_permute_plan(mesh, mesh)
+    assert moved == 0
+    assert pairs == tuple((c, c) for c in range(G))
+    if G < 2:
+        return
+    pairs, moved = chunk_permute_plan(mesh, _rotated(mesh))
+    assert moved == G                       # full rotation: all move
+    assert len(pairs) == G
+
+
+# ---------------------------------------------------------------------------
+# plan-cache non-aliasing
+# ---------------------------------------------------------------------------
+def test_resharded_matrix_never_aliases_source_plans():
+    """``dist_plan_fingerprint`` folds ``mesh_fingerprint(mesh,
+    layout)``, so any real reshard (layout change, placement change,
+    shrink) yields a distinct plan identity — the engine's dist-plan
+    cache cannot hand a pre-reshard executable to the new partition."""
+    A = _tridiag(96)
+    dA = shard_csr(A)
+    fp0 = dist_plan_fingerprint(dA)
+    B = reshard(dA, layout="2d-block")
+    assert dist_plan_fingerprint(B) != fp0
+    devs = list(np.asarray(dA.mesh.devices).reshape(-1))
+    if len(devs) >= 2:
+        rot = reshard(dA, mesh=_rotated(dA.mesh))
+        assert dist_plan_fingerprint(rot) != fp0
+        assert (mesh_fingerprint(rot.mesh, rot.layout)
+                != mesh_fingerprint(dA.mesh, dA.layout))
+        small = reshard(dA, mesh=make_row_mesh(devs[:-1]))
+        assert dist_plan_fingerprint(small) != fp0
+    # The no-op rung keeps the identity (same object, same plans).
+    assert dist_plan_fingerprint(reshard(dA)) == fp0

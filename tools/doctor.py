@@ -217,6 +217,24 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
             "failing dependency before tuning thresholds",
             str(int(trips))))
 
+    # -- Recovery without checkpoint advance: device losses were
+    #    survived, but every recovery restarted from scratch (or from
+    #    one stale snapshot) — the checkpoint cadence is off or far
+    #    coarser than the loss rate, so restored work is being lost.
+    recoveries = ev.counter("resil.recovery.attempts") or ev.field(
+        "resil_recoveries", 0)
+    ck_saves = ev.counter("resil.ckpt.saves") or ev.field(
+        "resil_ckpt_saves", 0)
+    if recoveries and not ck_saves:
+        out.append(_finding(
+            "warn", "recovery-without-checkpoint-advance",
+            f"{int(recoveries)} device-loss recoveries ran with zero "
+            f"checkpoint saves — every recovery restarted from x0",
+            "docs/RESILIENCE.md: set LEGATE_SPARSE_TPU_RESIL_CKPT_"
+            "ITERS (or open checkpoint.scope) so restores resume "
+            "from a recent iterate instead of replaying the solve",
+            str(int(recoveries))))
+
     # -- Plan-cache thrash: every miss is an XLA recompile.
     hits = ev.counter("engine.plan.hits") or ev.field(
         "engine_plan_hits", 0)

@@ -435,6 +435,44 @@ def _build_spmm_halo():
                  notes={"k": k})
 
 
+@_program("dist/reshard/1d-row/chunk-permute/f32", "dist",
+          _DIST_SRC + ("legate_sparse_tpu/parallel/reshard.py",))
+def _build_reshard_chunk_permute():
+    """THE cached chunk-permute reshard program (``parallel/
+    reshard.py``): one ``ppermute`` over the flat mesh moving each
+    vector chunk from its source device to its destination-placement
+    owner.  The fixture destination is the rotate-by-one device order,
+    so every chunk moves — the worst case the static prediction
+    (``obs.comm.reshard_volumes``) must price exactly.  The contract
+    pins the collective schedule: exactly one collective-permute, all
+    pairs moving, no other transfers."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from legate_sparse_tpu.obs.comm import reshard_volumes
+    from legate_sparse_tpu.parallel.dist_csr import shard_vector
+    from legate_sparse_tpu.parallel.reshard import (
+        _chunk_permute_program,
+    )
+
+    mesh = _row_mesh()
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    dst = _fix("rot_mesh", lambda: Mesh(
+        np.asarray(devs[1:] + devs[:1]), mesh.axis_names))
+    x = shard_vector(np.ones(N_1D, np.float32), mesh, N_1D)
+    fn, _pairs, moved = _chunk_permute_program(mesh, dst)
+    hlo = fn.lower(x).as_text()
+    jaxpr = jax.make_jaxpr(fn)(x)
+    return Built(hlo=hlo, jaxpr=jaxpr,
+                 predicted=reshard_volumes(
+                     moved_chunks=moved,
+                     chunk_elems=N_1D // MESH_DEVICES, itemsize=4,
+                     shards=MESH_DEVICES),
+                 notes={"moved_pairs": moved,
+                        "shards": MESH_DEVICES})
+
+
 # ------------------------------------------------------------------ #
 # solver cycle bodies (transfer-freedom inside the loop)
 # ------------------------------------------------------------------ #
