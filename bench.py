@@ -674,8 +674,15 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # restore -> resume — recording the golden-pinned exact
 # ``resil_ckpt_saves`` / ``resil_recoveries`` / ``resil_restored``
 # plus the measured ``resil_reshard_bytes`` and the timing pair
-# ``recovery_clean_ms`` / ``recovery_recovered_ms``.
-SCHEMA_VERSION = 16
+# ``recovery_clean_ms`` / ``recovery_recovered_ms``.  17 = graph
+# phase (docs/GRAPH.md): the four semiring algorithms (BFS or-and,
+# SSSP min-plus, CC min-label, PageRank plus-times) on one seeded
+# R-MAT matrix over the all-device mesh — golden-pinned exact
+# ``graph_n`` / ``graph_nnz`` / ``graph_<alg>_iters`` plus the
+# comm-ledger deltas ``graph_<alg>_comm_bytes`` (the
+# ``*_comm_bytes`` band) and the informational timing field
+# ``graph_ms``.
+SCHEMA_VERSION = 17
 
 
 def main() -> None:
@@ -1630,6 +1637,66 @@ def main() -> None:
                         _resil.reset()
         except Exception as e:
             sys.stderr.write(f"bench: recovery phase failed: {e!r}\n")
+
+    # Graph phase (schema_version 17, docs/GRAPH.md): the four
+    # semiring algorithms on one seeded R-MAT matrix over the
+    # all-device mesh.  Every input is deterministic (fixed rng,
+    # fixed shapes) and the host loops run a fixed number of sweeps
+    # given the structure (BFS/CC to their fixed points, SSSP to the
+    # Bellman-Ford fixed point, PageRank with tol=0 to exactly
+    # ``pr_iters``), so the smoke golden pins the per-algorithm
+    # iteration counts exactly and the per-algorithm
+    # ``graph_<alg>_comm_bytes`` (delta of ``comm.total_bytes``
+    # around each run) through the ``*_comm_bytes`` band.  Timings
+    # stay informational.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_GRAPH",
+                           "0") != "1")
+            and not past_deadline(result, "graph")):
+        try:
+            import time as _time
+
+            from legate_sparse_tpu import gallery as _gallery
+            from legate_sparse_tpu import graph as _graph
+
+            scale_g = 9 if smoke else 13
+            pr_iters = 20
+            A_g = _gallery.rmat(scale_g, nnz_per_row=4,
+                                rng=np.random.default_rng(1234),
+                                directed=True)
+            result["graph_n"] = int(A_g.shape[0])
+            result["graph_nnz"] = int(A_g.nnz)
+            runs = (
+                ("bfs", lambda: _graph.bfs(A_g, source=0)),
+                ("sssp", lambda: _graph.sssp(A_g, source=0)),
+                ("cc", lambda: _graph.connected_components(A_g)),
+                ("pagerank", lambda: _graph.pagerank(
+                    A_g, tol=0.0, max_iters=pr_iters)),
+            )
+            with obs.span("bench.graph") as _sp:
+                t0 = _time.perf_counter()
+                for name_g, run_g in runs:
+                    it_key = f"graph.{name_g}.iters"
+                    it0 = obs.counters.get(it_key)
+                    b0 = obs.counters.get("comm.total_bytes")
+                    out_g = run_g()
+                    jax.block_until_ready(
+                        out_g[1] if isinstance(out_g, tuple)
+                        else out_g)
+                    result[f"graph_{name_g}_iters"] = int(
+                        obs.counters.get(it_key) - it0)
+                    result[f"graph_{name_g}_comm_bytes"] = int(
+                        obs.counters.get("comm.total_bytes") - b0)
+                result["graph_ms"] = round(
+                    (_time.perf_counter() - t0) * 1e3, 4)
+                if _sp is not None:
+                    _sp.set(n=result["graph_n"],
+                            nnz=result["graph_nnz"],
+                            bfs_iters=result["graph_bfs_iters"],
+                            pagerank_iters=result[
+                                "graph_pagerank_iters"])
+        except Exception as e:
+            sys.stderr.write(f"bench: graph phase failed: {e!r}\n")
 
     # Saturation phase (schema_version 10, obs v3): offered load vs
     # the request executor — the p50/p99-vs-load curve ROADMAP item 1

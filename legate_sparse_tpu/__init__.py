@@ -30,6 +30,7 @@ from .coverage import clone_module
 from . import linalg  # noqa: F401
 from . import parallel  # noqa: F401
 from . import engine  # noqa: F401
+from . import graph  # noqa: F401
 
 __version__ = "25.07.1"
 

@@ -80,6 +80,38 @@ def _run_sliced_ell_bf16(A, operand, op: str):
         A._get_sliced_ell(), operand, A.shape[0])
 
 
+# Semiring-generalized family (graph/semiring.py catalog): the same
+# three memory layouts with the (add, multiply) pair threaded through
+# as static strings.  Raced under the default plus-times pair — where
+# each is bit-identical to its specialized sibling — so the verdicts
+# transfer to every semiring dispatch of the same structure
+# (graph.matvec routes by these labels).
+def _run_semiring_csr(A, operand, op: str):
+    rid = A._get_row_ids()
+    nnz = A.data.shape[0]
+    if op == "spmv":
+        return _sp.csr_semiring_spmv_rowids_masked(
+            A.data, A.indices, rid, nnz, operand, A.shape[0],
+            "sum", "times")
+    return _sp.csr_semiring_spmm_rowids_masked(
+        A.data, A.indices, rid, nnz, operand, A.shape[0],
+        "sum", "times")
+
+
+def _run_semiring_ell(A, operand, op: str):
+    ell = A._get_ell()
+    if op == "spmv":
+        return _sp.ell_semiring_spmv(ell[0], ell[1], ell[2], operand,
+                                     "sum", "times")
+    return _sp.ell_semiring_spmm(ell[0], ell[1], ell[2], operand,
+                                 "sum", "times")
+
+
+def _run_semiring_sliced_ell(A, operand, op: str):
+    return _sp.sliced_ell_semiring_spmv(
+        A._get_sliced_ell(), operand, A.shape[0], "sum", "times")
+
+
 @dataclass(frozen=True)
 class Candidate:
     """One routable kernel family (see module docstring)."""
@@ -129,5 +161,24 @@ CANDIDATES = {
         eligible=lambda A: _low_precision(A)
         and A._get_sliced_ell() is not None,
         run=_run_sliced_ell_bf16,
+    ),
+    "semiring-csr": Candidate(
+        label="semiring-csr", kernel="csr_semiring_spmv_rowids_masked",
+        ops=("spmv", "spmm"),
+        eligible=lambda A: True,
+        run=_run_semiring_csr,
+    ),
+    "semiring-ell": Candidate(
+        label="semiring-ell", kernel="ell_semiring_spmv",
+        ops=("spmv", "spmm"),
+        eligible=lambda A: A._get_ell() is not None,
+        run=_run_semiring_ell,
+    ),
+    "semiring-sliced-ell": Candidate(
+        label="semiring-sliced-ell",
+        kernel="sliced_ell_semiring_spmv",
+        ops=("spmv",),
+        eligible=lambda A: A._get_sliced_ell() is not None,
+        run=_run_semiring_sliced_ell,
     ),
 }

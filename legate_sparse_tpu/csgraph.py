@@ -225,28 +225,45 @@ def _graph_edges(csgraph, directed, unweighted):
 
 @partial(jax.jit, static_argnames=("n",))
 def _relax_all(rows, cols, w, sources, n: int):
-    """Bellman-Ford for all sources at once.  One sweep = gather the
-    tentative distances at every edge tail (for every source) + one
-    scatter-min into the heads: a min-plus sparse-times-dense product.
-    Runs at most n sweeps; a sweep that still improves after n-1 of
-    them can only mean a reachable negative cycle."""
+    """Bellman-Ford for all sources at once.  One sweep = one min-plus
+    semiring SpMM (``ops/spmv.py csr_semiring_spmm_rowids_masked``) of
+    the transposed edge operator against the (n, S) tentative-distance
+    block — the SAME kernel the distributed graph engine dispatches
+    (``legate_sparse_tpu.graph``), so single-device and distributed
+    relaxation share one code path.  Bit-compatible with the previous
+    scatter-min form: min over the identical multiset of dist[u]+w
+    candidates is order-insensitive, unlike a sum.  Runs at most n
+    sweeps; a sweep that still improves after n-1 of them can only
+    mean a reachable negative cycle."""
+    from .ops import spmv as _sp
+
     S = sources.shape[0]
-    dist0 = jnp.full((S, n), jnp.inf, dtype=w.dtype)
-    dist0 = dist0.at[jnp.arange(S), sources].set(0.0)
+    # Sort edges by head so segment_min sees sorted segment ids (the
+    # kernel's indices_are_sorted contract); tails become the gather.
+    order = jnp.argsort(cols, stable=True)
+    heads, tails, we = cols[order], rows[order], w[order]
+    nnz = jnp.asarray(we.shape[0], dtype=jnp.int32)
+    dist0 = jnp.full((n, S), jnp.inf, dtype=w.dtype)
+    dist0 = dist0.at[sources, jnp.arange(S)].set(0.0)
+
+    def sweep(dist):
+        relaxed = _sp.csr_semiring_spmm_rowids_masked(
+            we, tails, heads, nnz, dist, n, "min", "plus")
+        return jnp.minimum(dist, relaxed)
 
     def body(state):
-        dist, sweep, _ = state
-        new = dist.at[:, cols].min(dist[:, rows] + w[None, :])
-        return new, sweep + 1, jnp.any(new < dist)
+        dist, k, _ = state
+        new = sweep(dist)
+        return new, k + 1, jnp.any(new < dist)
 
     def cond(state):
-        _, sweep, changed = state
-        return changed & (sweep < n)
+        _, k, changed = state
+        return changed & (k < n)
 
     dist, _, _ = jax.lax.while_loop(
         cond, body, (dist0, jnp.asarray(0), jnp.asarray(True)))
-    extra = dist.at[:, cols].min(dist[:, rows] + w[None, :])
-    return dist, jnp.any(extra < dist)
+    extra = sweep(dist)
+    return dist.T, jnp.any(extra < dist)
 
 
 @partial(jax.jit, static_argnames=("n",))
@@ -450,9 +467,13 @@ def shortest_path(csgraph, method="auto", directed=True,
 def _boruvka(rows, cols, w, n: int):
     """Boruvka MST over the stored (directed) edge list, treated as
     undirected.  Each round every component scatter-mins its cheapest
-    outgoing edge (ties to the smallest stored index — row-major first,
-    which is also the copy scipy emits for symmetric inputs), mutual
-    duplicate picks are dropped on the larger component id, and
+    outgoing edge under the STRICT total order (weight, stored index);
+    stored CSR order is (row, col), so this is lexicographic
+    lowest-(weight, row, col) — ties never depend on scatter order,
+    and the perturbed-weight MST is unique, so the returned edge set
+    is a deterministic function of the input (pinned by the tie-heavy
+    regression test against a reference lexicographic Kruskal).
+    Mutual duplicate picks are dropped on the larger component id, and
     components merge by min-label propagation with path compression.
     O(log n) rounds, each a handful of gathers/scatter-mins — the
     TPU-shaped replacement for Kruskal's inherently sequential
@@ -539,7 +560,13 @@ def minimum_spanning_tree(csgraph, overwrite=False):
     shape: CSR holding each chosen edge at its stored position, other
     entries implicit).  Runs Boruvka rounds natively on device; with
     distinct weights the MST is unique, so the edge set matches
-    scipy's Kruskal exactly (tie-breaks may legitimately differ).
+    scipy's Kruskal exactly.  Equal-weight ties break by the
+    DETERMINISTIC lowest-(weight, row, col) policy: among tied
+    candidates the edge at the lexicographically smallest stored
+    (row, col) wins — equivalently the smallest stored index, so for
+    a symmetric input the row-major-first copy is the one kept.
+    scipy's own tie-breaks may differ edge-by-edge, but the total
+    tree weight always agrees.
 
     scipy-wart parity, both verified against scipy 1.17: the output
     data is float64 regardless of input dtype, and a CHOSEN zero-

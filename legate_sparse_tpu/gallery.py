@@ -367,17 +367,29 @@ def random(m, n, density=0.01, format="coo", dtype=None, rng=None,
 
 
 def powerlaw(m, n=None, nnz_per_row=8, alpha=1.8, rng=None,
-             format="csr", dtype=None):
+             format="csr", dtype=None, directed=True):
     """Power-law (heavy-tailed row-length) random sparse matrix — the
-    autotuner's irregular-SpMV workload.  Row lengths are drawn as
-    ``nnz_per_row * Zipf(alpha)`` capped at ``n``; columns are uniform.
+    autotuner's irregular-SpMV and the graph suite's scale-free
+    workload.
+
+    Degree distribution: row i's OUT-degree is drawn as
+    ``min(nnz_per_row * Zipf(alpha), n)`` — a discrete power law
+    P(k) ∝ k^-alpha scaled by the mean-degree knob ``nnz_per_row`` —
+    with uniform column (head) endpoints, so in-degrees concentrate
+    near Binomial(nnz, 1/n) while out-degrees are heavy-tailed.
     ``alpha`` near 1.5-2 gives the web-graph / social-network skew
     (most rows short, a few huge hubs) that defeats flat-ELL padding
-    budgets and starves segment-sum SpMV.  Seeded ``rng`` makes the
-    structure deterministic (bench/test usage).  Duplicate coordinates
-    survive construction (COO semantics) and merge on the first
-    canonicalizing op, so ``nnz`` may slightly undercount after
-    ``sum_duplicates``."""
+    budgets and starves segment-sum SpMV; larger ``alpha`` thins the
+    tail toward a regular matrix.
+
+    ``directed=True`` (default) keeps edges as sampled (the historical
+    behavior).  ``directed=False`` symmetrizes — every sampled edge is
+    stored in both orientations with the same value, so the result is
+    an undirected graph (square only) with power-law TOTAL degree;
+    ``nnz`` roughly doubles.  Seeded ``rng`` makes the structure
+    deterministic (bench/test usage).  Duplicate coordinates survive
+    construction (COO semantics) and merge on the first canonicalizing
+    op, so ``nnz`` may slightly undercount after ``sum_duplicates``."""
     from .csr import csr_array
 
     m = int(m)
@@ -394,6 +406,13 @@ def powerlaw(m, n=None, nnz_per_row=8, alpha=1.8, rng=None,
     out_dtype = (np.dtype(dtype) if dtype is not None
                  else runtime.default_float)
     vals = rng.random(nnz).astype(out_dtype)
+    if not directed:
+        if m != n:
+            raise ValueError(
+                "powerlaw: directed=False requires a square matrix")
+        rows, cols = (np.concatenate([rows, cols]),
+                      np.concatenate([cols, rows]))
+        vals = np.concatenate([vals, vals])
     order = np.lexsort((cols, rows))
     A = csr_array(
         (vals[order], (rows[order], cols[order])), shape=(m, n)
@@ -402,15 +421,31 @@ def powerlaw(m, n=None, nnz_per_row=8, alpha=1.8, rng=None,
 
 
 def rmat(scale, nnz_per_row=8, a=0.57, b=0.19, c=0.19, rng=None,
-         format="csr", dtype=None):
+         format="csr", dtype=None, directed=True):
     """R-MAT (recursive-matrix) random graph, Graph500-style defaults:
     ``2**scale`` square with ``nnz_per_row * 2**scale`` edges sampled
     by recursive quadrant descent with probabilities ``(a, b, c,
-    1-a-b-c)``.  The skewed quadrants produce the power-law degree AND
-    community block structure real graphs show — a harder irregular
-    workload than :func:`powerlaw`'s independent rows.  Vectorized:
-    one ``(nnz, scale)`` uniform block, no Python-level recursion.
-    Duplicate edges survive construction (see :func:`powerlaw`)."""
+    1-a-b-c)``.
+
+    Degree distribution: the quadrant skew controls the tail — at each
+    of the ``scale`` levels an edge lands in quadrant (row-half,
+    col-half) with probabilities a (top-left), b (top-right), c
+    (bottom-left), d=1-a-b-c; repeated descent concentrates edges on
+    low-index vertices, giving approximately power-law in- AND
+    out-degrees with heavier tails as ``max(a,b,c,d)`` grows (the
+    Graph500 defaults a=0.57, b=c=0.19, d=0.05 target the observed
+    web-graph skew; a=b=c=d=0.25 degenerates to an Erdős–Rényi-like
+    flat matrix).  ``nnz_per_row`` scales the mean degree.  The skewed
+    quadrants produce the power-law degree AND community block
+    structure real graphs show — a harder irregular workload than
+    :func:`powerlaw`'s independent rows.
+
+    ``directed=True`` (default) keeps edges as sampled;
+    ``directed=False`` symmetrizes (both orientations stored with the
+    same value — undirected graph, ``nnz`` roughly doubles).
+    Vectorized: one ``(nnz, scale)`` uniform block, no Python-level
+    recursion.  Duplicate edges survive construction (see
+    :func:`powerlaw`)."""
     from .csr import csr_array
 
     scale = int(scale)
@@ -439,6 +474,10 @@ def rmat(scale, nnz_per_row=8, a=0.57, b=0.19, c=0.19, rng=None,
     out_dtype = (np.dtype(dtype) if dtype is not None
                  else runtime.default_float)
     vals = rng.random(nnz).astype(out_dtype)
+    if not directed:
+        rows, cols = (np.concatenate([rows, cols]),
+                      np.concatenate([cols, rows]))
+        vals = np.concatenate([vals, vals])
     order = np.lexsort((cols, rows))
     A = csr_array(
         (vals[order], (rows[order], cols[order])), shape=(m, m)

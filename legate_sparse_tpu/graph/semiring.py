@@ -1,0 +1,108 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""The closed semiring catalog the graph engine computes over.
+
+A semiring (add, multiply, additive identity) generalizes the
+matrix-vector product: ``y[i] = ADD_j data[i, j] MUL x[j]`` over the
+stored entries of row ``i``.  Four closed semirings cover the
+classical traversal algorithms (docs/GRAPH.md cookbook):
+
+=============  =====  ========  ==================  =================
+name           add    multiply  additive identity   algorithm
+=============  =====  ========  ==================  =================
+``plus-times`` sum    a * x     0                   PageRank / linalg
+``min-plus``   min    a + x     +inf                SSSP, CC labels
+``max-times``  max    a * x     -inf                widest/best path
+``or-and``     or     a AND x   False               BFS frontiers
+=============  =====  ========  ==================  =================
+
+In every entry the additive identity is ALSO the multiplicative
+annihilator (0*x = 0; inf + x = inf; -inf capped products; False AND x
+= False), which is exactly what lets the padded-slot masking of the
+``ops/spmv.py`` kernels generalize: a padded slot's *product* is
+replaced by the identity/annihilator and the segment reduction
+absorbs it, the same IEEE discipline as the plus-times kernels (mask
+the product, never the operand).
+
+``add`` / ``mul`` are the static strings the jitted kernels branch on
+(``sum``/``min``/``max`` segment reductions; ``times``/``plus``/``and``
+products — ``or`` IS ``max`` over booleans, so no fourth reduction
+exists in the lowered IR).  ``collective`` names the cross-shard
+all-reduce the 2-d-block distributed realization performs
+(psum -> pmin/pmax/por), which is also the ``comm.<op>.<collective>``
+ledger kind it is priced under.
+
+The ``or-and`` multiply is *structural*: a stored entry IS an edge
+(matching ``csgraph``'s explicit-zero convention), so the product is
+the gathered frontier bit, not value arithmetic — an explicitly
+stored zero still propagates the frontier.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Union
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Semiring:
+    """One closed semiring: the (add, multiply) pair plus the derived
+    static dispatch/pricing fields (see module docstring)."""
+
+    name: str
+    add: str             # segment reduction: "sum" | "min" | "max"
+    mul: str             # product: "times" | "plus" | "and"
+    collective: str      # cross-shard add all-reduce / ledger kind
+
+    def identity(self, dtype):
+        """Additive identity as a rank-0 array of ``dtype`` — the
+        value padded slots are masked to (== the multiplicative
+        annihilator for every catalog entry)."""
+        dtype = jnp.dtype(dtype)
+        if self.add == "sum":
+            return jnp.zeros((), dtype=dtype)
+        if dtype == jnp.bool_:
+            # or (= max over booleans): False; and-of-all (min): True.
+            return jnp.asarray(self.add == "min", dtype=dtype)
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(
+                jnp.inf if self.add == "min" else -jnp.inf, dtype=dtype)
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(
+            info.max if self.add == "min" else info.min, dtype=dtype)
+
+    def annihilator(self, dtype):
+        """Multiplicative annihilator (identical to the additive
+        identity in this closed catalog; kept as its own accessor so
+        callers state which role they mean)."""
+        return self.identity(dtype)
+
+
+PLUS_TIMES = Semiring("plus-times", add="sum", mul="times",
+                      collective="psum")
+MIN_PLUS = Semiring("min-plus", add="min", mul="plus",
+                    collective="pmin")
+MAX_TIMES = Semiring("max-times", add="max", mul="times",
+                     collective="pmax")
+OR_AND = Semiring("or-and", add="max", mul="and",
+                  collective="por")
+
+SEMIRINGS: Dict[str, Semiring] = {
+    s.name: s for s in (PLUS_TIMES, MIN_PLUS, MAX_TIMES, OR_AND)
+}
+
+
+def resolve(semiring: Union[str, Semiring]) -> Semiring:
+    """Catalog lookup accepting a name or a :class:`Semiring`
+    (pass-through — user-defined closed semirings with the same
+    ``add``/``mul`` vocabulary dispatch over the same kernels)."""
+    if isinstance(semiring, Semiring):
+        return semiring
+    try:
+        return SEMIRINGS[semiring]
+    except KeyError:
+        raise ValueError(
+            f"unknown semiring {semiring!r}; catalog: "
+            f"{sorted(SEMIRINGS)}") from None

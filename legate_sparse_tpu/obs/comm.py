@@ -281,6 +281,30 @@ def spmv_volumes_2d(*, grid_rows: int, grid_cols: int, spc: int,
     return {k: b for k, b in vols.items() if b > 0}
 
 
+def spmv_volumes_2d_semiring(*, grid_rows: int, grid_cols: int,
+                             spc: int, rps: int, x_itemsize: int,
+                             y_itemsize: int,
+                             collective: str) -> Volumes:
+    """Per-call collective volumes of one 2-d-block SEMIRING dist
+    SpMV, mirroring ``_block_semiring_spmv_2d_fn`` exactly: the input
+    fixup ``ppermute`` and x panel ``all_gather`` are the plus-times
+    program verbatim (``spmv_volumes_2d``), but ``psum_scatter`` only
+    exists for sum — the output reduction is the semiring's add
+    ALL-reduce (pmin/pmax/por) of the full ``rps``-element partial
+    row block along mesh columns, ring cost 2*(g-1)*rps per row group
+    (twice the reduce-scatter half), recorded under the semiring
+    ``collective`` kind.  x and y itemsizes differ for ``or-and``
+    (bool frontier in, bool out) and mixed-precision operands."""
+    moved = transpose_moved_chunks(grid_rows, grid_cols)
+    vols = {
+        "ppermute": moved * int(spc) * int(x_itemsize),
+        "all_gather": grid_cols * all_gather_bytes(spc, x_itemsize,
+                                                   grid_rows),
+        collective: grid_rows * psum_bytes(rps, y_itemsize, grid_cols),
+    }
+    return {k: b for k, b in vols.items() if b > 0}
+
+
 def cg_iteration_volumes(spmv_vols: Volumes, itemsize: int,
                          shards: int) -> Volumes:
     """One iteration of the fused CG while_loop body: the SpMV

@@ -331,6 +331,156 @@ def sliced_ell_spmv_f32acc(bins, x, rows: int):
     return y
 
 
+# --- Semiring-generalized kernels (graph/semiring.py catalog) -----------
+#
+# Graph traversal is SpMV with the (add, multiply) pair swapped
+# (min-plus relaxation, or-and frontier push, max-times best path —
+# docs/GRAPH.md).  These kernels are the plus-times masked kernels
+# with two static strings threaded through: ``add`` picks the segment
+# reduction, ``mul`` the product.  The masking contract generalizes
+# verbatim: a padded slot's *product* is replaced by the semiring's
+# additive identity (== its multiplicative annihilator: 0 / +-inf /
+# False), so the reduction absorbs it exactly as the plus-times
+# kernels absorb an exact 0 — and the empty-segment fill of
+# ``segment_min``/``segment_max`` (+inf / -inf) is that same identity,
+# so rows with no stored entries come out right for free.
+
+_SEG_REDUCE = {
+    "sum": jax.ops.segment_sum,
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+_ROW_REDUCE = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}
+
+
+def semiring_identity(add: str, dtype):
+    """Additive identity of a catalog add-op as a rank-0 ``dtype``
+    array — the padded-slot masking value (sum: 0; min: +inf; max:
+    -inf; booleans: or IS max, identity False)."""
+    dtype = jnp.dtype(dtype)
+    if add == "sum":
+        return jnp.zeros((), dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(add == "min", dtype=dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if add == "min" else -jnp.inf,
+                           dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if add == "min" else info.min,
+                       dtype=dtype)
+
+
+def _semiring_product(mul: str, vals, gathered):
+    """The per-slot product.  ``and`` is structural (a stored entry IS
+    an edge — csgraph's explicit-zero convention), so the product is
+    the gathered frontier bit, independent of the stored value."""
+    if mul == "times":
+        return vals * gathered
+    if mul == "plus":
+        return vals + gathered
+    if mul == "and":
+        return gathered.astype(jnp.bool_)
+    raise ValueError(f"unknown semiring multiply {mul!r}")
+
+
+@partial(jax.jit, static_argnames=("rows", "add", "mul"))
+def csr_semiring_spmv_rowids_masked(data, indices, row_ids, valid_nnz,
+                                    x, rows: int, add: str, mul: str):
+    """Semiring SpMV over a padded nonzero suffix: the
+    ``csr_spmv_rowids_masked`` program with the reduction and product
+    generalized to the (add, mul) pair.  ``add="sum", mul="times"``
+    is bit-identical to the plus-times kernel (same gather, same
+    in-order segment reduction)."""
+    _obs.inc("trace.csr_semiring_spmv_rowids_masked")
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = _semiring_product(mul, data, x[indices])
+    prod = jnp.where(slot < valid_nnz, prod,
+                     semiring_identity(add, prod.dtype))
+    return _SEG_REDUCE[add](
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+
+
+@partial(jax.jit, static_argnames=("rows", "add", "mul"))
+def csr_semiring_spmm_rowids_masked(data, indices, row_ids, valid_nnz,
+                                    X, rows: int, add: str, mul: str):
+    """Batched semiring SpMV (k stacked operand columns in one
+    dispatch — the multi-source frontier kernel, the semiring arm of
+    the PR-8 stacked ``multi_matvec`` packing): column by column this
+    is exactly :func:`csr_semiring_spmv_rowids_masked`, so a batch of
+    k sources is bit-for-bit the k individual sweeps."""
+    _obs.inc("trace.csr_semiring_spmm_rowids_masked")
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = _semiring_product(mul, data[:, None], X[indices, :])
+    prod = jnp.where((slot < valid_nnz)[:, None], prod,
+                     semiring_identity(add, prod.dtype))
+    return _SEG_REDUCE[add](
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+
+
+@partial(jax.jit, static_argnames=("add", "mul"))
+def ell_semiring_spmv(ell_data, ell_cols, ell_counts, x, add: str,
+                      mul: str):
+    """Semiring SpMV over ELL-packed structure (the :func:`ell_spmv`
+    program generalized): padded slots' products masked to the
+    semiring identity, W-width row reduction by the add-op."""
+    _obs.inc("trace.ell_semiring_spmv")
+    W = ell_data.shape[1]
+    slot = jnp.arange(W, dtype=ell_counts.dtype)
+    valid = slot[None, :] < ell_counts[:, None]
+    prod = _semiring_product(mul, ell_data, x[ell_cols])
+    prod = jnp.where(valid, prod, semiring_identity(add, prod.dtype))
+    return _ROW_REDUCE[add](prod, axis=1)
+
+
+@partial(jax.jit, static_argnames=("add", "mul"))
+def ell_semiring_spmm(ell_data, ell_cols, ell_counts, X, add: str,
+                      mul: str):
+    """Batched semiring SpMV over ELL structure (dense (cols, k)
+    operand — the distributed multi-source frontier's per-shard
+    kernel).  Frontier batches are narrow, so the (rows, W, k)
+    product is materialized in one fused pass (no
+    ``_ELL_SPMM_MATERIALIZE_CAP`` loop arm)."""
+    _obs.inc("trace.ell_semiring_spmm")
+    W = ell_data.shape[1]
+    slot = jnp.arange(W, dtype=ell_counts.dtype)
+    valid = slot[None, :] < ell_counts[:, None]
+    prod = _semiring_product(mul, ell_data[:, :, None], X[ell_cols, :])
+    prod = jnp.where(valid[:, :, None], prod,
+                     semiring_identity(add, prod.dtype))
+    return _ROW_REDUCE[add](prod, axis=1)
+
+
+@partial(jax.jit, static_argnames=("rows", "add", "mul"))
+def sliced_ell_semiring_spmv(bins, x, rows: int, add: str, mul: str):
+    """Semiring SpMV over a :func:`sliced_ell_pack` structure: one
+    masked ELL reduction per bin scattered back in original row order
+    (same unique-sorted ``.at[].set`` as :func:`sliced_ell_spmv`).
+    Rows outside every bin (zero stored entries) keep the semiring
+    identity — the empty-segment value of the rowids kernels."""
+    _obs.inc("trace.sliced_ell_semiring_spmv")
+    probe = _semiring_product(mul, bins[0][0][:1, :1],
+                              x[bins[0][1][:1, :1]])
+    out_dtype = probe.dtype
+    y = jnp.full((rows,), semiring_identity(add, out_dtype),
+                 dtype=out_dtype)
+    for ell_data, ell_cols, cnt, row_idx in bins:
+        W = ell_data.shape[1]
+        slot = jnp.arange(W, dtype=cnt.dtype)
+        valid = slot[None, :] < cnt[:, None]
+        prod = _semiring_product(mul, ell_data, x[ell_cols])
+        prod = jnp.where(valid, prod,
+                         semiring_identity(add, prod.dtype))
+        y = y.at[row_idx].set(
+            _ROW_REDUCE[add](prod, axis=1).astype(out_dtype),
+            indices_are_sorted=True, unique_indices=True)
+    return y
+
+
 # Above this many intermediate elements (rows*W*k), ell_spmm switches to
 # a W-slice accumulation loop instead of materializing the full
 # (rows, W, k) product tensor (~512 MB of f32 at the default cap).

@@ -1213,6 +1213,125 @@ def _block_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int], rps: int,
     ))
 
 
+@lru_cache(maxsize=128)
+def _block_semiring_spmv_fn(mesh: Mesh, halo: int, precise: bool,
+                            ell: bool, rps: int, add: str, mul: str):
+    """Cached shard_map callable for the semiring dist SpMV over ELL /
+    padded-CSR blocks: the ``_block_spmv_fn`` program with the local
+    kernel generalized to the (add, mul) pair (graph/semiring.py).
+    The x realization (precise all_to_all / halo ppermute / tiled
+    all_gather) is semiring-independent — on 1-d layouts output rows
+    live with the row partition, so no cross-shard output reduction
+    exists and the collectives are byte-identical to plus-times."""
+    _obs.inc("jit_miss.dist_csr.block_semiring_spmv_fn")
+    from ._compat import shard_map
+
+    from ..ops import spmv as _spmv_ops
+
+    def realize(x_local, gidx_local=None):
+        if precise:
+            parts = x_local[gidx_local]
+            recv = jax.lax.all_to_all(
+                parts, ROW_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            return jnp.concatenate([recv.reshape(-1), x_local])
+        if halo >= 0:
+            return _extend_x(x_local, halo)
+        return jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+
+    if ell:
+        if precise:
+            def kernel(data, cols, counts, gidx, x_local):
+                x_src = realize(x_local, gidx[0])
+                return _spmv_ops.ell_semiring_spmv(
+                    data[0], cols[0], counts[0], x_src, add, mul)
+
+            in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS))
+        else:
+            def kernel(data, cols, counts, x_local):
+                x_src = realize(x_local)
+                return _spmv_ops.ell_semiring_spmv(
+                    data[0], cols[0], counts[0], x_src, add, mul)
+
+            in_specs = (P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS))
+    else:
+        if precise:
+            def kernel(data, cols, row_ids, counts, gidx, x_local):
+                x_src = realize(x_local, gidx[0])
+                return _spmv_ops.csr_semiring_spmv_rowids_masked(
+                    data[0], cols[0], row_ids[0], counts[0], x_src,
+                    rps, add, mul)
+
+            in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS),
+                        P(ROW_AXIS, None, None), P(ROW_AXIS))
+        else:
+            def kernel(data, cols, row_ids, counts, x_local):
+                x_src = realize(x_local)
+                return _spmv_ops.csr_semiring_spmv_rowids_masked(
+                    data[0], cols[0], row_ids[0], counts[0], x_src,
+                    rps, add, mul)
+
+            in_specs = (P(ROW_AXIS, None), P(ROW_AXIS, None),
+                        P(ROW_AXIS, None), P(ROW_AXIS), P(ROW_AXIS))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs, out_specs=P(ROW_AXIS),
+        check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
+def _block_semiring_spmv_2d_fn(mesh: Mesh, grid: Tuple[int, int],
+                               rps: int, add: str, mul: str):
+    """Cached shard_map callable for the 2-d-block semiring dist SpMV.
+
+    Steps 1-3 are ``_block_spmv_2d_fn`` verbatim (chunk-transpose
+    ppermute, x panel all_gather along mesh rows, local semiring
+    kernel).  Step 4 is where the semiring changes the wire program:
+    ``psum_scatter`` only exists for sum, so the partial row blocks
+    reduce with the semiring's add ALL-reduce along mesh columns
+    (``jax.lax.pmin``/``pmax`` — lowered as a min/max ``all_reduce``)
+    and each device then slices its own output chunk locally.  Ring
+    cost is 2*(Rc-1)*rps elements per row group — twice the
+    reduce-scatter half — priced under the semiring's collective kind
+    (``comm.dist_spmv.pmin``/``pmax``/``por``)."""
+    _obs.inc("jit_miss.dist_csr.block_semiring_spmv_2d_fn")
+    from ._compat import shard_map
+
+    from ..ops import spmv as _spmv_ops
+
+    Rr, Rc = grid
+    perm = _transpose_perm(grid)
+    skip_perm = all(s == d for s, d in perm)
+    reduce_op = {"min": jax.lax.pmin, "max": jax.lax.pmax}[add]
+    chunk = rps // Rc
+
+    def kernel(data, cols, row_ids, counts, x_local):
+        if not skip_perm:
+            x_local = jax.lax.ppermute(
+                x_local, (ROW_AXIS, COL_AXIS), perm
+            )
+        x_panel = jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+        y_part = _spmv_ops.csr_semiring_spmv_rowids_masked(
+            data[0, 0], cols[0, 0], row_ids[0, 0], counts[0, 0],
+            x_panel, rps, add, mul,
+        )
+        y_full = reduce_op(y_part, COL_AXIS)
+        j = jax.lax.axis_index(COL_AXIS)
+        return jax.lax.dynamic_slice_in_dim(y_full, j * chunk, chunk)
+
+    in_specs = (P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS, None),
+                P(ROW_AXIS, COL_AXIS, None), P(ROW_AXIS, COL_AXIS),
+                P((ROW_AXIS, COL_AXIS)))
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P((ROW_AXIS, COL_AXIS)), check_vma=False,
+    ))
+
+
 # The distributed plan shapes this module can lower, as static
 # (entry point, layout, realization) triples — enumerable WITHOUT
 # devices or meshes, so the contract gates (``tools/verify`` and the
@@ -1231,7 +1350,13 @@ DIST_PLAN_SHAPES: Tuple[Tuple[str, str, str], ...] = (
     ("dist_spmv", "1d-row", "precise"),
     ("dist_spmv", "1d-col", "panel"),
     ("dist_spmv", "2d-block", "panel"),
+    ("dist_spmv_semiring", "1d-row", "halo"),
+    ("dist_spmv_semiring", "1d-row", "all_gather"),
+    ("dist_spmv_semiring", "1d-row", "precise"),
+    ("dist_spmv_semiring", "1d-col", "panel"),
+    ("dist_spmv_semiring", "2d-block", "panel"),
     ("dist_spmm", "1d-row", "halo"),
+    ("dist_spmm_semiring", "1d-row", "halo"),
     ("dist_cg", "1d-row", "halo"),
     ("dist_cg", "2d-block", "panel"),
     ("dist_gmres", "1d-row", "halo"),
@@ -1287,8 +1412,106 @@ def cg_comm_volumes(A: DistCSR, itemsize: int, iters: int):
     return vols, calls
 
 
-def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
-    """y = A @ x with row-block parallelism (jittable).
+def semiring_spmv_comm_volumes(A: DistCSR, x_itemsize: int,
+                               y_itemsize: int, collective: str,
+                               cols: int = 1):
+    """Per-call collective volumes of one semiring ``dist_spmv`` (or
+    ``dist_spmm`` with ``cols`` > 1) on ``A``.  1-d layouts realize x
+    exactly as plus-times (no output collective exists), so the
+    volumes are ``spmv_comm_volumes`` at the x itemsize; 2-d-block
+    swaps the psum_scatter for the semiring add all-reduce
+    (``obs.comm.spmv_volumes_2d_semiring``)."""
+    from ..obs import comm as _comm
+
+    x_local = A.rows_padded // A.num_shards
+    if A.grid is not None:
+        return _comm.spmv_volumes_2d_semiring(
+            grid_rows=A.grid[0], grid_cols=A.grid[1],
+            spc=x_local, rps=A.rows_per_shard,
+            x_itemsize=x_itemsize, y_itemsize=y_itemsize,
+            collective=collective,
+        )
+    precise_C = (int(A.gather_idx.shape[-1])
+                 if A.gather_idx is not None else None)
+    return _comm.spmv_volumes(
+        shards=A.num_shards, halo=A.halo, precise_C=precise_C,
+        x_local_elems=x_local * max(cols, 1), itemsize=x_itemsize,
+        cols=max(cols, 1),
+    )
+
+
+def _dist_spmv_semiring(A: DistCSR, x: jax.Array, sr) -> jax.Array:
+    """Semiring arm of ``dist_spmv`` (``sr`` a resolved non-plus-times
+    :class:`~..graph.semiring.Semiring`): same accounting discipline
+    as ``_dist_spmv_impl`` — comm volumes priced from static fields
+    before dispatch, span with realization path — plus the ``graph.*``
+    ledger row for the semiring family.  Structure-specialized
+    plus-times paths (DIA/BSR) don't generalize, so dispatch goes
+    straight to the ELL / padded-CSR block programs."""
+    _obs.inc("op.dist_spmv")
+    _obs.inc("graph.dist_spmv." + sr.name)
+    from ..obs import comm as _comm
+
+    x_item = jnp.dtype(x.dtype).itemsize
+    y_item = (1 if sr.mul == "and"
+              else jnp.dtype(jnp.result_type(A.dtype, x.dtype)).itemsize)
+    vols = semiring_spmv_comm_volumes(A, x_item, y_item, sr.collective)
+    comm_bytes = _comm.record("dist_spmv", vols, layout=A.layout)
+    with _tctx.profiler_scope("dist_spmv"), \
+            _lat.timer("lat.dist_spmv."
+                       + _lat.shape_bucket(A.shape[0])), \
+            _obs.span("dist_spmv", shards=A.num_shards, halo=A.halo,
+                      comm_bytes=comm_bytes,
+                      comm_calls=sum(1 for b in vols.values() if b > 0)
+                      ) as sp:
+        if A.grid is not None:
+            fn = _block_semiring_spmv_2d_fn(
+                A.mesh, A.grid, A.rows_per_shard, sr.add, sr.mul)
+            if sp is not None:
+                sp.set(path="2d-block", layout=A.layout,
+                       semiring=sr.name)
+            return fn(A.data, A.cols, A.row_ids, A.counts, x)
+        A._require_blocks("dist_spmv")
+        precise = A.gather_idx is not None
+        fn = _block_semiring_spmv_fn(
+            A.mesh, A.halo, precise, A.ell, A.rows_per_shard,
+            sr.add, sr.mul)
+        if A.ell:
+            args = (A.data, A.cols, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (x,)
+        else:
+            args = (A.data, A.cols, A.row_ids, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (x,)
+        if sp is not None:
+            sp.set(path="ell" if A.ell else "padded-csr",
+                   precise=precise, semiring=sr.name)
+        return fn(*args)
+
+
+def _resolve_semiring_arg(semiring):
+    """None for plus-times/absent (the standard program IS that
+    semiring), else the resolved catalog entry."""
+    if semiring is None:
+        return None
+    from ..graph.semiring import resolve as _resolve_sr
+
+    sr = _resolve_sr(semiring)
+    if sr.add == "sum" and sr.mul == "times":
+        return None
+    return sr
+
+
+def dist_spmv(A: DistCSR, x: jax.Array, semiring=None) -> jax.Array:
+    """y = A (x) with row-block parallelism (jittable).
+
+    ``semiring`` generalizes the product to any catalog entry
+    (``graph/semiring.py``): ``None``/"plus-times" runs the standard
+    y = A @ x program below; other semirings dispatch the generalized
+    block kernels, with the 2-d-block cross-shard reduction swapped
+    for the semiring's add collective (psum -> pmin/pmax/por) — see
+    docs/GRAPH.md.
 
     ``x`` and the result are row-block sharded vectors of length
     ``A.rows_padded``.  The distribution contract matches the reference
@@ -1305,6 +1528,15 @@ def dist_spmv(A: DistCSR, x: jax.Array) -> jax.Array:
     the traced program, and the driver-level sites (``dist.cg``,
     ``solver.*.conv``) own recovery for those.
     """
+    sr = _resolve_semiring_arg(semiring)
+    if sr is not None:
+        # ABFT's checksum identity sum(y) = <w, x> is plus-times
+        # algebra; semiring dispatches retry under the same site
+        # policy but run unverified.
+        if _rsettings.resil and csr_array._can_build_cache(x):
+            return _resil_guarded(
+                "dist.spmv", lambda: _dist_spmv_semiring(A, x, sr))
+        return _dist_spmv_semiring(A, x, sr)
     if _rsettings.resil and csr_array._can_build_cache(x):
         if _rsettings.resil_abft:
             return _resil_guarded("dist.spmv",
@@ -1573,6 +1805,71 @@ def _block_spmm_fn(mesh: Mesh, halo: int, precise: bool, ell: bool,
 
 
 @lru_cache(maxsize=128)
+def _block_semiring_spmm_fn(mesh: Mesh, halo: int, precise: bool,
+                            ell: bool, rps: int, col_sharded: bool,
+                            add: str, mul: str):
+    """Cached shard_map callable for distributed semiring SpMM — the
+    batched multi-source frontier program (k stacked sources ride one
+    dispatch, the distributed arm of the PR-8 ``multi_matvec``
+    packing).  Structure is ``_block_spmm_fn`` with the local kernel
+    generalized; x realization collectives are semiring-independent
+    (1-d layouts only, like ``dist_spmm`` itself)."""
+    _obs.inc("jit_miss.dist_csr.block_semiring_spmm_fn")
+    from ._compat import shard_map
+
+    from ..ops import spmv as _spmv_ops
+
+    xcol = COL_AXIS if col_sharded else None
+
+    def realize(x_local, gidx_local=None):
+        if precise:
+            parts = x_local[gidx_local]
+            recv = jax.lax.all_to_all(
+                parts, ROW_AXIS, split_axis=0, concat_axis=0, tiled=True
+            )
+            return jnp.concatenate(
+                [recv.reshape(-1, x_local.shape[1]), x_local]
+            )
+        if halo >= 0:
+            return _extend_x(x_local, halo)
+        return jax.lax.all_gather(x_local, ROW_AXIS, tiled=True)
+
+    if ell:
+        def kernel(data, cols, counts, *rest):
+            gidx = rest[0][0] if precise else None
+            X_local = rest[-1]
+            X_src = realize(X_local, gidx)
+            return _spmv_ops.ell_semiring_spmm(
+                data[0], cols[0], counts[0], X_src, add, mul)
+
+        in_specs = (
+            P(ROW_AXIS, None, None), P(ROW_AXIS, None, None),
+            P(ROW_AXIS, None),
+        ) + ((P(ROW_AXIS, None, None),) if precise else ()) + (
+            P(ROW_AXIS, xcol),
+        )
+    else:
+        def kernel(data, cols, row_ids, counts, *rest):
+            gidx = rest[0][0] if precise else None
+            X_local = rest[-1]
+            X_src = realize(X_local, gidx)
+            return _spmv_ops.csr_semiring_spmm_rowids_masked(
+                data[0], cols[0], row_ids[0], counts[0], X_src,
+                rps, add, mul)
+
+        in_specs = (
+            P(ROW_AXIS, None), P(ROW_AXIS, None), P(ROW_AXIS, None),
+            P(ROW_AXIS),
+        ) + ((P(ROW_AXIS, None, None),) if precise else ()) + (
+            P(ROW_AXIS, xcol),
+        )
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=in_specs,
+        out_specs=P(ROW_AXIS, xcol), check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=128)
 def _dia_spmm_dist_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
                       rps: int, tile: int, col_sharded: bool,
                       interpret: bool):
@@ -1607,7 +1904,7 @@ def _dia_spmm_dist_fn(mesh: Mesh, offsets: Tuple[int, ...], halo: int,
     ))
 
 
-def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
+def dist_spmm(A: DistCSR, X: jax.Array, semiring=None) -> jax.Array:
     """Y = A @ X for a dense (rows_padded, k) operand (jittable).
 
     Same distribution contract as ``dist_spmv`` lifted one axis: X and
@@ -1615,6 +1912,11 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
     (``make_grid_mesh``) their columns additionally shard over "cols",
     with the sparse blocks replicated along that axis.  Use
     ``shard_dense`` to lay out X.
+
+    ``semiring`` generalizes the product exactly as in ``dist_spmv``
+    — the batched multi-source frontier path (k stacked sources per
+    dispatch; docs/GRAPH.md).  1-d layouts only, like the plus-times
+    program.
     """
     if A.grid is not None:
         raise NotImplementedError(
@@ -1635,6 +1937,21 @@ def dist_spmm(A: DistCSR, X: jax.Array) -> jax.Array:
         A, (int(X.shape[0]) // A.num_shards) * max(k_loc, 1),
         jnp.dtype(X.dtype).itemsize, cols=max(k_loc, 1),
     ))
+    sr = _resolve_semiring_arg(semiring)
+    if sr is not None:
+        _obs.inc("graph.dist_spmm." + sr.name)
+        fn = _block_semiring_spmm_fn(
+            A.mesh, A.halo, precise, A.ell, A.rows_per_shard,
+            col_sharded, sr.add, sr.mul)
+        if A.ell:
+            args = (A.data, A.cols, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (X,)
+        else:
+            args = (A.data, A.cols, A.row_ids, A.counts) + (
+                (A.gather_idx,) if precise else ()
+            ) + (X,)
+        return fn(*args)
     if (A.pdia_tile and A.halo >= 0 and not precise
             and jnp.result_type(A.dtype, X.dtype) == A.dtype):
         from ..ops.pallas_dia import _VMEM_BUDGET, pallas_dist_mode

@@ -162,6 +162,14 @@ Settings
       ``autotune_warmup`` (``..._AUTOTUNE_WARMUP``, 1): median-of-k
       measurement budget per candidate.
 
+``graph`` knobs (``legate_sparse_tpu.graph``)
+    - ``graph_max_iters`` (``LEGATE_SPARSE_TPU_GRAPH_MAX_ITERS``,
+      0 = n+1): sweep cap for BFS / connected-components semiring
+      traversal loops.
+    - ``graph_conv_iters`` (``LEGATE_SPARSE_TPU_GRAPH_CONV_ITERS``,
+      5): PageRank device iterations per host convergence fetch
+      (one-fetch-per-cycle cadence; see ``docs/GRAPH.md``).
+
 Settings epoch
 --------------
 ``settings.epoch`` is a monotone counter bumped by every post-import
@@ -414,6 +422,21 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_OBS_SLO_WATCHDOG_MS",
                            "0")
         )
+        # ---- graph analytics (legate_sparse_tpu.graph) ----
+        # Sweep cap for the semiring traversal loops (BFS/CC label
+        # propagation); 0 = derive from the vertex count (n+1, the
+        # structural bound).  SSSP keeps its own n-sweep cap — that
+        # one is the negative-cycle detector, not a budget.
+        self.graph_max_iters: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_GRAPH_MAX_ITERS", "0")
+        )
+        # PageRank convergence-fetch cadence: device iterations per
+        # host residual fetch (the solvers' one-fetch-per-cycle
+        # pattern; also quantizes iteration counts for the bench
+        # golden).
+        self.graph_conv_iters: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_GRAPH_CONV_ITERS", "5")
+        )
         # ---- autotuner (legate_sparse_tpu.autotune) ----
         self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
                                         False)
@@ -466,6 +489,9 @@ class Settings:
         # SLO evaluation only *reads* the always-on latency
         # histograms — pure telemetry, like ``obs``.
         "obs_slo", "obs_slo_watchdog_ms",
+        # Graph loop caps/cadence shape the HOST iteration loop around
+        # semiring dist_spmv dispatches, never what any plan lowers to.
+        "graph_max_iters", "graph_conv_iters",
         # Autotune knobs pick *which already-compiled kernel* serves a
         # dispatch (routing) or shape the measurement budget — never
         # what any kernel lowers to.  Verdict keys carry the epoch

@@ -464,6 +464,25 @@ def test_gallery_rmat_deterministic_and_valid():
         gallery.rmat(4, a=0.6, b=0.3, c=0.2)  # probs sum > 1
 
 
+def test_gallery_directed_flag_symmetrizes():
+    # directed=False stores both orientations of every sampled edge
+    # with the same value -> structurally and numerically symmetric.
+    for A in (gallery.rmat(7, nnz_per_row=4, rng=3, directed=False),
+              gallery.powerlaw(256, nnz_per_row=4, rng=3,
+                               directed=False)):
+        D = np.asarray(A.todense())
+        # allclose, not equal: duplicate sampled edges sum in a
+        # different order on the two orientations (reassociation).
+        np.testing.assert_allclose(D, D.T, rtol=1e-12)
+    # directed=True (the default) keeps the historical structure.
+    A1 = gallery.powerlaw(256, nnz_per_row=4, rng=9)
+    A2 = gallery.powerlaw(256, nnz_per_row=4, rng=9, directed=True)
+    assert np.array_equal(np.asarray(A1.indices),
+                          np.asarray(A2.indices))
+    with pytest.raises(ValueError):
+        gallery.powerlaw(8, 6, directed=False)  # rectangular
+
+
 # ---------------------------------------------------------------- #
 # static gate
 # ---------------------------------------------------------------- #

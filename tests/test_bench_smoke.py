@@ -44,7 +44,9 @@ GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "gateway_rejected_queue_full,"
                  "gateway_interactive_served,gateway_interactive_shed,"
                  "gateway_batch_served,gateway_background_served,"
-                 "gateway_background_shed")
+                 "gateway_background_shed,"
+                 "graph_n,graph_nnz,graph_bfs_iters,graph_sssp_iters,"
+                 "graph_cc_iters,graph_pagerank_iters")
 
 
 from utils_test.tools import load_tool as _tool
@@ -269,6 +271,56 @@ def test_smoke_trace_has_recovery_ledger(smoke_run, capsys):
     assert "checkpoints: 12 saved" in out
     assert "recoveries: 1 device losses" in out
     assert "20 iterations restored" in out
+
+
+def test_smoke_graph_phase_numbers(smoke_run):
+    """ISSUE 16 acceptance: the smoke lane runs the four semiring
+    algorithms on one seeded R-MAT matrix (scale 9, 4 edges/row,
+    rng 1234) over the 8-device mesh.  Structure is deterministic, so
+    the sweep counts are exact: BFS drains its frontier in 3 or-and
+    sweeps, Bellman-Ford reaches its fixed point in 6 min-plus
+    relaxations, min-label CC converges in 3 sweeps over the
+    symmetrized structure, and PageRank with ``tol=0`` runs exactly
+    its 20-iteration budget.  Per-algorithm comm bytes ride the
+    ``*_comm_bytes`` golden band; the timing stays informational."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 17
+    assert result["graph_n"] == 512
+    assert result["graph_nnz"] == 2048
+    assert result["graph_bfs_iters"] == 3
+    assert result["graph_sssp_iters"] == 6
+    assert result["graph_cc_iters"] == 3
+    assert result["graph_pagerank_iters"] == 20
+    for alg in ("bfs", "sssp", "cc", "pagerank"):
+        assert result[f"graph_{alg}_comm_bytes"] > 0, alg
+    # Bool frontiers move 1-byte blocks; float distances 4-byte — the
+    # or-and sweep must be the cheapest per-iteration mover.
+    assert (result["graph_bfs_comm_bytes"] / result["graph_bfs_iters"]
+            < result["graph_sssp_comm_bytes"]
+            / result["graph_sssp_iters"])
+    assert result["graph_ms"] > 0
+
+
+def test_smoke_trace_has_graph_ledger(smoke_run, capsys):
+    """The trace artifact carries the graph.* counters (per-algorithm
+    runs/iters plus the per-semiring dist dispatch rows) and
+    ``trace_summary --graph`` renders the ledger."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    for alg in ("bfs", "sssp", "cc", "pagerank"):
+        assert ctrs.get(f"graph.{alg}.runs", 0) >= 1, alg
+        assert ctrs.get(f"graph.{alg}.iters", 0) >= 1, alg
+    assert ctrs.get("graph.dist_spmv.or-and", 0) >= 1
+    assert ctrs.get("graph.dist_spmv.min-plus", 0) >= 1
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "bench.graph" in names
+    assert "graph.pagerank" in names
+    rc = _tool("trace_summary").main([str(trace_path), "--graph"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "graph ledger:" in out
+    assert "graph.bfs" in out
 
 
 def test_smoke_saturation_phase_numbers(smoke_run):

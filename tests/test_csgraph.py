@@ -291,3 +291,64 @@ def test_minimum_spanning_tree_native():
                                dtype=np.int64))
     assert sparse.csgraph.minimum_spanning_tree(
         sparse.csr_array(Zi)).dtype == np.float64
+
+
+def _kruskal_lex(S):
+    """Reference Kruskal under the strict (weight, row, col) total
+    order over stored entries, treating the graph as undirected — the
+    pinned minimum_spanning_tree tie-breaking policy, independently
+    implemented."""
+    coo = S.tocoo()
+    order = np.lexsort((coo.col, coo.row, coo.data))
+    parent = np.arange(S.shape[0])
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    out = np.zeros(S.shape, dtype=np.float64)
+    for k in order:
+        u, v = int(coo.row[k]), int(coo.col[k])
+        ru, rv = find(u), find(v)
+        if ru != rv:
+            parent[ru] = rv
+            out[u, v] = coo.data[k]
+    return out
+
+
+def test_minimum_spanning_tree_tie_breaking_deterministic():
+    # Tie-heavy graphs (weights drawn from {1, 2, 3} only): the pinned
+    # lowest-(weight, row, col) policy must reproduce the reference
+    # lexicographic Kruskal at EXACT stored positions, every trial —
+    # not merely match the (unique) tree weight.
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        n = int(rng.integers(6, 40))
+        Eu = sp.triu(sp.random(n, n, density=0.25, random_state=rng),
+                     k=1).tocoo()
+        w = rng.integers(1, 4, size=len(Eu.data)).astype(np.float64)
+        S = sp.csr_array((np.concatenate([w, w]),
+                          (np.concatenate([Eu.row, Eu.col]),
+                           np.concatenate([Eu.col, Eu.row]))),
+                         shape=(n, n))
+        got = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(S))
+        np.testing.assert_array_equal(np.asarray(got.todense()),
+                                      _kruskal_lex(S))
+        # Tree weight still agrees with scipy (unique even where its
+        # tie-broken edge choices differ from ours).
+        np.testing.assert_allclose(np.asarray(got.sum()),
+                                   scsg.minimum_spanning_tree(S).sum())
+    # Asymmetric tie-heavy input: same policy over stored positions.
+    D = sp.random(30, 30, density=0.15, random_state=rng).tocsr()
+    D.data[:] = rng.integers(1, 3, size=D.nnz).astype(np.float64)
+    D.setdiag(0)
+    D.eliminate_zeros()
+    gotd = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(D))
+    np.testing.assert_array_equal(np.asarray(gotd.todense()),
+                                  _kruskal_lex(D))
+    # Determinism: a repeated run is bit-identical.
+    got2 = sparse.csgraph.minimum_spanning_tree(sparse.csr_array(S))
+    np.testing.assert_array_equal(np.asarray(got.todense()),
+                                  np.asarray(got2.todense()))

@@ -110,6 +110,23 @@ def render_autotune_table(counters: dict) -> str:
     return report.format_table(headers, lines, left_cols=1)
 
 
+def render_graph_table(counters: dict) -> str:
+    """Graph-analytics ledger from the ``graph.*`` counters embedded
+    in a Chrome-trace artifact: per-algorithm runs/iteration totals
+    and the per-semiring distributed dispatch counts
+    (``graph.dist_spmv.<semiring>`` / ``graph.dist_spmm.<semiring>`` /
+    ``graph.matvec.<semiring>`` rows)."""
+    rows = {name: val for name, val in counters.items()
+            if name.startswith("graph.")}
+    if not rows:
+        return ("no graph.* counters recorded (no "
+                "legate_sparse_tpu.graph algorithm or semiring "
+                "dispatch ran)")
+    headers = ["counter", "value"]
+    lines = [[name, str(int(val))] for name, val in sorted(rows.items())]
+    return report.format_table(headers, lines, left_cols=1)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Per-op table from a legate_sparse_tpu trace file."
@@ -156,6 +173,11 @@ def main(argv=None) -> int:
                     help="also render the SLO burn ledger (latest "
                          "verdict per objective from slo.verdict "
                          "events + the exact slo.breach.* counters)")
+    ap.add_argument("--graph", action="store_true",
+                    help="also render the graph-analytics ledger "
+                         "(per-algorithm runs/iters and per-semiring "
+                         "distributed dispatch counts from the "
+                         "graph.* counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -221,6 +243,10 @@ def main(argv=None) -> int:
     if args.autotune:
         print("\nautotune ledger:")
         print(render_autotune_table(meta.get("counters") or {}))
+
+    if args.graph:
+        print("\ngraph ledger:")
+        print(render_graph_table(meta.get("counters") or {}))
 
     if args.flows:
         print("\ncausal flows:")

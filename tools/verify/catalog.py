@@ -356,6 +356,73 @@ for _op, _pid in (("spmv", "kernel/csr-rowids-bf16/spmv"),
         lambda op=_op: _f32acc_build(op))
 
 
+_SEMIRING_SRC = _KERNEL_SRC + ("legate_sparse_tpu/graph/semiring.py",)
+
+
+def _semiring_kernel_build(label: str):
+    """Semiring kernel programs (the autotune labels ``semiring-csr``
+    / ``semiring-ell`` / ``semiring-sliced-ell`` — docs/GRAPH.md),
+    lowered at the jitted entry points directly (like the ``*-bf16``
+    variants: the graph dispatcher and autotune registry are their
+    only callers) under the min-plus pair, the catalog entry whose
+    reduction is NOT a sum — so the contract pins the generalized
+    segment/row-min program, not the plus-times degenerate case."""
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.ops import spmv as _ops
+
+    sds = jax.ShapeDtypeStruct
+    f32 = np.dtype(np.float32)
+    kw = {"add": "min", "mul": "plus"}
+    if label == "semiring-csr":
+        nnz = 4 * N_1D
+        fn = _ops.csr_semiring_spmv_rowids_masked
+        specs = (sds((nnz,), f32), sds((nnz,), np.int32),
+                 sds((nnz,), np.int32), sds((), np.int32),
+                 sds((N_1D,), f32))
+        kw["rows"] = N_1D
+    else:                                   # flat ELL
+        W = 3
+        fn = _ops.ell_semiring_spmv
+        specs = (sds((N_1D, W), f32), sds((N_1D, W), np.int32),
+                 sds((N_1D,), np.int32), sds((N_1D,), f32))
+    hlo = fn.lower(*specs, **kw).as_text()
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kw))(*specs)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 notes={"semiring": "min-plus"})
+
+
+for _label, _pid in (("semiring-csr", "kernel/semiring-csr/spmv/f32"),
+                     ("semiring-ell", "kernel/semiring-ell/spmv/f32")):
+    _program(_pid, "kernel", _SEMIRING_SRC)(
+        lambda label=_label: _semiring_kernel_build(label))
+
+
+@_program("kernel/semiring-sliced-ell/spmv/f32", "kernel",
+          _SEMIRING_SRC)
+def _build_semiring_sliced_ell():
+    import jax
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from legate_sparse_tpu.ops.spmv import (
+        sliced_ell_pack, sliced_ell_semiring_spmv,
+    )
+
+    A = _banded_np(N_1D)
+    bins = sliced_ell_pack(jnp.asarray(A.data),
+                           jnp.asarray(A.indices), A.indptr, N_1D)
+    x = jax.ShapeDtypeStruct((N_1D,), np.float32)
+    kw = {"rows": N_1D, "add": "min", "mul": "plus"}
+    hlo = sliced_ell_semiring_spmv.lower(bins, x, **kw).as_text()
+    jaxpr = jax.make_jaxpr(
+        lambda b, v: sliced_ell_semiring_spmv(b, v, **kw))(bins, x)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 notes={"bins": len(bins), "semiring": "min-plus"})
+
+
 @_program("kernel/sliced-ell-bf16/spmv", "kernel", _KERNEL_SRC)
 def _build_sliced_ell_bf16():
     import jax
@@ -433,6 +500,86 @@ def _build_spmm_halo():
     return Built(hlo=hlo, jaxpr=jaxpr,
                  predicted=_spmv_predicted(dA, cols=k),
                  notes={"k": k})
+
+
+# ------------------------------------------------------------------ #
+# semiring dist_spmv / dist_spmm plan shapes (docs/GRAPH.md): the
+# DIST_PLAN_SHAPES ("dist_spmv_semiring", ...) triples, lowered
+# through the public dispatchers under min-plus — the catalog entry
+# whose 2-d-block cross-shard reduction is a pmin all_reduce instead
+# of the psum_scatter (the wire program the semiring generalization
+# actually changes; 1-d layouts realize x identically to plus-times).
+# ------------------------------------------------------------------ #
+
+_DIST_SEMIRING_SRC = _DIST_SRC + (
+    "legate_sparse_tpu/graph/semiring.py",)
+
+
+def _semiring_spmv_predicted(dA, cols: int = 1):
+    from legate_sparse_tpu.parallel.dist_csr import (
+        semiring_spmv_comm_volumes,
+    )
+
+    vols = semiring_spmv_comm_volumes(dA, 4, 4, "pmin", cols=cols)
+    return {k: v for k, v in vols.items() if v > 0}
+
+
+def _lower_dist_spmv_semiring(dA, cols: int = 1):
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.parallel.dist_csr import (
+        dist_spmm, dist_spmv, shard_dense, shard_vector,
+    )
+
+    n = dA.shape[0]
+    if cols == 1:
+        x = shard_vector(np.ones(n, np.float32), dA.mesh,
+                         dA.rows_padded, layout=dA.layout)
+        fn = lambda v: dist_spmv(dA, v,             # noqa: E731
+                                 semiring="min-plus")
+    else:
+        x = shard_dense(np.ones((n, cols), np.float32), dA.mesh,
+                        dA.rows_padded)
+        fn = lambda v: dist_spmm(dA, v,             # noqa: E731
+                                 semiring="min-plus")
+    hlo = jax.jit(fn).lower(x).as_text()
+    jaxpr = jax.make_jaxpr(fn)(x)
+    return hlo, jaxpr
+
+
+def _spmv_semiring_program(pid: str, fixture_key: str, **shard_kwargs):
+    @_program(pid, "dist", _DIST_SEMIRING_SRC)
+    def _build():
+        dA = _dist_A(fixture_key, **shard_kwargs)
+        hlo, jaxpr = _lower_dist_spmv_semiring(dA)
+        return Built(hlo=hlo, jaxpr=jaxpr,
+                     predicted=_semiring_spmv_predicted(dA),
+                     notes={"layout": dA.layout,
+                            "shards": dA.num_shards,
+                            "semiring": "min-plus"})
+
+
+_spmv_semiring_program("dist/spmv-semiring/1d-row/halo/f32", "dA_halo")
+_spmv_semiring_program("dist/spmv-semiring/1d-row/all-gather/f32",
+                       "dA_ag", force_all_gather=True)
+_spmv_semiring_program("dist/spmv-semiring/1d-row/precise/f32",
+                       "dA_precise", precise=True)
+_spmv_semiring_program("dist/spmv-semiring/1d-col/panel/f32",
+                       "dA_1dcol", layout="1d-col")
+_spmv_semiring_program("dist/spmv-semiring/2d-block/panel/f32",
+                       "dA_2d", layout="2d-block")
+
+
+@_program("dist/spmm-semiring/1d-row/halo/f32", "dist",
+          _DIST_SEMIRING_SRC)
+def _build_spmm_semiring_halo():
+    k = 4
+    dA = _dist_A("dA_halo")
+    hlo, jaxpr = _lower_dist_spmv_semiring(dA, cols=k)
+    return Built(hlo=hlo, jaxpr=jaxpr,
+                 predicted=_semiring_spmv_predicted(dA, cols=k),
+                 notes={"k": k, "semiring": "min-plus"})
 
 
 @_program("dist/reshard/1d-row/chunk-permute/f32", "dist",

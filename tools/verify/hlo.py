@@ -44,6 +44,9 @@ COLLECTIVE_KINDS = (
 # IR op name -> the comm-ledger kind obs/comm.py prices.  Both
 # all_reduce and reduce_scatter settle into the ledger's "psum" bucket:
 # the model prices the *reduction*, the partitioner picks the op.
+# Non-add reductions (the semiring dist programs — docs/GRAPH.md) are
+# priced under their own ledger kinds; ``CollectiveOp.model_kind``
+# refines an add-less all_reduce via ``_REDUCE_MODEL_KIND``.
 MODEL_KIND = {
     "collective_permute": "ppermute",
     "all_gather": "all_gather",
@@ -51,6 +54,21 @@ MODEL_KIND = {
     "reduce_scatter": "psum",
     "all_to_all": "all_to_all",
 }
+
+# Reduction-region op -> ledger kind for non-add all_reduce.  "or" is
+# how a boolean max may print; a max over i1 *operands* is classified
+# as "or" at parse time (jax.lax.pmax over bool lowers to
+# ``stablehlo.maximum : tensor<i1>`` — the ledger prices it as "por").
+_REDUCE_MODEL_KIND = {"min": "pmin", "max": "pmax", "or": "por"}
+
+
+def ledger_kind(kind: str, reduce: Optional[str] = None) -> str:
+    """Comm-ledger kind for one lowered collective: ``MODEL_KIND``
+    refined by the reduction-region op when an ``all_reduce`` computes
+    something other than add (the semiring dist programs)."""
+    if kind == "all_reduce" and reduce in _REDUCE_MODEL_KIND:
+        return _REDUCE_MODEL_KIND[reduce]
+    return MODEL_KIND[kind]
 
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
@@ -111,20 +129,29 @@ class CollectiveOp:
     moved_pairs: int = 0      # collective_permute: non-self pairs
     # replica_groups shape (n_groups, group_size); None for permutes.
     groups: Optional[Tuple[int, int]] = None
+    # Reduction-region op ("add"/"min"/"max"/"or") for all_reduce /
+    # reduce_scatter; None for region-less collectives.
+    reduce: Optional[str] = None
 
     @property
     def model_kind(self) -> str:
-        return MODEL_KIND[self.kind]
+        return ledger_kind(self.kind, self.reduce)
 
     def signature(self) -> dict:
         """JSON-stable schedule entry (what contracts commit)."""
-        return {
+        sig = {
             "kind": self.kind,
             "operand_bytes": self.operand_bytes,
             "moved_pairs": self.moved_pairs if
             self.kind == "collective_permute" else None,
             "groups": list(self.groups) if self.groups else None,
         }
+        # Only the non-add reductions stamp the schedule entry, so
+        # every contract committed before the semiring programs stays
+        # byte-identical (add is the implied default).
+        if self.reduce in _REDUCE_MODEL_KIND:
+            sig["reduce"] = self.reduce
+        return sig
 
 
 def _region_end(text: str, start: int) -> int:
@@ -142,6 +169,25 @@ def _region_end(text: str, start: int) -> int:
     raise ValueError("unbalanced region in StableHLO text")
 
 
+_REDUCE_OP_RE = re.compile(
+    r"stablehlo\.(add|minimum|maximum|or|and|multiply)\b")
+
+
+def _classify_reduce(region: str) -> Optional[str]:
+    """Reduce-op tag ("add"/"min"/"max"/"or"/...) of one reduction
+    region's text.  A ``maximum`` over ``i1`` operands is boolean or
+    (how ``jax.lax.pmax`` over a bool frontier prints), so it
+    classifies as "or" — the ledger kind the semiring programs price
+    it under ("por")."""
+    m = _REDUCE_OP_RE.search(region)
+    if m is None:
+        return None
+    op = {"minimum": "min", "maximum": "max"}.get(m.group(1), m.group(1))
+    if op == "max" and re.search(r"tensor<i1>", region):
+        return "or"
+    return op
+
+
 def parse_collectives(text: str) -> List[CollectiveOp]:
     """All collective ops in ``text``, in textual (= program) order."""
     ops: List[CollectiveOp] = []
@@ -155,11 +201,15 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
                              f"near offset {m.start()}")
         attrs = am.group(1)
         pos = am.end()
-        # Skip an optional reduction region "({ ... })" before the
-        # type signature (all_reduce / reduce_scatter).
+        # Read an optional reduction region "({ ... })" before the
+        # type signature (all_reduce / reduce_scatter) — both to skip
+        # past it and to classify the reduce op it computes.
+        reduce = None
         rm = re.compile(r"\s*\(\s*\{").match(text, pos)
         if rm:
-            pos = _region_end(text, text.index("{", pos))
+            rstart = text.index("{", pos)
+            pos = _region_end(text, rstart)
+            reduce = _classify_reduce(text[rstart:pos])
             # past the region's closing ')'
             pos = text.index(")", pos) + 1
         sm = _SIG_RE.search(text, pos)
@@ -183,7 +233,7 @@ def parse_collectives(text: str) -> List[CollectiveOp]:
             groups = (int(gm.group(1)), int(gm.group(2)))
         ops.append(CollectiveOp(kind=kind, operand_bytes=ob,
                                 n_pairs=n_pairs, moved_pairs=moved,
-                                groups=groups))
+                                groups=groups, reduce=reduce))
     return ops
 
 

@@ -66,7 +66,7 @@ def lowered_volumes(built: Built) -> Dict[str, int]:
     collectives."""
     vols: Dict[str, int] = {}
     for entry in schedule_of(built):
-        kind = hlo.MODEL_KIND[entry["kind"]]
+        kind = hlo.ledger_kind(entry["kind"], entry.get("reduce"))
         vols[kind] = vols.get(kind, 0) + entry["bytes"]
     return {k: v for k, v in vols.items() if v > 0}
 
