@@ -681,8 +681,15 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # ``graph_n`` / ``graph_nnz`` / ``graph_<alg>_iters`` plus the
 # comm-ledger deltas ``graph_<alg>_comm_bytes`` (the
 # ``*_comm_bytes`` band) and the informational timing field
-# ``graph_ms``.
-SCHEMA_VERSION = 17
+# ``graph_ms``.  18 = multi-tenant attribution phase
+# (docs/OBSERVABILITY.md): a 3-tenant gateway load plus dist SpMV
+# dispatches under tenant contexts and a packed multi-tenant attrib
+# scope, with the attribution ledger armed — golden-pinned exact
+# ``attrib_requests`` / ``attrib_tenants`` / ``attrib_conserved`` /
+# ``attrib_tenant_bytes`` and the comm-ledger delta
+# ``attrib_comm_bytes`` (the ``*_comm_bytes`` band), plus the
+# informational timing field ``attrib_ms``.
+SCHEMA_VERSION = 18
 
 
 def main() -> None:
@@ -1961,6 +1968,128 @@ def main() -> None:
                                 "gateway_rejected_queue_full"])
         except Exception as e:
             sys.stderr.write(f"bench: gateway phase failed: {e!r}\n")
+
+    # Multi-tenant attribution phase (schema 18,
+    # docs/OBSERVABILITY.md): the elastic-placement sensor proof.
+    # With the attribution ledger armed (restored on exit — it must
+    # stay inert for every other phase): (a) a 2-tenant gateway load
+    # whose alternating matrices land in packed multi-tenant batches,
+    # exercising the declared split rule on real dispatches; (b) two
+    # dist SpMV dispatches — one under a single-tenant TraceContext,
+    # one under a packed 3-member attrib scope — pushing real
+    # comm-ledger bytes (remainder included) through the apportioner.
+    # The conservation verdict (per-tenant byte sum == the untagged
+    # comm.total_bytes delta, exactly) and the deterministic totals
+    # are golden-pinned in smoke.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_ATTRIB",
+                           "0") != "1")
+            and not past_deadline(result, "attrib")):
+        try:
+            from legate_sparse_tpu.engine import Engine as _AEngine
+            from legate_sparse_tpu.engine import Gateway as _AGateway
+            from legate_sparse_tpu.obs import attrib as _attrib_mod
+            from legate_sparse_tpu.obs import context as _actx
+            from legate_sparse_tpu.parallel import (
+                make_row_mesh as _a_mesh, shard_csr as _a_shard,
+            )
+            from legate_sparse_tpu.parallel.dist_csr import (
+                dist_spmv as _a_spmv, shard_vector as _a_shard_vec,
+            )
+            from legate_sparse_tpu.settings import settings as _ast2
+
+            t_attr0 = _time_mod.perf_counter()
+            n_a = (1 << 12 if smoke else 1 << 14) - 91
+            with obs.span("bench.attrib") as _sp:
+                A_a1 = _engine_config(sparse, n_a, nnz_per_row)
+                A_a2 = _engine_config(sparse, n_a, nnz_per_row,
+                                      seed=13)
+                x_a = jnp.ones((n_a,), jnp.float32)
+                at_tenants = ("interactive", "batch", "background")
+                comm0 = int(obs.counters.get("comm.total_bytes"))
+                at_counters = ["attrib.total.comm_bytes",
+                               "gateway.packed", "gateway.submitted"]
+                at_counters += [f"attrib.tenant.{t}.comm_bytes"
+                                for t in at_tenants
+                                + ("__untagged__",)]
+                c0a = {k: obs.counters.get(k) for k in at_counters}
+                saved_gw2 = _ast2.gateway
+                saved_attr = _ast2.obs_attrib
+                try:
+                    _ast2.gateway = True
+                    _ast2.obs_attrib = True
+                    gw_at = _AGateway(
+                        _AEngine(), max_batch=4, queue_depth=128,
+                        tenant_quota=64, rate=0.0, burst=16.0,
+                        slack_ms=5.0, timeout_ms=0.0)
+                    try:
+                        futs = []
+                        for i in range(8):
+                            futs.append(gw_at.submit(
+                                A_a1 if i % 2 == 0 else A_a2, x_a,
+                                tenant="interactive",
+                                qos="interactive"))
+                        for _i in range(8):
+                            futs.append(gw_at.submit(
+                                A_a2, x_a, tenant="batch",
+                                qos="batch"))
+                        gw_at.flush()
+                        for f in futs:
+                            _ = f.result(timeout=120)
+                    finally:
+                        gw_at.shutdown()
+                    # Dist segment: real collective bytes through the
+                    # apportioner — the conservation proof is only
+                    # meaningful on non-zero volumes.
+                    mesh_a = _a_mesh()
+                    A_ad = _banded_config(
+                        sparse, 1 << (12 if smoke else 14),
+                        nnz_per_row)
+                    dA_a = _a_shard(A_ad, mesh=mesh_a)
+                    x_ad = _a_shard_vec(
+                        np.ones(A_ad.shape[0], np.float32), mesh_a,
+                        dA_a.rows_padded)
+                    with _actx.use(_actx.TraceContext(
+                            "bench-attrib-one", tenant="interactive",
+                            qos="interactive")):
+                        _ = float(jnp.sum(_a_spmv(dA_a, x_ad)))
+                    with _attrib_mod.scope([(t, t)
+                                            for t in at_tenants]):
+                        _ = float(jnp.sum(_a_spmv(dA_a, x_ad)))
+                finally:
+                    _ast2.gateway = saved_gw2
+                    _ast2.obs_attrib = saved_attr
+
+                def _da(name):
+                    return int(obs.counters.get(name) - c0a[name])
+
+                comm_delta = int(
+                    obs.counters.get("comm.total_bytes")) - comm0
+                # Conservation sums over EVERY attribution target —
+                # the named tenants plus the __untagged__ sink — so
+                # the invariant stays exact even if an untagged comm
+                # source ever lands inside the armed window.
+                tenant_bytes = sum(
+                    _da(f"attrib.tenant.{t}.comm_bytes")
+                    for t in at_tenants + ("__untagged__",))
+                result["attrib_requests"] = _da("gateway.submitted")
+                result["attrib_packed"] = _da("gateway.packed")
+                result["attrib_comm_bytes"] = comm_delta
+                result["attrib_tenant_comm_bytes"] = tenant_bytes
+                result["attrib_tenants"] = sum(
+                    1 for t in at_tenants
+                    if _da(f"attrib.tenant.{t}.comm_bytes"))
+                result["attrib_conserved"] = int(
+                    tenant_bytes == _da("attrib.total.comm_bytes")
+                    == comm_delta and comm_delta > 0)
+                result["attrib_ms"] = round(
+                    (_time_mod.perf_counter() - t_attr0) * 1e3, 3)
+                if _sp is not None:
+                    _sp.set(requests=result["attrib_requests"],
+                            comm_bytes=comm_delta,
+                            conserved=result["attrib_conserved"])
+        except Exception as e:
+            sys.stderr.write(f"bench: attrib phase failed: {e!r}\n")
 
     # Autotune phase (schema_version 11, docs/AUTOTUNER.md): the
     # irregular-SpMV speedup proof.  A seeded power-law matrix gets a

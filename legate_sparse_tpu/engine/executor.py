@@ -82,6 +82,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional, Tuple
 
 from .. import obs as _obs
+from ..obs import attrib as _attrib
 from ..obs import context as _context
 from ..obs import latency as _latency
 from ..obs import trace as _trace
@@ -170,7 +171,11 @@ class _Request:
         _obs.inc(f"engine.exec.outcome.{outcome}")
         # Queue wait for EVERY outcome (the shed-vs-served wait
         # comparison the shedder is judged by); end-to-end latency by
-        # shape bucket for requests that produced a result.
+        # shape bucket for requests that produced a result.  The
+        # attribution ledger charges the same wait to the request's
+        # (tenant, qos) identity — shed requests attribute wait only.
+        _attrib.on_wait(self.tctx.tenant, self.tctx.qos,
+                        t_pop - self.t_ns)
         _latency.observe(f"lat.engine.wait.{outcome}", queue_ms)
         if outcome in ("resolved", "inline", "fallback"):
             _latency.observe(
@@ -497,10 +502,12 @@ class RequestExecutor:
             # request's context so downstream spans (spmv, dist
             # collectives) auto-tag — a multi-request batch has no
             # single identity to activate.
-            with _obs.span("engine.batch", reqs=k, rows=A.shape[0],
-                           nnz=A.nnz,
-                           trace_ids=[r.tctx.trace_id for r in group]
-                           ) as sp:
+            with _attrib.scope([(r.tctx.tenant, r.tctx.qos)
+                                for r in group]), \
+                    _obs.span("engine.batch", reqs=k, rows=A.shape[0],
+                              nnz=A.nnz,
+                              trace_ids=[r.tctx.trace_id for r in group]
+                              ) as sp:
                 # Eligibility was checked at submit (_checked=True):
                 # re-checking would rebuild structure caches per batch
                 # for nothing; mutation-in-flight is out of contract.

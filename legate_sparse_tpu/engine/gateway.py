@@ -76,6 +76,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from .. import obs as _obs
+from ..obs import attrib as _attrib
 from ..obs import context as _context
 from ..obs import latency as _latency
 from ..resilience import deadline as _rdeadline
@@ -151,13 +152,15 @@ class _GwRequest:
         self.x = x
         self.future: Future = Future()
         self.rid = next(_REQUEST_IDS)
+        self.tenant = tenant
+        self.qos = qos
         # Causal identity (obs/context.py): rides the record across
         # the drain-worker thread boundary; the admit span, the batch
         # span's member list, and downstream dispatch spans all carry
-        # this id, rendering one connected flow arc per request.
-        self.tctx = _context.mint(rid=self.rid)
-        self.tenant = tenant
-        self.qos = qos
+        # this id, rendering one connected flow arc per request — and,
+        # obs v5, the (tenant, qos) identity the attribution ledger
+        # charges dispatch costs to.
+        self.tctx = _context.mint(rid=self.rid, tenant=tenant, qos=qos)
         self.rank = _QOS_RANK[qos]
         self.vtag = 0.0
         self.t_ns = time.perf_counter_ns()
@@ -180,8 +183,12 @@ class _GwRequest:
         if self._finished:
             return False
         self._finished = True
-        wait_ms = (time.perf_counter_ns() - self.t_ns) / 1e6
+        wait_ns = time.perf_counter_ns() - self.t_ns
+        wait_ms = wait_ns / 1e6
         _obs.inc(f"gateway.outcome.{outcome}")
+        # Every outcome attributes its queue wait (obs/attrib.py):
+        # shed/errored requests show wait but zero dispatch cost.
+        _attrib.on_wait(self.tenant, self.qos, wait_ns)
         _latency.observe(f"lat.gateway.wait.{self.qos}", wait_ms)
         if outcome == "served":
             _latency.observe(f"lat.gateway.request.{self.qos}",
@@ -595,9 +602,14 @@ class Gateway:
                     self._serve_inline(r)
                 return
         try:
-            with _obs.span("gateway.batch", reqs=k,
-                           trace_ids=[r.tctx.trace_id for r in live]
-                           ) as sp:
+            # Attribution scope (obs/attrib.py): the batch span's wall
+            # time apportions across its member requests; per-group
+            # inner scopes in _dispatch_engine narrow comm attribution
+            # to the members actually dispatched together.
+            with _attrib.scope([(r.tenant, r.qos) for r in live]), \
+                    _obs.span("gateway.batch", reqs=k,
+                              trace_ids=[r.tctx.trace_id for r in live]
+                              ) as sp:
                 self._dispatch_engine(live, sp)
         except Exception:
             # Engine-side failure: the gateway inherits the executor's
@@ -650,15 +662,19 @@ class Gateway:
             if len(g) == 1:
                 # Single-member group: activate its trace context so
                 # the downstream dispatch spans (spmv, dist
-                # collectives) auto-tag onto this request's flow arc.
-                with _context.use(g[0].tctx):
+                # collectives) auto-tag onto this request's flow arc;
+                # the inner attrib scope narrows cost attribution from
+                # the whole batch to this one member.
+                with _attrib.scope([(g[0].tenant, g[0].qos)]), \
+                        _context.use(g[0].tctx):
                     y = self._engine.matvec(A, g[0].x, _checked=True)
                 g[0].serve(y)
             else:
                 X = jnp.stack(
                     [jnp.asarray(r.x).astype(A.dtype) for r in g],
                     axis=1)
-                Y = self._engine.matmat(A, X, _checked=True)
+                with _attrib.scope([(r.tenant, r.qos) for r in g]):
+                    Y = self._engine.matmat(A, X, _checked=True)
                 for i, r in enumerate(g):
                     r.serve(Y[:, i])
 

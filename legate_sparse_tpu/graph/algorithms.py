@@ -38,6 +38,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import obs as _obs
+from ..obs import latency as _latency
 
 
 def _edge_arrays(csgraph, directed: bool, unweighted: bool):
@@ -137,8 +138,9 @@ def bfs(csgraph, source=0, *, directed: bool = True, mesh=None,
     dA = _shard_operator(op, mesh, layout)
     cap = _max_iters(n, max_iters)
     _obs.inc("graph.bfs.runs")
-    with _obs.span("graph.bfs", n=n, sources=int(sources.size),
-                   layout=dA.layout) as sp:
+    with _latency.timer("lat.graph.bfs"), \
+            _obs.span("graph.bfs", n=n, sources=int(sources.size),
+                      layout=dA.layout) as sp:
         batched = sources.size > 1 and dA.grid is None
         if batched:
             F0 = np.zeros((n, sources.size), dtype=bool)
@@ -225,8 +227,9 @@ def sssp(csgraph, source=0, *, directed: bool = True,
     # so the cap is the detector, not a budget.
     cap = n if max_iters is None else _max_iters(n, max_iters)
     _obs.inc("graph.sssp.runs")
-    with _obs.span("graph.sssp", n=n, sources=int(sources.size),
-                   layout=dA.layout) as sp:
+    with _latency.timer("lat.graph.sssp"), \
+            _obs.span("graph.sssp", n=n, sources=int(sources.size),
+                      layout=dA.layout) as sp:
         batched = sources.size > 1 and dA.grid is None
         if batched:
             D0 = np.full((n, sources.size), np.inf, dtype=fdt)
@@ -284,7 +287,8 @@ def connected_components(csgraph, *, mesh=None, layout=None,
     dA = _shard_operator(op, mesh, layout)
     cap = _max_iters(n, max_iters)
     _obs.inc("graph.cc.runs")
-    with _obs.span("graph.cc", n=n, layout=dA.layout) as sp:
+    with _latency.timer("lat.graph.cc"), \
+            _obs.span("graph.cc", n=n, layout=dA.layout) as sp:
         labels = _shard_vec(np.arange(n, dtype=np.int32), dA)
         it = 0
         while it < cap:
@@ -354,7 +358,8 @@ def pagerank(csgraph, *, alpha: float = 0.85, tol: float = 1e-6,
     inv_n = 1.0 / n
     _obs.inc("graph.pagerank.runs")
     it = 0
-    with _obs.span("graph.pagerank", n=n, layout=dM.layout) as sp:
+    with _latency.timer("lat.graph.pagerank"), \
+            _obs.span("graph.pagerank", n=n, layout=dM.layout) as sp:
         while it < max_iters:
             r_prev = r
             for _ in range(cycle):

@@ -3,7 +3,7 @@
 """legate_sparse_tpu.obs: observability — op-level tracing, counters,
 and structured perf evidence.
 
-Ten pieces (see each module's docstring for the design contract):
+Twelve pieces (see each module's docstring for the design contract):
 
 - ``trace``    — near-zero-overhead spans (``with obs.span("spmv",
                  nnz=...)``) recording wall time + first-call vs
@@ -39,6 +39,17 @@ Ten pieces (see each module's docstring for the design contract):
                  error budgets, evaluated as multi-window burn rates
                  over the ``lat.*`` histograms; inert without
                  ``LEGATE_SPARSE_TPU_OBS_SLO`` (obs v4).
+- ``attrib``   — per-tenant cost attribution ledger: wall time, comm
+                 bytes, wait, dispatch/compile counts and watermark
+                 growth charged to the ``(tenant, qos)`` identity the
+                 TraceContext carries, with an exact-conservation
+                 split rule for packed multi-tenant batches; inert
+                 without ``LEGATE_SPARSE_TPU_OBS_ATTRIB`` (obs v5).
+- ``capacity`` — rolling mesh-slice utilization window over the
+                 attributed dispatch spans + the pure-function
+                 advisory capacity report (``capacity.recommendation``
+                 events) joining demand, QoS weight and SLO burn
+                 (obs v5).
 
 Enable tracing with ``LEGATE_SPARSE_TPU_OBS=1`` (read once at import,
 like the other settings) or programmatically::
@@ -54,8 +65,8 @@ null context manager; counters stay live either way.
 """
 
 from . import (  # noqa: F401
-    comm, context, counters, export, latency, memory, regress, report,
-    slo, trace,
+    attrib, capacity, comm, context, counters, export, latency, memory,
+    regress, report, slo, trace,
 )
 from .counters import inc, snapshot  # noqa: F401
 from .export import snapshot_openmetrics, write_openmetrics  # noqa: F401
@@ -66,8 +77,8 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
-    "comm", "context", "counters", "export", "latency", "memory",
-    "regress", "report", "slo", "trace",
+    "attrib", "capacity", "comm", "context", "counters", "export",
+    "latency", "memory", "regress", "report", "slo", "trace",
     "inc", "snapshot", "observe",
     "snapshot_openmetrics", "write_openmetrics",
     "enable", "disable", "enabled", "event", "records", "reset", "span",
@@ -84,3 +95,5 @@ def reset_all() -> None:
     counters.reset()
     latency.reset()
     slo.reset()
+    attrib.reset()
+    capacity.reset()

@@ -54,32 +54,41 @@ _var: "contextvars.ContextVar[Optional[TraceContext]]" = \
 
 class TraceContext:
     """Immutable causal identity for one request: ``trace_id`` (the
-    flow key, process-unique) and the originating request ``rid``
-    (when known).  Ride this on the request record to cross threads;
-    activate with :func:`use`."""
+    flow key, process-unique), the originating request ``rid`` (when
+    known), and — obs v5 — the admission identity ``tenant`` /
+    ``qos`` the attribution ledger charges costs to.  Ride this on
+    the request record to cross threads; activate with :func:`use`."""
 
-    __slots__ = ("trace_id", "rid")
+    __slots__ = ("trace_id", "rid", "tenant", "qos")
 
-    def __init__(self, trace_id: str, rid: Optional[int] = None):
+    def __init__(self, trace_id: str, rid: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 qos: Optional[str] = None):
         object.__setattr__(self, "trace_id", trace_id)
         object.__setattr__(self, "rid", rid)
+        object.__setattr__(self, "tenant", tenant)
+        object.__setattr__(self, "qos", qos)
 
     def __setattr__(self, name, value):  # immutability by contract
         raise AttributeError("TraceContext is immutable")
 
     def __repr__(self) -> str:
-        return f"TraceContext({self.trace_id!r}, rid={self.rid!r})"
+        return (f"TraceContext({self.trace_id!r}, rid={self.rid!r}, "
+                f"tenant={self.tenant!r}, qos={self.qos!r})")
 
 
-def mint(rid: Optional[int] = None, kind: str = "req") -> TraceContext:
+def mint(rid: Optional[int] = None, kind: str = "req",
+         tenant: Optional[str] = None,
+         qos: Optional[str] = None) -> TraceContext:
     """New process-unique context.  If a context is already active on
     this thread (e.g. an outer caller minted one), the active context
     is returned instead — causality attaches to the outermost
-    request, and nested submits join its arc."""
+    request, and nested submits join its arc (including its tenant:
+    costs charge to the outermost admission identity)."""
     cur = _var.get()
     if cur is not None:
         return cur
-    return TraceContext(f"{kind}-{next(_IDS):06d}", rid)
+    return TraceContext(f"{kind}-{next(_IDS):06d}", rid, tenant, qos)
 
 
 def current() -> Optional[TraceContext]:

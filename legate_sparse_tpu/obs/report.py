@@ -499,6 +499,71 @@ def render_gateway_table(counters: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# Per-tenant attributed-cost kinds (obs/attrib.py naming contract).
+_ATTRIB_KINDS = ("wall_ns", "wait_ns", "comm_bytes", "comm_calls",
+                 "dispatches", "compiles", "mem_kb")
+
+
+def render_tenants_table(counters: Dict[str, Any]) -> str:
+    """Per-tenant attributed-cost ledger from the ``attrib.tenant.*``
+    counters (``tools/trace_summary.py --tenants``; obs/attrib.py
+    naming contract): one row per tenant with attributed dispatch
+    busy time, queue wait, interconnect bytes/collective calls,
+    dispatch/compile counts and watermark growth — plus the
+    conservation line checking the attributed byte sum against the
+    untagged ``comm.total_bytes`` ledger, and the utilization
+    totals."""
+    per_tenant: Dict[str, Dict[str, float]] = {}
+    for name, val in counters.items():
+        if not name.startswith("attrib.tenant."):
+            continue
+        body = name[len("attrib.tenant."):]
+        tenant, _, kind = body.rpartition(".")
+        if not tenant or kind not in _ATTRIB_KINDS:
+            continue
+        per_tenant.setdefault(
+            tenant, {k: 0 for k in _ATTRIB_KINDS})[kind] += val
+    lines = []
+    if per_tenant:
+        rows = [
+            [t, f"{r['wall_ns'] / 1e6:.3f}", f"{r['wait_ns'] / 1e6:.3f}",
+             str(int(r["comm_bytes"])), str(int(r["comm_calls"])),
+             str(int(r["dispatches"])), str(int(r["compiles"])),
+             str(int(r["mem_kb"]))]
+            for t, r in sorted(per_tenant.items(),
+                               key=lambda kv: (-kv[1]["wall_ns"],
+                                               kv[0]))
+        ]
+        lines.append(format_table(
+            ["tenant", "busy_ms", "wait_ms", "comm_bytes", "comm_calls",
+             "dispatches", "compiles", "mem_kb"], rows))
+    else:
+        lines.append("no attrib.tenant.* counters recorded "
+                     "(attribution off — LEGATE_SPARSE_TPU_OBS_ATTRIB "
+                     "unset?)")
+        return "\n".join(lines)
+    attributed_b = sum(int(r["comm_bytes"]) for r in per_tenant.values())
+    total_b = int(counters.get("attrib.total.comm_bytes", 0))
+    ledger_b = int(counters.get("comm.total_bytes", 0))
+    verdict = "exact" if attributed_b == total_b else "VIOLATED"
+    lines.append(
+        f"conservation: {attributed_b} attributed bytes vs "
+        f"{total_b} attributed-window total ({verdict}); untagged "
+        f"comm.total_bytes = {ledger_b}")
+    busy = counters.get("util.busy_ns", 0)
+    if busy:
+        lines.append(
+            f"utilization: {busy / 1e6:.3f} busy ms over "
+            f"{int(counters.get('util.dispatches', 0))} dispatch "
+            f"spans, {int(counters.get('capacity.reports', 0))} "
+            f"capacity reports")
+    folds = counters.get("attrib.fold.other", 0)
+    if folds:
+        lines.append(f"tenant cap: {int(folds)} labels folded into "
+                     f"__other__")
+    return "\n".join(lines)
+
+
 def render_flows_table(records: Iterable[Dict[str, Any]]) -> str:
     """Per-request causal-flow ledger (``tools/trace_summary.py
     --flows``): one row per trace id found in span ``trace_id`` /

@@ -111,6 +111,17 @@ DEFAULT_SLOS = (
         target=0.95),
     Slo("engine.request", "engine.request", None,
         "lat.engine.request.", objective_ms=250.0, target=0.99),
+    # Graph analytics (PR 16): whole-algorithm wall objectives over
+    # the always-on lat.graph.<alg> histograms — loose targets, these
+    # are batch traversals, not interactive serving.
+    Slo("graph.bfs", "graph.bfs", None, "lat.graph.bfs",
+        objective_ms=1000.0, target=0.95),
+    Slo("graph.sssp", "graph.sssp", None, "lat.graph.sssp",
+        objective_ms=2000.0, target=0.95),
+    Slo("graph.cc", "graph.cc", None, "lat.graph.cc",
+        objective_ms=2000.0, target=0.95),
+    Slo("graph.pagerank", "graph.pagerank", None, "lat.graph.pagerank",
+        objective_ms=5000.0, target=0.95),
 )
 
 _lock = threading.Lock()

@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import attrib as _attrib
 from . import context as _context
 from . import counters as _counters
 
@@ -177,6 +178,9 @@ class Span:
             val = self.attrs.get(key)
             if isinstance(val, (int, float)) and not isinstance(val, bool):
                 _counters.inc(counter, val)
+        # Per-tenant attribution (obs/attrib.py): dispatch busy spans
+        # charge their wall time to the active tenant members.
+        _attrib.on_span_close(self.name, dur, seq == 0)
 
 
 class _NullSpan:

@@ -146,6 +146,20 @@ Settings
     0 = off) arms a daemon watchdog thread evaluating on a
     monotonic-clock cadence.
 
+``obs_attrib`` (``LEGATE_SPARSE_TPU_OBS_ATTRIB``)
+    Per-tenant resource attribution + capacity advisor
+    (``legate_sparse_tpu.obs.attrib`` / ``.capacity``,
+    ``docs/OBSERVABILITY.md``): charges dispatch wall time, ``comm.*``
+    bytes, queue wait, and memory-watermark growth to the
+    ``(tenant, qos)`` identity minted at ``Gateway.submit``, with a
+    deterministic split rule for packed multi-tenant batches so
+    per-tenant sums conserve exactly against the untagged totals.
+    Off by default — every hook is then one flag read, no
+    ``attrib.*``/``util.*``/``capacity.*`` counter ever moves, and
+    results are bit-for-bit identical (inertness pinned by test).
+    ``obs_tenant_cap`` (``LEGATE_SPARSE_TPU_OBS_TENANT_CAP``, 64)
+    bounds distinct tenant labels; overflow folds into ``__other__``.
+
 ``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
     Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
     ``docs/AUTOTUNER.md``): measured kernel selection for the
@@ -422,6 +436,14 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_OBS_SLO_WATCHDOG_MS",
                            "0")
         )
+        # ---- per-tenant attribution (legate_sparse_tpu.obs.attrib) ----
+        self.obs_attrib: bool = _env_bool(
+            "LEGATE_SPARSE_TPU_OBS_ATTRIB", False)
+        # Distinct tenant labels before counters fold into __other__
+        # (bounded OpenMetrics label cardinality).
+        self.obs_tenant_cap: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_OBS_TENANT_CAP", "64")
+        )
         # ---- graph analytics (legate_sparse_tpu.graph) ----
         # Sweep cap for the semiring traversal loops (BFS/CC label
         # propagation); 0 = derive from the vertex count (n+1, the
@@ -489,6 +511,10 @@ class Settings:
         # SLO evaluation only *reads* the always-on latency
         # histograms — pure telemetry, like ``obs``.
         "obs_slo", "obs_slo_watchdog_ms",
+        # The attribution ledger only *tags* costs the obs stack
+        # already measures — pure telemetry; the tenant-label cap
+        # shapes counter naming, never any plan.
+        "obs_attrib", "obs_tenant_cap",
         # Graph loop caps/cadence shape the HOST iteration loop around
         # semiring dist_spmv dispatches, never what any plan lowers to.
         "graph_max_iters", "graph_conv_iters",

@@ -1,0 +1,553 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Per-tenant attribution ledger + capacity sensor (obs/attrib.py,
+obs/capacity.py — docs/OBSERVABILITY.md "Per-tenant attribution").
+
+The load-bearing contracts, each pinned here:
+
+- **exact conservation**: attributed integer costs (comm bytes, wall
+  ns, waits) sum over tenants to the untagged totals EXACTLY — for
+  single-tenant dispatches, packed multi-tenant batches (the declared
+  remainder apportioning rule), and under the composed-fault chaos
+  drill;
+- **every outcome attributes its wait**: shed requests show queue
+  wait but zero dispatch/comm cost;
+- **bounded label cardinality**: tenant names are sanitized to a
+  dot-free OpenMetrics-safe charset (fuzzed with quotes / newlines /
+  unicode) and fold into ``__other__`` past the cap;
+- **inert-by-default**: without ``LEGATE_SPARSE_TPU_OBS_ATTRIB`` no
+  ``attrib.*`` / ``util.*`` / ``capacity.*`` counter ever moves and
+  results are bit-for-bit identical;
+- **capacity report**: the pure ``recommend()`` join of demand, QoS
+  weight and SLO burn is deterministic, and ``capacity_report`` emits
+  the advisory ``capacity.recommendation`` event;
+- **doctor**: the ``noisy-neighbor`` rule fires on a hog + page-level
+  burn and stays quiet otherwise.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+from legate_sparse_tpu import graph, obs, resilience
+from legate_sparse_tpu.engine import Engine, Gateway
+from legate_sparse_tpu.obs import (
+    attrib, capacity, context, counters, export, latency, report,
+    slo, trace,
+)
+from legate_sparse_tpu.parallel import make_row_mesh, shard_csr
+from legate_sparse_tpu.parallel.dist_csr import dist_spmv, shard_vector
+from legate_sparse_tpu.resilience import chaos
+from legate_sparse_tpu.settings import settings
+
+from utils_test.tools import load_tool as _tool
+
+R = len(jax.devices())
+needs_mesh = pytest.mark.skipif(R < 2, reason="needs a multi-device mesh")
+
+_ENG = Engine()
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    context.reset_ids()
+    yield
+    obs.reset_all()
+    context.reset_ids()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+@pytest.fixture
+def attrib_on():
+    saved = (settings.obs_attrib, settings.obs_tenant_cap)
+    settings.obs_attrib = True
+    yield settings
+    settings.obs_attrib, settings.obs_tenant_cap = saved
+
+
+@pytest.fixture
+def gw_on():
+    saved = settings.gateway
+    settings.gateway = True
+    yield settings
+    settings.gateway = saved
+
+
+_RESIL_KNOBS = (
+    "resil", "resil_retries", "resil_backoff_ms", "resil_breaker_k",
+    "resil_breaker_cooldown_ms",
+)
+
+
+@pytest.fixture
+def armed(gw_on):
+    """Gateway + resilience armed (the chaos-drill configuration)."""
+    saved = {k: getattr(settings, k) for k in _RESIL_KNOBS}
+    settings.resil = True
+    settings.resil_backoff_ms = 0.0
+    resilience.reset()
+    yield settings
+    for k, v in saved.items():
+        setattr(settings, k, v)
+    resilience.reset()
+
+
+def _random_csr(n=400, density=0.03, seed=0):
+    S = sp.random(n, n, density=density, format="csr",
+                  random_state=np.random.default_rng(seed),
+                  dtype=np.float32)
+    return lst.csr_array(S)
+
+
+def _banded(n, dtype=np.float32):
+    return lst.diags(
+        [np.ones(n - 1), np.full(n, 4.0), np.ones(n - 1)], [-1, 0, 1],
+        shape=(n, n), format="csr", dtype=dtype,
+    )
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+
+def _gateway(**kw):
+    base = dict(max_batch=64, queue_depth=128, tenant_quota=64,
+                rate=0.0, burst=16.0, slack_ms=1.0, timeout_ms=0.0)
+    base.update(kw)
+    return Gateway(_ENG, **base)
+
+
+def _tenant_sum(kind):
+    """Sum of ``attrib.tenant.<t>.<kind>`` over every tenant."""
+    return sum(v for k, v in counters.snapshot("attrib.tenant.").items()
+               if k.endswith("." + kind))
+
+
+# ------------------------------------------------- apportioning rule --
+def test_apportion_conserves_and_orders_remainder():
+    members = [("b", "x"), ("a", "x"), ("a", "x")]
+    shares = attrib.apportion(10, members)
+    assert sum(shares) == 10
+    # Remainder units go one at a time in ascending (tenant, qos,
+    # position) order: the two "a" members lead "b".
+    assert shares == [3, 4, 3]
+    assert attrib.apportion(9, members) == [3, 3, 3]
+    assert attrib.apportion(2, members) == [0, 1, 1]
+    assert attrib.apportion(0, members) == [0, 0, 0]
+    assert attrib.apportion(7, [("t", "q")]) == [7]
+
+
+# ------------------------------------------------- label sanitation --
+def test_tenant_label_fuzz_sanitizes_hostile_names(attrib_on):
+    hostile = ['evil"quote', "line\nbreak", "tab\there",
+               "dots.in.name", "semi;colon", 'back\\slash',
+               "uniécode-\U0001f680", "x" * 200]
+    for raw in hostile:
+        label = attrib.tenant_label(raw)
+        assert label, raw
+        assert len(label) <= 64, raw
+        assert set(label) <= attrib._SAFE, (raw, label)
+        assert "." not in label and '"' not in label and \
+            "\n" not in label, (raw, label)
+    # Fully-mangled names keep a stable stand-in, never a reserved
+    # name collision; empties fall to the untagged sink.
+    assert attrib.tenant_label("\U0001f680\U0001f680") == "t2"
+    assert attrib.tenant_label("") == attrib.UNTAGGED
+    assert attrib.tenant_label(None) == attrib.UNTAGGED
+    assert attrib.tenant_label(attrib.UNTAGGED) == attrib.UNTAGGED
+    assert attrib.tenant_label(attrib.OTHER) == attrib.OTHER
+
+
+def test_tenant_label_fuzz_openmetrics_roundtrip(attrib_on):
+    """Counters named with sanitized hostile tenants must survive the
+    OpenMetrics render -> parse round trip exactly."""
+    for raw in ('quo"te', "new\nline", "unié-\U0001f680",
+                "ok-tenant_1"):
+        with attrib.scope([(raw, "interactive")]):
+            attrib.on_comm("fuzz_op", 37, 1)
+    snap = counters.snapshot("attrib.")
+    assert snap, "no attributed counters recorded"
+    text = export.snapshot_openmetrics()
+    parsed_counters, _hists = export.parse_openmetrics(text)
+    for name, val in snap.items():
+        assert parsed_counters.get(name) == val, name
+
+
+def test_tenant_cap_folds_overflow_into_other(attrib_on):
+    settings.obs_tenant_cap = 2
+    assert attrib.tenant_label("alpha") == "alpha"
+    assert attrib.tenant_label("beta") == "beta"
+    # Third distinct label folds; already-seen labels stay stable.
+    assert attrib.tenant_label("gamma") == attrib.OTHER
+    assert attrib.tenant_label("delta") == attrib.OTHER
+    assert attrib.tenant_label("alpha") == "alpha"
+    assert counters.get("attrib.fold.other") == 2
+    # Folded tenants still attribute (into the shared bucket).
+    with attrib.scope([("gamma", "batch")]):
+        attrib.on_comm("cap_op", 11, 1)
+    assert counters.get(
+        f"attrib.tenant.{attrib.OTHER}.comm_bytes") == 11
+
+
+# --------------------------------------------- conservation: bytes --
+@needs_mesh
+def test_dist_spmv_bytes_conserve_exactly(attrib_on):
+    """The tier-1 conservation pin: per-tenant attributed comm bytes
+    sum EXACTLY to the untagged ``comm.total_bytes`` — one
+    single-tenant dispatch plus one packed 3-member dispatch whose
+    byte total does not divide evenly (remainder apportioning)."""
+    mesh = make_row_mesh()
+    n = 32 * R
+    dA = shard_csr(_banded(n), mesh=mesh)
+    assert dA.halo == 1
+    x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+    per_call = 2 * R * dA.halo * 4      # two-sided halo exchange, f32
+
+    with context.use(context.mint(tenant="alice", qos="interactive")):
+        _ = np.asarray(dist_spmv(dA, x))
+    with attrib.scope([("alice", "interactive"), ("bob", "batch"),
+                       ("carol", "background")]):
+        _ = np.asarray(dist_spmv(dA, x))
+
+    base, rem = divmod(per_call, 3)
+    assert rem == 1, "fixture must exercise the remainder path"
+    # alice sorts first among the members, so she takes the remainder
+    # unit — on top of her whole single-tenant dispatch.
+    assert counters.get("attrib.tenant.alice.comm_bytes") == \
+        per_call + base + 1
+    assert counters.get("attrib.tenant.bob.comm_bytes") == base
+    assert counters.get("attrib.tenant.carol.comm_bytes") == base
+    assert _tenant_sum("comm_bytes") == \
+        counters.get("attrib.total.comm_bytes") == \
+        counters.get("comm.total_bytes") == 2 * per_call
+    # Collective-call conservation: 1 call per dispatch; the packed
+    # dispatch's single call lands on the first-sorted member.
+    assert counters.get("attrib.tenant.alice.comm_calls") == 2
+    assert _tenant_sum("comm_calls") == \
+        counters.get("comm.total_calls") == 2
+
+
+# ----------------------------------------- conservation: wall time --
+def test_packed_gateway_wall_ns_conserves_to_span_sum(gw_on,
+                                                      attrib_on):
+    """A packed multi-tenant gateway batch: attributed wall ns per
+    tenant sums exactly to the dispatch spans' summed durations, and
+    both tenants in the pack carry nonzero cost."""
+    obs.enable()
+    A1, A2 = _random_csr(seed=3), _random_csr(seed=4)
+    gw = _gateway(max_batch=4)
+    try:
+        futs = [gw.submit(A1, _x(400, seed=1), tenant="alice",
+                          qos="interactive"),
+                gw.submit(A2, _x(400, seed=2), tenant="alice",
+                          qos="interactive"),
+                gw.submit(A1, _x(400, seed=3), tenant="bob",
+                          qos="batch"),
+                gw.submit(A2, _x(400, seed=4), tenant="bob",
+                          qos="batch")]
+        gw.flush()
+        for f in futs:
+            _ = np.asarray(f.result(timeout=60))
+    finally:
+        gw.shutdown()
+    span_sum = sum(r["dur_ns"] for r in obs.records()
+                   if r.get("type") == "span"
+                   and r["name"] in attrib.DISPATCH_SPANS)
+    assert span_sum > 0
+    assert _tenant_sum("wall_ns") == \
+        counters.get("attrib.total.wall_ns") == span_sum
+    for tenant in ("alice", "bob"):
+        assert counters.get(f"attrib.tenant.{tenant}.wall_ns") > 0
+        assert counters.get(f"attrib.tenant.{tenant}.wait_ns") > 0
+    assert _tenant_sum("dispatches") == 4
+    # Per-(tenant, qos, op) wall breakdown conserves too.
+    op_sum = sum(counters.snapshot("attrib.op.").values())
+    assert op_sum == span_sum
+    # The dispatch fed the utilization window.
+    assert counters.get("util.busy_ns") == span_sum
+    assert counters.get("util.dispatches") >= 1
+
+
+# --------------------------------------- chaos-drill conservation --
+@needs_mesh
+def test_chaos_drill_conserves_attribution(armed, attrib_on):
+    """Satellite: the multi-tenant chaos drill with faults armed — a
+    deadline-storm tenant shed every round — plus a distributed
+    dispatch in the same window.  Per-tenant attributed bytes and
+    wall-ns sum EXACTLY to the untagged ledgers, and shed requests
+    attribute wait but zero dispatch/comm cost."""
+    obs.enable()
+    A_good, A_storm = _random_csr(seed=3), _random_csr(seed=4)
+    gw = _gateway(max_batch=8)
+    try:
+        rep = chaos.run_drill(
+            gw,
+            tenants=[
+                {"name": "good", "qos": "interactive", "A": A_good,
+                 "xs": [_x(400, seed=s) for s in range(3)]},
+                {"name": "storm", "qos": "background", "A": A_storm,
+                 "xs": [_x(400, seed=s) for s in range(10, 13)],
+                 "deadline_ms": 0.0},
+            ],
+            rounds=3, seed=7)
+    finally:
+        gw.shutdown()
+    assert rep.ok(), rep.violations
+    assert rep.per_tenant["storm"]["shed"] == 9
+
+    # Real interconnect bytes inside the same attributed window.
+    mesh = make_row_mesh()
+    n = 32 * R
+    dA = shard_csr(_banded(n), mesh=mesh)
+    x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+    with context.use(context.mint(tenant="good", qos="interactive")):
+        _ = np.asarray(dist_spmv(dA, x))
+
+    # Bytes: exact conservation against the untagged comm ledger.
+    assert counters.get("comm.total_bytes") > 0
+    assert _tenant_sum("comm_bytes") == \
+        counters.get("attrib.total.comm_bytes") == \
+        counters.get("comm.total_bytes")
+    # Wall: exact conservation against the dispatch span durations.
+    span_sum = sum(r["dur_ns"] for r in obs.records()
+                   if r.get("type") == "span"
+                   and r["name"] in attrib.DISPATCH_SPANS)
+    assert span_sum > 0
+    assert _tenant_sum("wall_ns") == \
+        counters.get("attrib.total.wall_ns") == span_sum
+    # The storm tenant was shed at admit every time: wait attributed,
+    # zero dispatch cost, zero bytes.
+    assert counters.get("attrib.tenant.storm.wait_ns") > 0
+    assert counters.get("attrib.tenant.storm.wall_ns") == 0
+    assert counters.get("attrib.tenant.storm.dispatches") == 0
+    assert counters.get("attrib.tenant.storm.comm_bytes") == 0
+    assert counters.get("attrib.tenant.good.wall_ns") > 0
+
+
+# ------------------------------------------------ inert by default --
+@needs_mesh
+def test_attrib_inert_without_flag(gw_on):
+    """Acceptance: with the flag off (default) the whole subsystem is
+    bit-for-bit + counter inert — tenant-tagged traffic moves no
+    ``attrib.*`` / ``util.*`` / ``capacity.*`` counter, and enabling
+    it changes no numerics."""
+    assert settings.obs_attrib is False
+    obs.enable()
+    mesh = make_row_mesh()
+    n = 32 * R
+    dA = shard_csr(_banded(n), mesh=mesh)
+    x = shard_vector(np.ones(n, np.float32), mesh, dA.rows_padded)
+    A = _random_csr()
+    xg = _x(400)
+
+    def _run():
+        with context.use(context.mint(tenant="alice",
+                                      qos="interactive")):
+            y_d = np.asarray(dist_spmv(dA, x))
+        gw = _gateway()
+        try:
+            fut = gw.submit(A, xg, tenant="alice", qos="interactive")
+            gw.flush()
+            y_g = np.asarray(fut.result(timeout=60))
+        finally:
+            gw.shutdown()
+        return y_d, y_g
+
+    with attrib.scope([("alice", "interactive")]):  # no-op while off
+        assert attrib.current_members() == \
+            ((attrib.UNTAGGED, "none"),)
+    y_d_off, y_g_off = _run()
+    for prefix in ("attrib.", "util.", "capacity."):
+        assert counters.snapshot(prefix) == {}, prefix
+    assert capacity.capacity_report() is None
+    assert counters.snapshot("capacity.") == {}
+
+    saved = settings.obs_attrib
+    try:
+        settings.obs_attrib = True
+        y_d_on, y_g_on = _run()
+    finally:
+        settings.obs_attrib = saved
+    assert np.array_equal(y_d_off, y_d_on)
+    assert np.array_equal(y_g_off, y_g_on)
+    assert counters.snapshot("attrib.") != {}
+
+
+# -------------------------------------------------- capacity layer --
+def test_recommend_is_pure_and_deterministic():
+    demand = {"a": {"busy_ns": 6_000_000_000, "qos": "interactive"},
+              "b": {"busy_ns": 3_000_000_000, "qos": "batch"},
+              "c": {"busy_ns": 1_000_000_000, "qos": "background"}}
+    weights = {"interactive": 8.0, "batch": 4.0, "background": 1.0}
+    rec = capacity.recommend(demand, weights, {}, devices=8)
+    assert rec["devices"] == 8
+    assert rec["tenants"]["a"]["devices"] == 6
+    assert rec["tenants"]["b"]["devices"] == 1
+    assert rec["tenants"]["c"]["devices"] == 1   # min 1 per demander
+    assert rec["allocated"] == 8
+    assert rec["undersized"] is False
+    # A page-level burn on the interactive class rounds its tenant UP;
+    # with no non-burning allocation above 1 to trim, the overshoot
+    # stands — the undersized signal.
+    rec2 = capacity.recommend(
+        demand, weights, {"interactive": capacity.BURN_PAGE}, 8)
+    assert rec2["tenants"]["a"]["burning"] is True
+    assert rec2["tenants"]["a"]["devices"] == 7
+    assert rec2["allocated"] == 9
+    assert rec2["undersized"] is True
+    assert capacity.recommend({}, weights, {}, 8)["allocated"] == 0
+
+
+def test_utilization_window_evicts_by_timestamp(attrib_on):
+    capacity.note_busy(5_000_000, (("alice", "interactive"),))
+    capacity.note_busy(3_000_000, (("bob", "batch"),))
+    now = time.monotonic_ns()
+    util = capacity.utilization(60_000.0, now_ns=now)
+    assert util["busy_ns"] == 8_000_000
+    assert util["per_tenant"] == {"alice": 5_000_000,
+                                  "bob": 3_000_000}
+    assert 0.0 < util["busy_frac"] <= 1.0
+    assert counters.get("util.busy_ns") == 8_000_000
+    assert counters.get("util.dispatches") == 2
+    # A window whose horizon is in the future evicts every sample.
+    empty = capacity.utilization(1.0, now_ns=now + 10 ** 12)
+    assert empty["busy_ns"] == 0 and empty["per_tenant"] == {}
+
+
+def test_capacity_report_emits_recommendation_event(attrib_on):
+    obs.enable()
+    with attrib.scope([("alice", "interactive")]):
+        attrib.on_span_close("gateway.batch", 5_000_000, True)
+    rec = capacity.capacity_report(devices=8)
+    assert rec is not None
+    assert rec["devices"] == 8
+    assert rec["tenants"]["alice"]["qos"] == "interactive"
+    assert rec["tenants"]["alice"]["devices"] == 8
+    assert rec["undersized"] is False
+    assert counters.get("capacity.reports") == 1
+    evs = [r for r in obs.records()
+           if r["name"] == "capacity.recommendation"]
+    assert len(evs) == 1
+    at = evs[0]["attrs"]
+    assert at["devices"] == 8 and at["allocated"] == 8
+    assert "alice" in at["tenants"]
+
+
+# ------------------------------------------------------- surfaces --
+def test_render_tenants_table_conservation_line():
+    assert "no attrib.tenant.* counters" in \
+        report.render_tenants_table({})
+    ctrs = {"attrib.tenant.alice.comm_bytes": 86,
+            "attrib.tenant.alice.wall_ns": 2_000_000,
+            "attrib.tenant.bob.comm_bytes": 42,
+            "attrib.total.comm_bytes": 128,
+            "comm.total_bytes": 128,
+            "util.busy_ns": 2_000_000,
+            "util.dispatches": 1}
+    out = report.render_tenants_table(ctrs)
+    assert "alice" in out and "bob" in out
+    assert "conservation: 128 attributed bytes" in out
+    assert "exact" in out and "VIOLATED" not in out
+    assert "utilization:" in out
+    bad = dict(ctrs)
+    bad["attrib.total.comm_bytes"] = 999
+    assert "VIOLATED" in report.render_tenants_table(bad)
+
+
+def test_trace_summary_tenants_flag(tmp_path, attrib_on, capsys):
+    obs.enable()
+    with attrib.scope([("alice", "interactive"), ("bob", "batch")]):
+        attrib.on_comm("unit_op", 9, 1)
+        with obs.span("engine.batch"):   # real dispatch span
+            pass
+    path = str(tmp_path / "t.trace.json")
+    obs.write_chrome_trace(path)
+    rc = _tool("trace_summary").main([path, "--tenants"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "tenant attribution:" in out
+    assert "alice" in out and "bob" in out
+    assert "conservation:" in out and "exact" in out
+
+
+def test_doctor_noisy_neighbor_rule():
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.counters = {"attrib.tenant.hog.wall_ns": 9e9,
+                   "attrib.tenant.meek.wall_ns": 1e9,
+                   "slo.breach.gateway.interactive": 2}
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "noisy-neighbor" in codes
+    finding = next(f for f in doctor.diagnose(ev)
+                   if f["code"] == "noisy-neighbor")
+    assert "hog" in finding["message"]
+    assert "0.90" == finding["value"]
+    # No page-level burn -> no finding (a hog alone is not a problem).
+    ev.counters.pop("slo.breach.gateway.interactive")
+    assert "noisy-neighbor" not in [
+        f["code"] for f in doctor.diagnose(ev)]
+    # Balanced tenants under a burn -> no finding (share not > 50%).
+    ev.counters = {"attrib.tenant.a.wall_ns": 5e9,
+                   "attrib.tenant.b.wall_ns": 5e9,
+                   "slo.breach.gateway.interactive": 1}
+    assert "noisy-neighbor" not in [
+        f["code"] for f in doctor.diagnose(ev)]
+    # The untagged sink never counts as a tenant pair.
+    ev.counters = {"attrib.tenant.hog.wall_ns": 9e9,
+                   "attrib.tenant.__untagged__.wall_ns": 1e9,
+                   "slo.breach.gateway.interactive": 1}
+    assert "noisy-neighbor" not in [
+        f["code"] for f in doctor.diagnose(ev)]
+
+
+# --------------------------------------- graph latency histograms --
+def test_graph_algorithms_record_latency_histograms():
+    """Satellite: the PR 16 graph algorithms feed always-on
+    ``lat.graph.<alg>`` histograms (tracing off — histograms are
+    always-on like every other lat.* family)."""
+    S = sp.random(64, 64, density=0.06, format="csr",
+                  random_state=np.random.default_rng(0))
+    S.data[:] = 1.0
+    graph.bfs(S, 0)
+    graph.sssp(S, 0)
+    graph.connected_components(S)
+    graph.pagerank(S, tol=0.0, max_iters=3)
+    for alg in ("bfs", "sssp", "cc", "pagerank"):
+        hist = latency.get(f"lat.graph.{alg}")
+        assert hist is not None and hist.count >= 1, alg
+
+
+def test_graph_slos_registered_by_default():
+    by_name = {s.name: s for s in slo.registered()}
+    for alg, objective in (("bfs", 1000.0), ("sssp", 2000.0),
+                           ("cc", 2000.0), ("pagerank", 5000.0)):
+        s = by_name[f"graph.{alg}"]
+        assert s.hist_prefix == f"lat.graph.{alg}"
+        assert s.objective_ms == objective
+        assert s.qos is None and s.target == 0.95
+
+
+# -------------------------------------------------- trace context --
+def test_trace_context_carries_tenant_and_qos():
+    c = context.mint(rid=1, tenant="alice", qos="interactive")
+    assert c.tenant == "alice" and c.qos == "interactive"
+    assert "alice" in repr(c)
+    with context.use(c):
+        # A nested mint joins the outer admission identity: costs
+        # charge to the outermost tenant, not an inner re-mint.
+        assert context.mint(rid=2, tenant="bob", qos="batch") is c
+        assert attrib.current_members() == (("alice", "interactive"),)
+    assert context.mint(rid=3).tenant is None

@@ -187,6 +187,7 @@ def test_sliced_ell_nonfinite_x_propagates():
     np.testing.assert_array_equal(np.isinf(y_csr), np.isinf(y_sl))
 
 
+@pytest.mark.slow
 def test_sliced_ell_padding_bound():
     """pow2 row bins bound padded slots below 2x nnz for any skew —
     the property that lets sliced-ELL skip flat ELL's budget knob."""

@@ -46,7 +46,9 @@ GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "gateway_batch_served,gateway_background_served,"
                  "gateway_background_shed,"
                  "graph_n,graph_nnz,graph_bfs_iters,graph_sssp_iters,"
-                 "graph_cc_iters,graph_pagerank_iters")
+                 "graph_cc_iters,graph_pagerank_iters,"
+                 "attrib_requests,attrib_packed,attrib_tenants,"
+                 "attrib_conserved")
 
 
 from utils_test.tools import load_tool as _tool
@@ -407,11 +409,13 @@ def test_smoke_trace_has_gateway_ledger(smoke_run, capsys):
     _, trace_path, _ = smoke_run
     doc = json.loads(trace_path.read_text())
     ctrs = doc["otherData"]["counters"]
-    assert ctrs.get("gateway.submitted", 0) == 96
+    # Process-cumulative: 96 from the fairness sweep + 16 from the
+    # attribution phase's 2-tenant load (8 interactive + 8 batch).
+    assert ctrs.get("gateway.submitted", 0) == 112
     assert ctrs.get("gateway.rejected.queue_full", 0) == 24
     # Per-tenant ledgers balance: submitted == served + shed.
-    for tenant, served, shed in (("interactive", 16, 0),
-                                 ("batch", 16, 0),
+    for tenant, served, shed in (("interactive", 24, 0),
+                                 ("batch", 24, 0),
                                  ("background", 40, 24)):
         assert ctrs.get(f"gateway.tenant.{tenant}.submitted", 0) == (
             served + shed), tenant
@@ -426,6 +430,55 @@ def test_smoke_trace_has_gateway_ledger(smoke_run, capsys):
     assert "gateway ledger:" in out
     assert "interactive" in out and "background" in out
     assert "24 queue_full" in out
+
+
+def test_smoke_attrib_phase_numbers(smoke_run):
+    """ISSUE 18 acceptance (smoke lane): the attribution phase arms
+    the per-tenant ledger over a deterministic 2-tenant gateway load
+    (16 requests; the interactive tenant's alternating matrices land
+    in 2 packed dispatches) plus two dist SpMV dispatches — one
+    single-tenant, one under a packed 3-member scope — and the
+    conservation verdict is exact: the per-tenant attributed byte sum
+    equals the untagged ``comm.total_bytes`` delta, remainder
+    apportioning included."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 18
+    assert result["attrib_requests"] == 16
+    assert result["attrib_packed"] == 2
+    assert result["attrib_tenants"] == 3
+    assert result["attrib_conserved"] == 1
+    assert result["attrib_comm_bytes"] > 0
+    assert result["attrib_tenant_comm_bytes"] == \
+        result["attrib_comm_bytes"]
+    assert result["attrib_ms"] > 0
+
+
+def test_smoke_trace_has_attrib_ledger(smoke_run, capsys):
+    """The trace artifact carries the attrib.*/util.* counters from
+    the attribution phase — per-tenant comm bytes and (with tracing
+    on) wall-time attribution from the dispatch spans — and
+    ``trace_summary --tenants`` renders the ledger with its
+    conservation line."""
+    _, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    for t in ("interactive", "batch", "background"):
+        assert ctrs.get(f"attrib.tenant.{t}.comm_bytes", 0) > 0, t
+    total = sum(v for k, v in ctrs.items()
+                if k.startswith("attrib.tenant.")
+                and k.endswith(".comm_bytes"))
+    assert total == ctrs.get("attrib.total.comm_bytes", 0)
+    # Tracing was on, so the gateway.batch dispatch spans attributed
+    # wall time and fed the utilization estimator.
+    assert ctrs.get("attrib.tenant.interactive.wall_ns", 0) > 0
+    assert ctrs.get("util.busy_ns", 0) > 0
+    assert ctrs.get("util.dispatches", 0) >= 4
+    rc = _tool("trace_summary").main([str(trace_path), "--tenants"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "tenant attribution:" in out
+    assert "interactive" in out
+    assert "conservation:" in out and "exact" in out
 
 
 def test_smoke_trace_has_latency_histograms(smoke_run, capsys):

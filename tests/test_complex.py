@@ -52,7 +52,10 @@ def test_complex_spmv_spmm(dtype):
         rtol=_tol(dtype), atol=_tol(dtype))
 
 
-@pytest.mark.parametrize("dtype", CDTYPES)
+@pytest.mark.parametrize("dtype", [
+    pytest.param(np.complex64, marks=pytest.mark.slow),
+    np.complex128,
+])
 def test_complex_spgemm_and_arithmetic(dtype):
     rng = np.random.default_rng(2)
     S1 = _rand_complex(40, 40, 0.15, rng, dtype)

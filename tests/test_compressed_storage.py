@@ -275,7 +275,8 @@ def test_verdict_key_separates_storage():
 @pytest.mark.parametrize("label,structure", [
     ("csr-rowids-bf16", "uniform"),
     ("ell-bf16", "uniform"),
-    ("sliced-ell-bf16", "powerlaw"),
+    pytest.param("sliced-ell-bf16", "powerlaw",
+                 marks=pytest.mark.slow),
 ])
 def test_routed_bf16_verdict_is_bitwise_direct(label, structure):
     if structure == "powerlaw":

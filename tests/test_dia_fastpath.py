@@ -124,6 +124,7 @@ def test_dia_disabled_by_setting(monkeypatch):
     assert A._dia is False
 
 
+@pytest.mark.slow
 def test_dist_dia_masked_holey_band():
     """Distributed masked DIA path: a holey band (diags().tocsr()
     dropped zeros) through shard_csr carries dia_mask blocks, and
@@ -270,6 +271,7 @@ def test_banded_spgemm_unreachable_slot_falls_back():
                                atol=1e-12)
 
 
+@pytest.mark.slow
 def test_banded_spgemm_rectangular():
     A = sparse.diags([np.ones(50), np.ones(50)], [0, 1],
                      shape=(50, 60), format="csr")
@@ -286,6 +288,7 @@ def test_banded_spgemm_rectangular():
                                atol=1e-12)
 
 
+@pytest.mark.slow
 def test_transpose_wide_band_storage_matches_dense():
     # Stored band wider than the matrix: scipy 1.17's dia transpose is
     # internally inconsistent here (S.T.toarray() != S.toarray().T —

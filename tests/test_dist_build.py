@@ -43,6 +43,7 @@ def test_dist_diags_scalar_bands(n, offsets):
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_diags_array_and_callable_bands():
     n = 50
     rng = np.random.default_rng(1)

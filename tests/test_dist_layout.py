@@ -220,8 +220,11 @@ def test_spmv_parity_2d_vs_1d_vs_local(dtype, tol):
 
 
 @needs_grid
-@pytest.mark.parametrize("dtype,tol", [(np.float32, 1e-5),
-                                       (np.float64, 1e-12)])
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype,tol", [
+    (np.float32, 1e-5),
+    (np.float64, 1e-12),
+])
 def test_spgemm_parity_2d_vs_1d_vs_local(dtype, tol):
     A_sp = _random_csr(64, 80, density=0.1, dtype=dtype, seed=2)
     B_sp = _random_csr(80, 72, density=0.12, dtype=dtype, seed=3)

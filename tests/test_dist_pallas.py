@@ -32,6 +32,7 @@ def _poisson(n_grid, dtype=np.float32):
     )
 
 
+@pytest.mark.slow
 def test_dist_dia_spmv_pallas_matches(mesh, monkeypatch):
     monkeypatch.setenv("LEGATE_SPARSE_TPU_PALLAS_DIST", "interpret")
     A = _poisson(16)

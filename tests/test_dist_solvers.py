@@ -37,6 +37,7 @@ def _ref(n):
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_gmres_converges():
     n = 300  # deliberately not a multiple of the shard count
     A = _nonsym(n)
@@ -75,6 +76,7 @@ def test_dist_gmres_callback_sees_unpadded():
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_minres_symmetric_indefinite():
     # Symmetric but INDEFINITE banded operator: cg is inapplicable,
     # minres converges; padded rows stay exactly zero.
@@ -101,7 +103,8 @@ def test_dist_minres_symmetric_indefinite():
 
 
 @needs_multi
-@pytest.mark.parametrize("which", ["LA", "SA"])
+@pytest.mark.parametrize(
+    "which", [pytest.param("LA", marks=pytest.mark.slow), "SA"])
 def test_dist_eigsh_matches_scipy(which):
     # Padding rows (300 not divisible by 8) must contribute no
     # spurious eigenvalues, even when slow SA convergence escalates

@@ -105,6 +105,7 @@ def test_dist_spgemm_mixed_layouts():
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_galerkin_triple_product():
     """A_c = R @ A @ P — the GMG coarse-operator construction."""
     nf, nc = 64, 32
@@ -188,6 +189,7 @@ def test_dist_band_spgemm_fast_path():
 
 
 @needs_multi
+@pytest.mark.slow
 def test_dist_band_spgemm_holey_falls_back():
     """Holey-band operands (masked DIA) must take the general ESC path
     and still match scipy."""

@@ -604,6 +604,7 @@ def test_svds_native_rectangular():
     np.testing.assert_allclose(Vh @ Vh.T, np.eye(5), atol=1e-8)
 
 
+@pytest.mark.slow
 def test_svds_values_only_and_sm():
     rng = np.random.default_rng(2)
     B_sp = sp.random(40, 30, density=0.3, format="csr", random_state=rng)

@@ -28,6 +28,7 @@ def test_kron_matches_scipy(S):
                                atol=1e-12)
 
 
+@pytest.mark.slow
 def test_kron_poisson_construction():
     """The classic kron(I,T)+kron(T,I) 2-D Laplacian assembly works
     natively (the pattern the reference's pde test builds via scipy)."""

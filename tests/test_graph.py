@@ -97,6 +97,7 @@ def test_semiring_kernels_plus_times_bitwise():
                                       np.asarray(ref_e))
 
 
+@pytest.mark.slow
 def test_semiring_kernels_differential_dense():
     # min-plus / max-times / or-and vs dense references over the
     # STORED structure (stored zeros are edges), incl. empty rows.

@@ -255,6 +255,7 @@ def test_differentiable_solve_minres_under_jit():
         linalg.differentiable_solve(S, b, method="gmres")
 
 
+@pytest.mark.slow
 def test_lsmr_scale_invariant_stopping():
     # An additive absolute-eps term in the stopping tests would
     # mis-fire on tiny-scale data; scipy's tests are relative.

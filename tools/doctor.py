@@ -58,6 +58,11 @@ COMM_DELTA_TOL = 0.01
 ROOFLINE_FLOOR = 0.7
 GATEWAY_REJECT_CEIL = 0.10
 OBS_OVERHEAD_CEIL_PCT = 5.0
+# A tenant holding more than this share of attributed device time is
+# a noisy-neighbor candidate; the finding fires only while some SLO
+# burns at page level (the obs/slo.py fast-window breach threshold).
+NOISY_NEIGHBOR_SHARE = 0.5
+SLO_PAGE_BURN = 14.4
 
 
 def _severity_rank(sev: str) -> int:
@@ -180,6 +185,41 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
             "trace_summary --slo, then the lat.* histograms behind "
             "the objective",
             str(int(breaches[slo_name]))))
+
+    # -- Noisy neighbor: one tenant monopolizes the attributed device
+    #    time (obs/attrib.py ledger) while some SLO burns at page
+    #    level — the capacity signal the submesh-carving actuator
+    #    (ROADMAP item 2) exists for.
+    wall: Dict[str, float] = {}
+    for name, val in ev.counters.items():
+        if (name.startswith("attrib.tenant.")
+                and name.endswith(".wall_ns")):
+            tenant = name[len("attrib.tenant."):-len(".wall_ns")]
+            if tenant not in ("__untagged__", "__other__"):
+                wall[tenant] = wall.get(tenant, 0) + val
+    total_wall = sum(wall.values())
+    burning = bool(breaches)
+    if not burning:
+        for rec in ev.records:
+            if (rec.get("type") == "event"
+                    and rec.get("name") == "slo.verdict"
+                    and float((rec.get("attrs") or {})
+                              .get("fast_burn", 0.0)) >= SLO_PAGE_BURN):
+                burning = True
+                break
+    if total_wall > 0 and len(wall) >= 2 and burning:
+        hog, hog_ns = max(wall.items(), key=lambda kv: (kv[1], kv[0]))
+        share = hog_ns / total_wall
+        if share > NOISY_NEIGHBOR_SHARE:
+            out.append(_finding(
+                "warn", "noisy-neighbor",
+                f"tenant '{hog}' holds {share:.0%} of attributed "
+                f"device time while an SLO burns at page level",
+                "docs/OBSERVABILITY.md 'Per-tenant attribution': "
+                "carve the hog a dedicated submesh (reshard(), "
+                "ROADMAP item 2) or tighten its gateway rate/quota "
+                "knobs",
+                f"{share:.2f}"))
 
     # -- Compiled-plan contract drift: the lowered IR no longer
     #    matches the committed planverify contract.  Critical, not a

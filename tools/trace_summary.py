@@ -19,6 +19,7 @@ Usage::
     python tools/trace_summary.py run.trace.json --plans
     python tools/trace_summary.py run.trace.json --resil
     python tools/trace_summary.py run.trace.json --gateway
+    python tools/trace_summary.py run.trace.json --tenants
     python tools/trace_summary.py run.trace.json --autotune
     python tools/trace_summary.py run.trace.json --flows --slo
 
@@ -178,6 +179,12 @@ def main(argv=None) -> int:
                          "(per-algorithm runs/iters and per-semiring "
                          "distributed dispatch counts from the "
                          "graph.* counters)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="also render the per-tenant attribution "
+                         "ledger (attributed busy/wait time, comm "
+                         "bytes, dispatch/compile counts and the "
+                         "conservation check from the attrib.* "
+                         "counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -247,6 +254,10 @@ def main(argv=None) -> int:
     if args.graph:
         print("\ngraph ledger:")
         print(render_graph_table(meta.get("counters") or {}))
+
+    if args.tenants:
+        print("\ntenant attribution:")
+        print(report.render_tenants_table(meta.get("counters") or {}))
 
     if args.flows:
         print("\ncausal flows:")
