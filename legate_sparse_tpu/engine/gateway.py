@@ -314,6 +314,17 @@ class Gateway:
             except BaseException as e:  # noqa: BLE001 - future contract
                 fut.set_exception(e)
             return fut
+        if _rsettings.placement:
+            # Per-tenant mesh routing (docs/PLACEMENT.md): a
+            # registered tenant's own matrix swaps for a handle
+            # pinning the placement version current NOW — in-flight
+            # requests drain on their admitted placement while later
+            # admissions route to wherever a migration moved the
+            # tenant.  One flag read on this line when placement is
+            # off (the inertness contract).
+            from ..placement import migrate as _placement
+
+            A = _placement.route(A, str(tenant))
         req = _GwRequest(A, x, tenant=str(tenant), qos=qos)
         # Obs v4: the whole admission decision runs under the
         # request's trace context, bracketed by one ``gateway.admit``
@@ -342,6 +353,21 @@ class Gateway:
                     req.shed("gateway.admit", "deadline_shed")
                     return req.future
                 if _rpolicy.breaker("gateway.dispatch").state == "open":
+                    if _rsettings.placement:
+                        from ..placement import migrate as _placement
+
+                        if _placement.is_placed_handle(req.A):
+                            # Breaker-degraded mode with a PLACED
+                            # tenant: its traffic never touched the
+                            # tripped shared dispatch path — keep
+                            # serving on its own submesh and flag the
+                            # tenant for a slice shrink instead of
+                            # shedding globally (the controller's
+                            # next step halves its slice).
+                            _obs.inc("placement.degraded_serve")
+                            _placement.flag_shrink(req.tenant)
+                            self._serve_inline(req)
+                            return req.future
                     # Degraded mode: the dispatch path is tripped —
                     # shed deferrable classes instead of queueing onto
                     # a broken path; interactive traffic is served
@@ -557,9 +583,16 @@ class Gateway:
     def _serve_inline(self, req: _GwRequest) -> None:
         """Serve one request through the plain ``A.dot`` dispatch
         (ineligible matrices, fault degradation, fallback) — errors
-        resolve THIS request's future only, never a batchmate's."""
+        resolve THIS request's future only, never a batchmate's.  The
+        dispatch runs under a ``gateway.inline`` attribution span
+        (``attrib.DISPATCH_SPANS``): placed tenants serve exclusively
+        on this path, and without it their busy time — the placement
+        controller's demand signal — would never reach the ledger."""
         try:
-            with _context.use(req.tctx):
+            with _context.use(req.tctx), \
+                    _attrib.scope([(req.tenant, req.qos)]), \
+                    _obs.span("gateway.inline", rid=req.rid,
+                              tenant=req.tenant, qos=req.qos):
                 y = req.A.dot(req.x)
             req.serve(y)
         except BaseException as e:   # noqa: BLE001 - future contract

@@ -109,9 +109,13 @@ _RESERVED = (UNTAGGED, OTHER)
 #: The dispatch busy-span set: top-level spans whose duration is
 #: attributed as device time.  ``gateway.batch`` and ``engine.batch``
 #: are never nested inside each other (the gateway dispatches the
-#: engine facade directly, not through the executor), so summing their
-#: durations never double-counts.
-DISPATCH_SPANS = frozenset({"gateway.batch", "engine.batch"})
+#: engine facade directly, not through the executor), and
+#: ``gateway.inline`` (the gateway's single-request plain dispatch:
+#: ineligible matrices — including placed-tenant handles — and
+#: fault/breaker degradation) never runs inside either, so summing
+#: their durations never double-counts.
+DISPATCH_SPANS = frozenset({"gateway.batch", "engine.batch",
+                            "gateway.inline"})
 
 # (tenant, qos) member list of the active packed batch, if any; set by
 # the gateway/executor dispatch paths around multi-member dispatches.

@@ -58,7 +58,7 @@ from ..settings import settings as _rsettings
 
 __all__ = [
     "BURN_PAGE", "note_busy", "utilization", "recommend",
-    "capacity_report", "reset",
+    "demand_snapshot", "capacity_report", "reset",
 ]
 
 #: Fast-window burn at or above this marks a tenant "burning" (the
@@ -183,17 +183,16 @@ def recommend(demand: Dict[str, Dict[str, object]],
     }
 
 
-def capacity_report(devices: int = 1, *,
-                    window_ms: float = 60_000.0) -> Optional[dict]:
-    """Join the live sensors into one advisory recommendation, bump
-    ``capacity.reports`` and emit the ``capacity.recommendation``
-    event.  Returns the recommendation dict (None when attribution is
-    off — one flag read)."""
-    if not _rsettings.obs_attrib:
-        return None
-    util = utilization(window_ms, devices=devices)
-    # Demand: attributed busy per tenant, classed by its dominant QoS
-    # (largest attrib.op.<tenant>.<qos>.*.ns bucket).
+def demand_snapshot(*, include_wait: bool = False
+                    ) -> Dict[str, Dict[str, object]]:
+    """Per-tenant demand from the live attribution ledger —
+    ``{tenant: {"busy_ns": int, "qos": str|None}}``, the first input
+    of :func:`recommend`.  Busy is the attributed dispatch wall time;
+    ``include_wait=True`` adds attributed queue wait (the placement
+    controller's choice: wait accrues on every armed gateway request,
+    so demand keeps moving even with span tracing off).  QoS is the
+    tenant's dominant class (largest ``attrib.op.<tenant>.<qos>.*``
+    bucket; None when no tagged dispatch span closed yet)."""
     per_qos: Dict[str, Dict[str, int]] = {}
     for cname, val in _counters.snapshot("attrib.op.").items():
         parts = cname[len("attrib.op."):].split(".")
@@ -205,12 +204,27 @@ def capacity_report(devices: int = 1, *,
     demand: Dict[str, Dict[str, object]] = {}
     for tenant, info in _attrib.tenant_snapshot().items():
         busy = int(info.get("wall_ns", 0))
+        if include_wait:
+            busy += int(info.get("wait_ns", 0))
         if busy <= 0:
             continue
         qos_hist = per_qos.get(tenant, {})
         qos = max(sorted(qos_hist), key=qos_hist.get) if qos_hist \
             else None
         demand[tenant] = {"busy_ns": busy, "qos": qos}
+    return demand
+
+
+def capacity_report(devices: int = 1, *,
+                    window_ms: float = 60_000.0) -> Optional[dict]:
+    """Join the live sensors into one advisory recommendation, bump
+    ``capacity.reports`` and emit the ``capacity.recommendation``
+    event.  Returns the recommendation dict (None when attribution is
+    off — one flag read)."""
+    if not _rsettings.obs_attrib:
+        return None
+    util = utilization(window_ms, devices=devices)
+    demand = demand_snapshot()
     burns: Dict[Optional[str], float] = {}
     for v in _slo.verdicts():
         burns[v.qos] = max(burns.get(v.qos, 0.0), v.fast_burn)

@@ -277,6 +277,7 @@ TRAJECTORY_FIELDS = [
     "engine_warm_ms", "engine_batched_ms_per_req",
     "saturation_p99_ms", "irregular_spmv_ms", "irregular_spmv_speedup",
     "irregular_spmv_path", "autotune_verdicts", "obs_overhead_pct",
+    "placement_migrations", "placement_reshard_bytes",
     "bench_wall_s",
 ]
 

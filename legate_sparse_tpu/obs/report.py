@@ -564,6 +564,44 @@ def render_tenants_table(counters: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_placement_table(counters: Dict[str, Any]) -> str:
+    """Elastic-placement control-loop ledger from the ``placement.*``
+    counters (``tools/trace_summary.py --placement``;
+    legate_sparse_tpu/placement naming contract): controller activity
+    (steps/proposals and per-reason holds), migration work (count,
+    declared reshard bytes, thrash), and the data-plane view (placed
+    tenants, routed admissions, breaker-degraded serves)."""
+    placement = {name[len("placement."):]: val
+                 for name, val in counters.items()
+                 if name.startswith("placement.")}
+    if not placement:
+        return ("no placement.* counters recorded (placement off — "
+                "LEGATE_SPARSE_TPU_PLACEMENT unset?)")
+    lines = []
+    holds = sorted((k[len("hold."):], int(v))
+                   for k, v in placement.items()
+                   if k.startswith("hold.") and v)
+    hold_s = ", ".join(f"{n} {r}" for r, n in holds) if holds else "none"
+    lines.append(
+        f"controller: {int(placement.get('steps', 0))} steps, "
+        f"{int(placement.get('proposals', 0))} proposals, "
+        f"holds: {hold_s}, "
+        f"{int(placement.get('watchdog.ticks', 0))} watchdog ticks")
+    lines.append(
+        f"migrations: {int(placement.get('migrations', 0))} applied, "
+        f"{int(placement.get('migration.bytes', 0))} declared reshard "
+        f"bytes (priced == measured: comm.dist_reshard.ppermute_bytes"
+        f" = {int(counters.get('comm.dist_reshard.ppermute_bytes', 0))}"
+        f"), {int(placement.get('thrash', 0))} thrash")
+    lines.append(
+        f"data plane: {int(placement.get('placed', 0))} tenants "
+        f"placed, {int(placement.get('routes', 0))} routed "
+        f"admissions, {int(placement.get('degraded_serve', 0))} "
+        f"breaker-degraded serves, "
+        f"{int(placement.get('shrink.flagged', 0))} shrink flags")
+    return "\n".join(lines)
+
+
 def render_flows_table(records: Iterable[Dict[str, Any]]) -> str:
     """Per-request causal-flow ledger (``tools/trace_summary.py
     --flows``): one row per trace id found in span ``trace_id`` /

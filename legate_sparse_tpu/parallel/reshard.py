@@ -137,9 +137,16 @@ def reshard_vector(x: jax.Array, mesh: Mesh,
     G = int(np.asarray(src_mesh.devices).size)
     L = int(x.shape[0])
     if int(np.asarray(mesh.devices).size) != G:
+        from .dist_csr import mesh_fingerprint
+
+        # Name BOTH endpoint fingerprints: the placement controller
+        # debugs failed migrations by the same mesh_fingerprint keys
+        # its plans and the permute-program cache are ledgered under.
         raise ValueError(
             f"reshard_vector: device count changed ({G} -> "
-            f"{int(np.asarray(mesh.devices).size)}); a mesh "
+            f"{int(np.asarray(mesh.devices).size)}; src mesh "
+            f"{mesh_fingerprint(src_mesh)} -> dst mesh "
+            f"{mesh_fingerprint(mesh)}); a mesh "
             "shrink/grow is a repartition — re-shard from host state "
             "(shard_vector / checkpoint restore)")
     if L % G:

@@ -89,6 +89,7 @@ class ChaosReport:
     faults_armed: int = 0
     faults_fired: int = 0
     recoveries: int = 0
+    migrations: int = 0
     per_tenant: Dict[str, Dict[str, int]] = field(default_factory=dict)
     violations: List[str] = field(default_factory=list)
 
@@ -186,12 +187,94 @@ def _run_device_loss_scenario(rng: random.Random, spec: dict,
             "reference")
 
 
+def _setup_migration_scenario(spec: dict, tenants: Sequence[dict],
+                              placed_refs: Dict[str, List],
+                              report: ChaosReport) -> dict:
+    """Arm the live-migration scenario before the first round: place
+    the target tenant's matrix and carve it onto its ``before`` slice
+    so the mid-storm migration has a placement to move off of.  The
+    pre-migration handle is pinned as a parity reference."""
+    from .. import placement as _placement
+
+    name = str(spec["tenant"])
+    spec_t = next((t for t in tenants if str(t["name"]) == name), None)
+    if spec_t is None:
+        raise ValueError(
+            f"chaos migration scenario: tenant {name!r} is not in the "
+            f"drill tenant list")
+    before, after = (int(spec["devices"][0]), int(spec["devices"][1]))
+    A = spec_t["A"]
+    _placement.place(name, A)
+    _placement.migrate_to(name, before)
+    report.migrations += 1
+    placed_refs[name] = [_placement.route(A, name)]
+    return {"tenant": name, "A": A, "after": after,
+            "payload": _placement.registry().payload_bytes()[name]}
+
+
+def _run_migration_scenario(state: dict,
+                            placed_refs: Dict[str, List],
+                            report: ChaosReport) -> None:
+    """Fire one live migration while the round's gateway submissions
+    are in flight, and hold it to the placement invariants:
+
+    1. **Exactly-once execution** — exactly one migration's worth of
+       ``placement.migration.*`` counter movement.
+    2. **Exact pricing** — the recorded ``comm.dist_reshard.*`` bytes
+       equal the ``price_migration`` prediction (one predictor on
+       both sides — the ISSUE 19 1% acceptance band is exact here).
+    3. **Version drain** — requests admitted before the swap drain on
+       the old placement; the post-migration handle joins the parity
+       reference set, so every served value must still match a clean
+       dispatch on whichever placement served it."""
+    from .. import placement as _placement
+    from ..placement import submesh as _submesh
+
+    c0p = _obs.counters.snapshot("placement.")
+    c0r = _obs.counters.snapshot("comm.dist_reshard.")
+    moved = _placement.migrate_to(state["tenant"], state["after"])
+    report.migrations += 1
+    c1p = _obs.counters.snapshot("placement.")
+    c1r = _obs.counters.snapshot("comm.dist_reshard.")
+
+    def delta(c0, c1, name: str) -> int:
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+    priced = _submesh.priced_bytes(_submesh.price_migration(
+        state["payload"], state["after"]))
+    if delta(c0p, c1p, "placement.migrations") != 1:
+        report.violations.append(
+            f"migration accounting: placement.migrations moved "
+            f"{delta(c0p, c1p, 'placement.migrations')} != 1")
+    if delta(c0p, c1p, "placement.migration.bytes") != moved:
+        report.violations.append(
+            f"migration accounting: placement.migration.bytes moved "
+            f"{delta(c0p, c1p, 'placement.migration.bytes')} != "
+            f"{moved} returned")
+    if delta(c0r, c1r, "comm.dist_reshard.ppermute") != 1:
+        report.violations.append(
+            f"migration accounting: comm.dist_reshard.ppermute moved "
+            f"{delta(c0r, c1r, 'comm.dist_reshard.ppermute')} != 1")
+    if delta(c0r, c1r, "comm.dist_reshard.ppermute_bytes") != priced:
+        report.violations.append(
+            f"migration pricing: comm.dist_reshard.ppermute_bytes "
+            f"moved {delta(c0r, c1r, 'comm.dist_reshard.ppermute_bytes')}"
+            f" != priced {priced}")
+    if moved != priced:
+        report.violations.append(
+            f"migration pricing: recorded {moved} bytes != priced "
+            f"{priced}")
+    placed_refs[state["tenant"]].append(
+        _placement.route(state["A"], state["tenant"]))
+
+
 def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
               seed: int = 0,
               sites: Sequence[str] = DEFAULT_SITES,
               kinds: Sequence[str] = DEFAULT_KINDS,
               result_timeout_s: float = 30.0,
-              device_loss: Optional[dict] = None) -> ChaosReport:
+              device_loss: Optional[dict] = None,
+              migration: Optional[dict] = None) -> ChaosReport:
     """Run ``rounds`` of composed-fault multi-tenant load through
     ``gateway`` and verify the isolation invariants (module
     docstring).
@@ -209,13 +292,34 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
     invariants (:func:`_run_device_loss_scenario`).  The spec dict:
     ``A`` (a ``shard_csr`` matrix), ``b``, and optional ``rtol`` /
     ``conv_test_iters`` / ``ckpt_iters`` / ``after`` /
-    ``parity_atol``."""
+    ``parity_atol``.
+
+    ``migration`` opts a live-migration scenario into the drill
+    (requires ``settings.placement``): the spec dict names a drill
+    ``tenant`` (a square-matrix one) and its ``devices = (before,
+    after)`` slice widths.  The tenant is placed on its ``before``
+    slice up front; at the midpoint round, while that round's
+    submissions are in flight, it live-migrates to ``after`` — held
+    to exactly-once / exact-pricing invariants
+    (:func:`_run_migration_scenario`), with both placement versions'
+    handles joining the tenant's bitwise-parity reference set (early
+    requests legitimately drain on the pre-migration placement)."""
     if not (_settings.gateway and _settings.resil):
         raise RuntimeError(
             "chaos.run_drill needs settings.gateway and settings.resil "
             "on — the drill composes faults through the armed system")
+    if migration is not None and not _settings.placement:
+        raise RuntimeError(
+            "chaos.run_drill migration scenario needs "
+            "settings.placement on — there is no live placement to "
+            "migrate otherwise")
     rng = random.Random(seed)
     report = ChaosReport(rounds=rounds)
+    placed_refs: Dict[str, List] = {}
+    mig_state: Optional[dict] = None
+    if migration is not None:
+        mig_state = _setup_migration_scenario(migration, tenants,
+                                              placed_refs, report)
     c0 = _obs.counters.snapshot("gateway.")
     names = [str(spec["name"]) for spec in tenants]
     try:
@@ -241,6 +345,13 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
                 # The recovery solve runs while this round's gateway
                 # submissions are still queued — live load.
                 _run_device_loss_scenario(rng, device_loss, report)
+            if mig_state is not None and _round == rounds // 2:
+                # Fire the live migration mid-storm, while this
+                # round's submissions are still in flight: admitted
+                # requests hold handles pinned at admission, so they
+                # drain on the old placement.
+                _run_migration_scenario(mig_state, placed_refs,
+                                        report)
             gateway.flush()
             report.faults_fired += sum(
                 a["fired"] for a in _faults.armed().values())
@@ -272,6 +383,12 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
                 report.served += 1
                 out_np = np.asarray(out)
                 refs = [np.asarray(spec["A"].dot(x))]
+                # A placed tenant's requests legitimately served on
+                # either placement version bracketing the mid-storm
+                # migration; both pinned handles are clean dispatch
+                # paths (faults are cleared above).
+                for h in placed_refs.get(str(spec["name"]), ()):
+                    refs.append(np.asarray(h.dot(x)))
                 eng = getattr(gateway, "_engine", None)
                 if eng is not None:
                     y_eng = eng.matvec(spec["A"], x)

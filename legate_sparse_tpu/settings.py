@@ -160,6 +160,30 @@ Settings
     ``obs_tenant_cap`` (``LEGATE_SPARSE_TPU_OBS_TENANT_CAP``, 64)
     bounds distinct tenant labels; overflow folds into ``__other__``.
 
+``placement`` (``LEGATE_SPARSE_TPU_PLACEMENT``)
+    Closed-loop elastic placement (``legate_sparse_tpu.placement``,
+    ``docs/PLACEMENT.md``): carves the global device grid into
+    contiguous per-tenant submeshes sized from QoS weight and observed
+    demand (``capacity.recommend``), with an SLO-burn-driven
+    controller that prices every migration via ``reshard_volumes``
+    and live-migrates tenant matrices behind the gateway.  Off by
+    default — the gateway pays one flag read per armed admission, no
+    ``placement.*`` counter ever moves, and results are bit-for-bit
+    those of the shared global mesh (inertness pinned by test).
+    Knobs (all env-overridable, prefix ``LEGATE_SPARSE_TPU_PLACEMENT_``):
+
+    - ``placement_cooldown_ms`` (``_COOLDOWN_MS``, 1000.0): minimum
+      wall time between executed migrations (anti-flap hysteresis;
+      breaker-driven shrinks override it).
+    - ``placement_watchdog_ms`` (``_WATCHDOG_MS``, 0 = off): arms a
+      daemon controller thread stepping on a monotonic-clock cadence
+      (mirrors the SLO watchdog).
+    - ``placement_amortize`` (``_AMORTIZE``, 1.0): predicted savings
+      must reach this multiple of the priced migration cost before an
+      efficiency-driven move executes.
+    - ``placement_bw_gbps`` (``_BW_GBPS``, 10.0): assumed migration
+      bandwidth converting priced bytes into amortization cost time.
+
 ``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
     Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
     ``docs/AUTOTUNER.md``): measured kernel selection for the
@@ -459,6 +483,25 @@ class Settings:
         self.graph_conv_iters: int = int(
             os.environ.get("LEGATE_SPARSE_TPU_GRAPH_CONV_ITERS", "5")
         )
+        # ---- elastic placement (legate_sparse_tpu.placement) ----
+        self.placement: bool = _env_bool("LEGATE_SPARSE_TPU_PLACEMENT",
+                                         False)
+        self.placement_cooldown_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_PLACEMENT_COOLDOWN_MS",
+                           "1000.0")
+        )
+        self.placement_watchdog_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_PLACEMENT_WATCHDOG_MS",
+                           "0")
+        )
+        self.placement_amortize: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_PLACEMENT_AMORTIZE",
+                           "1.0")
+        )
+        self.placement_bw_gbps: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_PLACEMENT_BW_GBPS",
+                           "10.0")
+        )
         # ---- autotuner (legate_sparse_tpu.autotune) ----
         self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
                                         False)
@@ -518,6 +561,14 @@ class Settings:
         # Graph loop caps/cadence shape the HOST iteration loop around
         # semiring dist_spmv dispatches, never what any plan lowers to.
         "graph_max_iters", "graph_conv_iters",
+        # Placement knobs shape which submesh serves a tenant and how
+        # often the controller migrates — request-lifecycle policy in
+        # front of the engine, never what any plan lowers to (the
+        # per-submesh dist plans are keyed on their own
+        # mesh_fingerprint; tests and the bench placement phase flip
+        # these per phase).
+        "placement", "placement_cooldown_ms", "placement_watchdog_ms",
+        "placement_amortize", "placement_bw_gbps",
         # Autotune knobs pick *which already-compiled kernel* serves a
         # dispatch (routing) or shape the measurement budget — never
         # what any kernel lowers to.  Verdict keys carry the epoch

@@ -32,6 +32,39 @@ if _USE_CACHE and _TEST_PLATFORM == "cpu":
     # explained there).
     os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
+if _USE_CACHE and _TEST_PLATFORM == "cpu":
+    # Export the cache to SUBPROCESS lanes too (bench smoke, pallas
+    # crash-regression, dist-int64, obs v4, resilience scripts all
+    # spawn `sys.executable` with `dict(os.environ)`): each child is
+    # a fresh jax process that would otherwise recompile its big
+    # shard_map/solver executables from scratch on every suite run.
+    # Env-var config must precede the child's jax import, which it
+    # does by construction; same >= 1 s persistence floor as below.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", ".jax_cache"),
+    )
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
+# jit-compile time, not execution, dominates tier-1 wall time (the
+# matrices are tiny), and the suite's ~1100 tests compile thousands of
+# executables.  Backend optimization level 0 skips the expensive LLVM
+# mid-end for a measured ~15% whole-suite win with identical test
+# verdicts (tolerances are unaffected: XLA stays semantics-preserving,
+# only fusion/scheduling effort drops).  CPU lane only — real-chip
+# runs must measure what production compiles.
+# LEGATE_SPARSE_TPU_TEST_FAST_COMPILE=0 restores default optimization.
+if (_TEST_PLATFORM == "cpu"
+        and os.environ.get("LEGATE_SPARSE_TPU_TEST_FAST_COMPILE",
+                           "1") != "0"
+        and "xla_backend_optimization_level"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_backend_optimization_level=0").strip()
+
 if os.environ.get("LEGATE_SPARSE_TPU_TEST_PLATFORM", "cpu") == "cpu":
     from legate_sparse_tpu._platform import pin_cpu
 

@@ -48,7 +48,10 @@ GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "graph_n,graph_nnz,graph_bfs_iters,graph_sssp_iters,"
                  "graph_cc_iters,graph_pagerank_iters,"
                  "attrib_requests,attrib_packed,attrib_tenants,"
-                 "attrib_conserved")
+                 "attrib_conserved,"
+                 "placement_migrations,placement_routes,"
+                 "placement_reshard_bytes,"
+                 "placement_noisy_served,placement_quiet_served")
 
 
 from utils_test.tools import load_tool as _tool
@@ -410,8 +413,10 @@ def test_smoke_trace_has_gateway_ledger(smoke_run, capsys):
     doc = json.loads(trace_path.read_text())
     ctrs = doc["otherData"]["counters"]
     # Process-cumulative: 96 from the fairness sweep + 16 from the
-    # attribution phase's 2-tenant load (8 interactive + 8 batch).
-    assert ctrs.get("gateway.submitted", 0) == 112
+    # attribution phase's 2-tenant load (8 interactive + 8 batch) +
+    # 30 from the placement phase (24 noisy + 6 quiet across its two
+    # serving rounds).
+    assert ctrs.get("gateway.submitted", 0) == 142
     assert ctrs.get("gateway.rejected.queue_full", 0) == 24
     # Per-tenant ledgers balance: submitted == served + shed.
     for tenant, served, shed in (("interactive", 24, 0),
@@ -479,6 +484,47 @@ def test_smoke_trace_has_attrib_ledger(smoke_run, capsys):
     assert "tenant attribution:" in out
     assert "interactive" in out
     assert "conservation:" in out and "exact" in out
+
+
+def test_smoke_placement_phase_numbers(smoke_run):
+    """ISSUE 19 acceptance (smoke lane): the placement phase serves
+    two placed tenants through the gateway's routing (16+4 pre-carve,
+    8+2 on the new carve — every armed admission routed, plus the two
+    warm-up routes: 32), and the burning-tenant plan migrates both
+    tenants exactly once (noisy onto a 7-device submesh, quiet onto
+    its 1-device slice) with the declared reshard bytes golden-pinned
+    as an exact field."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 19
+    assert result["placement_migrations"] == 2
+    assert result["placement_routes"] == 32
+    assert result["placement_reshard_bytes"] > 0
+    assert result["placement_noisy_served"] == 24
+    assert result["placement_quiet_served"] == 6
+    assert result["placement_ms"] > 0
+
+
+def test_smoke_trace_has_placement_ledger(smoke_run, capsys):
+    """The trace artifact carries the placement.* counters with the
+    declared-volume invariant (placement.migration.bytes equals the
+    phase's recorded field) and ``trace_summary --placement`` renders
+    the ledger."""
+    result, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("placement.placed", 0) == 2
+    assert ctrs.get("placement.migrations", 0) == 2
+    assert ctrs.get("placement.migration.bytes", 0) == \
+        result["placement_reshard_bytes"]
+    assert ctrs.get("placement.routes", 0) == 32
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "bench.placement" in names
+    assert "placement.migration" in names
+    rc = _tool("trace_summary").main([str(trace_path), "--placement"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "placement ledger:" in out
+    assert "migrations: 2 applied" in out
 
 
 def test_smoke_trace_has_latency_histograms(smoke_run, capsys):

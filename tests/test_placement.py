@@ -1,0 +1,716 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Closed-loop elastic placement (ISSUE 19, docs/PLACEMENT.md).
+
+The subsystem's load-bearing contracts, each pinned here:
+
+- **off == inert**: with ``LEGATE_SPARSE_TPU_PLACEMENT`` unset the
+  armed-gateway serving path is bit-for-bit the pre-placement path,
+  no ``placement.*`` counter ever moves, ``step()`` returns ``None``
+  and the watchdog refuses to start;
+- **submesh invariants**: deterministic contiguous disjoint carves,
+  ``mesh_fingerprint``-stable rebuilds (the dist-plan ledger and the
+  cached reshard permute programs survive controller epochs);
+- **propose() purity**: a pure function of its snapshot — known
+  values pinned the same way ``capacity.recommend``'s purity is
+  pinned in tests/test_attrib.py, plus a source-level no-clock/
+  no-counter/no-settings guard;
+- **amortization + hysteresis**: hold reasons (steady / no_demand /
+  unamortized / cooldown), burning and shrink overrides, thrash
+  detection;
+- **live migration**: priced == measured ``comm.dist_reshard.*``
+  bytes exactly, atomic version swap with old handles draining;
+- **the acceptance drill**: a two-tenant skewed load with a burning
+  interactive SLO migrates the noisy tenant onto its own submesh,
+  measured bytes within 1% of the priced prediction, and the
+  post-migration burn drops below the breach threshold;
+- **chaos**: the drill's migration-mid-storm scenario holds
+  exactly-once / exact-pricing / bitwise-parity invariants.
+"""
+
+import inspect
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as lst
+from legate_sparse_tpu import obs, placement, resilience
+from legate_sparse_tpu.engine import Engine, Gateway
+from legate_sparse_tpu.obs import (
+    capacity, context, counters, report as obs_report, slo, trace,
+)
+from legate_sparse_tpu.parallel.dist_csr import mesh_fingerprint
+from legate_sparse_tpu.placement import (
+    PlacementController, PlacementSnapshot, propose,
+)
+from legate_sparse_tpu.placement import controller as pctl
+from legate_sparse_tpu.placement import migrate as pmig
+from legate_sparse_tpu.placement import submesh as psub
+from legate_sparse_tpu.resilience import chaos
+from legate_sparse_tpu.resilience import faults as rfaults
+from legate_sparse_tpu.resilience import policy as rpolicy
+from legate_sparse_tpu.resilience.outcomes import Rejected
+from legate_sparse_tpu.settings import settings
+
+from utils_test.tools import load_tool as _tool
+
+R = len(jax.devices())
+DEVS = list(jax.devices())
+needs_mesh = pytest.mark.skipif(R < 2, reason="needs >= 2 devices")
+needs_grid = pytest.mark.skipif(R < 4, reason="needs >= 4 devices")
+
+_ENG = Engine()
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    context.reset_ids()
+    placement.reset()
+    yield
+    placement.reset()
+    obs.reset_all()
+    context.reset_ids()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+@pytest.fixture
+def placement_on():
+    saved = (settings.placement, settings.placement_cooldown_ms,
+             settings.placement_watchdog_ms, settings.placement_amortize,
+             settings.placement_bw_gbps)
+    settings.placement = True
+    yield settings
+    (settings.placement, settings.placement_cooldown_ms,
+     settings.placement_watchdog_ms, settings.placement_amortize,
+     settings.placement_bw_gbps) = saved
+
+
+@pytest.fixture
+def gw_on():
+    saved = settings.gateway
+    settings.gateway = True
+    yield settings
+    settings.gateway = saved
+
+
+_RESIL_KNOBS = (
+    "resil", "resil_retries", "resil_backoff_ms", "resil_breaker_k",
+    "resil_breaker_cooldown_ms",
+)
+
+
+@pytest.fixture
+def armed(gw_on):
+    """Gateway + resilience armed (the chaos-drill configuration)."""
+    saved = {k: getattr(settings, k) for k in _RESIL_KNOBS}
+    settings.resil = True
+    settings.resil_backoff_ms = 0.0
+    resilience.reset()
+    yield settings
+    for k, v in saved.items():
+        setattr(settings, k, v)
+    resilience.reset()
+
+
+@pytest.fixture
+def sensors_on():
+    """Attribution + SLO evaluator armed (the controller's sensors)."""
+    saved = (settings.obs_attrib, settings.obs_slo)
+    settings.obs_attrib = True
+    settings.obs_slo = True
+    yield settings
+    settings.obs_attrib, settings.obs_slo = saved
+
+
+def _random_csr(n=400, density=0.03, seed=0):
+    """Engine-eligible square CSR (no DIA/BSR structure to decline
+    to) — the un-placed control tenant's matrix."""
+    import scipy.sparse as sp
+
+    S = sp.random(n, n, density=density, format="csr",
+                  random_state=np.random.default_rng(seed),
+                  dtype=np.float32)
+    return lst.csr_array(S)
+
+
+def _tridiag(n=256):
+    return lst.diags(
+        [np.full(n, 4.0, np.float32), np.full(n - 1, -1.0, np.float32),
+         np.full(n - 1, -1.0, np.float32)],
+        [0, 1, -1], format="csr", dtype=np.float32)
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+
+def _gateway(**kw):
+    base = dict(max_batch=64, queue_depth=128, tenant_quota=64,
+                rate=0.0, burst=64.0, slack_ms=1.0, timeout_ms=0.0)
+    base.update(kw)
+    return Gateway(_ENG, **base)
+
+
+def _delta(c0, c1, name):
+    return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+
+def _snap(**kw):
+    base = dict(demand={}, qos_weights={}, burns={}, devices=R,
+                current={}, payload_bytes={}, shrink=())
+    base.update(kw)
+    return PlacementSnapshot(**base)
+
+
+# ---------------------------------------------------------------------------
+# off-by-default contract
+# ---------------------------------------------------------------------------
+def test_placement_off_is_bit_for_bit_and_counter_inert(gw_on):
+    """The acceptance inertness clause: with the flag unset the armed
+    gateway serves exactly the pre-placement path (inline dispatch for
+    placed-shape traffic == plain ``A.dot``), no ``placement.*``
+    counter moves, the controller declines to step and the watchdog
+    refuses to start."""
+    assert settings.placement is False, \
+        "suite must run with PLACEMENT unset"
+    A = _tridiag(200)
+    xs = [_x(200, seed=s) for s in range(4)]
+    gw = _gateway()
+    c0 = counters.snapshot("placement.")
+    try:
+        futs = [gw.submit(A, x, tenant="t0", qos="interactive")
+                for x in xs]
+        gw.flush()
+        for x, fut in zip(xs, futs):
+            got = np.asarray(fut.result(timeout=30))
+            ref = np.asarray(_ENG.matvec(A, x, _checked=True))
+            assert (np.array_equal(got, ref)
+                    or np.array_equal(got, np.asarray(A.dot(x))))
+    finally:
+        gw.shutdown()
+    assert counters.snapshot("placement.") == c0 == {}
+    ctl = PlacementController(devices=DEVS)
+    assert ctl.step() is None
+    assert ctl.start_watchdog(interval_ms=5) is False
+    assert counters.snapshot("placement.") == {}
+
+
+# ---------------------------------------------------------------------------
+# submesh invariants
+# ---------------------------------------------------------------------------
+def test_feasible_allocation_trims_deterministically():
+    rec = {"tenants": {"a": {"devices": 6, "share": 0.7},
+                       "b": {"devices": 3, "share": 0.2},
+                       "c": {"devices": 1, "share": 0.1}}}
+    alloc = psub.feasible_allocation(rec, 8)
+    assert alloc == {"a": 4, "b": 3, "c": 1}
+    assert psub.feasible_allocation(rec, 8) == alloc  # deterministic
+    # Everyone at 1 and still over budget: smallest shares drop out.
+    rec2 = {"tenants": {t: {"devices": 1, "share": s}
+                        for t, s in (("a", 0.5), ("b", 0.3),
+                                     ("c", 0.2))}}
+    assert psub.feasible_allocation(rec2, 2) == {"a": 1, "b": 1}
+
+
+def test_carve_contiguous_disjoint_sorted():
+    alloc = {"b": 3, "a": 2, "c": 1}
+    slices = psub.carve(alloc, 8)
+    assert slices == {"a": (0, 2), "b": (2, 3), "c": (5, 1)}
+    assert psub.carve(dict(alloc), 8) == slices   # order-insensitive
+    # Contiguity + disjointness: sorted starts tile a prefix.
+    spans = sorted(slices.values())
+    cursor = 0
+    for start, count in spans:
+        assert start == cursor
+        cursor += count
+    assert cursor <= 8
+    with pytest.raises(ValueError, match="feasible_allocation"):
+        psub.carve({"a": 9}, 8)
+
+
+@needs_mesh
+def test_build_submesh_fingerprint_stable():
+    """Invariant 2: equal slices over equal device lists rebuild
+    meshes with equal ``mesh_fingerprint``s — the key the dist-plan
+    ledger and the reshard permute-program cache survive on."""
+    m1 = psub.build_submesh(DEVS, 0, 2)
+    m2 = psub.build_submesh(DEVS, 0, 2)
+    assert mesh_fingerprint(m1) == mesh_fingerprint(m2)
+    if R >= 3:
+        m3 = psub.build_submesh(DEVS, 1, 2)
+        assert mesh_fingerprint(m3) != mesh_fingerprint(m1)
+    assert psub.build_submesh(DEVS, 0, 1) is None
+    with pytest.raises(ValueError, match="falls off"):
+        psub.build_submesh(DEVS, R - 1, 2)
+
+
+def test_price_migration_is_the_reshard_predictor():
+    from legate_sparse_tpu.obs import comm as obs_comm
+
+    vols = psub.price_migration(1000, 7)
+    chunk = -(-1000 // 7)
+    assert vols == obs_comm.reshard_volumes(
+        moved_chunks=7, chunk_elems=chunk, itemsize=1, shards=7)
+    assert psub.priced_bytes(vols) == 7 * chunk
+    # Single-device destination still crosses the wire (shards >= 2).
+    assert psub.priced_bytes(psub.price_migration(1000, 1)) == 1000
+    assert psub.price_migration(0, 4) == {}
+    A = _tridiag(64)
+    assert psub.payload_bytes(A) == sum(
+        np.asarray(p).nbytes for p in (A.data, A.indices, A.indptr))
+
+
+# ---------------------------------------------------------------------------
+# propose(): purity + decision logic
+# ---------------------------------------------------------------------------
+def test_propose_is_pure_and_deterministic():
+    """ISSUE 19 satellite: ``propose`` is a pure function of its
+    snapshot — known values pinned like ``recommend``'s purity in
+    tests/test_attrib.py, no clock/counter/settings reads inside."""
+    snap = _snap(
+        demand={"noisy": {"busy_ns": 8_000_000_000,
+                          "qos": "interactive"},
+                "quiet": {"busy_ns": 1_000_000_000,
+                          "qos": "background"}},
+        qos_weights={"interactive": 8.0, "background": 1.0},
+        burns={"interactive": 1000.0}, devices=8,
+        payload_bytes={"noisy": 1000, "quiet": 1000})
+    d1 = propose(snap)
+    d2 = propose(snap)
+    assert d1 == d2
+    assert d1.act is True and d1.reason == "burning"
+    assert d1.allocation == {"noisy": 7, "quiet": 1}
+    assert d1.slices == {"noisy": (0, 7), "quiet": (7, 1)}
+    assert d1.moves == d1.slices
+    chunk = -(-1000 // 7)
+    assert d1.priced_bytes == {"noisy": 7 * chunk, "quiet": 1000}
+    assert d1.total_priced_bytes == 7 * chunk + 1000
+    # eff_src = fair_share(8, 2) = 4 -> saving 8e9 * (1 - 4/7).
+    assert d1.predicted_saving_ns == pytest.approx(
+        8e9 * (1 - 4 / 7))
+    assert d1.priced_cost_ns == pytest.approx(
+        d1.total_priced_bytes / 10.0)
+    # No counter movement, and a source-level purity guard: the
+    # function body reads no clock, no counters, no settings.
+    c0 = counters.snapshot("")
+    propose(snap)
+    assert counters.snapshot("") == c0
+    src = inspect.getsource(pctl.propose)
+    for banned in ("time.", "_counters", "_rsettings", "_trace",
+                   "monotonic", "perf_counter"):
+        assert banned not in src, banned
+
+
+def test_propose_hold_and_override_reasons():
+    base = dict(
+        demand={"t": {"busy_ns": 1_000, "qos": "interactive"}},
+        qos_weights={"interactive": 8.0}, devices=R)
+    # No demand, nothing placed: nothing to decide.
+    d = propose(_snap())
+    assert (d.act, d.reason) == (False, "no_demand")
+    # Demand but nothing registered to move: advisory only.
+    d = propose(_snap(**base))
+    assert (d.act, d.reason) == (False, "steady")
+    assert d.moves == {}
+    # A registered tenant with negligible busy time: the priced cost
+    # cannot amortize.
+    d = propose(_snap(**base, payload_bytes={"t": 10 ** 9}))
+    assert (d.act, d.reason) == (False, "unamortized")
+    assert d.total_priced_bytes > 0 and d.priced_cost_ns > 0
+    # Same move, burning class: the breach already costs more.
+    d = propose(_snap(**base, payload_bytes={"t": 10 ** 9},
+                      burns={"interactive": pctl.BURN_PAGE}))
+    assert (d.act, d.reason) == (True, "burning")
+    # Huge dominant demand, tiny payload: the mover grows well past
+    # its fair share and efficiency alone amortizes.
+    d = propose(_snap(
+        demand={"t": {"busy_ns": 10 ** 12, "qos": "interactive"},
+                "u": {"busy_ns": 10 ** 10, "qos": "background"}},
+        qos_weights={"interactive": 8.0, "background": 1.0}, devices=8,
+        payload_bytes={"t": 64}))
+    assert (d.act, d.reason) == (True, "amortized")
+    assert d.predicted_saving_ns >= d.priced_cost_ns
+
+
+def test_propose_shrink_halves_flagged_tenant():
+    d = propose(_snap(
+        devices=8, current={"t": (0, 8)}, payload_bytes={"t": 1000},
+        shrink=("t",)))
+    assert (d.act, d.reason) == (True, "shrink")
+    assert d.allocation["t"] == 4
+    assert d.moves == {"t": (0, 4)}
+    # Floor 1: a 1-wide slice cannot shrink further, so nothing moves.
+    d = propose(_snap(
+        devices=8, current={"t": (0, 1)}, payload_bytes={"t": 1000},
+        shrink=("t",)))
+    assert (d.act, d.reason) == (False, "steady")
+
+
+def test_propose_keep_your_slice_re_trims():
+    """Placed-but-idle tenants keep their slice; when that re-overflows
+    the mesh the same deterministic trim applies before carving."""
+    d = propose(_snap(
+        demand={"a": {"busy_ns": 10 ** 10, "qos": "interactive"}},
+        qos_weights={"interactive": 8.0}, devices=8,
+        current={"idle": (0, 4)},
+        payload_bytes={"a": 1000, "idle": 1000}))
+    total = sum(n for n in d.allocation.values())
+    assert total <= 8
+    assert "idle" in d.allocation and d.allocation["idle"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# registry: place / route / migrate / version drain
+# ---------------------------------------------------------------------------
+def test_place_requires_square():
+    A = lst.csr_array(np.ones((4, 6), np.float32))
+    with pytest.raises(ValueError, match="square"):
+        placement.place("t", A)
+    with pytest.raises(KeyError, match="not placed"):
+        placement.migrate_to("ghost", 2, DEVS)
+
+
+@needs_mesh
+def test_migration_priced_equals_measured_and_swaps_version():
+    A = _tridiag(256)
+    x = _x(256, seed=3)
+    placement.place("pt", A)
+    reg = placement.registry()
+    h0 = placement.route(A, "pt")
+    assert placement.is_placed_handle(h0) and h0.version == 0
+    assert h0._dist is None
+    ref = np.asarray(A.dot(x))
+    assert np.array_equal(np.asarray(h0.dot(x)), ref)
+    c0 = counters.snapshot("")
+    payload = reg.payload_bytes()["pt"]
+    moved = placement.migrate_to("pt", R, DEVS)
+    c1 = counters.snapshot("")
+    priced = psub.priced_bytes(psub.price_migration(payload, R))
+    # priced == measured is exact: one predictor on both sides.
+    assert moved == priced
+    assert _delta(c0, c1, "placement.migrations") == 1
+    assert _delta(c0, c1, "placement.migration.bytes") == moved
+    assert _delta(c0, c1, "comm.dist_reshard.ppermute_bytes") == moved
+    assert _delta(c0, c1, "comm.dist_reshard.ppermute") == 1
+    # Atomic swap: new admissions pin v1 on the submesh; the old
+    # handle keeps draining on the old placement, bit-for-bit.
+    h1 = placement.route(A, "pt")
+    assert h1.version == 1 and h1._dist is not None
+    assert reg.slices()["pt"] == (0, R)
+    assert np.allclose(np.asarray(h1.dot(x)), ref, rtol=1e-5,
+                       atol=1e-5)
+    assert np.array_equal(np.asarray(h0.dot(x)), ref)
+    # Re-placing resets the placement and the version.
+    placement.place("pt", A)
+    assert reg.version("pt") == 0 and reg.slices() == {}
+
+
+@needs_mesh
+def test_gateway_routes_placed_tenant_inline(gw_on, placement_on):
+    A = _random_csr(400)    # engine-eligible: the un-placed tenant's
+    x = _x(400, seed=5)     # copy must take the queued path
+    placement.place("pt", A)
+    gw = _gateway()
+    c0 = counters.snapshot("")
+    try:
+        fut = gw.submit(A, x, tenant="pt", qos="interactive")
+        assert fut.done(), "placed traffic serves inline at admission"
+        assert np.array_equal(np.asarray(fut.result()),
+                              np.asarray(A.dot(x)))
+        # Another tenant submitting the same matrix is NOT routed.
+        fut2 = gw.submit(A, x, tenant="other", qos="interactive")
+        gw.flush()
+        fut2.result(timeout=30)
+    finally:
+        gw.shutdown()
+    c1 = counters.snapshot("")
+    assert _delta(c0, c1, "placement.routes") == 1
+    assert _delta(c0, c1, "gateway.inline") == 1
+
+
+@needs_grid
+def test_breaker_degraded_placed_tenant_shrinks(armed, placement_on):
+    """Breaker-degraded mode: a placed tenant keeps serving on its own
+    submesh (deferrable class included), gets flagged, and the
+    controller's next step halves its slice — cooldown-exempt —
+    instead of the gateway shedding globally."""
+    A = _tridiag(256)
+    x = _x(256, seed=2)
+    placement.place("pt", A)
+    placement.migrate_to("pt", 4, DEVS)
+    br = rpolicy.breaker("gateway.dispatch")
+    for _ in range(settings.resil_breaker_k):
+        br.record_failure()
+    assert br.state == "open"
+    gw = _gateway()
+    c0 = counters.snapshot("placement.")
+    try:
+        fut = gw.submit(A, x, tenant="pt", qos="batch")
+        assert fut.done()
+        out = fut.result()
+        assert not isinstance(out, Rejected)
+        assert np.allclose(np.asarray(out), np.asarray(A.dot(x)),
+                           rtol=1e-5, atol=1e-5)
+        # A non-placed deferrable tenant still sheds typed `breaker`.
+        B = _tridiag(256)
+        shed = gw.submit(B, x, tenant="np", qos="batch").result()
+        assert isinstance(shed, Rejected) and shed.reason == "breaker"
+        # The flag (and its counter) is idempotent until acted on.
+        gw.submit(A, x, tenant="pt", qos="batch").result()
+    finally:
+        gw.shutdown()
+    c1 = counters.snapshot("placement.")
+    assert _delta(c0, c1, "placement.degraded_serve") == 2
+    assert _delta(c0, c1, "placement.shrink.flagged") == 1
+    assert placement.registry().shrink_flagged() == ("pt",)
+    ctl = PlacementController(devices=DEVS, cooldown_ms=10 ** 6)
+    decision = ctl.step()
+    assert decision.act is True and decision.reason == "shrink"
+    assert placement.registry().slices()["pt"] == (0, 2)
+    assert placement.registry().shrink_flagged() == ()
+
+
+# ---------------------------------------------------------------------------
+# controller: cooldown, hysteresis, thrash, watchdog
+# ---------------------------------------------------------------------------
+@needs_grid
+def test_controller_cooldown_and_thrash(placement_on):
+    placement.place("hog", _tridiag(128))
+    reg = placement.registry()
+    ctl = PlacementController(devices=DEVS, cooldown_ms=1000.0)
+    burn = {"interactive": 20.0}
+    weights = {"interactive": 8.0}
+    snap1 = _snap(
+        demand={"hog": {"busy_ns": 8 * 10 ** 9, "qos": "interactive"}},
+        qos_weights=weights, burns=burn, devices=R,
+        payload_bytes=reg.payload_bytes())
+    ctl.snapshot = lambda: snap1
+    d1 = ctl.step(now_ns=0)
+    assert d1.act is True and d1.reason == "burning"
+    assert reg.slices()["hog"] == (0, R)
+    # A second burning plan inside the cooldown window is held.
+    snap2 = _snap(
+        demand={"b": {"busy_ns": 8 * 10 ** 9, "qos": "interactive"},
+                "hog": {"busy_ns": 8 * 10 ** 9, "qos": "interactive"}},
+        qos_weights=weights, burns=burn, devices=R,
+        current=reg.slices(), payload_bytes=reg.payload_bytes())
+    ctl.snapshot = lambda: snap2
+    d2 = ctl.step(now_ns=500_000_000)
+    assert d2.act is False and d2.reason == "cooldown"
+    # A shrink bypasses the cooldown; re-migrating the still-burning
+    # tenant inside its window is the thrash signature.
+    snap3 = snap2._replace(shrink=("hog",))
+    ctl.snapshot = lambda: snap3
+    d3 = ctl.step(now_ns=600_000_000)
+    assert d3.act is True and d3.reason == "shrink"
+    c = counters.snapshot("placement.")
+    assert c.get("placement.steps") == 3
+    assert c.get("placement.proposals") == 3
+    assert c.get("placement.migrations") == 2
+    assert c.get("placement.hold.cooldown") == 1
+    assert c.get("placement.thrash") == 1
+    # Outside the window the same plan executes without thrash.
+    snap4 = _snap(
+        demand={"hog": {"busy_ns": 8 * 10 ** 9, "qos": "interactive"}},
+        qos_weights=weights, burns=burn, devices=R,
+        current=reg.slices(), payload_bytes=reg.payload_bytes())
+    ctl.snapshot = lambda: snap4
+    d4 = ctl.step(now_ns=3_000_000_000)
+    assert d4.act is True
+    assert counters.get("placement.thrash") == 1
+
+
+def test_controller_watchdog_ticks(placement_on):
+    ctl = PlacementController(devices=DEVS, cooldown_ms=10 ** 6)
+    ctl.snapshot = lambda: _snap()
+    assert ctl.start_watchdog(interval_ms=0) is False
+    assert ctl.start_watchdog(interval_ms=5) is True
+    assert ctl.start_watchdog(interval_ms=5) is True   # idempotent
+    deadline = time.monotonic() + 5.0
+    while (counters.get("placement.watchdog.ticks") < 2
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    ctl.stop_watchdog()
+    assert counters.get("placement.watchdog.ticks") >= 2
+    assert counters.get("placement.hold.no_demand") >= 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: closed loop, SLO-driven migration
+# ---------------------------------------------------------------------------
+@needs_grid
+def test_closed_loop_migration_drops_burn(armed, placement_on,
+                                          sensors_on):
+    """ISSUE 19 acceptance: two-tenant skewed load with a burning
+    interactive SLO -> the controller proposes and executes a
+    migration whose measured ``comm.dist_reshard.*`` bytes match the
+    priced prediction within 1%, and the noisy tenant's post-migration
+    fast-window burn drops below the breach threshold."""
+    obs.enable()          # dispatch spans feed the qos attribution
+    A1, A2 = _tridiag(256), _tridiag(192)
+    placement.place("noisy", A1)
+    placement.place("quiet", A2)
+    gw = _gateway()
+    try:
+        # Round 1: a 60ms admission stall on every request blows the
+        # 50ms interactive objective for the noisy tenant (background
+        # has a 1000ms objective and rides through).
+        rfaults.inject("gateway.admit", kind="latency", count=10,
+                       latency_ms=60.0)
+        for s in range(8):
+            gw.submit(A1, _x(256, seed=s), tenant="noisy",
+                      qos="interactive").result(timeout=30)
+        for s in range(2):
+            gw.submit(A2, _x(192, seed=40 + s), tenant="quiet",
+                      qos="background").result(timeout=30)
+        rfaults.clear()
+        verdicts = {v.slo: v for v in slo.evaluate()}
+        v1 = verdicts["gateway.interactive"]
+        assert v1.status == "breach"
+        assert v1.fast_burn >= pctl.BURN_PAGE
+        assert counters.get("slo.breach.gateway.interactive") == 1
+        # The controller senses the burn + skewed demand and acts.
+        ctl = PlacementController(devices=DEVS, cooldown_ms=1000.0)
+        c0 = counters.snapshot("comm.dist_reshard.")
+        decision = ctl.step()
+        c1 = counters.snapshot("comm.dist_reshard.")
+        assert decision.act is True and decision.reason == "burning"
+        assert "noisy" in decision.moves
+        slices = placement.registry().slices()
+        assert slices["noisy"][1] >= 2, "the hog got a real submesh"
+        measured = _delta(c0, c1, "comm.dist_reshard.ppermute_bytes")
+        assert measured > 0
+        assert abs(measured - decision.total_priced_bytes) <= \
+            0.01 * decision.total_priced_bytes, (
+                measured, decision.total_priced_bytes)
+        # Warm the new serving path OUTSIDE the measured window (the
+        # dist compile is a one-time cost, not steady-state latency),
+        # then rebase the fast window on it.
+        for s in range(2):
+            gw.submit(A1, _x(256, seed=100 + s), tenant="noisy",
+                      qos="interactive").result(timeout=60)
+        gw.submit(A2, _x(192, seed=120), tenant="quiet",
+                  qos="background").result(timeout=60)
+        slo.evaluate()      # rebase; the warm compile may breach here
+        breaches_warm = counters.get("slo.breach.gateway.interactive")
+        # Round 2: same skewed load, no stall, new placement — the
+        # burn must fall below the page threshold.
+        for s in range(8):
+            gw.submit(A1, _x(256, seed=200 + s), tenant="noisy",
+                      qos="interactive").result(timeout=60)
+        for s in range(2):
+            gw.submit(A2, _x(192, seed=240 + s), tenant="quiet",
+                      qos="background").result(timeout=60)
+        verdicts = {v.slo: v for v in slo.evaluate()}
+        v2 = verdicts["gateway.interactive"]
+        assert v2.fast_total >= 8
+        assert v2.status != "breach"
+        assert v2.fast_burn < pctl.BURN_PAGE
+        assert counters.get("slo.breach.gateway.interactive") \
+            == breaches_warm, "no new breach on the new placement"
+    finally:
+        rfaults.clear()
+        gw.shutdown()
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# chaos: migration mid-storm
+# ---------------------------------------------------------------------------
+def test_chaos_migration_scenario_requires_placement(armed):
+    with pytest.raises(RuntimeError, match="settings.placement"):
+        chaos.run_drill(None, tenants=[],
+                        migration={"tenant": "t", "devices": (2, 4)})
+
+
+@needs_grid
+def test_chaos_drill_migration_mid_storm(armed, placement_on):
+    """ISSUE 19 satellite: multi-tenant load with a 0ms-deadline storm
+    tenant, a live migration fired mid-round — exactly-once
+    resolution, bitwise parity across both placement versions, exact
+    ``placement.migration.*`` / ``comm.dist_reshard.*`` accounting
+    (asserted inside the scenario; violations land in the report)."""
+    A_good = _tridiag(256)
+    A_storm = _tridiag(192)
+    xs_good = [_x(256, seed=s) for s in range(3)]
+    xs_storm = [_x(192, seed=s) for s in range(10, 13)]
+    gw = _gateway(max_batch=8)
+    c0 = counters.snapshot("")
+    try:
+        report = chaos.run_drill(
+            gw,
+            tenants=[
+                {"name": "good", "qos": "interactive",
+                 "A": A_good, "xs": xs_good},
+                {"name": "storm", "qos": "background",
+                 "A": A_storm, "xs": xs_storm, "deadline_ms": 0.0},
+            ],
+            rounds=4, seed=7,
+            migration={"tenant": "good", "devices": (2, 4)})
+    finally:
+        gw.shutdown()
+    c1 = counters.snapshot("")
+    assert report.ok(), report.violations
+    assert report.migrations == 2       # setup carve + mid-storm move
+    assert report.submitted == 24
+    good = report.per_tenant["good"]
+    assert good["submitted"] == good["served"] == 12
+    assert good["shed"] == 0 and good["error"] == 0
+    storm = report.per_tenant["storm"]
+    assert storm["shed"] >= 1, "a 0ms deadline storm must shed"
+    assert _delta(c0, c1, "placement.migrations") == 2
+    assert _delta(c0, c1, "comm.dist_reshard.ppermute") == 2
+    assert placement.registry().slices()["good"] == (0, 4)
+    assert not rfaults.armed()
+
+
+# ---------------------------------------------------------------------------
+# ledger rendering + doctor
+# ---------------------------------------------------------------------------
+def test_render_placement_table():
+    assert "placement off" in obs_report.render_placement_table({})
+    text = obs_report.render_placement_table({
+        "placement.steps": 3, "placement.proposals": 3,
+        "placement.hold.cooldown": 1, "placement.migrations": 1,
+        "placement.migration.bytes": 1001,
+        "comm.dist_reshard.ppermute_bytes": 1001,
+        "placement.placed": 2, "placement.routes": 5,
+    })
+    assert "controller: 3 steps" in text
+    assert "migrations: 1 applied" in text
+    assert "cooldown" in text and "1001" in text
+
+
+def test_doctor_migration_thrash_and_disabled_rules():
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    ev.counters = {"placement.thrash": 2}
+    finding = next(f for f in doctor.diagnose(ev)
+                   if f["code"] == "migration-thrash")
+    assert finding["severity"] == "warn"
+    assert "2x" in finding["message"]
+    assert finding["value"] == "2"
+    # A noisy-neighbor burn with NO placement.* counters: the info
+    # finding points at the subsystem that would fix it...
+    ev.counters = {"attrib.tenant.hog.wall_ns": 9e9,
+                   "attrib.tenant.meek.wall_ns": 1e9,
+                   "slo.breach.gateway.interactive": 2}
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "noisy-neighbor" in codes
+    assert "placement-disabled-while-noisy-neighbor" in codes
+    # ...and stays quiet once placement is demonstrably live.
+    ev.counters["placement.steps"] = 1
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "noisy-neighbor" in codes
+    assert "placement-disabled-while-noisy-neighbor" not in codes

@@ -218,6 +218,27 @@ def test_vector_shrink_rejected_typed():
         chunk_permute_plan(mesh, make_row_mesh(devs[:-1]))
 
 
+def test_vector_device_count_error_names_both_fingerprints():
+    """ISSUE 19 satellite: the device-count mismatch error reports the
+    src AND dst ``mesh_fingerprint`` — the same keys the dist-plan
+    ledger and permute-program cache (and the placement controller's
+    plans) are indexed by, so a failed migration is debuggable against
+    those ledgers."""
+    mesh = make_row_mesh()
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    n = 64 * len(devs)
+    v = shard_vector(np.ones(n, np.float32), mesh, n)
+    dst = make_row_mesh(devs[:-1])
+    with pytest.raises(ValueError) as ei:
+        reshard_vector(v, dst)
+    msg = str(ei.value)
+    assert mesh_fingerprint(mesh) in msg
+    assert mesh_fingerprint(dst) in msg
+    assert f"{len(devs)} -> {len(devs) - 1}" in msg
+
+
 def test_chunk_permute_plan_pairs():
     mesh = make_row_mesh()
     devs = list(np.asarray(mesh.devices).reshape(-1))

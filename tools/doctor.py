@@ -220,6 +220,37 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
                 "ROADMAP item 2) or tighten its gateway rate/quota "
                 "knobs",
                 f"{share:.2f}"))
+            # The actuator for exactly this smell exists
+            # (legate_sparse_tpu/placement); if no placement.* counter
+            # moved in the evidence, the control loop that would carve
+            # the hog its own submesh never ran.
+            if not any(n.startswith("placement.")
+                       for n in ev.counters):
+                out.append(_finding(
+                    "info", "placement-disabled-while-noisy-neighbor",
+                    "a noisy-neighbor burns an SLO but the elastic "
+                    "placement controller is off (no placement.* "
+                    "counters in the evidence)",
+                    "set LEGATE_SPARSE_TPU_PLACEMENT=1 and drive "
+                    "PlacementController.step() (docs/PLACEMENT.md) "
+                    "so the hog is carved a dedicated submesh "
+                    "automatically",
+                    "-"))
+
+    # -- Migration thrash: the placement controller re-migrated a
+    #    tenant within its own cooldown without the tenant's burn
+    #    improving — the control loop is oscillating, not converging.
+    thrash = ev.counter("placement.thrash")
+    if thrash:
+        out.append(_finding(
+            "warn", "migration-thrash",
+            f"placement controller re-migrated a still-burning tenant "
+            f"within cooldown {int(thrash)}x (oscillating, not "
+            f"converging)",
+            "raise LEGATE_SPARSE_TPU_PLACEMENT_COOLDOWN_MS or "
+            "LEGATE_SPARSE_TPU_PLACEMENT_AMORTIZE so migrations must "
+            "pay for themselves; inspect trace_summary --placement",
+            str(int(thrash))))
 
     # -- Compiled-plan contract drift: the lowered IR no longer
     #    matches the committed planverify contract.  Critical, not a

@@ -185,6 +185,11 @@ def main(argv=None) -> int:
                          "bytes, dispatch/compile counts and the "
                          "conservation check from the attrib.* "
                          "counters)")
+    ap.add_argument("--placement", action="store_true",
+                    help="also render the elastic-placement ledger "
+                         "(controller steps/holds, migration count "
+                         "and declared reshard bytes, routed "
+                         "admissions from the placement.* counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -258,6 +263,10 @@ def main(argv=None) -> int:
     if args.tenants:
         print("\ntenant attribution:")
         print(report.render_tenants_table(meta.get("counters") or {}))
+
+    if args.placement:
+        print("\nplacement ledger:")
+        print(report.render_placement_table(meta.get("counters") or {}))
 
     if args.flows:
         print("\ncausal flows:")
