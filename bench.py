@@ -695,8 +695,18 @@ def _cpu_roofline_items(sparse, A, x, dt_ms: float, bw_ms: float,
 # live-migration registry — golden-pinned exact
 # ``placement_migrations`` / ``placement_reshard_bytes`` /
 # ``placement_routes`` / per-tenant served counts, plus the
-# informational timing field ``placement_ms``.
-SCHEMA_VERSION = 19
+# informational timing field ``placement_ms``.  20 = streaming-
+# mutation phase (docs/MUTATION.md): a DeltaCSR served through the
+# gateway's delta routing while a seeded ``gallery.mutation_stream``
+# update storm lands in the side-buffer, then one background
+# compaction with an atomic version swap and a post-swap serving
+# round — golden-pinned exact ``mutation_updates`` /
+# ``mutation_applied`` / ``mutation_merged`` /
+# ``mutation_compactions`` / ``mutation_version_swaps`` /
+# ``mutation_served`` / ``mutation_routes``, plus the timing pair
+# ``mutation_ms`` / ``mutation_compaction_ms`` (serve-while-mutating
+# wall time and the off-path merge cost).
+SCHEMA_VERSION = 20
 
 
 def main() -> None:
@@ -2220,6 +2230,113 @@ def main() -> None:
                             routes=result["placement_routes"])
         except Exception as e:
             sys.stderr.write(f"bench: placement phase failed: {e!r}\n")
+
+    # Streaming-mutation phase (schema 20, docs/MUTATION.md): the
+    # serve-while-mutating proof.  A DeltaCSR serves through the
+    # gateway's delta routing while the seeded update storm lands in
+    # the side-buffer (two-term dispatch, pinned views), then one
+    # background compaction merges the buffer into a fresh base with
+    # an atomic version swap and a final round serves the merged
+    # base.  The stream is ``gallery.mutation_stream`` under a fixed
+    # seed over a fixed pattern, so every counted total is
+    # deterministic and the smoke golden pins them exactly.
+    if ((smoke
+         or os.environ.get("LEGATE_SPARSE_TPU_BENCH_SKIP_MUTATION",
+                           "0") != "1")
+            and not past_deadline(result, "mutation")):
+        try:
+            from legate_sparse_tpu import gallery as _mgallery
+            from legate_sparse_tpu.delta import DeltaCSR as _MDelta
+            from legate_sparse_tpu.engine import Engine as _MEngine
+            from legate_sparse_tpu.engine import Gateway as _MGateway
+            from legate_sparse_tpu.settings import settings as _mst
+
+            t_m0 = _time_mod.perf_counter()
+            n_m = (1 << 12 if smoke else 1 << 14) - 91
+            with obs.span("bench.mutation") as _msp:
+                A_m = _engine_config(sparse, n_m, nnz_per_row,
+                                     seed=29)
+                x_m = jnp.ones((n_m,), jnp.float32)
+                m_counters = (
+                    "delta.updates",
+                    "delta.applied",
+                    "delta.compaction.merged",
+                    "delta.compactions",
+                    "delta.swap.versions",
+                    "delta.served",
+                    "delta.routes",
+                )
+                c0m = {k: obs.counters.get(k) for k in m_counters}
+                saved_m = (_mst.gateway, _mst.delta)
+                t_compact = 0.0
+                try:
+                    _mst.gateway = True
+                    _mst.delta = True
+                    D_m = _MDelta(A_m, capacity=256)
+                    gw_m = _MGateway(
+                        _MEngine(), max_batch=4, queue_depth=128,
+                        tenant_quota=64, rate=0.0, burst=16.0,
+                        slack_ms=5.0, timeout_ms=0.0)
+                    try:
+                        def _mserve(k):
+                            futs = [gw_m.submit(D_m, x_m,
+                                                tenant="mut",
+                                                qos="interactive")
+                                    for _i in range(k)]
+                            gw_m.flush()
+                            for f in futs:
+                                _ = f.result(timeout=120)
+
+                        # Warm the two delta compiles outside the
+                        # timed serving rounds (base bucket + the
+                        # coo-segment capacity bucket).
+                        _ = np.asarray(D_m.dot(x_m))
+                        D_m.update([0], [0], [1.0])
+                        _ = np.asarray(D_m.dot(x_m))
+                        # Serve-while-mutating: 10 seeded update
+                        # batches (100 entry updates) interleaved
+                        # with gateway rounds on the live buffer.
+                        for rows_m, cols_m, vals_m in (
+                                _mgallery.mutation_stream(
+                                    23, A_m, 100, batch=10)):
+                            D_m.update(rows_m, cols_m, vals_m)
+                            _mserve(2)
+                        # Background compaction + atomic version
+                        # swap, off the serving path.
+                        t_c0 = _time_mod.perf_counter()
+                        D_m.compact()
+                        t_compact = (_time_mod.perf_counter()
+                                     - t_c0) * 1e3
+                        # Post-swap round serves the merged base
+                        # (empty buffer — base dispatch alone).
+                        _mserve(4)
+                    finally:
+                        gw_m.shutdown()
+                finally:
+                    _mst.gateway, _mst.delta = saved_m
+
+                def _dm(name):
+                    return int(obs.counters.get(name) - c0m[name])
+
+                result["mutation_updates"] = _dm("delta.updates")
+                result["mutation_applied"] = _dm("delta.applied")
+                result["mutation_merged"] = _dm(
+                    "delta.compaction.merged")
+                result["mutation_compactions"] = _dm(
+                    "delta.compactions")
+                result["mutation_version_swaps"] = _dm(
+                    "delta.swap.versions")
+                result["mutation_served"] = _dm("delta.served")
+                result["mutation_routes"] = _dm("delta.routes")
+                result["mutation_compaction_ms"] = round(t_compact, 3)
+                result["mutation_ms"] = round(
+                    (_time_mod.perf_counter() - t_m0) * 1e3, 3)
+                if _msp is not None:
+                    _msp.set(updates=result["mutation_updates"],
+                             merged=result["mutation_merged"],
+                             swaps=result["mutation_version_swaps"])
+        except Exception as e:
+            sys.stderr.write(f"bench: mutation phase failed: {e!r}\n")
 
     # Autotune phase (schema_version 11, docs/AUTOTUNER.md): the
     # irregular-SpMV speedup proof.  A seeded power-law matrix gets a

@@ -112,6 +112,22 @@ def _run_semiring_sliced_ell(A, operand, op: str):
         A._get_sliced_ell(), operand, A.shape[0], "sum", "times")
 
 
+# Delta-layer serving kernel (delta/core.py, docs/MUTATION.md): the
+# masked COO segment-sum over a pow2-padded update buffer.  Registered
+# so its planverify contract has an owner and the kernel-registry
+# three-view check covers it, but never raced: the side-buffer is tiny
+# by construction (capacity-bounded), always rides on top of a
+# base-matrix dispatch the autotuner already owns, and its bucket
+# identity (pow2 capacity) is not the sparsity fingerprint the verdict
+# store keys on — so ``eligible`` declines every matrix and the delta
+# layer dispatches it directly.
+def _run_coo_segment(A, operand, op: str):
+    rid = A._get_row_ids()
+    nnz = A.data.shape[0]
+    return _sp.coo_spmv_segment(A.data, rid, A.indices, nnz, operand,
+                                A.shape[0])
+
+
 @dataclass(frozen=True)
 class Candidate:
     """One routable kernel family (see module docstring)."""
@@ -180,5 +196,13 @@ CANDIDATES = {
         ops=("spmv",),
         eligible=lambda A: A._get_sliced_ell() is not None,
         run=_run_semiring_sliced_ell,
+    ),
+    "coo-segment": Candidate(
+        label="coo-segment", kernel="coo_spmv_segment",
+        ops=("spmv",),
+        # Autotune-decline path: the delta layer owns this dispatch
+        # (see _run_coo_segment's comment).
+        eligible=lambda A: False,
+        run=_run_coo_segment,
     ),
 }

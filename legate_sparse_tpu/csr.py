@@ -149,6 +149,15 @@ class csr_array(CompressedBase, DenseSparseBase):
             if shape is None:
                 shape = (int(row.max()) + 1, int(col.max()) + 1)
             shape = tuple(int(s) for s in shape)
+            # Pow2 shape-bucketed COO-build counter (bounded
+            # cardinality): repeated same-bucket rebuilds are the
+            # doctor's delta-disabled-but-rebuilding signal — a
+            # workload paying full CSR reconstruction for what the
+            # delta layer serves as a streamed second term
+            # (docs/MUTATION.md).
+            _obs.inc("build.csr.coo."
+                     f"{1 << max(shape[0] - 1, 0).bit_length()}x"
+                     f"{1 << max(shape[1] - 1, 0).bit_length()}")
             cdt = coord_dtype_for(max(shape))
             data, indices, indptr = _convert.coo_to_csr(
                 row.astype(cdt), col.astype(cdt), data_in, shape[0]

@@ -1,0 +1,23 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Streaming matrix mutation under live traffic (docs/MUTATION.md).
+
+Inert by default behind ``LEGATE_SPARSE_TPU_DELTA``: a
+:class:`~.core.DeltaCSR` serves an immutable base ``csr_array`` plus a
+bounded COO side-buffer of entry updates as ``base @ x + delta @ x``,
+with background compaction merging the buffer into a fresh base CSR
+off the serving path and atomically swapping versions behind the
+gateway.  :class:`~.dist.DistDeltaCSR` is the mesh-scale twin: updates
+route to owner shards by the layout arithmetic and are priced in the
+comm ledger as ``comm.delta.*``.
+"""
+
+from .core import (  # noqa: F401
+    DeltaCapacityError, DeltaCSR, DeltaView, is_delta, route,
+)
+from .dist import DistDeltaCSR  # noqa: F401
+
+__all__ = [
+    "DeltaCSR", "DeltaView", "DistDeltaCSR", "DeltaCapacityError",
+    "is_delta", "route",
+]

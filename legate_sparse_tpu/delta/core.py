@@ -1,0 +1,525 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Delta layer: mutable serving views over immutable CSR matrices.
+
+Every matrix in the package is immutable after build; the production
+workloads the north star names (recommender graphs, risk matrices, PDE
+remeshing) mutate *while serving*.  :class:`DeltaCSR` closes that gap
+without giving up the immutability the engine's plan caches rely on:
+
+- the **base** stays an untouched ``csr_array``, serving through every
+  existing path (engine buckets, autotune verdicts, packs);
+- mutations land in a **bounded COO side-buffer** of absolute entry
+  updates (overwrite-wins within the buffer; a 0.0 target deletes the
+  entry at compaction), padded to pow2 capacity buckets on device so
+  streaming mutation never retraces;
+- ``.dot`` serves ``base @ x + delta @ x`` — the delta term through
+  the masked :func:`~..ops.spmv.coo_spmv_segment` kernel, skipped
+  bit-for-bit when the buffer is empty;
+- :meth:`DeltaCSR.compact` merges the buffer into a **fresh base**
+  off the serving path and atomically swaps an immutable
+  :class:`DeltaView` exactly like ``placement/migrate.py`` swaps
+  placements: in-flight requests drain on the view pinned at
+  admission, later admissions serve the new version.  Fresh bases are
+  new objects, so fingerprint/autotune/plan caches invalidate
+  structurally — no epoch bump, no retrace of unrelated plans.
+
+The additive trick: an absolute update ``A[r, c] = v`` is stored on
+device as the difference ``v - base[r, c]`` (``v`` for an insert), so
+the two-term product is exact without rewriting the base — the
+in-situ streamed-second-term scheduling of PAPERS.md 2311.03826, with
+compaction as SpArch's background merge pass (2002.08947).
+
+Inert by default: constructing a :class:`DeltaCSR` without
+``LEGATE_SPARSE_TPU_DELTA`` raises, the gateway's routing hook is one
+flag read, and no ``delta.*`` counter moves while the flag is off
+(pinned by test).
+
+Counters / events / histograms (docs/OBSERVABILITY.md):
+
+- ``delta.updates`` / ``delta.applied`` / ``delta.overwrites`` /
+  ``delta.served`` / ``delta.compactions`` /
+  ``delta.compaction.merged`` / ``delta.compaction.bytes`` /
+  ``delta.swap.versions`` / ``delta.routes`` /
+  ``delta.watermark.exceeded``
+- events ``delta.update`` / ``delta.compaction`` /
+  ``delta.watermark``
+- histograms ``lat.delta.update`` / ``lat.delta.compaction``
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import obs as _obs
+from ..obs import latency as _latency
+from ..resilience import faults as _rfaults
+from ..resilience import policy as _rpolicy
+from ..settings import settings as _settings
+
+__all__ = [
+    "DeltaCapacityError", "DeltaCSR", "DeltaView", "is_delta", "route",
+]
+
+
+class DeltaCapacityError(ValueError):
+    """The bounded side-buffer is full: compact before updating."""
+
+    def __init__(self, pending: int, capacity: int):
+        self.pending = pending
+        self.capacity = capacity
+        super().__init__(
+            f"delta buffer full: {pending} pending update slots "
+            f"exceed capacity {capacity} "
+            f"(LEGATE_SPARSE_TPU_DELTA_CAPACITY) — call compact() or "
+            f"arm the watermark worker")
+
+
+def _pow2_bucket(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the padded device-buffer
+    width, so a growing buffer recompiles the serving kernel only at
+    bucket crossings (log2(capacity) compiles, ever)."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _base_values_at(base, rows: np.ndarray,
+                    cols: np.ndarray) -> np.ndarray:
+    """Host lookup of ``base[r, c]`` per update coordinate (0.0 where
+    the slot is structurally absent — an insert)."""
+    indptr = np.asarray(base.indptr)
+    indices = np.asarray(base.indices)
+    data = np.asarray(base.data)
+    out = np.zeros(rows.shape[0], dtype=data.dtype)
+    for i, (r, c) in enumerate(zip(rows, cols)):
+        lo, hi = int(indptr[r]), int(indptr[r + 1])
+        j = lo + int(np.searchsorted(indices[lo:hi], c))
+        if j < hi and int(indices[j]) == int(c):
+            out[i] = data[j]
+    return out
+
+
+class DeltaView:
+    """One immutable serving snapshot: (base, padded device buffer,
+    version).  Quacks enough like ``csr_array`` for the gateway
+    (shape/nnz/dtype/dot) while deliberately failing the engine's
+    ``isinstance`` eligibility gate — delta traffic serves inline
+    through its own two-term dispatch, the ``PlacedHandle`` trick.
+    Readers never lock: a compaction swaps the owner's current view;
+    requests admitted before the swap drain on this one."""
+
+    __slots__ = ("base", "version", "pending", "_rows_dev",
+                 "_cols_dev", "_dvals_dev", "_valid")
+
+    def __init__(self, base, version: int, pending: int,
+                 rows_dev=None, cols_dev=None, dvals_dev=None,
+                 valid: int = 0):
+        self.base = base
+        self.version = int(version)
+        self.pending = int(pending)
+        self._rows_dev = rows_dev
+        self._cols_dev = cols_dev
+        self._dvals_dev = dvals_dev
+        self._valid = int(valid)
+
+    @property
+    def shape(self):
+        return self.base.shape
+
+    @property
+    def nnz(self):
+        return self.base.nnz
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    def dot(self, x):
+        """Serve one SpMV on the pinned version: the base term through
+        the full existing dispatch ladder (engine/autotune included),
+        plus the masked COO delta term.  An empty buffer is bit-for-bit
+        the base dispatch alone (no ``+ 0`` term — IEEE signed zeros
+        forbid a free-riding add)."""
+        y = self.base.dot(x)
+        if self._valid == 0:
+            return y
+        import jax.numpy as jnp
+
+        from ..ops.spmv import coo_spmv_segment
+
+        _obs.inc("delta.served")
+        xa = jnp.asarray(x)
+        cdt = jnp.result_type(self.base.dtype, xa.dtype)
+        with _obs.span("delta.serve", version=self.version,
+                       pending=self.pending, path="coo-segment"):
+            yd = coo_spmv_segment(
+                self._dvals_dev.astype(cdt), self._rows_dev,
+                self._cols_dev, self._valid, xa.astype(cdt),
+                self.base.shape[0])
+        return y + yd
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"DeltaView(v{self.version}, pending={self.pending}, "
+                f"base={self.base.shape})")
+
+
+class _Buffer:
+    """The bounded overwrite-wins update ledger, shared by the local
+    and distributed wrappers.  Host truth is an insertion-ordered
+    ``{(row, col): (target, additive)}`` dict; the device image is the
+    (row, col)-sorted triple padded to the pow2 capacity bucket with
+    the out-of-range row sentinel."""
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self.entries: Dict[Tuple[int, int], Tuple[float, float]] = {}
+
+    @property
+    def pending(self) -> int:
+        return len(self.entries)
+
+    def ingest(self, rows, cols, vals, base_vals) -> Tuple[int, int]:
+        """Apply one absolute-update batch (later wins on a repeated
+        coordinate, within the batch and against earlier batches).
+        Returns ``(new_slots, overwrites)``; raises
+        :class:`DeltaCapacityError` before mutating anything when the
+        resolved batch would overflow."""
+        # A batch may hit one new coordinate twice; resolve exactly.
+        seen = set()
+        new_slots = 0
+        for r, c in zip(rows, cols):
+            key = (int(r), int(c))
+            if key not in self.entries and key not in seen:
+                new_slots += 1
+                seen.add(key)
+        if self.pending + new_slots > self.capacity:
+            raise DeltaCapacityError(self.pending + new_slots,
+                                     self.capacity)
+        overwrites = 0
+        for r, c, v, bv in zip(rows, cols, vals, base_vals):
+            key = (int(r), int(c))
+            if key in self.entries:
+                overwrites += 1
+            self.entries[key] = (float(v), float(v) - float(bv))
+        return new_slots, overwrites
+
+    def device_image(self, dtype, sentinel_row: int):
+        """(row_ids, col_ids, additive_vals, valid) padded to the pow2
+        bucket, sorted by (row, col) so the serving kernel's
+        ``indices_are_sorted`` contract holds."""
+        import jax.numpy as jnp
+
+        n = self.pending
+        cap = _pow2_bucket(min(max(n, 1), self.capacity))
+        rows = np.full(cap, sentinel_row, dtype=np.int32)
+        cols = np.zeros(cap, dtype=np.int32)
+        vals = np.zeros(cap, dtype=dtype)
+        if n:
+            keys = sorted(self.entries)
+            rows[:n] = [k[0] for k in keys]
+            cols[:n] = [k[1] for k in keys]
+            vals[:n] = [self.entries[k][1] for k in keys]
+        return (jnp.asarray(rows), jnp.asarray(cols),
+                jnp.asarray(vals), n)
+
+    def snapshot_arrays(self):
+        """Host numpy triple of the resolved buffer (checkpoint
+        payload: survives any device loss by construction)."""
+        keys = sorted(self.entries)
+        return (np.asarray([k[0] for k in keys], dtype=np.int64),
+                np.asarray([k[1] for k in keys], dtype=np.int64),
+                np.asarray([self.entries[k][0] for k in keys],
+                           dtype=np.float64))
+
+
+def _require_enabled(what: str) -> None:
+    if not _settings.delta:
+        raise RuntimeError(
+            f"{what} requires the delta layer "
+            f"(set LEGATE_SPARSE_TPU_DELTA=1, docs/MUTATION.md); off "
+            f"by default so the immutable serving path stays "
+            f"bit-for-bit and counter-inert")
+
+
+class DeltaCSR:
+    """A served matrix that mutates: immutable base ``csr_array`` +
+    bounded COO side-buffer, versioned compaction (module docstring).
+
+    All mutation runs under one lock and publishes a fresh immutable
+    :class:`DeltaView`; ``dot``/routing read the current view with a
+    single reference load, so serving never blocks on an in-progress
+    compaction and a mid-compaction request drains on the version it
+    was admitted under."""
+
+    def __init__(self, base, capacity: Optional[int] = None):
+        _require_enabled("DeltaCSR")
+        from ..csr import csr_array
+
+        if not isinstance(base, csr_array):
+            base = csr_array(base)
+        self._lock = threading.RLock()
+        self._buffer = _Buffer(
+            _settings.delta_capacity if capacity is None else capacity)
+        self._view = DeltaView(base._canonicalized(), version=0,
+                               pending=0)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_stop = threading.Event()
+
+    # ---------------- serving surface ----------------
+
+    @property
+    def shape(self):
+        return self._view.shape
+
+    @property
+    def nnz(self):
+        return self._view.nnz
+
+    @property
+    def dtype(self):
+        return self._view.dtype
+
+    @property
+    def base(self):
+        return self._view.base
+
+    @property
+    def version(self) -> int:
+        return self._view.version
+
+    @property
+    def pending(self) -> int:
+        return self._view.pending
+
+    @property
+    def capacity(self) -> int:
+        return self._buffer.capacity
+
+    def view(self) -> DeltaView:
+        """The current immutable serving snapshot (what the gateway
+        pins at admission)."""
+        return self._view
+
+    def dot(self, x):
+        return self._view.dot(x)
+
+    # ---------------- mutation ----------------
+
+    def update(self, rows, cols, vals):
+        """Absolute entry updates ``A[rows[i], cols[i]] = vals[i]``
+        (overwrite-wins on repeats; a 0.0 target deletes the entry at
+        compaction).  Bounded: raises :class:`DeltaCapacityError`
+        without mutating anything when the batch would overflow the
+        buffer."""
+        t0 = time.perf_counter_ns()
+        rows = np.atleast_1d(np.asarray(rows, dtype=np.int64))
+        cols = np.atleast_1d(np.asarray(cols, dtype=np.int64))
+        vals = np.atleast_1d(np.asarray(vals))
+        if not (rows.shape == cols.shape == vals.shape):
+            raise ValueError(
+                f"delta update: rows/cols/vals shapes disagree "
+                f"({rows.shape}, {cols.shape}, {vals.shape})")
+        m, n = self.shape
+        if rows.size and (rows.min() < 0 or rows.max() >= m
+                          or cols.min() < 0 or cols.max() >= n):
+            raise IndexError(
+                f"delta update: coordinates out of range for shape "
+                f"{self.shape}")
+        with self._lock:
+            view = self._view
+            base_vals = _base_values_at(view.base, rows, cols)
+            new_slots, overwrites = self._buffer.ingest(
+                rows, cols, vals, base_vals)
+            self._publish(view.base, view.version)
+            pending = self._buffer.pending
+        _obs.inc("delta.updates")
+        _obs.inc("delta.applied", new_slots)
+        if overwrites:
+            _obs.inc("delta.overwrites", overwrites)
+        _latency.observe("lat.delta.update",
+                         (time.perf_counter_ns() - t0) / 1e6)
+        _obs.event("delta.update", applied=new_slots,
+                   overwrites=overwrites, pending=pending,
+                   version=self.version)
+        if pending >= self._watermark_slots():
+            _obs.inc("delta.watermark.exceeded")
+            _obs.event("delta.watermark", pending=pending,
+                       capacity=self._buffer.capacity)
+            self._ensure_worker()
+
+    # scipy-flavoured alias: the row/entry-set API is the same
+    # absolute overwrite-wins ingestion.
+    set_entries = update
+
+    def entries(self) -> Dict[Tuple[int, int], float]:
+        """Pending buffered targets ``{(row, col): value}`` (host
+        snapshot; 0.0 marks a pending delete)."""
+        with self._lock:
+            return {k: tv for k, (tv, _d) in
+                    self._buffer.entries.items()}
+
+    # ---------------- compaction / versioned swap ----------------
+
+    def compact(self) -> int:
+        """Merge the buffer into a fresh base CSR off the serving path
+        and atomically swap versions: in-flight requests drain on
+        their admitted view, later admissions serve the merged base
+        with an empty buffer.  Returns the number of entries merged
+        (0 = nothing pending, no swap, no counter movement).
+
+        Resilience: with ``LEGATE_SPARSE_TPU_RESIL`` the merge runs
+        under the ``delta.compact`` site policy (injectable, retried
+        with backoff), and an active ``resilience.checkpoint`` scope
+        snapshots the resolved buffer to host first — a device loss
+        mid-compaction recovers by re-merging from host truth."""
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            view = self._view
+            merged = self._buffer.pending
+            if merged == 0:
+                return 0
+            if _settings.resil:
+                from ..resilience import checkpoint as _ckpt
+
+                ck = _ckpt.current()
+                if ck is not None:
+                    ck.save(view.version,
+                            self._buffer.snapshot_arrays())
+
+                def attempt():
+                    _rfaults.fault_point("delta.compact")
+                    return self._merged_base(view.base)
+
+                new_base = _rpolicy.run("delta.compact", attempt)
+            else:
+                new_base = self._merged_base(view.base)
+            self._buffer.entries.clear()
+            self._publish(new_base, view.version + 1)
+            version = self._view.version
+        nbytes = (int(np.asarray(new_base.data).nbytes)
+                  + int(np.asarray(new_base.indices).nbytes)
+                  + int(np.asarray(new_base.indptr).nbytes))
+        _obs.inc("delta.compactions")
+        _obs.inc("delta.compaction.merged", merged)
+        _obs.inc("delta.compaction.bytes", nbytes)
+        _obs.inc("delta.swap.versions")
+        _latency.observe("lat.delta.compaction",
+                         (time.perf_counter_ns() - t0) / 1e6)
+        _obs.event("delta.compaction", merged=merged, version=version,
+                   nnz=new_base.nnz, bytes=nbytes)
+        return merged
+
+    def _merged_base(self, base):
+        """Fresh canonical base = base entries overridden by buffered
+        targets (0.0 deletes).  Goes through the public COO
+        constructor — the same canonicalization a cold rebuild of the
+        mutated matrix uses, so post-compaction serving is bitwise the
+        cold rebuild (acceptance criterion c)."""
+        from ..csr import csr_array
+
+        brows, bcols, bdata = (np.asarray(a) for a in
+                               base._coo_parts())
+        merged: Dict[Tuple[int, int], float] = {
+            (int(r), int(c)): v
+            for r, c, v in zip(brows, bcols, bdata)
+        }
+        for key, (target, _d) in self._buffer.entries.items():
+            if target == 0.0:
+                merged.pop(key, None)
+            else:
+                merged[key] = target
+        keys = sorted(merged)
+        rows = np.asarray([k[0] for k in keys], dtype=np.int64)
+        cols = np.asarray([k[1] for k in keys], dtype=np.int64)
+        vals = np.asarray([merged[k] for k in keys], dtype=base.dtype)
+        return csr_array((vals, (rows, cols)), shape=base.shape,
+                         dtype=base.dtype)
+
+    def _publish(self, base, version: int) -> None:
+        """Swap in a fresh immutable view (callers hold the lock)."""
+        if self._buffer.pending:
+            rid, cid, dvals, valid = self._buffer.device_image(
+                base.dtype, sentinel_row=base.shape[0])
+            self._view = DeltaView(base, version,
+                                   self._buffer.pending, rid, cid,
+                                   dvals, valid)
+        else:
+            self._view = DeltaView(base, version, 0)
+
+    # ---------------- watermark worker ----------------
+
+    def _watermark_slots(self) -> int:
+        frac = max(float(_settings.delta_watermark), 0.0)
+        return max(int(frac * self._buffer.capacity), 1)
+
+    def maybe_compact(self) -> int:
+        """Compact iff the watermark is exceeded (the worker's step,
+        callable inline by serving loops that poll their own
+        cadence)."""
+        if self._buffer.pending >= self._watermark_slots():
+            return self.compact()
+        return 0
+
+    def _ensure_worker(self) -> None:
+        cadence_ms = float(_settings.delta_worker_ms)
+        if cadence_ms <= 0:
+            return
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker_stop.clear()
+            ref = weakref.ref(self)
+            stop = self._worker_stop
+
+            def loop():
+                while not stop.wait(cadence_ms / 1e3):
+                    owner = ref()
+                    if owner is None:
+                        return
+                    try:
+                        owner.maybe_compact()
+                    except Exception:  # pragma: no cover - worker
+                        # A failed background merge must never kill
+                        # the daemon; the next step retries and the
+                        # serving path is untouched either way.
+                        _obs.inc("delta.worker.errors")
+                    if owner._buffer.pending == 0:
+                        return
+                    del owner
+
+            t = threading.Thread(target=loop, daemon=True,
+                                 name="delta-compaction-worker")
+            self._worker = t
+            t.start()
+
+    def stop_worker(self) -> None:
+        """Stop a running background compaction worker (tests)."""
+        self._worker_stop.set()
+        t = self._worker
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"DeltaCSR(v{self.version}, "
+                f"pending={self.pending}/{self.capacity}, "
+                f"base={self.base.shape})")
+
+
+def is_delta(A) -> bool:
+    return isinstance(A, DeltaCSR)
+
+
+def route(A):
+    """Admission-time routing (``engine/gateway.py``): a submitted
+    :class:`DeltaCSR` swaps for its current immutable
+    :class:`DeltaView` — the version pinned NOW — so in-flight
+    requests drain on the pre-compaction view while later admissions
+    serve the merged base.  Anything else passes through untouched."""
+    if not isinstance(A, DeltaCSR):
+        return A
+    _obs.inc("delta.routes")
+    return A.view()

@@ -1,0 +1,348 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Mesh-scale delta serving: :class:`DistDeltaCSR` (docs/MUTATION.md).
+
+The distributed twin of :class:`~.core.DeltaCSR`: an immutable base
+:class:`~..parallel.dist_csr.DistCSR` plus the same bounded
+overwrite-wins side-buffer, so time-evolving graph analytics
+(PageRank/BFS over a mutating edge set) and serve-while-mutating
+traffic work at mesh scale.  Differences from the local wrapper:
+
+- **updates route to owner shards** by the existing layout
+  arithmetic (``shard_row_starts`` / ``rows_per_shard``) and are
+  priced in the comm ledger as ``comm.delta.scatter*`` — a host
+  update batch is a scatter of (row, col, value) records to the
+  shards that own the rows;
+- **the delta term is an all_gather-realized second term**: the
+  padded sharded ``x`` is realized once (priced as
+  ``comm.delta.all_gather*``), run through the same masked
+  :func:`~..ops.spmv.coo_spmv_segment` kernel over
+  ``rows_padded`` segments, and re-sharded onto the row partition
+  before the add — zero new collective programs;
+- **compaction is a repartition**: the merge runs on the retained
+  host source (the same path :func:`~..parallel.reshard.reshard`
+  uses), then ``shard_csr`` rebuilds the base on the same mesh and
+  layout and the version swaps atomically.
+
+``reshard()`` on a wrapper with pending updates must never silently
+drop them: the hook :meth:`DistDeltaCSR._delta_reshard_carry` carries
+the buffer across the repartition (additive deltas are
+base-relative, and a reshard preserves the logical base, so the
+buffer transfers verbatim) — pinned by regression test.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import obs as _obs
+from ..obs import comm as _comm
+from ..obs import latency as _latency
+from ..settings import settings as _settings
+from .core import _Buffer, _base_values_at, _require_enabled
+
+__all__ = ["DistDeltaCSR"]
+
+
+class DistDeltaCSR:
+    """A served distributed matrix that mutates: immutable base
+    ``DistCSR`` + bounded COO side-buffer with owner-shard routed
+    updates, versioned compaction-by-repartition (module docstring).
+
+    1d-row layouts only: the delta term's re-shard add and the
+    owner-shard routing arithmetic are row-partition identities; a
+    2-d-block wrapper would need block-local column rebasing with no
+    workload behind it yet."""
+
+    def __init__(self, base, capacity: Optional[int] = None):
+        _require_enabled("DistDeltaCSR")
+        from ..parallel.dist_csr import DistCSR
+        from ..parallel.mesh import LAYOUT_1D_ROW
+
+        if not isinstance(base, DistCSR):
+            raise TypeError(
+                f"DistDeltaCSR wraps a DistCSR (got "
+                f"{type(base).__name__}); shard first via shard_csr")
+        if base.layout != LAYOUT_1D_ROW:
+            raise ValueError(
+                f"DistDeltaCSR supports the 1d-row layout only (got "
+                f"{base.layout!r}): the owner-shard routing and the "
+                f"delta-term re-shard are row-partition arithmetic")
+        if getattr(base, "_src_csr", None) is None:
+            raise ValueError(
+                "DistDeltaCSR: base DistCSR carries no retained "
+                "source matrix (_src_csr); build it via shard_csr")
+        self._lock = threading.RLock()
+        self._base = base
+        self._buffer = _Buffer(
+            _settings.delta_capacity if capacity is None else capacity)
+        self._version = 0
+        self._image = None  # (rid, cid, dvals, valid) device snapshot
+
+    # ---------------- serving surface ----------------
+
+    @property
+    def shape(self):
+        return self._base.shape
+
+    @property
+    def dtype(self):
+        return self._base.dtype
+
+    @property
+    def base(self):
+        return self._base
+
+    @property
+    def mesh(self):
+        return self._base.mesh
+
+    @property
+    def layout(self) -> str:
+        return self._base.layout
+
+    @property
+    def num_shards(self) -> int:
+        return self._base.num_shards
+
+    @property
+    def rows_padded(self) -> int:
+        return self._base.rows_padded
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    @property
+    def pending(self) -> int:
+        return self._buffer.pending
+
+    @property
+    def capacity(self) -> int:
+        return self._buffer.capacity
+
+    def dot(self, x):
+        """``y = base (x) + delta (x)`` on the row partition: the base
+        term through the full ``dist_spmv`` dispatch, the delta term
+        as an all_gather-realized masked COO segment sum re-sharded
+        onto the row blocks (priced as ``comm.delta.all_gather*``).
+        ``x`` and the result are row-block sharded padded vectors of
+        length ``base.rows_padded`` (the ``dist_spmv`` contract);
+        an empty buffer is bit-for-bit the base dispatch alone."""
+        from ..parallel.dist_csr import dist_spmv
+
+        with self._lock:
+            base = self._base
+            image = self._image
+            version = self._version
+            pending = self._buffer.pending
+        y = dist_spmv(base, x)
+        if image is None:
+            return y
+        import jax.numpy as jnp
+
+        from ..ops.spmv import coo_spmv_segment
+        from ..parallel.dist_csr import shard_vector
+
+        _obs.inc("delta.served")
+        rid, cid, dvals, valid = image
+        shards = base.num_shards
+        chunk_bytes = (base.rows_per_shard
+                       * np.dtype(base.dtype).itemsize)
+        _comm.record(
+            "delta", {"all_gather": shards * (shards - 1)
+                      * chunk_bytes},
+            calls={"all_gather": 1}, layout=base.layout)
+        xg = jnp.asarray(x)
+        cdt = jnp.result_type(base.dtype, xg.dtype)
+        with _obs.span("delta.serve", version=version,
+                       pending=pending, path="coo-segment",
+                       dist=True):
+            yd = coo_spmv_segment(
+                dvals.astype(cdt), rid, cid, valid, xg.astype(cdt),
+                base.rows_padded)
+        return y + shard_vector(np.asarray(yd), base.mesh,
+                                base.rows_padded, base.layout)
+
+    # ---------------- mutation ----------------
+
+    def update(self, rows, cols, vals):
+        """Absolute entry updates, routed to owner shards by the row
+        partition and priced as ``comm.delta.scatter*``.  Semantics
+        match :meth:`DeltaCSR.update` exactly (overwrite-wins, 0.0
+        deletes at compaction, typed capacity error)."""
+        t0 = time.perf_counter_ns()
+        rows = np.atleast_1d(np.asarray(rows, dtype=np.int64))
+        cols = np.atleast_1d(np.asarray(cols, dtype=np.int64))
+        vals = np.atleast_1d(np.asarray(vals))
+        if not (rows.shape == cols.shape == vals.shape):
+            raise ValueError(
+                f"delta update: rows/cols/vals shapes disagree "
+                f"({rows.shape}, {cols.shape}, {vals.shape})")
+        m, n = self.shape
+        if rows.size and (rows.min() < 0 or rows.max() >= m
+                          or cols.min() < 0 or cols.max() >= n):
+            raise IndexError(
+                f"delta update: coordinates out of range for shape "
+                f"{self.shape}")
+        with self._lock:
+            base = self._base
+            src = base._src_csr
+            base_vals = _base_values_at(src, rows, cols)
+            new_slots, overwrites = self._buffer.ingest(
+                rows, cols, vals, base_vals)
+            self._refresh_image()
+            pending = self._buffer.pending
+        # Owner-shard routing: each record travels to the shard whose
+        # row block owns it — (row, col) int32 coords + the value.
+        owners = rows // np.int64(base.rows_per_shard)
+        rec_bytes = 2 * 4 + np.dtype(base.dtype).itemsize
+        _comm.record(
+            "delta", {"scatter": int(rows.size) * rec_bytes},
+            calls={"scatter": 1}, layout=base.layout)
+        _obs.inc("delta.updates")
+        _obs.inc("delta.applied", new_slots)
+        if overwrites:
+            _obs.inc("delta.overwrites", overwrites)
+        _latency.observe("lat.delta.update",
+                         (time.perf_counter_ns() - t0) / 1e6)
+        _obs.event("delta.update", applied=new_slots,
+                   overwrites=overwrites, pending=pending,
+                   version=self._version, dist=True,
+                   shards_touched=int(np.unique(owners).size))
+        if pending >= self._watermark_slots():
+            _obs.inc("delta.watermark.exceeded")
+            _obs.event("delta.watermark", pending=pending,
+                       capacity=self._buffer.capacity)
+
+    set_entries = update
+
+    def entries(self) -> Dict[Tuple[int, int], float]:
+        """Pending buffered targets ``{(row, col): value}``."""
+        with self._lock:
+            return {k: tv for k, (tv, _d) in
+                    self._buffer.entries.items()}
+
+    # ---------------- compaction / versioned swap ----------------
+
+    def compact(self) -> int:
+        """Merge the buffer into the retained host source, re-shard
+        onto the same mesh/layout (the repartition path ``reshard``
+        uses) and atomically swap versions.  Returns entries merged."""
+        from ..parallel.dist_csr import shard_csr
+
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            base = self._base
+            merged = self._buffer.pending
+            if merged == 0:
+                return 0
+            src = base._src_csr
+            new_src = self._merged_src(src)
+            with _obs.span("delta.compaction", dist=True,
+                           merged=merged):
+                new_base = shard_csr(new_src, mesh=base.mesh,
+                                     layout=base.layout)
+            self._buffer.entries.clear()
+            self._base = new_base
+            self._image = None
+            self._version += 1
+            version = self._version
+        nbytes = (int(np.asarray(new_src.data).nbytes)
+                  + int(np.asarray(new_src.indices).nbytes)
+                  + int(np.asarray(new_src.indptr).nbytes))
+        _obs.inc("delta.compactions")
+        _obs.inc("delta.compaction.merged", merged)
+        _obs.inc("delta.compaction.bytes", nbytes)
+        _obs.inc("delta.swap.versions")
+        _latency.observe("lat.delta.compaction",
+                         (time.perf_counter_ns() - t0) / 1e6)
+        _obs.event("delta.compaction", merged=merged, version=version,
+                   nnz=new_src.nnz, bytes=nbytes, dist=True)
+        return merged
+
+    def _merged_src(self, src):
+        """Fresh canonical source = source entries overridden by
+        buffered targets (0.0 deletes) — the same merge the local
+        wrapper runs, so a compacted distributed matrix equals a cold
+        ``shard_csr`` of the mutated source."""
+        from ..csr import csr_array
+
+        brows, bcols, bdata = (np.asarray(a) for a in
+                               src._coo_parts())
+        merged: Dict[Tuple[int, int], float] = {
+            (int(r), int(c)): v
+            for r, c, v in zip(brows, bcols, bdata)
+        }
+        for key, (target, _d) in self._buffer.entries.items():
+            if target == 0.0:
+                merged.pop(key, None)
+            else:
+                merged[key] = target
+        keys = sorted(merged)
+        rows = np.asarray([k[0] for k in keys], dtype=np.int64)
+        cols = np.asarray([k[1] for k in keys], dtype=np.int64)
+        vals = np.asarray([merged[k] for k in keys], dtype=src.dtype)
+        return csr_array((vals, (rows, cols)), shape=src.shape,
+                         dtype=src.dtype)
+
+    def _watermark_slots(self) -> int:
+        frac = max(float(_settings.delta_watermark), 0.0)
+        return max(int(frac * self._buffer.capacity), 1)
+
+    def maybe_compact(self) -> int:
+        """Compact iff the watermark is exceeded."""
+        if self._buffer.pending >= self._watermark_slots():
+            return self.compact()
+        return 0
+
+    def _refresh_image(self) -> None:
+        """Rebuild the device buffer snapshot (callers hold the
+        lock).  Sentinel row = ``rows_padded`` so padded slots drop
+        out of the ``rows_padded``-segment sum."""
+        if self._buffer.pending == 0:
+            self._image = None
+            return
+        rid, cid, dvals, valid = self._buffer.device_image(
+            self._base.dtype, sentinel_row=self._base.rows_padded)
+        self._image = (rid, cid, dvals, valid)
+
+    # ---------------- reshard carry (the ride-along bugfix) -------
+
+    def _delta_reshard_carry(self, mesh, layout):
+        """``reshard()`` hook: repartition the base and CARRY the
+        pending buffer — never silently drop updates.  Additive
+        deltas are base-relative and the repartition preserves the
+        logical base, so the buffer transfers verbatim; the routing
+        scatter onto the new row partition is re-priced."""
+        from ..parallel.reshard import reshard as _reshard
+
+        with self._lock:
+            new_base = _reshard(self._base, mesh=mesh, layout=layout)
+            if new_base is self._base:
+                return self  # same placement — zero-byte fast path
+            out = DistDeltaCSR(new_base,
+                               capacity=self._buffer.capacity)
+            out._buffer.entries.update(self._buffer.entries)
+            out._version = self._version
+            out._refresh_image()
+        if out._buffer.pending:
+            rec_bytes = 2 * 4 + np.dtype(out._base.dtype).itemsize
+            _comm.record(
+                "delta",
+                {"scatter": out._buffer.pending * rec_bytes},
+                calls={"scatter": 1}, layout=out._base.layout)
+            _obs.event("delta.reshard_carry",
+                       pending=out._buffer.pending,
+                       version=out._version)
+        return out
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"DistDeltaCSR(v{self._version}, "
+                f"pending={self.pending}/{self.capacity}, "
+                f"shape={self.shape}, "
+                f"shards={self._base.num_shards})")

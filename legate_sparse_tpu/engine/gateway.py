@@ -325,6 +325,16 @@ class Gateway:
             from ..placement import migrate as _placement
 
             A = _placement.route(A, str(tenant))
+        if _rsettings.delta:
+            # Versioned mutation serving (docs/MUTATION.md): a
+            # submitted DeltaCSR swaps for its current immutable
+            # DeltaView — the version pinned NOW — so in-flight
+            # requests drain on the pre-compaction view while later
+            # admissions serve the freshly merged base.  Same
+            # one-flag-read inertness discipline as placement above.
+            from ..delta import core as _delta
+
+            A = _delta.route(A)
         req = _GwRequest(A, x, tenant=str(tenant), qos=qos)
         # Obs v4: the whole admission decision runs under the
         # request's trace context, bracketed by one ``gateway.admit``

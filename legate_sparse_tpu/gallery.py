@@ -578,3 +578,76 @@ def kronsum(A, B, format=None):
     L = kron(identity(B.shape[0], dtype=A.dtype), A)
     R_ = kron(B, identity(A.shape[0], dtype=B.dtype))
     return (L + R_).asformat(format)
+
+
+def mutation_stream(seed, A, n_updates=100, *, insert_frac=0.3,
+                    delete_frac=0.1, batch=10, rng=None):
+    """Deterministic seeded update stream over an existing sparsity
+    pattern — the shared mutation source for tests, chaos drills and
+    the bench mutation phase (docs/MUTATION.md).
+
+    Yields ``(rows, cols, vals)`` batches (host int64/float arrays)
+    drawn from a mix of three update kinds against the pattern of
+    ``A`` (a ``csr_array`` or anything with ``_coo_parts``/scipy
+    triple):
+
+    - **overwrite** (the remainder): an existing stored entry gets a
+      fresh value — the recommender-weight-refresh case;
+    - **insert** (``insert_frac``): a coordinate NOT in the pattern
+      gets a new value — edge arrival;
+    - **delete** (``delete_frac``): an existing stored entry is set
+      to exactly 0.0 — edge removal (the delta layer drops 0.0
+      targets structurally at compaction).
+
+    Same ``seed`` (plus the same matrix pattern and knobs) ⇒ the
+    bitwise-identical stream, independent of process or platform —
+    golden-pinnable by the bench phase.  ``n_updates`` counts
+    individual entry updates; the final batch may be short.
+    """
+    rng = rng if isinstance(rng, np.random.Generator) else (
+        np.random.default_rng(seed)
+    )
+    m, n = A.shape
+    if hasattr(A, "_coo_parts"):
+        erows, ecols, _ = (np.asarray(p) for p in A._coo_parts())
+    else:
+        coo = A.tocoo()
+        erows, ecols = (np.asarray(coo.row, dtype=np.int64),
+                        np.asarray(coo.col, dtype=np.int64))
+    erows = erows.astype(np.int64)
+    ecols = ecols.astype(np.int64)
+    existing = set(zip(erows.tolist(), ecols.tolist()))
+    if erows.size == 0 and delete_frac + (1 - insert_frac) > 0:
+        raise ValueError("mutation_stream: matrix has no stored "
+                         "entries to overwrite or delete")
+    n_updates = int(n_updates)
+    batch = max(int(batch), 1)
+    emitted = 0
+    while emitted < n_updates:
+        take = min(batch, n_updates - emitted)
+        rows = np.zeros(take, dtype=np.int64)
+        cols = np.zeros(take, dtype=np.int64)
+        vals = np.zeros(take, dtype=np.float64)
+        kinds = rng.random(take)
+        for i in range(take):
+            if kinds[i] < insert_frac:
+                # Insert: rejection-sample a coordinate outside the
+                # pattern (bounded retry keeps dense corners safe).
+                for _ in range(64):
+                    r = int(rng.integers(0, m))
+                    c = int(rng.integers(0, n))
+                    if (r, c) not in existing:
+                        break
+                existing.add((r, c))
+                rows[i], cols[i] = r, c
+                vals[i] = float(rng.random()) + 0.5
+            elif kinds[i] < insert_frac + delete_frac:
+                j = int(rng.integers(0, erows.size))
+                rows[i], cols[i] = int(erows[j]), int(ecols[j])
+                vals[i] = 0.0
+            else:
+                j = int(rng.integers(0, erows.size))
+                rows[i], cols[i] = int(erows[j]), int(ecols[j])
+                vals[i] = float(rng.random()) + 0.5
+        emitted += take
+        yield rows, cols, vals

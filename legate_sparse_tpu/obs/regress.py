@@ -278,6 +278,8 @@ TRAJECTORY_FIELDS = [
     "saturation_p99_ms", "irregular_spmv_ms", "irregular_spmv_speedup",
     "irregular_spmv_path", "autotune_verdicts", "obs_overhead_pct",
     "placement_migrations", "placement_reshard_bytes",
+    "mutation_updates", "mutation_version_swaps",
+    "mutation_compaction_ms",
     "bench_wall_s",
 ]
 

@@ -602,6 +602,46 @@ def render_placement_table(counters: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_delta_table(counters: Dict[str, Any]) -> str:
+    """Streaming-mutation ledger from the ``delta.*`` counters
+    (``tools/trace_summary.py --delta``; legate_sparse_tpu/delta
+    naming contract, docs/MUTATION.md): buffer activity (update
+    batches, applied/overwritten slots, the derived still-pending
+    count), compaction work (merges, bytes, version swaps, watermark
+    pressure) and the serving view (two-term serves, gateway routes,
+    distributed comm pricing)."""
+    delta = {name[len("delta."):]: val
+             for name, val in counters.items()
+             if name.startswith("delta.")}
+    if not delta:
+        return ("no delta.* counters recorded (delta off — "
+                "LEGATE_SPARSE_TPU_DELTA unset?)")
+    applied = int(delta.get("applied", 0))
+    merged = int(delta.get("compaction.merged", 0))
+    lines = []
+    lines.append(
+        f"buffer: {int(delta.get('updates', 0))} update batches, "
+        f"{applied} slots applied, "
+        f"{int(delta.get('overwrites', 0))} overwrites, "
+        f"{max(applied - merged, 0)} pending")
+    lines.append(
+        f"compaction: {int(delta.get('compactions', 0))} runs, "
+        f"{merged} entries merged, "
+        f"{int(delta.get('compaction.bytes', 0))} fresh-base bytes, "
+        f"{int(delta.get('swap.versions', 0))} version swaps, "
+        f"{int(delta.get('watermark.exceeded', 0))} watermark "
+        f"exceedances, {int(delta.get('worker.errors', 0))} worker "
+        f"errors")
+    lines.append(
+        f"serving: {int(delta.get('served', 0))} two-term serves, "
+        f"{int(delta.get('routes', 0))} routed admissions, "
+        f"comm: {int(counters.get('comm.delta.scatter_bytes', 0))} "
+        f"scatter bytes, "
+        f"{int(counters.get('comm.delta.all_gather_bytes', 0))} "
+        f"all_gather bytes")
+    return "\n".join(lines)
+
+
 def render_flows_table(records: Iterable[Dict[str, Any]]) -> str:
     """Per-request causal-flow ledger (``tools/trace_summary.py
     --flows``): one row per trace id found in span ``trace_id`` /

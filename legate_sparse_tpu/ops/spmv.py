@@ -133,6 +133,30 @@ def csr_multi_spmv_rowids_masked(data, indices, row_ids, valid_nnz, X,
     return out.reshape(b, rows + 1)[:, :rows]
 
 
+@partial(jax.jit, static_argnames=("rows",))
+def coo_spmv_segment(data, row_ids, col_ids, valid_nnz, x, rows: int):
+    """Masked COO SpMV over a pow2-padded update buffer (the delta
+    layer's serving kernel, docs/MUTATION.md): slots >= ``valid_nnz``
+    contribute an exact 0 via the masked product (never ``0*x`` — the
+    same IEEE discipline as ``csr_spmv_rowids_masked``), and padded
+    ``row_ids`` carry the out-of-range sentinel ``rows`` so
+    ``segment_sum`` drops them (the engine-pack padding contract).
+    The buffer is padded to a pow2 capacity bucket by the caller, so
+    streaming mutation never retraces — one compile per bucket."""
+    _obs.inc("trace.coo_spmv_segment")
+    nnz = data.shape[0]
+    slot = jnp.arange(nnz, dtype=jnp.int32)
+    prod = jnp.where(
+        slot < valid_nnz, data * x[col_ids],
+        jnp.zeros((1,), dtype=data.dtype),
+    )
+    # The delta layer ingests entries sorted by (row, col); the padded
+    # sentinel tail (row id == rows) sorts after every valid id.
+    return jax.ops.segment_sum(
+        prod, row_ids, num_segments=rows, indices_are_sorted=True
+    )
+
+
 @jax.jit
 def ell_spmv(ell_data, ell_cols, ell_counts, x):
     """SpMV over ELL-packed structure: the TPU fast path.

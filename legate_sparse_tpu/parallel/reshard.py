@@ -201,6 +201,13 @@ def reshard(A, mesh: Optional[Mesh] = None,
     the caller to reshard from their own source."""
     from .dist_csr import mesh_fingerprint, shard_csr
 
+    # Delta wrappers (delta/dist.py) carry their pending update
+    # buffer across the repartition — resharding must never silently
+    # drop buffered mutations (pinned by test_delta.py).
+    carry = getattr(A, "_delta_reshard_carry", None)
+    if carry is not None:
+        return carry(mesh, layout)
+
     lay = A.layout if layout is None else resolve_layout(layout)
     dst_mesh = _default_mesh(A, lay) if mesh is None else mesh
     _obs.inc("op.reshard")

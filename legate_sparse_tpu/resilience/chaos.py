@@ -90,6 +90,8 @@ class ChaosReport:
     faults_fired: int = 0
     recoveries: int = 0
     migrations: int = 0
+    mutations: int = 0
+    compactions: int = 0
     per_tenant: Dict[str, Dict[str, int]] = field(default_factory=dict)
     violations: List[str] = field(default_factory=list)
 
@@ -268,13 +270,139 @@ def _run_migration_scenario(state: dict,
         _placement.route(state["A"], state["tenant"]))
 
 
+def _setup_mutation_scenario(spec: dict, tenants: Sequence[dict],
+                             placed_refs: Dict[str, List],
+                             report: ChaosReport) -> dict:
+    """Arm the serve-while-mutating scenario before the first round:
+    wrap the target tenant's matrix in a :class:`~..delta.DeltaCSR`
+    so every later submission routes through versioned delta serving,
+    and pin the pristine v0 view as the first parity reference."""
+    from ..delta import DeltaCSR
+
+    name = str(spec["tenant"])
+    spec_t = next((t for t in tenants if str(t["name"]) == name), None)
+    if spec_t is None:
+        raise ValueError(
+            f"chaos mutation scenario: tenant {name!r} is not in the "
+            f"drill tenant list")
+    A = spec_t["A"]
+    D = DeltaCSR(A, capacity=spec.get("capacity"))
+    spec_t["A"] = D
+    placed_refs[name] = [D.view()]
+    return {"tenant": name, "delta": D, "base": A,
+            "updates": int(spec.get("updates", 100)),
+            "batch": int(spec.get("batch", 10)),
+            "seed": int(spec.get("seed", 0))}
+
+
+def _run_mutation_scenario(state: dict,
+                           placed_refs: Dict[str, List],
+                           report: ChaosReport) -> None:
+    """Stream the seeded update storm into the served matrix and fire
+    one background compaction with an atomic version swap, while the
+    round's gateway submissions are in flight.  Invariants held:
+
+    1. **Exactly-once resolution** — ``delta.*`` counter movement is
+       exactly the independently book-kept applied/overwrite/merge
+       counts of the seeded stream (no double-apply, no loss).
+    2. **Version drain** — every intermediate view (one per update
+       batch) plus the post-compaction view joins the parity
+       reference set, so every served value must bitwise-match a
+       clean dispatch on whichever version served it.
+    3. **Compaction = cold rebuild** — the swapped-in base is
+       bitwise the COO rebuild of base-entries + resolved stream."""
+    from ..csr import csr_array
+    from ..gallery import mutation_stream
+
+    D = state["delta"]
+    name = state["tenant"]
+    c0 = _obs.counters.snapshot("delta.")
+    expected: Dict[Tuple[int, int], float] = {}
+    exp_batches = exp_applied = exp_over = 0
+    for rows, cols, vals in mutation_stream(
+            state["seed"], state["base"], state["updates"],
+            batch=state["batch"]):
+        batch_seen = set()
+        for r, c, v in zip(rows, cols, vals):
+            key = (int(r), int(c))
+            if key in expected or key in batch_seen:
+                exp_over += 1
+            else:
+                exp_applied += 1
+            batch_seen.add(key)
+            expected[key] = float(v)
+        D.update(rows, cols, vals)
+        exp_batches += 1
+        report.mutations += 1
+        # Each batch publishes a fresh view; a request admitted
+        # between batches legitimately drains on it.
+        placed_refs[name].append(D.view())
+    pending = D.pending
+    merged = D.compact()
+    report.compactions += 1
+    placed_refs[name].append(D.view())
+    c1 = _obs.counters.snapshot("delta.")
+
+    def delta(cname: str) -> int:
+        return int(c1.get(cname, 0)) - int(c0.get(cname, 0))
+
+    for cname, want in (("delta.updates", exp_batches),
+                        ("delta.applied", exp_applied),
+                        ("delta.overwrites", exp_over),
+                        ("delta.compactions", 1),
+                        ("delta.swap.versions", 1),
+                        ("delta.compaction.merged", merged)):
+        if delta(cname) != want:
+            report.violations.append(
+                f"mutation accounting: {cname} moved {delta(cname)} "
+                f"!= {want}")
+    if merged != pending:
+        report.violations.append(
+            f"mutation accounting: compaction merged {merged} != "
+            f"{pending} pending")
+    if D.pending != 0:
+        report.violations.append(
+            f"mutation: {D.pending} updates survived compaction")
+    # Criterion (c): the swapped-in base == a cold COO rebuild of the
+    # mutated matrix, bitwise (independent bookkeeping on both sides).
+    base = state["base"]
+    brows, bcols, bdata = (np.asarray(a) for a in base._coo_parts())
+    cold_entries = {(int(r), int(c)): float(v)
+                    for r, c, v in zip(brows, bcols, bdata)}
+    for key, v in expected.items():
+        if v == 0.0:
+            cold_entries.pop(key, None)
+        else:
+            cold_entries[key] = v
+    keys = sorted(cold_entries)
+    cold = csr_array(
+        (np.asarray([cold_entries[k] for k in keys],
+                    dtype=base.dtype),
+         (np.asarray([k[0] for k in keys], dtype=np.int64),
+          np.asarray([k[1] for k in keys], dtype=np.int64))),
+        shape=base.shape, dtype=base.dtype)
+    nb = D.view().base
+    same = (nb.nnz == cold.nnz
+            and np.array_equal(np.asarray(nb.data),
+                               np.asarray(cold.data))
+            and np.array_equal(np.asarray(nb.indices),
+                               np.asarray(cold.indices))
+            and np.array_equal(np.asarray(nb.indptr),
+                               np.asarray(cold.indptr)))
+    if not same:
+        report.violations.append(
+            "mutation: compacted base != cold rebuild of the mutated "
+            "matrix (bitwise)")
+
+
 def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
               seed: int = 0,
               sites: Sequence[str] = DEFAULT_SITES,
               kinds: Sequence[str] = DEFAULT_KINDS,
               result_timeout_s: float = 30.0,
               device_loss: Optional[dict] = None,
-              migration: Optional[dict] = None) -> ChaosReport:
+              migration: Optional[dict] = None,
+              mutation: Optional[dict] = None) -> ChaosReport:
     """Run ``rounds`` of composed-fault multi-tenant load through
     ``gateway`` and verify the isolation invariants (module
     docstring).
@@ -303,7 +431,19 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
     to exactly-once / exact-pricing invariants
     (:func:`_run_migration_scenario`), with both placement versions'
     handles joining the tenant's bitwise-parity reference set (early
-    requests legitimately drain on the pre-migration placement)."""
+    requests legitimately drain on the pre-migration placement).
+
+    ``mutation`` opts the serve-while-mutating scenario into the
+    drill (requires ``settings.delta``, docs/MUTATION.md): the spec
+    dict names a drill ``tenant`` plus optional ``updates`` (default
+    100), ``batch``, ``seed`` and ``capacity``.  The tenant's matrix
+    is wrapped in a ``DeltaCSR`` up front; at the midpoint round,
+    while that round's submissions are in flight, the seeded update
+    storm streams in and a background compaction fires with an
+    atomic version swap — held to exactly-once / exact
+    ``delta.*``-accounting / cold-rebuild-bitwise invariants
+    (:func:`_run_mutation_scenario`), with every version's view
+    joining the parity reference set."""
     if not (_settings.gateway and _settings.resil):
         raise RuntimeError(
             "chaos.run_drill needs settings.gateway and settings.resil "
@@ -313,6 +453,10 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
             "chaos.run_drill migration scenario needs "
             "settings.placement on — there is no live placement to "
             "migrate otherwise")
+    if mutation is not None and not _settings.delta:
+        raise RuntimeError(
+            "chaos.run_drill mutation scenario needs settings.delta "
+            "on — there is no delta layer to mutate otherwise")
     rng = random.Random(seed)
     report = ChaosReport(rounds=rounds)
     placed_refs: Dict[str, List] = {}
@@ -320,6 +464,10 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
     if migration is not None:
         mig_state = _setup_migration_scenario(migration, tenants,
                                               placed_refs, report)
+    mut_state: Optional[dict] = None
+    if mutation is not None:
+        mut_state = _setup_mutation_scenario(mutation, tenants,
+                                             placed_refs, report)
     c0 = _obs.counters.snapshot("gateway.")
     names = [str(spec["name"]) for spec in tenants]
     try:
@@ -352,6 +500,12 @@ def run_drill(gateway, tenants: Sequence[dict], *, rounds: int = 4,
                 # drain on the old placement.
                 _run_migration_scenario(mig_state, placed_refs,
                                         report)
+            if mut_state is not None and _round == rounds // 2:
+                # Fire the update storm + compaction mid-storm: the
+                # round's admitted requests hold views pinned at
+                # admission and drain on the pre-mutation version.
+                _run_mutation_scenario(mut_state, placed_refs,
+                                       report)
             gateway.flush()
             report.faults_fired += sum(
                 a["fired"] for a in _faults.armed().values())

@@ -95,6 +95,9 @@ CATALOG: Dict[str, str] = {
     "gateway.dispatch":
         "engine/gateway.py: WFQ batch dispatch (stacked multi-matrix "
         "or per-matrix plan execution)",
+    "delta.compact":
+        "delta/core.py: background compaction merge (side-buffer -> "
+        "fresh base CSR) before the atomic version swap",
 }
 
 #: Fault kinds a site can be armed with.

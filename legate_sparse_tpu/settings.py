@@ -184,6 +184,25 @@ Settings
     - ``placement_bw_gbps`` (``_BW_GBPS``, 10.0): assumed migration
       bandwidth converting priced bytes into amortization cost time.
 
+``delta`` (``LEGATE_SPARSE_TPU_DELTA``)
+    Streaming matrix mutation under live traffic
+    (``legate_sparse_tpu.delta``, ``docs/MUTATION.md``): a
+    ``DeltaCSR`` wrapper serving an immutable base ``csr_array`` plus
+    a bounded COO side-buffer of entry updates as ``base @ x +
+    delta @ x``, with background compaction merging the buffer into a
+    fresh base and atomically swapping versions behind the gateway.
+    Off by default — the gateway pays one flag read per armed
+    admission, no ``delta.*`` counter ever moves, and results are
+    bit-for-bit those of the immutable path (inertness pinned by
+    test).  Knobs (prefix ``LEGATE_SPARSE_TPU_DELTA_``):
+
+    - ``delta_capacity`` (``_CAPACITY``, 1024): distinct (row, col)
+      update slots before ``update()`` raises ``DeltaCapacityError``.
+    - ``delta_watermark`` (``_WATERMARK``, 0.75): pending/capacity
+      fraction that flags the matrix for background compaction.
+    - ``delta_worker_ms`` (``_WORKER_MS``, 0 = off): arms a daemon
+      compaction worker stepping on a monotonic-clock cadence.
+
 ``autotune`` (``LEGATE_SPARSE_TPU_AUTOTUNE``)
     Sparsity-fingerprint autotuner (``legate_sparse_tpu.autotune``,
     ``docs/AUTOTUNER.md``): measured kernel selection for the
@@ -502,6 +521,28 @@ class Settings:
             os.environ.get("LEGATE_SPARSE_TPU_PLACEMENT_BW_GBPS",
                            "10.0")
         )
+        # ---- streaming mutation / delta layer (legate_sparse_tpu.delta) ----
+        self.delta: bool = _env_bool("LEGATE_SPARSE_TPU_DELTA", False)
+        # Side-buffer bound: distinct (row, col) update slots a
+        # DeltaCSR may hold before update() raises DeltaCapacityError
+        # (compact first).  Device buffers pad to pow2 buckets up to
+        # this bound so streaming mutation never retraces.
+        self.delta_capacity: int = int(
+            os.environ.get("LEGATE_SPARSE_TPU_DELTA_CAPACITY", "1024")
+        )
+        # Compaction watermark as a fraction of capacity: crossing it
+        # flags the matrix for background compaction (and bumps
+        # delta.watermark.exceeded — the doctor's compaction-lagging
+        # evidence).
+        self.delta_watermark: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_DELTA_WATERMARK", "0.75")
+        )
+        # Background compaction worker cadence (ms); 0 = no worker
+        # thread — compaction runs only via compact() / the watermark
+        # check at update time when a worker is armed.
+        self.delta_worker_ms: float = float(
+            os.environ.get("LEGATE_SPARSE_TPU_DELTA_WORKER_MS", "0")
+        )
         # ---- autotuner (legate_sparse_tpu.autotune) ----
         self.autotune: bool = _env_bool("LEGATE_SPARSE_TPU_AUTOTUNE",
                                         False)
@@ -569,6 +610,15 @@ class Settings:
         # these per phase).
         "placement", "placement_cooldown_ms", "placement_watchdog_ms",
         "placement_amortize", "placement_bw_gbps",
+        # Delta knobs shape the mutation side-buffer's bound and
+        # compaction cadence — request-lifecycle policy around the
+        # serving path, never what any plan lowers to (a compaction
+        # swaps in a FRESH base matrix whose packs/fingerprints are
+        # new objects, so plan/autotune caches invalidate structurally
+        # without an epoch bump; tests and the bench mutation phase
+        # flip these per phase).
+        "delta", "delta_capacity", "delta_watermark",
+        "delta_worker_ms",
         # Autotune knobs pick *which already-compiled kernel* serves a
         # dispatch (routing) or shape the measurement budget — never
         # what any kernel lowers to.  Verdict keys carry the epoch

@@ -26,9 +26,10 @@ GOLDEN = os.path.join(REPO, "evidence", "BENCH_golden_smoke.json")
 # accounting, the saturation sweep's totals (fixed request plan;
 # every request batches exactly once; one deterministic shed drill),
 # the autotune phase's verdict count (one pinned verdict against a
-# fresh store), and the gateway fairness sweep's admission/packing/
-# rejection totals (fixed submission sequence, flush-only dispatch)
-# do not.
+# fresh store), the gateway fairness sweep's admission/packing/
+# rejection totals (fixed submission sequence, flush-only dispatch),
+# and the mutation phase's exact delta accounting (a seeded
+# ``gallery.mutation_stream`` against a fixed matrix) do not.
 GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "schema_version,"
                  "spmv_bytes_per_nnz,spmv_bytes_per_nnz_bf16,"
@@ -51,7 +52,10 @@ GOLDEN_FIELDS = ("*_comm_bytes,dist_shards,dist2d_cg_iters,"
                  "attrib_conserved,"
                  "placement_migrations,placement_routes,"
                  "placement_reshard_bytes,"
-                 "placement_noisy_served,placement_quiet_served")
+                 "placement_noisy_served,placement_quiet_served,"
+                 "mutation_updates,mutation_applied,mutation_merged,"
+                 "mutation_compactions,mutation_version_swaps,"
+                 "mutation_served,mutation_routes")
 
 
 from utils_test.tools import load_tool as _tool
@@ -415,8 +419,9 @@ def test_smoke_trace_has_gateway_ledger(smoke_run, capsys):
     # Process-cumulative: 96 from the fairness sweep + 16 from the
     # attribution phase's 2-tenant load (8 interactive + 8 batch) +
     # 30 from the placement phase (24 noisy + 6 quiet across its two
-    # serving rounds).
-    assert ctrs.get("gateway.submitted", 0) == 142
+    # serving rounds) + 24 from the mutation phase's "mut" tenant
+    # (20 live-storm serves + 4 post-swap serves).
+    assert ctrs.get("gateway.submitted", 0) == 166
     assert ctrs.get("gateway.rejected.queue_full", 0) == 24
     # Per-tenant ledgers balance: submitted == served + shed.
     for tenant, served, shed in (("interactive", 24, 0),
@@ -525,6 +530,61 @@ def test_smoke_trace_has_placement_ledger(smoke_run, capsys):
     assert rc == 0, out
     assert "placement ledger:" in out
     assert "migrations: 2 applied" in out
+
+
+def test_smoke_mutation_phase_numbers(smoke_run):
+    """ISSUE 20 acceptance (smoke lane): the mutation phase wraps a
+    fixed engine matrix in a ``DeltaCSR`` and serves it through the
+    gateway's delta routing while a seeded ``gallery.mutation_stream``
+    storm (100 updates, batch=10, seed 23) lands in the side-buffer.
+    Every count is exact: 11 update batches (1 warm-up + 10 stream),
+    101 distinct slots applied (the warm-up entry + 100 stream slots),
+    21 delta-term serves (1 warm direct + 20 live gateway — the 4
+    post-swap serves ride the fresh base with an empty buffer and
+    bump nothing), 24 routed admissions, and exactly 1 compaction
+    merging all 101 into the version-2 base (1 atomic swap)."""
+    result, _, _ = smoke_run
+    assert result["schema_version"] >= 20
+    assert result["mutation_updates"] == 11
+    assert result["mutation_applied"] == 101
+    assert result["mutation_merged"] == 101
+    assert result["mutation_compactions"] == 1
+    assert result["mutation_version_swaps"] == 1
+    assert result["mutation_served"] == 21
+    assert result["mutation_routes"] == 24
+    assert result["mutation_compaction_ms"] > 0
+    assert result["mutation_ms"] > 0
+
+
+def test_smoke_trace_has_delta_ledger(smoke_run, capsys):
+    """The trace artifact carries the delta.* counters matching the
+    phase's JSON fields, the mutation tenant's balanced gateway
+    ledger, the delta latency histograms, and ``trace_summary
+    --delta`` renders the mutation ledger."""
+    result, trace_path, _ = smoke_run
+    doc = json.loads(trace_path.read_text())
+    ctrs = doc["otherData"]["counters"]
+    assert ctrs.get("delta.updates", 0) == 11
+    assert ctrs.get("delta.applied", 0) == 101
+    assert ctrs.get("delta.compaction.merged", 0) == 101
+    assert ctrs.get("delta.compactions", 0) == 1
+    assert ctrs.get("delta.swap.versions", 0) == 1
+    assert ctrs.get("delta.served", 0) == 21
+    assert ctrs.get("delta.routes", 0) == 24
+    assert ctrs.get("delta.compaction.bytes", 0) > 0
+    assert ctrs.get("gateway.tenant.mut.submitted", 0) == 24
+    assert ctrs.get("gateway.tenant.mut.served", 0) == 24
+    hists = doc["otherData"].get("histograms") or {}
+    assert hists.get("lat.delta.update", {}).get("count", 0) == 11
+    assert hists.get("lat.delta.compaction", {}).get("count", 0) == 1
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    assert "bench.mutation" in names
+    assert "delta.compaction" in names
+    rc = _tool("trace_summary").main([str(trace_path), "--delta"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "delta ledger:" in out
+    assert "compaction" in out
 
 
 def test_smoke_trace_has_latency_histograms(smoke_run, capsys):

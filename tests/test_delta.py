@@ -1,0 +1,714 @@
+# Copyright 2026.
+# SPDX-License-Identifier: Apache-2.0
+"""Streaming mutation under live traffic (ISSUE 20, docs/MUTATION.md).
+
+The delta layer's load-bearing contracts, each pinned here:
+
+- **off == inert**: with ``LEGATE_SPARSE_TPU_DELTA`` unset the
+  constructors raise, gateway serving is bit-for-bit the plain path,
+  and no ``delta.*`` counter ever moves;
+- **buffer semantics**: absolute overwrite-wins updates, 0.0 deletes,
+  typed ``DeltaCapacityError`` before any mutation on overflow;
+- **two-term serving**: ``base @ x + delta @ x`` numerically matches
+  the mutated matrix; an empty buffer is bitwise the base dispatch;
+- **versioned swap**: a view pinned at admission keeps serving its
+  version across updates and a compaction (drain semantics);
+- **compaction == cold rebuild bitwise** (acceptance criterion c):
+  the merged base's CSR arrays equal a fresh COO construction of the
+  mutated matrix exactly;
+- **resilience**: compaction checkpoints the buffer under an active
+  scope, retries injected ``delta.compact`` faults exactly-once;
+- **distributed**: owner-shard routed updates with exact
+  ``comm.delta.*`` pricing, dist serve parity, compaction-by-
+  repartition, typed layout/type errors;
+- **reshard carry** (the ride-along bugfix): ``reshard()`` of a
+  wrapper with pending updates carries the buffer — never drops it;
+- **the closed-loop acceptance drill**: ``chaos.run_drill`` with a
+  ``mutation`` scenario — >= 100 seeded updates under live
+  multi-tenant gateway load, a mid-storm compaction + atomic version
+  swap, exactly-once accounting and bitwise parity throughout;
+- **time-evolving graphs**: mutate-compact-rerun equals the cold
+  rebuild for BFS (bitwise levels) and PageRank (tolerance).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import legate_sparse_tpu as sparse
+from legate_sparse_tpu import gallery, obs, resilience
+from legate_sparse_tpu.csr import csr_array
+from legate_sparse_tpu.delta import (
+    DeltaCapacityError, DeltaCSR, DistDeltaCSR, is_delta, route,
+)
+from legate_sparse_tpu.delta import core as delta_core
+from legate_sparse_tpu.engine import Engine, Gateway
+from legate_sparse_tpu.graph import bfs, pagerank
+from legate_sparse_tpu.obs import counters, report as obs_report, trace
+from legate_sparse_tpu.parallel import (
+    dist_spmv, make_row_mesh, reshard, shard_csr,
+)
+from legate_sparse_tpu.parallel.dist_csr import shard_vector
+from legate_sparse_tpu.resilience import chaos, checkpoint as rckpt
+from legate_sparse_tpu.resilience import faults as rfaults
+from legate_sparse_tpu.settings import settings
+
+from utils_test.tools import load_tool as _tool
+
+R = len(jax.devices())
+needs_mesh = pytest.mark.skipif(R < 2, reason="needs >= 2 devices")
+
+_ENG = Engine()
+
+_DELTA_KNOBS = ("delta", "delta_capacity", "delta_watermark",
+                "delta_worker_ms")
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    was = trace.enabled()
+    obs.reset_all()
+    trace.disable()
+    yield
+    obs.reset_all()
+    if was:
+        trace.enable()
+    else:
+        trace.disable()
+
+
+@pytest.fixture
+def delta_on():
+    saved = {k: getattr(settings, k) for k in _DELTA_KNOBS}
+    settings.delta = True
+    yield settings
+    for k, v in saved.items():
+        setattr(settings, k, v)
+
+
+@pytest.fixture
+def gw_on():
+    saved = settings.gateway
+    settings.gateway = True
+    yield settings
+    settings.gateway = saved
+
+
+@pytest.fixture
+def resil_on():
+    saved = (settings.resil, settings.resil_backoff_ms)
+    settings.resil = True
+    settings.resil_backoff_ms = 0.0
+    resilience.reset()
+    yield settings
+    (settings.resil, settings.resil_backoff_ms) = saved
+    resilience.reset()
+
+
+def _tridiag(n, dtype=np.float64):
+    return sparse.diags(
+        [np.full(n, 4.0, dtype), np.full(n - 1, -1.0, dtype),
+         np.full(n - 1, -1.0, dtype)],
+        [0, 1, -1], format="csr", dtype=dtype)
+
+
+def _x(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(n)
+
+
+def _gateway(**kw):
+    base = dict(max_batch=8, queue_depth=128, tenant_quota=64,
+                rate=0.0, burst=64.0, slack_ms=1.0, timeout_ms=0.0)
+    base.update(kw)
+    return Gateway(_ENG, **base)
+
+
+def _cold_rebuild(A, targets):
+    """Fresh csr_array of ``A`` with ``targets`` applied (0.0
+    deletes) — the independent reference every compaction must equal
+    bitwise."""
+    rows, cols, data = (np.asarray(p) for p in A._coo_parts())
+    merged = {(int(r), int(c)): v
+              for r, c, v in zip(rows, cols, data)}
+    for (r, c), v in targets.items():
+        if v == 0.0:
+            merged.pop((r, c), None)
+        else:
+            merged[(r, c)] = v
+    keys = sorted(merged)
+    return csr_array(
+        (np.asarray([merged[k] for k in keys], dtype=A.dtype),
+         (np.asarray([k[0] for k in keys], dtype=np.int64),
+          np.asarray([k[1] for k in keys], dtype=np.int64))),
+        shape=A.shape, dtype=A.dtype)
+
+
+# ---------------------------------------------------------------------------
+# inertness: flag off
+# ---------------------------------------------------------------------------
+def test_constructors_require_flag():
+    assert not settings.delta, "suite must run with delta off"
+    with pytest.raises(RuntimeError, match="LEGATE_SPARSE_TPU_DELTA"):
+        DeltaCSR(_tridiag(16))
+    with pytest.raises(RuntimeError, match="LEGATE_SPARSE_TPU_DELTA"):
+        DistDeltaCSR(None)
+
+
+def test_flag_off_serving_is_bitwise_and_counter_inert(gw_on):
+    """The whole armed-gateway serving path with delta off: identical
+    bits to the direct dispatch, zero delta.* counter movement."""
+    A = _tridiag(64)
+    x = _x(64)
+    y_direct = np.asarray(A.dot(jnp.asarray(x)))
+    c0 = counters.snapshot("")
+    gw = _gateway()
+    try:
+        y_gw = np.asarray(
+            gw.submit(A, x, tenant="t", qos="interactive")
+            .result(timeout=30))
+    finally:
+        gw.shutdown()
+    c1 = counters.snapshot("")
+    np.testing.assert_array_equal(y_gw, y_direct)
+    moved = {k for k in c1 if c1[k] != c0.get(k, 0)}
+    assert not any(k.startswith("delta.") for k in moved), moved
+    assert route(A) is A, "route must pass plain matrices through"
+
+
+# ---------------------------------------------------------------------------
+# buffer semantics
+# ---------------------------------------------------------------------------
+def test_update_overwrite_wins_and_delete(delta_on):
+    A = _tridiag(32)
+    D = DeltaCSR(A, capacity=16)
+    assert is_delta(D) and not is_delta(A)
+    D.update([0, 0], [1, 1], [5.0, 7.0])      # within-batch repeat
+    assert D.entries() == {(0, 1): 7.0}
+    D.set_entries([0], [1], [9.0])            # cross-batch overwrite
+    assert D.entries() == {(0, 1): 9.0}
+    D.update([3], [3], [0.0])                 # pending delete
+    assert D.entries()[(3, 3)] == 0.0
+    assert D.pending == 2
+    c = counters.snapshot("delta.")
+    assert c.get("delta.updates") == 3
+    assert c.get("delta.applied") == 2
+    # Overwrites count every rewrite of an occupied slot — the
+    # within-batch repeat AND the cross-batch one.
+    assert c.get("delta.overwrites") == 2
+
+
+def test_update_validation(delta_on):
+    D = DeltaCSR(_tridiag(8))
+    with pytest.raises(ValueError, match="shapes disagree"):
+        D.update([0, 1], [0], [1.0])
+    with pytest.raises(IndexError, match="out of range"):
+        D.update([8], [0], [1.0])
+    with pytest.raises(IndexError, match="out of range"):
+        D.update([0], [-1], [1.0])
+
+
+def test_capacity_typed_error_mutates_nothing(delta_on):
+    D = DeltaCSR(_tridiag(32), capacity=2)
+    D.update([0], [0], [1.0])
+    with pytest.raises(DeltaCapacityError) as ei:
+        D.update([1, 2], [1, 2], [1.0, 2.0])
+    assert ei.value.pending == 3
+    assert ei.value.capacity == 2
+    assert D.entries() == {(0, 0): 1.0}, "failed batch must not land"
+    assert D.pending == 1
+
+
+# ---------------------------------------------------------------------------
+# two-term serving
+# ---------------------------------------------------------------------------
+def test_empty_buffer_serves_base_bitwise(delta_on):
+    A = _tridiag(96)
+    x = jnp.asarray(_x(96))
+    D = DeltaCSR(A)
+    c0 = counters.snapshot("delta.")
+    np.testing.assert_array_equal(np.asarray(D.dot(x)),
+                                  np.asarray(A.dot(x)))
+    assert counters.snapshot("delta.") == c0, \
+        "empty-buffer serve must not move delta counters"
+
+
+def test_two_term_serve_matches_mutated_matrix(delta_on):
+    A = _tridiag(64)
+    x = jnp.asarray(_x(64))
+    D = DeltaCSR(A)
+    targets = {(0, 0): 9.5, (5, 6): -2.25, (63, 62): 0.5,
+               (10, 40): 3.0}                 # insert outside pattern
+    for (r, c), v in targets.items():
+        D.update([r], [c], [v])
+    ref = _cold_rebuild(A, targets)
+    np.testing.assert_allclose(np.asarray(D.dot(x)),
+                               np.asarray(ref.dot(x)),
+                               rtol=1e-12, atol=1e-12)
+    assert counters.snapshot("delta.").get("delta.served") == 1
+
+
+def test_pow2_bucket_policy():
+    assert delta_core._pow2_bucket(0) == 1
+    assert delta_core._pow2_bucket(1) == 1
+    assert delta_core._pow2_bucket(2) == 2
+    assert delta_core._pow2_bucket(3) == 4
+    assert delta_core._pow2_bucket(1024) == 1024
+
+
+def test_buffer_growth_never_retraces_within_bucket(delta_on):
+    """Updates within one pow2 bucket reuse the compiled serving
+    kernel: the trace counter moves only at bucket crossings."""
+    A = _tridiag(64)
+    x = jnp.asarray(_x(64))
+    D = DeltaCSR(A, capacity=16)
+    D.update([0, 1, 2], [0, 1, 2], [1.0, 2.0, 3.0])  # bucket 4
+    D.dot(x)
+    c0 = counters.snapshot("trace.")
+    D.update([3], [3], [4.0])                  # 4 pending: bucket 4
+    D.dot(x)
+    c1 = counters.snapshot("trace.")
+    assert c1.get("trace.coo_spmv_segment", 0) == \
+        c0.get("trace.coo_spmv_segment", 0)
+    D.update([4], [4], [5.0])                  # 5 pending: bucket 8
+    D.dot(x)
+    c2 = counters.snapshot("trace.")
+    assert c2.get("trace.coo_spmv_segment", 0) == \
+        c1.get("trace.coo_spmv_segment", 0) + 1, \
+        "a bucket crossing recompiles once"
+
+
+# ---------------------------------------------------------------------------
+# compaction + versioned swap
+# ---------------------------------------------------------------------------
+def test_compact_is_bitwise_cold_rebuild(delta_on):
+    A = _tridiag(48)
+    D = DeltaCSR(A)
+    targets = {(0, 1): 11.0, (7, 7): 0.0, (20, 3): 1.75}
+    for (r, c), v in targets.items():
+        D.update([r], [c], [v])
+    assert D.compact() == 3
+    ref = _cold_rebuild(A, targets)
+    np.testing.assert_array_equal(np.asarray(D.base.data),
+                                  np.asarray(ref.data))
+    np.testing.assert_array_equal(np.asarray(D.base.indices),
+                                  np.asarray(ref.indices))
+    np.testing.assert_array_equal(np.asarray(D.base.indptr),
+                                  np.asarray(ref.indptr))
+    assert D.base.nnz == A.nnz, "one insert + one delete cancel"
+    assert D.pending == 0 and D.version == 1
+    x = jnp.asarray(_x(48))
+    np.testing.assert_array_equal(np.asarray(D.dot(x)),
+                                  np.asarray(ref.dot(x)))
+    c = counters.snapshot("delta.")
+    assert c.get("delta.compactions") == 1
+    assert c.get("delta.compaction.merged") == 3
+    assert c.get("delta.swap.versions") == 1
+    assert c.get("delta.compaction.bytes", 0) > 0
+    assert D.compact() == 0, "empty buffer: no-op"
+    assert counters.snapshot("delta.").get("delta.compactions") == 1
+
+
+def test_pinned_view_drains_its_version_across_swap(delta_on):
+    """A view pinned at admission serves its version while updates and
+    a compaction swap newer ones underneath — the drain contract."""
+    A = _tridiag(40)
+    x = jnp.asarray(_x(40))
+    D = DeltaCSR(A)
+    v0 = D.view()
+    y0 = np.asarray(v0.dot(x))
+    D.update([0], [0], [123.0])
+    v1 = D.view()
+    assert v1 is not v0 and v1.pending == 1
+    D.compact()
+    v2 = D.view()
+    assert v2.version == 1 and v2.pending == 0
+    # The pinned v0 still serves the pristine base, bitwise.
+    np.testing.assert_array_equal(np.asarray(v0.dot(x)), y0)
+    np.testing.assert_array_equal(np.asarray(A.dot(x)), y0)
+    # ...and the post-swap wrapper serves the merged matrix.
+    ref = _cold_rebuild(A, {(0, 0): 123.0})
+    np.testing.assert_array_equal(np.asarray(D.dot(x)),
+                                  np.asarray(ref.dot(x)))
+
+
+def test_watermark_worker_compacts_in_background(delta_on):
+    settings.delta_watermark = 0.5
+    settings.delta_worker_ms = 5.0
+    D = DeltaCSR(_tridiag(32), capacity=8)
+    try:
+        D.update([0, 1, 2, 3], [0, 1, 2, 3],
+                 [1.0, 2.0, 3.0, 4.0])       # 4/8 hits the watermark
+        deadline = time.monotonic() + 10.0
+        while D.pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        D.stop_worker()
+    assert D.pending == 0 and D.version == 1
+    c = counters.snapshot("delta.")
+    assert c.get("delta.watermark.exceeded", 0) >= 1
+    assert c.get("delta.compactions") == 1
+
+
+def test_maybe_compact_below_watermark_is_noop(delta_on):
+    D = DeltaCSR(_tridiag(32), capacity=100)
+    D.update([0], [0], [1.0])
+    assert D.maybe_compact() == 0
+    assert D.pending == 1
+
+
+# ---------------------------------------------------------------------------
+# resilience: checkpoint + fault injection at delta.compact
+# ---------------------------------------------------------------------------
+def test_compact_snapshots_buffer_under_checkpoint_scope(
+        delta_on, resil_on):
+    D = DeltaCSR(_tridiag(32))
+    D.update([3, 5], [2, 5], [1.5, 0.0])
+    with rckpt.scope("delta.compact", every=1) as ck:
+        assert D.compact() == 2
+    assert ck.saves == 1
+    assert ck.iterations == 0, "keyed by the pre-swap version"
+    rows, cols, vals = ck.arrays
+    np.testing.assert_array_equal(rows, [3, 5])
+    np.testing.assert_array_equal(cols, [2, 5])
+    np.testing.assert_array_equal(vals, [1.5, 0.0])
+
+
+def test_compact_retries_injected_fault_exactly_once(
+        delta_on, resil_on):
+    """An injected error at the delta.compact site is retried by the
+    site policy; the swap lands exactly once and the merged base is
+    still the bitwise cold rebuild."""
+    A = _tridiag(32)
+    D = DeltaCSR(A)
+    D.update([0], [2], [42.0])
+    rfaults.inject("delta.compact", kind="error", count=1)
+    try:
+        assert D.compact() == 1
+    finally:
+        rfaults.clear()
+    c = counters.snapshot("")
+    assert c.get("resil.retry.delta.compact") == 1
+    assert c.get("delta.compactions") == 1
+    assert c.get("delta.swap.versions") == 1
+    assert D.version == 1 and D.pending == 0
+    ref = _cold_rebuild(A, {(0, 2): 42.0})
+    np.testing.assert_array_equal(np.asarray(D.base.data),
+                                  np.asarray(ref.data))
+
+
+def test_compact_exhausted_retries_keep_buffer_intact(
+        delta_on, resil_on):
+    """A compaction that fails beyond the retry budget propagates and
+    leaves the buffer and version untouched — no half-applied swap."""
+    D = DeltaCSR(_tridiag(32))
+    D.update([1], [1], [9.0])
+    rfaults.inject("delta.compact", kind="error", count=99)
+    try:
+        with pytest.raises(Exception):
+            D.compact()
+    finally:
+        rfaults.clear()
+    assert D.pending == 1 and D.version == 0
+    assert D.entries() == {(1, 1): 9.0}
+    assert counters.snapshot("delta.").get("delta.compactions",
+                                           0) == 0
+
+
+# ---------------------------------------------------------------------------
+# gateway routing
+# ---------------------------------------------------------------------------
+def test_gateway_routes_delta_and_serves_two_terms(delta_on, gw_on):
+    A = _tridiag(64)
+    x = _x(64)
+    D = DeltaCSR(A)
+    D.update([0], [0], [7.5])
+    gw = _gateway()
+    try:
+        y = np.asarray(
+            gw.submit(D, x, tenant="mut", qos="interactive")
+            .result(timeout=30))
+    finally:
+        gw.shutdown()
+    ref = _cold_rebuild(A, {(0, 0): 7.5})
+    np.testing.assert_allclose(
+        y, np.asarray(ref.dot(jnp.asarray(x))),
+        rtol=1e-12, atol=1e-12)
+    c = counters.snapshot("delta.")
+    assert c.get("delta.routes") == 1
+    assert c.get("delta.served") == 1
+
+
+# ---------------------------------------------------------------------------
+# gallery.mutation_stream (satellite 1)
+# ---------------------------------------------------------------------------
+def test_mutation_stream_deterministic_and_mixed():
+    A = _tridiag(128)
+    def collect(seed):
+        return list(gallery.mutation_stream(seed, A, 60, batch=7))
+    s1, s2 = collect(5), collect(5)
+    assert len(s1) == 9                       # ceil(60 / 7)
+    assert sum(r.size for r, _c, _v in s1) == 60
+    assert s1[-1][0].size == 4, "final batch is short"
+    for (r1, c1, v1), (r2, c2, v2) in zip(s1, s2):
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(c1, c2)
+        np.testing.assert_array_equal(v1, v2)
+    other = collect(6)
+    assert any(not np.array_equal(a[0], b[0])
+               for a, b in zip(s1, other)), "seed must matter"
+    pattern = set(zip(*(np.asarray(p).tolist()
+                        for p in A._coo_parts()[:2])))
+    flat = [(int(r), int(c), float(v))
+            for rows, cols, vals in s1
+            for r, c, v in zip(rows, cols, vals)]
+    assert any(v == 0.0 for _r, _c, v in flat), "deletes present"
+    assert any((r, c) not in pattern for r, c, _v in flat), \
+        "inserts present"
+    assert any(v != 0.0 and (r, c) in pattern
+               for r, c, v in flat), "overwrites present"
+
+
+def test_mutation_stream_empty_matrix_raises():
+    empty = csr_array(np.zeros((4, 4)))
+    with pytest.raises(ValueError, match="no stored entries"):
+        next(gallery.mutation_stream(0, empty, 10))
+
+
+# ---------------------------------------------------------------------------
+# distributed
+# ---------------------------------------------------------------------------
+@needs_mesh
+def test_dist_delta_typed_errors(delta_on):
+    with pytest.raises(TypeError, match="wraps a DistCSR"):
+        DistDeltaCSR(_tridiag(16))
+    mesh = make_row_mesh(2)
+    dA = shard_csr(_tridiag(64, np.float32), mesh=mesh,
+                   layout="1d-col")
+    with pytest.raises(ValueError, match="1d-row"):
+        DistDeltaCSR(dA)
+
+
+@needs_mesh
+def test_dist_delta_serve_update_pricing_and_compact(delta_on):
+    mesh = make_row_mesh(2)
+    A = _tridiag(64, np.float32)
+    dA = shard_csr(A, mesh=mesh, layout="1d-row")
+    D = DistDeltaCSR(dA)
+    x = _x(64, seed=3).astype(np.float32)
+    xv = shard_vector(x, mesh, dA.rows_padded, layout="1d-row")
+    y_base = np.asarray(dist_spmv(dA, xv))[:64]
+    np.testing.assert_array_equal(
+        np.asarray(D.dot(xv))[:64], y_base), \
+        "empty buffer == base dispatch"
+    c0 = counters.snapshot("comm.delta.")
+    targets = {(0, 0): 2.5, (33, 32): -1.0, (10, 20): 4.0}
+    D.update([0, 33, 10], [0, 32, 20], [2.5, -1.0, 4.0])
+    c1 = counters.snapshot("comm.delta.")
+    rec = 2 * 4 + np.dtype(np.float32).itemsize
+    assert c1.get("comm.delta.scatter_bytes", 0) \
+        - c0.get("comm.delta.scatter_bytes", 0) == 3 * rec
+    ref = _cold_rebuild(A, targets)
+    y_ref = np.asarray(ref.dot(jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(D.dot(xv))[:64], y_ref,
+                               rtol=1e-5, atol=1e-5)
+    c2 = counters.snapshot("comm.delta.")
+    assert c2.get("comm.delta.all_gather_bytes", 0) > 0
+    assert D.compact() == 3
+    assert D.version == 1 and D.pending == 0
+    # Compacted == cold shard_csr of the merged source, served equal.
+    cold = shard_csr(ref, mesh=mesh, layout="1d-row")
+    np.testing.assert_array_equal(
+        np.asarray(dist_spmv(D.base, xv))[:64],
+        np.asarray(dist_spmv(cold, xv))[:64])
+
+
+# ---------------------------------------------------------------------------
+# reshard carry: the ride-along bugfix regression pin
+# ---------------------------------------------------------------------------
+@needs_mesh
+def test_reshard_carries_pending_delta_buffer(delta_on):
+    """Repartitioning a wrapper with a non-empty buffer must carry
+    the pending updates (never silently drop them) and keep serving
+    the mutated values on the new mesh."""
+    mesh2 = make_row_mesh(2)
+    A = _tridiag(64, np.float32)
+    D = DistDeltaCSR(shard_csr(A, mesh=mesh2, layout="1d-row"))
+    targets = {(5, 5): 9.0, (40, 39): 0.5}
+    D.update([5, 40], [5, 39], [9.0, 0.5])
+    # Identity repartition: zero-byte fast path returns the wrapper.
+    assert reshard(D, mesh=mesh2, layout="1d-row") is D
+    mesh1 = make_row_mesh(1)
+    D1 = reshard(D, mesh=mesh1, layout="1d-row")
+    assert isinstance(D1, DistDeltaCSR)
+    assert D1.pending == 2, "buffer must survive the repartition"
+    assert D1.entries() == targets
+    assert D1.version == D.version
+    assert D1.num_shards == 1
+    x = _x(64, seed=9).astype(np.float32)
+    ref = _cold_rebuild(A, targets)
+    y_ref = np.asarray(ref.dot(jnp.asarray(x)))
+    xv1 = shard_vector(x, mesh1, D1.rows_padded, layout="1d-row")
+    np.testing.assert_allclose(np.asarray(D1.dot(xv1))[:64], y_ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the closed-loop acceptance drill
+# ---------------------------------------------------------------------------
+def test_chaos_mutation_scenario_requires_delta(gw_on, resil_on):
+    with pytest.raises(RuntimeError, match="settings.delta"):
+        chaos.run_drill(None, tenants=[],
+                        mutation={"tenant": "t"})
+
+
+def test_chaos_drill_mutation_mid_storm(delta_on, gw_on, resil_on):
+    """ISSUE 20 acceptance: >= 100 seeded updates stream into a
+    served tenant under live multi-tenant gateway load with composed
+    faults, one background compaction fires mid-round with an atomic
+    version swap — exactly-once resolution with exact ``delta.*``
+    accounting, bitwise serving parity on whichever version served,
+    and post-compaction == cold-rebuild bitwise (all asserted inside
+    the scenario; violations land in the report)."""
+    A_mut = _tridiag(128)
+    A_storm = _tridiag(96)
+    gw = _gateway()
+    c0 = counters.snapshot("")
+    try:
+        report = chaos.run_drill(
+            gw,
+            tenants=[
+                {"name": "mut", "qos": "interactive",
+                 "A": A_mut, "xs": [_x(128, seed=s)
+                                    for s in range(3)]},
+                {"name": "storm", "qos": "background",
+                 "A": A_storm, "xs": [_x(96, seed=s)
+                                      for s in range(10, 13)],
+                 "deadline_ms": 0.0},
+            ],
+            rounds=4, seed=3,
+            mutation={"tenant": "mut", "updates": 100, "seed": 11})
+    finally:
+        gw.shutdown()
+    c1 = counters.snapshot("")
+
+    def moved(name):
+        return int(c1.get(name, 0)) - int(c0.get(name, 0))
+
+    assert report.ok(), report.violations
+    assert report.mutations == 10, "100 updates in batches of 10"
+    assert report.compactions == 1
+    assert moved("delta.compactions") == 1
+    assert moved("delta.swap.versions") == 1
+    assert moved("delta.updates") == 10
+    assert not rfaults.armed()
+
+
+# ---------------------------------------------------------------------------
+# time-evolving graphs (satellite 3)
+# ---------------------------------------------------------------------------
+def test_evolving_graph_bfs_bitwise_after_compaction(delta_on):
+    """Mutate edges through the delta layer, compact, re-run BFS: the
+    int32 level array is bitwise the cold rebuild's."""
+    G = gallery.rmat(6, nnz_per_row=4, rng=77)   # 64 vertices
+    D = DeltaCSR(G)
+    # Edge arrivals + one removal, streamed through the buffer.
+    targets = {(0, 63): 1.0, (63, 1): 1.0}
+    first = tuple(int(v) for v in
+                  np.asarray(G._coo_parts()[0])[:1]), tuple(
+                      int(v) for v in np.asarray(G._coo_parts()[1])[:1])
+    targets[(first[0][0], first[1][0])] = 0.0    # remove one edge
+    for (r, c), v in targets.items():
+        D.update([r], [c], [v])
+    D.compact()
+    ref = _cold_rebuild(G, targets)
+    lv_delta = np.asarray(bfs(D.base, source=0))
+    lv_cold = np.asarray(bfs(ref, source=0))
+    np.testing.assert_array_equal(lv_delta, lv_cold)
+    assert int(lv_delta[63]) == 1, "the inserted 0->63 edge serves"
+
+
+def test_evolving_graph_pagerank_matches_cold_rebuild(delta_on):
+    G = gallery.rmat(6, nnz_per_row=4, rng=78)
+    D = DeltaCSR(G)
+    updates = list(gallery.mutation_stream(13, G, 30, batch=10))
+    for rows, cols, vals in updates:
+        D.update(rows, cols, vals)
+    D.compact()
+    targets = {}
+    for rows, cols, vals in updates:
+        for r, c, v in zip(rows, cols, vals):
+            targets[(int(r), int(c))] = float(v)
+    ref = _cold_rebuild(G, targets)
+    r_delta = np.asarray(pagerank(D.base, alpha=0.85, tol=1e-10,
+                                  max_iters=60))
+    r_cold = np.asarray(pagerank(ref, alpha=0.85, tol=1e-10,
+                                 max_iters=60))
+    np.testing.assert_allclose(r_delta, r_cold, rtol=1e-7, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# ledger rendering + doctor (satellite 2)
+# ---------------------------------------------------------------------------
+def test_render_delta_table():
+    assert "delta off" in obs_report.render_delta_table({})
+    text = obs_report.render_delta_table({
+        "delta.updates": 11, "delta.applied": 101,
+        "delta.overwrites": 2, "delta.compactions": 1,
+        "delta.compaction.merged": 101,
+        "delta.compaction.bytes": 4096, "delta.swap.versions": 1,
+        "delta.served": 21, "delta.routes": 24,
+        "comm.delta.scatter_bytes": 48,
+    })
+    assert "11 update batches" in text
+    assert "101 entries merged" in text
+    assert "21 two-term serves" in text
+    assert "48" in text
+
+
+def test_doctor_compaction_lagging_and_delta_disabled_rules():
+    doctor = _tool("doctor")
+    ev = doctor.Evidence()
+    # Watermark pressure while an SLO burns: warn.
+    ev.counters = {"delta.watermark.exceeded": 3,
+                   "slo.breach.gateway.interactive": 2}
+    finding = next(f for f in doctor.diagnose(ev)
+                   if f["code"] == "compaction-lagging")
+    assert finding["severity"] == "warn"
+    assert finding["value"] == "3"
+    assert "WORKER_MS" in finding["hint"]
+    # Watermark pressure alone (no burn): quiet.
+    ev.counters = {"delta.watermark.exceeded": 3}
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "compaction-lagging" not in codes
+    # Repeated same-bucket COO rebuilds with delta off: info points
+    # at the subsystem that amortizes them...
+    ev.counters = {"build.csr.coo.64x64": 5, "build.csr.coo.8x8": 1}
+    finding = next(f for f in doctor.diagnose(ev)
+                   if f["code"] == "delta-disabled-but-rebuilding")
+    assert finding["severity"] == "info"
+    assert "64x64" in finding["message"]
+    assert finding["value"] == "5"
+    # ...and stays quiet once the delta layer is demonstrably live.
+    ev.counters["delta.updates"] = 1
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "delta-disabled-but-rebuilding" not in codes
+    # Below the rebuild floor: quiet.
+    ev.counters = {"build.csr.coo.64x64": 2}
+    codes = [f["code"] for f in doctor.diagnose(ev)]
+    assert "delta-disabled-but-rebuilding" not in codes
+
+
+def test_coo_constructor_bumps_shape_bucket_counter():
+    A = _tridiag(48)                           # diags -> COO path?
+    c0 = counters.snapshot("build.csr.coo.")
+    rows, cols, data = (np.asarray(p) for p in A._coo_parts())
+    csr_array((data, (rows, cols)), shape=A.shape, dtype=A.dtype)
+    c1 = counters.snapshot("build.csr.coo.")
+    assert c1.get("build.csr.coo.64x64", 0) \
+        == c0.get("build.csr.coo.64x64", 0) + 1

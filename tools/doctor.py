@@ -63,6 +63,10 @@ OBS_OVERHEAD_CEIL_PCT = 5.0
 # burns at page level (the obs/slo.py fast-window breach threshold).
 NOISY_NEIGHBOR_SHARE = 0.5
 SLO_PAGE_BURN = 14.4
+# Same-shape-bucket COO->CSR rebuild count at which a workload looks
+# like streaming mutation being served by full reconstruction — the
+# delta-disabled-but-rebuilding evidence (docs/MUTATION.md).
+COO_REBUILD_FLOOR = 3
 
 
 def _severity_rank(sev: str) -> int:
@@ -251,6 +255,47 @@ def diagnose(ev: Evidence) -> List[Dict[str, str]]:
             "LEGATE_SPARSE_TPU_PLACEMENT_AMORTIZE so migrations must "
             "pay for themselves; inspect trace_summary --placement",
             str(int(thrash))))
+
+    # -- Compaction lagging: the delta side-buffer crossed its
+    #    watermark while some SLO burns at page level — every serve
+    #    pays the two-term dispatch on a near-full buffer instead of
+    #    the merged base, and mutation pressure is outrunning the
+    #    background merge.
+    wm = ev.counter("delta.watermark.exceeded")
+    if wm and burning:
+        out.append(_finding(
+            "warn", "compaction-lagging",
+            f"delta buffer crossed its compaction watermark "
+            f"{int(wm)}x while an SLO burns at page level (mutation "
+            f"pressure outrunning the background merge)",
+            "lower LEGATE_SPARSE_TPU_DELTA_WATERMARK (compact "
+            "earlier) or arm/shorten LEGATE_SPARSE_TPU_DELTA_"
+            "WORKER_MS (docs/MUTATION.md); inspect trace_summary "
+            "--delta",
+            str(int(wm))))
+
+    # -- Rebuilding what the delta layer would serve: repeated
+    #    same-shape-bucket COO->CSR constructions with the delta flag
+    #    off — the workload is mutating by full reconstruction, the
+    #    exact cost the side-buffer + background-compaction path
+    #    amortizes away.
+    if not any(n.startswith("delta.") for n in ev.counters):
+        rebuilds = {name[len("build.csr.coo."):]: val
+                    for name, val in ev.counters.items()
+                    if name.startswith("build.csr.coo.")
+                    and val >= COO_REBUILD_FLOOR}
+        if rebuilds:
+            bucket, n = max(rebuilds.items(),
+                            key=lambda kv: (kv[1], kv[0]))
+            out.append(_finding(
+                "info", "delta-disabled-but-rebuilding",
+                f"{int(n)} same-shape COO->CSR rebuilds (bucket "
+                f"{bucket}) with the delta layer off — mutation "
+                f"served by full reconstruction",
+                "set LEGATE_SPARSE_TPU_DELTA=1 and serve updates "
+                "through DeltaCSR.update() + background compaction "
+                "(docs/MUTATION.md) instead of rebuilding",
+                str(int(n))))
 
     # -- Compiled-plan contract drift: the lowered IR no longer
     #    matches the committed planverify contract.  Critical, not a

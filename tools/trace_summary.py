@@ -190,6 +190,11 @@ def main(argv=None) -> int:
                          "(controller steps/holds, migration count "
                          "and declared reshard bytes, routed "
                          "admissions from the placement.* counters)")
+    ap.add_argument("--delta", action="store_true",
+                    help="also render the streaming-mutation ledger "
+                         "(update batches, applied/pending slots, "
+                         "compaction merges and version swaps, comm "
+                         "pricing from the delta.* counters)")
     ap.add_argument("--latency", action="store_true",
                     help="also render the latency-histogram ledger "
                          "(count/p50/p95/p99/max per op and shape "
@@ -267,6 +272,10 @@ def main(argv=None) -> int:
     if args.placement:
         print("\nplacement ledger:")
         print(report.render_placement_table(meta.get("counters") or {}))
+
+    if args.delta:
+        print("\ndelta ledger:")
+        print(report.render_delta_table(meta.get("counters") or {}))
 
     if args.flows:
         print("\ncausal flows:")

@@ -423,6 +423,36 @@ def _build_semiring_sliced_ell():
                  notes={"bins": len(bins), "semiring": "min-plus"})
 
 
+@_program("kernel/coo-segment/spmv/f32", "kernel",
+          _KERNEL_SRC + ("legate_sparse_tpu/delta/core.py",))
+def _build_coo_segment():
+    """The delta layer's side-buffer serving kernel
+    (docs/MUTATION.md): masked COO segment-sum over one pow2 capacity
+    bucket.  The contract pins the two-term schedule's delta half —
+    the masked product (exact zero beyond ``valid_nnz``, never
+    ``0*x``), the sorted ``segment_sum`` over ``rows`` segments that
+    drops the out-of-range sentinel padding, and f32 dtype discipline
+    end to end — so a mutation-path refactor that changes what a
+    buffered update lowers to fails verify before it ships."""
+    import jax
+    import numpy as np
+
+    from legate_sparse_tpu.ops.spmv import coo_spmv_segment
+
+    sds = jax.ShapeDtypeStruct
+    f32 = np.dtype(np.float32)
+    cap = 64                     # one pow2 capacity bucket
+    specs = (sds((cap,), f32), sds((cap,), np.int32),
+             sds((cap,), np.int32), sds((), np.int32),
+             sds((N_1D,), f32))
+    kw = {"rows": N_1D}
+    hlo = coo_spmv_segment.lower(*specs, **kw).as_text()
+    jaxpr = jax.make_jaxpr(
+        lambda *a: coo_spmv_segment(*a, **kw))(*specs)
+    return Built(hlo=hlo, jaxpr=jaxpr, predicted={},
+                 notes={"capacity_bucket": cap})
+
+
 @_program("kernel/sliced-ell-bf16/spmv", "kernel", _KERNEL_SRC)
 def _build_sliced_ell_bf16():
     import jax
